@@ -1,0 +1,22 @@
+"""heat_tpu: a TPU-native distributed array and data-analytics framework.
+
+Namespace assembly mirrors the reference's heat/__init__.py:5-21 — the
+``core`` namespace (and ``core.linalg``) is flattened into the top level
+and the domain subpackages are mounted as submodules, so the public API
+surface matches ``ht.*``.
+"""
+
+from .version import __version__
+
+from . import parallel
+from .parallel import Communication, WORLD, SELF, get_comm, sanitize_comm, use_comm
+
+from . import core
+from .core import *
+from .core import linalg
+from .core import random
+from .core import io
+from .core import devices
+from .core import types
+
+communication = parallel  # API-parity alias for heat.core.communication

@@ -1,0 +1,31 @@
+"""Core namespace assembly, analog of heat/core/__init__.py."""
+
+from .devices import *
+from .types import *
+from .dndarray import *
+from .factories import *
+from .stride_tricks import *
+from .sanitation import *
+from .memory import *
+from .base import *
+from .constants import *
+from .arithmetics import *
+from .trigonometrics import *
+from .exponential import *
+from .rounding import *
+from .relational import *
+from .logical import *
+from .complex_math import *
+from .printing import *
+from .statistics import *
+from .manipulations import *
+from .indexing import *
+from .signal import *
+from .vmap import *
+from . import devices
+from . import types
+from . import random
+from . import io
+from . import linalg
+from .linalg import *
+from ..version import __version__  # noqa: F401

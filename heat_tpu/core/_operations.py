@@ -1,0 +1,270 @@
+"""Generic operation wrappers, analog of heat/core/_operations.py.
+
+The reference funnels nearly the whole NumPy API through four generic
+wrappers: ``__binary_op`` (_operations.py:22), ``__cum_op`` (:230),
+``__local_op`` (:331) and ``__reduce_op`` (:404), each mixing local torch
+calls with explicit MPI collectives.  Here the same four wrappers exist but
+the "communication half" vanishes: operands are global sharded jax.Arrays,
+so a single jnp call *is* the distributed op — XLA/GSPMD emits any psum /
+all-gather / resharding.  What remains of the distribution logic is the
+pad-and-mask bookkeeping (see core/dndarray.py docstring):
+
+* element-wise ops run straight on the padded buffers (padding is garbage
+  in, garbage out — never observed);
+* reductions/scans that cross the split axis first overwrite padding with
+  the op's neutral element (the analog of the reference's neutral-element
+  fill for empty local chunks, _operations.py:450-459).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.comm import sanitize_comm
+from . import types
+from .devices import sanitize_device
+from .dndarray import DNDarray
+from .sanitation import sanitize_out
+from .stride_tricks import broadcast_shape, sanitize_axis
+
+__all__ = []
+
+Scalar = Union[int, float, bool, complex]
+
+
+def _as_dndarray(x, reference: Optional[DNDarray] = None) -> DNDarray:
+    from . import factories
+
+    if isinstance(x, DNDarray):
+        return x
+    device = reference.device if reference is not None else None
+    comm = reference.comm if reference is not None else None
+    return factories.array(x, device=device, comm=comm)
+
+
+def _out_split_binary(t1: DNDarray, t2: DNDarray, out_shape) -> Optional[int]:
+    """Output split of a broadcasting binary op: splits are right-aligned
+    into the output shape; the first operand's split wins (matching the
+    dominant-operand choice in _operations.py:173-194)."""
+    nd_out = len(out_shape)
+    for t in (t1, t2):
+        if t.split is not None:
+            cand = t.split + (nd_out - t.ndim)
+            # a broadcast (size-1) split dim cannot carry the distribution
+            if t.shape[t.split] == out_shape[cand] and out_shape[cand] != 1 or out_shape[cand] == t.shape[t.split]:
+                return cand
+    return None
+
+
+def __binary_op(
+    operation: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    where=True,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Generic distributed binary operation (_operations.py:22)."""
+    fn_kwargs = fn_kwargs or {}
+    ref = t1 if isinstance(t1, DNDarray) else (t2 if isinstance(t2, DNDarray) else None)
+    if ref is None:
+        t1 = _as_dndarray(t1)
+        ref = t1
+    t1 = _as_dndarray(t1, ref)
+    t2 = _as_dndarray(t2, ref)
+    if t1.comm != t2.comm:
+        raise NotImplementedError("operands must share a communication context")
+
+    out_shape = broadcast_shape(t1.shape, t2.shape)
+
+    # fast path: identical layout, no broadcasting -> operate on padded buffers
+    if t1.shape == t2.shape == out_shape and t1.split == t2.split:
+        result = operation(t1.larray_padded, t2.larray_padded, **fn_kwargs)
+        res = DNDarray(
+            jax.device_put(result, t1.comm.sharding(t1.split)),
+            out_shape,
+            types.canonical_heat_type(result.dtype),
+            t1.split,
+            t1.device,
+            t1.comm,
+        )
+    else:
+        out_split = _out_split_binary(t1, t2, out_shape)
+        result = operation(t1._dense(), t2._dense(), **fn_kwargs)
+        res = DNDarray.from_dense(result, out_split, t1.device, t1.comm)
+
+    if where is not True and where is not None:
+        where_nd = _as_dndarray(where, ref)
+        base = out if out is not None else None
+        base_dense = base._dense() if base is not None else jnp.zeros(out_shape, result.dtype)
+        sel = jnp.where(where_nd._dense(), res._dense(), base_dense)
+        res = DNDarray.from_dense(sel, res.split, res.device, res.comm)
+
+    if out is not None:
+        sanitize_out(out, out_shape, res.split, res.device)
+        casted = res._dense().astype(out.dtype.jax_type())
+        out._replace(
+            DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded
+        )
+        return out
+    return res
+
+
+def __local_op(
+    operation: Callable,
+    x: DNDarray,
+    out: Optional[DNDarray] = None,
+    no_cast: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """Element-wise unary op (_operations.py:331): one jnp call on the padded
+    buffer; sharding (and thus distribution) is preserved."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    arr = x.larray_padded
+    if not no_cast and not (
+        types.heat_type_is_inexact(x.dtype)
+    ):
+        arr = arr.astype(jnp.float32)
+    result = operation(arr, **kwargs)
+    res = DNDarray(
+        result,
+        x.shape,
+        types.canonical_heat_type(result.dtype),
+        x.split,
+        x.device,
+        x.comm,
+    )
+    if out is not None:
+        sanitize_out(out, x.shape, x.split, x.device)
+        casted = res._dense().astype(out.dtype.jax_type())
+        out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
+        return out
+    return res
+
+
+def __reduce_op(
+    operation: Callable,
+    x: DNDarray,
+    axis,
+    neutral: Optional[Scalar],
+    out: Optional[DNDarray] = None,
+    keepdims: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """Generic reduction (_operations.py:404).
+
+    The reference computes a local partial then Allreduces with a custom MPI
+    op when the split axis is reduced; here the global jnp reduction already
+    spans shards, so the only distribution work is (a) masking padding with
+    the neutral element when the split axis participates, and (b) tracking
+    the output split index.
+    """
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    axis = sanitize_axis(x.shape, axis)
+    axes: Tuple[int, ...]
+    if axis is None:
+        axes = tuple(range(x.ndim))
+    elif isinstance(axis, tuple):
+        axes = axis
+    else:
+        axes = (axis,)
+
+    split_reduced = x.split is not None and x.split in axes
+    if split_reduced and x._pad > 0:
+        if neutral is None:
+            arr = x._dense()
+            result = operation(arr, axis=(axis if axis is not None else None), keepdims=keepdims, **kwargs)
+            out_split = _reduced_split(x.split, axes, keepdims, reduced=True)
+            res = DNDarray.from_dense(result, out_split, x.device, x.comm)
+            return _finalize_reduce(res, out)
+        arr = x._masked(neutral)
+    else:
+        arr = x.larray_padded
+
+    result = operation(arr, axis=(axis if axis is not None else None), keepdims=keepdims, **kwargs)
+
+    if split_reduced or x.split is None:
+        out_split = None if not keepdims or x.split is None else None
+        res = DNDarray.from_dense(result, out_split, x.device, x.comm)
+    else:
+        # split axis survives; result is still canonically padded along it
+        new_split = _reduced_split(x.split, axes, keepdims, reduced=False)
+        gshape = _reduced_shape(x.shape, axes, keepdims)
+        res = DNDarray(
+            jax.device_put(result, x.comm.sharding(new_split)),
+            gshape,
+            types.canonical_heat_type(result.dtype),
+            new_split,
+            x.device,
+            x.comm,
+        )
+    return _finalize_reduce(res, out)
+
+
+def _finalize_reduce(res: DNDarray, out: Optional[DNDarray]) -> DNDarray:
+    if out is not None:
+        sanitize_out(out, res.shape, res.split, res.device)
+        casted = res._dense().astype(out.dtype.jax_type())
+        out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
+        return out
+    return res
+
+
+def _reduced_shape(shape, axes, keepdims) -> Tuple[int, ...]:
+    if keepdims:
+        return tuple(1 if d in axes else s for d, s in enumerate(shape))
+    return tuple(s for d, s in enumerate(shape) if d not in axes)
+
+
+def _reduced_split(split, axes, keepdims, reduced: bool) -> Optional[int]:
+    if reduced:
+        return None
+    if keepdims:
+        return split
+    return split - sum(1 for a in axes if a < split)
+
+
+def __cum_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: int,
+    neutral: Scalar,
+    out: Optional[DNDarray] = None,
+    dtype=None,
+) -> DNDarray:
+    """Cumulative op along an axis (_operations.py:230).
+
+    The reference does a local cumop, an Exscan of totals and a final local
+    combine; here a single jnp cum-op over the (neutral-masked) global array
+    compiles to the same scan pattern.
+    """
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    axis = sanitize_axis(x.shape, axis)
+    if axis is None:
+        raise NotImplementedError("cumulative ops over flattened arrays: pass an int axis")
+    arr = x._masked(neutral) if (x.split == axis and x._pad > 0) else x.larray_padded
+    result = operation(arr, axis=axis)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    res = DNDarray(
+        jax.device_put(result, x.comm.sharding(x.split)),
+        x.shape,
+        types.canonical_heat_type(result.dtype),
+        x.split,
+        x.device,
+        x.comm,
+    )
+    if out is not None:
+        sanitize_out(out, res.shape, res.split, res.device)
+        casted = res._dense().astype(out.dtype.jax_type())
+        out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
+        return out
+    return res

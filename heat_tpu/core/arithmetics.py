@@ -1,0 +1,283 @@
+"""Arithmetic operations, analog of heat/core/arithmetics.py (39 exports).
+
+Every function is a thin shim over the generic wrappers in
+core/_operations.py; the distributed behavior documented in the reference
+(split matching, Allreduce on reduced split axes, Exscan for cumops) falls
+out of the sharded-jnp execution model.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import __binary_op as _binary_op
+from ._operations import __cum_op as _cum_op
+from ._operations import __local_op as _local_op
+from ._operations import __reduce_op as _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "copysign",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "divmod",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "gcd",
+    "hypot",
+    "invert",
+    "lcm",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "nan_to_num",
+    "nanprod",
+    "nansum",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=True):
+    """Element-wise addition (arithmetics.py:42)."""
+    return _binary_op(jnp.add, t1, t2, out, where)
+
+
+def _check_int_or_bool(t1, t2, name):
+    for t in (t1, t2):
+        if isinstance(t, DNDarray) and not types.heat_type_is_exact(t.dtype):
+            raise TypeError(f"{name} is only supported for integer or boolean types, got {t.dtype.__name__}")
+        if isinstance(t, float):
+            raise TypeError(f"{name} is only supported for integer or boolean types, got float")
+
+
+def bitwise_and(t1, t2, out=None, where=True):
+    """Element-wise AND of bits (arithmetics.py:175)."""
+    _check_int_or_bool(t1, t2, "bitwise_and")
+    return _binary_op(jnp.bitwise_and, t1, t2, out, where)
+
+
+def bitwise_or(t1, t2, out=None, where=True):
+    """Element-wise OR of bits (arithmetics.py:252)."""
+    _check_int_or_bool(t1, t2, "bitwise_or")
+    return _binary_op(jnp.bitwise_or, t1, t2, out, where)
+
+
+def bitwise_xor(t1, t2, out=None, where=True):
+    """Element-wise XOR of bits (arithmetics.py:329)."""
+    _check_int_or_bool(t1, t2, "bitwise_xor")
+    return _binary_op(jnp.bitwise_xor, t1, t2, out, where)
+
+
+def bitwise_not(t, out=None):
+    """Element-wise bit inversion, alias invert (arithmetics.py:1369)."""
+    return invert(t, out)
+
+
+def copysign(t1, t2, out=None, where=True):
+    """Magnitude of t1 with sign of t2 (arithmetics.py:406)."""
+    return _binary_op(jnp.copysign, t1, t2, out, where)
+
+
+def cumprod(t, axis, dtype=None, out=None):
+    """Cumulative product along ``axis`` (arithmetics.py:468)."""
+    return _cum_op(jnp.cumprod, t, axis, neutral=1, out=out, dtype=dtype)
+
+
+cumproduct = cumprod
+
+
+def cumsum(t, axis, dtype=None, out=None):
+    """Cumulative sum along ``axis`` (arithmetics.py:526)."""
+    return _cum_op(jnp.cumsum, t, axis, neutral=0, out=out, dtype=dtype)
+
+
+def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None):
+    """n-th discrete difference along an axis (arithmetics.py:584)."""
+    if n < 0:
+        raise ValueError(f"diff requires that n be a positive number, got {n}")
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"'a' must be a DNDarray, got {type(a)}")
+    if n == 0:
+        return a
+    from .stride_tricks import sanitize_axis
+
+    axis = sanitize_axis(a.shape, axis)
+    dense = a._dense()
+    pre = prepend._dense() if isinstance(prepend, DNDarray) else prepend
+    app = append._dense() if isinstance(append, DNDarray) else append
+    kwargs = {}
+    if pre is not None:
+        kwargs["prepend"] = jnp.asarray(pre)
+    if app is not None:
+        kwargs["append"] = jnp.asarray(app)
+    result = jnp.diff(dense, n=n, axis=axis, **kwargs)
+    split = a.split if a.split is None or a.split < result.ndim else None
+    return DNDarray.from_dense(result, split, a.device, a.comm)
+
+
+def div(t1, t2, out=None, where=True):
+    """Element-wise true division (arithmetics.py:717)."""
+    return _binary_op(jnp.true_divide, t1, t2, out, where)
+
+
+divide = div
+
+
+def divmod(t1, t2, out1=None, out2=None, out=None, where=True):
+    """Simultaneous floordiv and mod (arithmetics.py:794)."""
+    if out is None:
+        out = (out1, out2)
+    if not isinstance(out, tuple) or len(out) != 2:
+        raise ValueError("out must be a 2-tuple")
+    d = floordiv(t1, t2, out[0], where)
+    m = mod(t1, t2, out[1], where)
+    return d, m
+
+
+def floordiv(t1, t2, out=None, where=True):
+    """Element-wise floor division (arithmetics.py:879)."""
+    return _binary_op(jnp.floor_divide, t1, t2, out, where)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None, where=True):
+    """C-style remainder (sign of dividend) (arithmetics.py:956)."""
+    return _binary_op(jnp.fmod, t1, t2, out, where)
+
+
+def gcd(t1, t2, out=None, where=True):
+    """Greatest common divisor (arithmetics.py:1032)."""
+    _check_int_or_bool(t1, t2, "gcd")
+    return _binary_op(jnp.gcd, t1, t2, out, where)
+
+
+def hypot(t1, t2, out=None, where=True):
+    """sqrt(t1^2 + t2^2) (arithmetics.py:1102)."""
+    for t in (t1, t2):
+        if isinstance(t, DNDarray) and types.heat_type_is_exact(t.dtype) or isinstance(t, int):
+            raise TypeError("hypot is only supported for floating point types")
+    return _binary_op(jnp.hypot, t1, t2, out, where)
+
+
+def invert(t, out=None):
+    """Element-wise bitwise NOT (arithmetics.py:1369)."""
+    if isinstance(t, DNDarray) and not types.heat_type_is_exact(t.dtype):
+        raise TypeError(f"invert is only supported for integer or boolean types, got {t.dtype.__name__}")
+    return _local_op(jnp.invert, t, out, no_cast=True)
+
+
+def lcm(t1, t2, out=None, where=True):
+    """Least common multiple (arithmetics.py:1444)."""
+    _check_int_or_bool(t1, t2, "lcm")
+    return _binary_op(jnp.lcm, t1, t2, out, where)
+
+
+def left_shift(t1, t2, out=None, where=True):
+    """Shift bits left (arithmetics.py:1512)."""
+    _check_int_or_bool(t1, t2, "left_shift")
+    return _binary_op(jnp.left_shift, t1, t2, out, where)
+
+
+def mod(t1, t2, out=None, where=True):
+    """Python-style modulo (sign of divisor), alias remainder
+    (arithmetics.py:1582)."""
+    return _binary_op(jnp.mod, t1, t2, out, where)
+
+
+remainder = mod
+
+
+def mul(t1, t2, out=None, where=True):
+    """Element-wise multiplication (arithmetics.py:1660)."""
+    return _binary_op(jnp.multiply, t1, t2, out, where)
+
+
+multiply = mul
+
+
+def nan_to_num(t, nan: float = 0.0, posinf=None, neginf=None, out=None):
+    """Replace NaN/Inf with finite numbers (arithmetics.py:1738)."""
+    return _local_op(jnp.nan_to_num, t, out, no_cast=True, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nanprod(a, axis=None, out=None, keepdims=False):
+    """Product treating NaN as 1 (arithmetics.py:1791)."""
+    return _reduce_op(jnp.nanprod, a, axis, neutral=1, out=out, keepdims=keepdims)
+
+
+def nansum(a, axis=None, out=None, keepdims=False):
+    """Sum treating NaN as 0 (arithmetics.py:1836)."""
+    return _reduce_op(jnp.nansum, a, axis, neutral=0, out=out, keepdims=keepdims)
+
+
+def neg(a, out=None):
+    """Element-wise negation (arithmetics.py:1880)."""
+    return _local_op(jnp.negative, a, out, no_cast=True)
+
+
+negative = neg
+
+
+def pos(a, out=None):
+    """Element-wise +a (copy) (arithmetics.py:1928)."""
+    return _local_op(jnp.positive, a, out, no_cast=True)
+
+
+positive = pos
+
+
+def pow(t1, t2, out=None, where=True):
+    """Element-wise power (arithmetics.py:1976)."""
+    return _binary_op(jnp.power, t1, t2, out, where)
+
+
+power = pow
+
+
+def prod(a, axis=None, out=None, keepdims=False):
+    """Product of elements over axes (arithmetics.py:2054)."""
+    return _reduce_op(jnp.prod, a, axis, neutral=1, out=out, keepdims=keepdims)
+
+
+def right_shift(t1, t2, out=None, where=True):
+    """Shift bits right (arithmetics.py:2100)."""
+    _check_int_or_bool(t1, t2, "right_shift")
+    return _binary_op(jnp.right_shift, t1, t2, out, where)
+
+
+def sub(t1, t2, out=None, where=True):
+    """Element-wise subtraction (arithmetics.py:2170)."""
+    return _binary_op(jnp.subtract, t1, t2, out, where)
+
+
+subtract = sub
+
+
+def sum(a, axis=None, out=None, keepdims=False):
+    """Sum of elements over axes (arithmetics.py:2248)."""
+    return _reduce_op(jnp.sum, a, axis, neutral=0, out=out, keepdims=keepdims)

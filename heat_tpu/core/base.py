@@ -1,0 +1,142 @@
+"""Estimator base classes, analog of heat/core/base.py (base.py:13-321)."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List, Optional
+
+__all__ = [
+    "BaseEstimator",
+    "ClassificationMixin",
+    "ClusteringMixin",
+    "RegressionMixin",
+    "TransformMixin",
+    "is_classifier",
+    "is_estimator",
+    "is_clusterer",
+    "is_regressor",
+    "is_transformer",
+]
+
+
+class BaseEstimator:
+    """sklearn-compatible estimator base (base.py:13-95)."""
+
+    @classmethod
+    def _parameter_names(cls) -> List[str]:
+        init = cls.__init__
+        if init is object.__init__:
+            return []
+        sig = inspect.signature(init)
+        return [
+            name
+            for name, p in sig.parameters.items()
+            if name != "self" and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+        ]
+
+    def get_params(self, deep: bool = True) -> Dict:
+        """Parameters of this estimator (base.py:30)."""
+        params = {}
+        for key in self._parameter_names():
+            value = getattr(self, key, None)
+            if deep and hasattr(value, "get_params"):
+                for sub_key, sub_value in value.get_params().items():
+                    params[f"{key}__{sub_key}"] = sub_value
+            params[key] = value
+        return params
+
+    def set_params(self, **params) -> "BaseEstimator":
+        """Set estimator parameters (base.py:60)."""
+        if not params:
+            return self
+        valid = self.get_params(deep=True)
+        for key, value in params.items():
+            key, _, sub_key = key.partition("__")
+            if key not in valid:
+                raise ValueError(f"Invalid parameter {key} for estimator {self}.")
+            if sub_key:
+                valid[key].set_params(**{sub_key: value})
+            else:
+                setattr(self, key, value)
+        return self
+
+    def __repr__(self, indent: int = 1) -> str:
+        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params(deep=False).items())
+        return f"{self.__class__.__name__}({params})"
+
+
+class ClassificationMixin:
+    """fit/predict protocol for classifiers (base.py:96)."""
+
+    def fit(self, x, y):
+        raise NotImplementedError()
+
+    def fit_predict(self, x, y):
+        self.fit(x, y)
+        return self.predict(x)
+
+    def predict(self, x):
+        raise NotImplementedError()
+
+
+class TransformMixin:
+    """fit/transform protocol (base.py:143)."""
+
+    def fit(self, x):
+        raise NotImplementedError()
+
+    def fit_transform(self, x):
+        return self.fit(x).transform(x)
+
+    def transform(self, x):
+        raise NotImplementedError()
+
+
+class ClusteringMixin:
+    """fit/fit_predict protocol for clusterers (base.py:184)."""
+
+    def fit(self, x):
+        raise NotImplementedError()
+
+    def fit_predict(self, x):
+        self.fit(x)
+        return self.predict(x)
+
+
+class RegressionMixin:
+    """fit/predict protocol for regressors (base.py:215)."""
+
+    def fit(self, x, y):
+        raise NotImplementedError()
+
+    def fit_predict(self, x, y):
+        self.fit(x, y)
+        return self.predict(x)
+
+    def predict(self, x):
+        raise NotImplementedError()
+
+
+def is_classifier(estimator) -> bool:
+    """True for classifiers (base.py:260)."""
+    return isinstance(estimator, ClassificationMixin)
+
+
+def is_estimator(estimator) -> bool:
+    """True for estimators (base.py:275)."""
+    return isinstance(estimator, BaseEstimator)
+
+
+def is_clusterer(estimator) -> bool:
+    """True for clusterers (base.py:290)."""
+    return isinstance(estimator, ClusteringMixin)
+
+
+def is_regressor(estimator) -> bool:
+    """True for regressors (base.py:305)."""
+    return isinstance(estimator, RegressionMixin)
+
+
+def is_transformer(estimator) -> bool:
+    """True for transformers (base.py:320)."""
+    return isinstance(estimator, TransformMixin)

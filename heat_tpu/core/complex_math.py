@@ -1,0 +1,33 @@
+"""Complex-number operations, analog of heat/core/complex_math.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import __local_op as _local_op
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x, deg: bool = False, out=None):
+    """Argument of complex values (complex_math.py:15)."""
+    return _local_op(lambda a: jnp.angle(a, deg=deg), x, out, no_cast=True)
+
+
+def conjugate(x, out=None):
+    """Complex conjugate (complex_math.py:48)."""
+    return _local_op(jnp.conjugate, x, out, no_cast=True)
+
+
+conj = conjugate
+
+
+def imag(x, out=None):
+    """Imaginary part (complex_math.py:78)."""
+    return _local_op(jnp.imag, x, out, no_cast=True)
+
+
+def real(x, out=None):
+    """Real part (complex_math.py:98)."""
+    return _local_op(jnp.real, x, out, no_cast=True)

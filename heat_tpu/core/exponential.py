@@ -1,0 +1,84 @@
+"""Exponential/logarithmic operations, analog of heat/core/exponential.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import __binary_op as _binary_op
+from ._operations import __local_op as _local_op
+
+__all__ = [
+    "exp",
+    "expm1",
+    "exp2",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "logaddexp",
+    "logaddexp2",
+    "sqrt",
+    "square",
+]
+
+
+def exp(x, out=None):
+    """e**x (exponential.py:15)."""
+    return _local_op(jnp.exp, x, out)
+
+
+def expm1(x, out=None):
+    """e**x - 1 (exponential.py:51)."""
+    return _local_op(jnp.expm1, x, out)
+
+
+def exp2(x, out=None):
+    """2**x (exponential.py:87)."""
+    return _local_op(jnp.exp2, x, out)
+
+
+def log(x, out=None):
+    """Natural logarithm (exponential.py:123)."""
+    return _local_op(jnp.log, x, out)
+
+
+def log2(x, out=None):
+    """Base-2 logarithm (exponential.py:161)."""
+    return _local_op(jnp.log2, x, out)
+
+
+def log10(x, out=None):
+    """Base-10 logarithm (exponential.py:199)."""
+    return _local_op(jnp.log10, x, out)
+
+
+def log1p(x, out=None):
+    """log(1 + x) (exponential.py:237)."""
+    return _local_op(jnp.log1p, x, out)
+
+
+def logaddexp(t1, t2):
+    """log(exp(t1) + exp(t2)) (exponential.py:275)."""
+    return _binary_op(jnp.logaddexp, t1, t2)
+
+
+def logaddexp2(t1, t2):
+    """log2(2**t1 + 2**t2) (exponential.py:297)."""
+    return _binary_op(jnp.logaddexp2, t1, t2)
+
+
+def sqrt(x, out=None):
+    """Square root (exponential.py:318)."""
+    return _local_op(jnp.sqrt, x, out)
+
+
+def square(x, out=None):
+    """x*x (exponential.py:282 analog)."""
+    return _local_op(jnp.square, x, out, no_cast=True)
+
+
+def pow_scalar_base(base, exponent):
+    """base ** exponent for scalar base (helper for logspace)."""
+    from . import arithmetics
+
+    return arithmetics.pow(base, exponent)

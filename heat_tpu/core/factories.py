@@ -1,0 +1,335 @@
+"""Array creation routines, analog of heat/core/factories.py.
+
+The reference materializes the full input on every MPI rank and slices out
+the local chunk via ``comm.chunk`` (factories.py:149-482); here the global
+array is built once on host and placed with the canonical NamedSharding
+(``jax.device_put`` scatters the shards over ICI).  ``is_split`` ingestion
+maps to ``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.comm import Communication, sanitize_comm
+from . import types
+from .devices import Device, sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "arange",
+    "array",
+    "asarray",
+    "empty",
+    "empty_like",
+    "eye",
+    "from_partitioned",
+    "from_partition_dict",
+    "full",
+    "full_like",
+    "linspace",
+    "logspace",
+    "meshgrid",
+    "ones",
+    "ones_like",
+    "zeros",
+    "zeros_like",
+]
+
+
+def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Evenly spaced values in [start, stop) (factories.py:41)."""
+    num_args = len(args)
+    if num_args == 1:
+        start, stop, step = 0, args[0], 1
+    elif num_args == 2:
+        start, stop, step = args[0], args[1], 1
+    elif num_args == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"arange takes 1 to 3 positional arguments, got {num_args}")
+
+    if dtype is None:
+        if all(isinstance(a, (int, np.integer)) for a in (start, stop, step)):
+            dtype = types.int32
+        else:
+            dtype = types.float32
+    dtype = types.canonical_heat_type(dtype)
+    data = jnp.arange(start, stop, step, dtype=dtype.jax_type())
+    return DNDarray.from_dense(data, sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm))
+
+
+def array(
+    obj,
+    dtype=None,
+    copy: Optional[bool] = None,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Create a DNDarray from array-like data (factories.py:149-482).
+
+    ``split`` distributes the (globally known) data along an axis;
+    ``is_split`` declares that ``obj`` is this process's pre-distributed
+    chunk along that axis.
+    """
+    if split is not None and is_split is not None:
+        raise ValueError("split and is_split are mutually exclusive")
+    if order not in ("C", "F"):
+        raise ValueError(f"invalid memory layout order, expected 'C' or 'F', got {order!r}")
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+
+    if isinstance(obj, DNDarray):
+        if dtype is not None and types.canonical_heat_type(dtype) != obj.dtype:
+            obj = obj.astype(dtype)
+        if split is not None and obj.split != sanitize_axis(obj.shape, split):
+            obj = obj.resplit(split)
+        return obj
+
+    if isinstance(obj, (jax.Array, jnp.ndarray)):
+        data = obj
+    else:
+        data = np.asarray(obj, order=order)
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        data = jnp.asarray(data, dtype=dtype.jax_type())
+    else:
+        # canonical defaults: python float data -> float32, ints -> int32,
+        # unless the input already carries an explicit wider dtype
+        if isinstance(data, np.ndarray) and data.dtype == np.float64 and not isinstance(obj, np.ndarray):
+            data = jnp.asarray(data, dtype=jnp.float32)
+        elif isinstance(data, np.ndarray) and data.dtype == np.int64 and not isinstance(obj, np.ndarray):
+            data = jnp.asarray(data, dtype=jnp.int32)
+        else:
+            data = jnp.asarray(data)
+        dtype = types.canonical_heat_type(data.dtype)
+
+    while data.ndim < ndmin:
+        data = data[jnp.newaxis]
+
+    if is_split is not None:
+        is_split = sanitize_axis(data.shape, is_split)
+        if jax.process_count() == 1:
+            return DNDarray.from_dense(data, is_split, device, comm)
+        # multi-host: assemble the global array from per-process chunks
+        # (the reference infers gshape via allgather, factories.py:382-428)
+        sharding = comm.sharding(is_split)  # pragma: no cover - multi-host
+        global_arr = jax.make_array_from_process_local_data(sharding, np.asarray(data))
+        return DNDarray(
+            global_arr,
+            tuple(global_arr.shape),
+            dtype,
+            is_split,
+            device,
+            comm,
+        )
+
+    split = sanitize_axis(data.shape, split)
+    return DNDarray.from_dense(jnp.asarray(data), split, device, comm)
+
+
+def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -> DNDarray:
+    """Convert to DNDarray without copying when possible (factories.py:483)."""
+    return array(obj, dtype=dtype, copy=copy, order=order, is_split=is_split, device=device)
+
+
+def __factory(shape, dtype, split, fill, device, comm, order="C") -> DNDarray:
+    """Generic shape-based factory (factories.py:719)."""
+    shape = sanitize_shape(shape)
+    dtype = types.canonical_heat_type(types.float32 if dtype is None else dtype)
+    split = sanitize_axis(shape, split)
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    # build directly at padded shape: no host materialization of the full array
+    if split is None:
+        padded_shape = shape
+    else:
+        padded_shape = tuple(
+            comm.padded_extent(s) if d == split else s for d, s in enumerate(shape)
+        )
+    sharding = comm.sharding(split)
+    arr = jax.jit(
+        lambda: jnp.full(padded_shape, fill, dtype=dtype.jax_type()),
+        out_shardings=sharding,
+    )()
+    return DNDarray(arr, shape, dtype, split, device, comm)
+
+
+def __factory_like(a, dtype, split, factory, device, comm, order="C", **kwargs) -> DNDarray:
+    """Mirror shape/dtype/split of ``a`` (factories.py:798)."""
+    if isinstance(a, DNDarray):
+        shape = a.shape
+        dtype = dtype if dtype is not None else a.dtype
+        split = split if split is not None else a.split
+        device = device if device is not None else a.device
+        comm = comm if comm is not None else a.comm
+    else:
+        shape = np.shape(a)
+        dtype = dtype if dtype is not None else types.heat_type_of(a)
+    return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
+
+
+def empty(shape, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Uninitialized array (factories.py:542) — zero-filled here (XLA has no
+    uninitialized allocation)."""
+    return __factory(shape, dtype, split, 0, device, comm, order)
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, empty, device, comm, order)
+
+
+def eye(shape, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """2-D identity-like array (factories.py:640)."""
+    if isinstance(shape, (int, np.integer)):
+        n, m = int(shape), int(shape)
+    else:
+        shape = sanitize_shape(shape)
+        if len(shape) == 1:
+            n = m = shape[0]
+        else:
+            n, m = shape[0], shape[1]
+    dtype = types.canonical_heat_type(types.float32 if dtype is None else dtype)
+    data = jnp.eye(n, m, dtype=dtype.jax_type())
+    return DNDarray.from_dense(data, sanitize_axis((n, m), split), sanitize_device(device), sanitize_comm(comm))
+
+
+def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Constant-filled array (factories.py:1022)."""
+    if dtype is None:
+        dtype = types.heat_type_of(fill_value)
+    return __factory(shape, dtype, split, fill_value, device, comm, order)
+
+
+def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, full, device, comm, order, fill_value=fill_value)
+
+
+def linspace(
+    start,
+    stop,
+    num: int = 50,
+    endpoint: bool = True,
+    retstep: bool = False,
+    dtype=None,
+    split=None,
+    device=None,
+    comm=None,
+):
+    """Evenly spaced samples over [start, stop] (factories.py:1105)."""
+    num = int(num)
+    if num <= 0:
+        raise ValueError(f"number of samples 'num' must be non-negative, got {num}")
+    data = jnp.linspace(float(start), float(stop), num, endpoint=endpoint)
+    if dtype is not None:
+        data = data.astype(types.canonical_heat_type(dtype).jax_type())
+    else:
+        data = data.astype(jnp.float32)
+    ht = DNDarray.from_dense(data, sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm))
+    if retstep:
+        if endpoint and num == 1:
+            step = float("nan")  # numpy semantics for a single sample
+        else:
+            step = (float(stop) - float(start)) / (num - 1 if endpoint else num)
+        return ht, step
+    return ht
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Log-spaced samples (factories.py:1189)."""
+    y = linspace(start, stop, num=num, endpoint=endpoint, split=split, device=device, comm=comm)
+    from . import exponential
+
+    result = exponential.pow_scalar_base(base, y)
+    if dtype is not None:
+        return result.astype(dtype)
+    return result
+
+
+def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
+    """Coordinate matrices from coordinate vectors (factories.py:1252).
+
+    As in the reference, the last (xy) / second (ij) grid dimension is split
+    if any input was split.
+    """
+    if indexing not in ("xy", "ij"):
+        raise ValueError(f"indexing must be 'xy' or 'ij', got {indexing!r}")
+    if not arrays:
+        return []
+    inputs = [array(a) for a in arrays]
+    split_sources = [a for a in inputs if isinstance(a, DNDarray) and a.split is not None]
+    comm = inputs[0].comm
+    device = inputs[0].device
+    dense = [a._dense() if isinstance(a, DNDarray) else jnp.asarray(a) for a in inputs]
+    grids = jnp.meshgrid(*dense, indexing=indexing)
+    if split_sources:
+        out_split = 1 if indexing == "xy" else 0
+        if len(grids[0].shape) <= out_split:
+            out_split = 0
+    else:
+        out_split = None
+    return [DNDarray.from_dense(g, out_split, device, comm) for g in grids]
+
+
+def ones(shape, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """One-filled array (factories.py:1380)."""
+    return __factory(shape, dtype, split, 1, device, comm, order)
+
+
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, ones, device, comm, order)
+
+
+def zeros(shape, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Zero-filled array (factories.py:1431)."""
+    return __factory(shape, dtype, split, 0, device, comm, order)
+
+
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return __factory_like(a, dtype, split, zeros, device, comm, order)
+
+
+def from_partitioned(x, comm=None) -> DNDarray:
+    """Build a DNDarray from an object exposing ``__partitioned__``
+    (factories.py:849)."""
+    parts = x.__partitioned__
+    return from_partition_dict(parts, comm=comm)
+
+
+def from_partition_dict(parts: dict, comm=None) -> DNDarray:
+    """Build a DNDarray from a partition dict (factories.py:997)."""
+    comm = sanitize_comm(comm)
+    shape = tuple(parts["shape"])
+    tiling = tuple(parts.get("partition_tiling", (1,) * len(shape)))
+    split_candidates = [i for i, t in enumerate(tiling) if t > 1]
+    split = split_candidates[0] if split_candidates else None
+    keys = sorted(parts["partitions"].keys())
+    pieces = []
+    getter = parts.get("get")
+    for k in keys:
+        p = parts["partitions"][k]
+        data = p["data"]
+        if callable(data):
+            data = data()
+        elif data is not None and callable(getter):
+            data = getter(data)
+        if data is None:
+            raise ValueError(f"partition {k} carries no data handle")
+        piece = np.asarray(data)
+        if piece.size == 0:
+            continue
+        pieces.append(piece)
+    if split is None:
+        global_np = pieces[0]
+    else:
+        global_np = np.concatenate(pieces, axis=split)
+    return array(global_np, split=split, comm=comm)

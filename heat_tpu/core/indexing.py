@@ -1,0 +1,36 @@
+"""Indexing helpers, analog of heat/core/indexing.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of non-zero elements as an (nnz, ndim) array
+    (indexing.py:16; the reference offsets local results by the chunk
+    offset — the global jnp.nonzero already yields global indices)."""
+    dense = x._dense()
+    idx = jnp.nonzero(dense)
+    stacked = jnp.stack(idx, axis=1) if x.ndim > 1 else idx[0]
+    split = 0 if x.split is not None else None
+    return DNDarray.from_dense(stacked.astype(jnp.int64), split, x.device, x.comm)
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """Ternary select / nonzero (indexing.py:91)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y must be given")
+    cd = cond._dense()
+    xd = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
+    yd = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)
+    result = jnp.where(cd, xd, yd)
+    out_split = cond.split
+    if out_split is not None and (result.ndim != cond.ndim or out_split >= result.ndim):
+        out_split = 0 if result.ndim > 0 else None
+    return DNDarray.from_dense(result, out_split, cond.device, cond.comm)

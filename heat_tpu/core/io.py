@@ -1,0 +1,306 @@
+"""Parallel I/O, analog of heat/core/io.py.
+
+The reference does MPI-IO-style parallel reads: each rank reads only its
+chunk slice from HDF5/netCDF/CSV (io.py:488,731) and collective writes via
+h5py-parallel or serialized rank-0 writes (:597).  On TPU VMs there is no
+MPI-IO; the equivalent is per-host POSIX slab reads feeding
+``jax.make_array_from_process_local_data`` (multi-host) or a single global
+read + canonical placement (single-controller).  Optional dependencies are
+gated exactly like the reference (supports_hdf5/netcdf/pandas,
+io.py:36-47,463-485,1205).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+from typing import List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from ..parallel.comm import sanitize_comm
+from . import types
+from .devices import sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "load",
+    "load_csv",
+    "load_hdf5",
+    "load_npy_from_path",
+    "save",
+    "save_csv",
+    "save_hdf5",
+    "supports_hdf5",
+    "supports_netcdf",
+    "supports_pandas",
+]
+
+try:  # optional dependency guard (io.py:36)
+    import h5py
+
+    __HDF5 = True
+except ImportError:  # pragma: no cover
+    __HDF5 = False
+
+try:  # (io.py:463)
+    import netCDF4
+
+    __NETCDF = True
+except ImportError:
+    __NETCDF = False
+
+try:  # (io.py:1205)
+    import pandas as pd
+
+    __PANDAS = True
+except ImportError:  # pragma: no cover
+    __PANDAS = False
+
+
+def supports_hdf5() -> bool:
+    """Whether HDF5 io is available (io.py:40)."""
+    return __HDF5
+
+
+def supports_netcdf() -> bool:
+    """Whether netCDF io is available (io.py:467)."""
+    return __NETCDF
+
+
+def supports_pandas() -> bool:
+    """Whether pandas-backed io is available (io.py:1209)."""
+    return __PANDAS
+
+
+if __NETCDF:
+    __all__.extend(["load_netcdf", "save_netcdf"])
+
+
+def load(path: str, *args, **kwargs) -> DNDarray:
+    """Load by file extension (io.py:680)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    ext = os.path.splitext(path)[-1].lower()
+    if ext in (".h5", ".hdf5"):
+        return load_hdf5(path, *args, **kwargs)
+    if ext in (".nc", ".nc4", ".netcdf"):
+        if not __NETCDF:
+            raise RuntimeError("netCDF4 is not available; install netCDF4 to load netCDF files")
+        return load_netcdf(path, *args, **kwargs)
+    if ext == ".csv":
+        return load_csv(path, *args, **kwargs)
+    if ext == ".npy":
+        return load_npy_from_path(path, *args, **kwargs) if os.path.isdir(path) else _load_npy_file(path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {ext}")
+
+
+def save(data: DNDarray, path: str, *args, **kwargs) -> None:
+    """Save by file extension (io.py:1091)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    ext = os.path.splitext(path)[-1].lower()
+    if ext in (".h5", ".hdf5"):
+        return save_hdf5(data, path, *args, **kwargs)
+    if ext in (".nc", ".nc4", ".netcdf"):
+        if not __NETCDF:
+            raise RuntimeError("netCDF4 is not available; install netCDF4 to save netCDF files")
+        return save_netcdf(data, path, *args, **kwargs)
+    if ext == ".csv":
+        return save_csv(data, path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {ext}")
+
+
+# ----------------------------------------------------------------------
+# HDF5 (io.py:488-679)
+# ----------------------------------------------------------------------
+def load_hdf5(
+    path: str,
+    dataset: str,
+    dtype=types.float32,
+    load_fraction: float = 1.0,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Parallel slab read of an HDF5 dataset (io.py:488).
+
+    Multi-host: each host reads only the rows its devices own (the analog of
+    the reference's per-rank chunk slice read); single-controller: one read.
+    """
+    if not __HDF5:
+        raise RuntimeError("h5py is not available")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    if not isinstance(dataset, str):
+        raise TypeError(f"dataset must be str, not {type(dataset)}")
+    if not isinstance(load_fraction, float) or not (0.0 < load_fraction <= 1.0):
+        raise ValueError("load_fraction must be a float in (0., 1.]")
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    dtype = types.canonical_heat_type(dtype)
+    with h5py.File(path, "r") as handle:
+        data = handle[dataset]
+        gshape = tuple(data.shape)
+        if load_fraction < 1.0 and split is not None:
+            gshape = tuple(
+                int(s * load_fraction) if d == split else s for d, s in enumerate(gshape)
+            )
+        split = sanitize_axis(gshape, split)
+        if jax.process_count() == 1:
+            arr = np.asarray(data[tuple(slice(0, s) for s in gshape)], dtype=np.dtype(dtype.jax_type()))
+            return DNDarray.from_dense(jax.numpy.asarray(arr), split, device, comm)
+        # multi-host slab read  # pragma: no cover - multi-host
+        _, _, slices = comm.chunk(gshape, split, rank=comm.rank)
+        local = np.asarray(data[slices], dtype=np.dtype(dtype.jax_type()))
+        sharding = comm.sharding(split)
+        global_arr = jax.make_array_from_process_local_data(sharding, local)
+        return DNDarray(global_arr, gshape, dtype, split, device, comm)
+
+
+def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
+    """Write a DNDarray to HDF5 (io.py:597).  The gathered global array is
+    written once (rank-0-write analog; parallel-HDF5 is not available
+    without MPI-IO)."""
+    if not __HDF5:
+        raise RuntimeError("h5py is not available")
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    if jax.process_index() == 0:
+        with h5py.File(path, mode) as handle:
+            handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+
+# ----------------------------------------------------------------------
+# netCDF (io.py:75-462), gated
+# ----------------------------------------------------------------------
+if __NETCDF:
+
+    def load_netcdf(path, variable, dtype=types.float32, split=None, device=None, comm=None, **kwargs):
+        """Parallel netCDF read (io.py:75)."""
+        comm = sanitize_comm(comm)
+        device = sanitize_device(device)
+        dtype = types.canonical_heat_type(dtype)
+        with netCDF4.Dataset(path, "r") as handle:
+            data = np.asarray(handle[variable][:], dtype=np.dtype(dtype.jax_type()))
+        return DNDarray.from_dense(jax.numpy.asarray(data), sanitize_axis(data.shape, split), device, comm)
+
+    def save_netcdf(data, path, variable, mode: str = "w", **kwargs):
+        """netCDF write (io.py:158)."""
+        if not isinstance(data, DNDarray):
+            raise TypeError(f"data must be a DNDarray, not {type(data)}")
+        if jax.process_index() == 0:
+            with netCDF4.Dataset(path, mode) as handle:
+                dims = []
+                for i, s in enumerate(data.shape):
+                    name = f"dim_{i}"
+                    handle.createDimension(name, s)
+                    dims.append(name)
+                var = handle.createVariable(variable, data.numpy().dtype, tuple(dims))
+                var[:] = data.numpy()
+
+
+# ----------------------------------------------------------------------
+# CSV (io.py:731-1090)
+# ----------------------------------------------------------------------
+def load_csv(
+    path: str,
+    header_lines: int = 0,
+    sep: str = ",",
+    dtype=types.float32,
+    encoding: str = "utf-8",
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load a CSV file (io.py:731).  The reference's parallel byte-range
+    scan becomes a host read + canonical placement (multi-host: each host
+    could read its own byte range; the global array assembly is identical).
+    """
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    if not isinstance(sep, str):
+        raise TypeError(f"separator must be str, not {type(sep)}")
+    if not isinstance(header_lines, int):
+        raise TypeError(f"header_lines must be int, not {type(header_lines)}")
+    dtype = types.canonical_heat_type(dtype)
+    np_dtype = np.dtype(dtype.jax_type())
+    rows: List[List[float]] = []
+    with open(path, "r", encoding=encoding, newline="") as f:
+        reader = _csv.reader(f, delimiter=sep)
+        for i, row in enumerate(reader):
+            if i < header_lines or not row:
+                continue
+            rows.append([np_dtype.type(x) for x in row])
+    data = np.asarray(rows, dtype=np_dtype)
+    return DNDarray.from_dense(
+        jax.numpy.asarray(data), sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm)
+    )
+
+
+def save_csv(
+    data: DNDarray,
+    path: str,
+    header_lines: Optional[List[str]] = None,
+    sep: str = ",",
+    decimals: int = -1,
+    encoding: str = "utf-8",
+    **kwargs,
+) -> None:
+    """Write a DNDarray to CSV (io.py:957)."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    if data.ndim > 2:
+        raise ValueError("CSV can only store 1-D or 2-D arrays")
+    arr = data.numpy()
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if jax.process_index() == 0:
+        with open(path, "w", encoding=encoding, newline="") as f:
+            if header_lines:
+                for line in header_lines:
+                    f.write(line if line.endswith("\n") else line + "\n")
+            writer = _csv.writer(f, delimiter=sep)
+            for row in arr:
+                if decimals >= 0:
+                    writer.writerow([round(float(x), decimals) for x in row])
+                else:
+                    writer.writerow(row.tolist())
+
+
+# ----------------------------------------------------------------------
+# npy shards (io.py:1145)
+# ----------------------------------------------------------------------
+def _load_npy_file(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    data = np.load(path)
+    if dtype is not None:
+        data = data.astype(np.dtype(types.canonical_heat_type(dtype).jax_type()))
+    return DNDarray.from_dense(
+        jax.numpy.asarray(data), sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm)
+    )
+
+
+def load_npy_from_path(
+    path: str, dtype=types.int32, split: int = 0, device=None, comm=None
+) -> DNDarray:
+    """Load a directory of per-rank .npy shards as one global array
+    (io.py:1145)."""
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    if not isinstance(split, int) and split is not None:
+        raise TypeError(f"split must be an integer or None, not {type(split)}")
+    files = sorted(f for f in os.listdir(path) if f.endswith(".npy"))
+    if not files:
+        raise ValueError(f"no .npy files found in {path}")
+    pieces = [np.load(os.path.join(path, f)) for f in files]
+    dtype = types.canonical_heat_type(dtype)
+    if split is None:
+        data = pieces[0]
+    else:
+        data = np.concatenate(pieces, axis=split)
+    data = data.astype(np.dtype(dtype.jax_type()))
+    return DNDarray.from_dense(
+        jax.numpy.asarray(data), sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm)
+    )

@@ -1,0 +1,7 @@
+"""Distributed linear algebra (analog of heat/core/linalg)."""
+
+from .basics import *
+from .qr import *
+from .svd import *
+from .svdtools import *
+from .solver import *

@@ -1,0 +1,123 @@
+"""QR decomposition, analog of heat/core/linalg/qr.py (qr.py:17-310).
+
+Reference algorithms: split=0 tall-skinny -> TS-QR with a tree merge of
+stacked R factors (procs_to_merge fan-in, Demmel et al. 2012, qr.py:64);
+split=1 -> block-wise stabilized Gram-Schmidt with Bcasts of the current
+column block.
+
+TPU-native: the TS-QR tree is expressed as a shard_map collective program —
+each shard takes a local QR, all-gathers the small R factors over ICI, and
+(redundantly, replicated across shards) merges them with one more QR; the
+local Q is then corrected by its block of the merge Q.  One ICI all-gather
+of p×(n×n) floats replaces the reference's log-p rounds of paired
+send/recvs.  Falls back to a global XLA QR when shards are ragged or wide.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import types
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+
+__all__ = ["qr"]
+
+QR = collections.namedtuple("QR", "Q, R")
+
+
+def qr(
+    A: DNDarray,
+    mode: str = "reduced",
+    procs_to_merge: int = 2,
+) -> QR:
+    """Reduced QR decomposition of a 2-D (or batched) array.
+
+    Returns the namedtuple ``QR(Q, R)``; with ``mode='r'`` the Q factor is
+    ``None`` (matching qr.py:33-40).
+    """
+    sanitize_in(A)
+    if mode not in ("reduced", "r"):
+        raise ValueError(f"mode must be 'reduced' or 'r', got {mode!r}")
+    if A.ndim < 2:
+        raise ValueError(f"Array A must be at least two-dimensional, but is {A.ndim}-dimensional")
+    if not types.heat_type_is_realfloating(A.dtype) and not types.heat_type_is_complexfloating(A.dtype):
+        A = A.astype(types.float32)
+
+    m, n = A.shape[-2], A.shape[-1]
+    comm = A.comm
+    p = comm.size
+
+    use_tsqr = (
+        A.ndim == 2
+        and A.split == 0
+        and p > 1
+        and m % p == 0
+        and (m // p) >= n
+    )
+    if use_tsqr:
+        q_pad, r = _tsqr_shard_map(A, compute_q=(mode == "reduced"))
+        R = DNDarray.from_dense(r, None, A.device, A.comm)
+        if mode == "r":
+            return QR(None, R)
+        Q = DNDarray(
+            jax.device_put(q_pad, comm.sharding(0)),
+            (m, n),
+            A.dtype,
+            0,
+            A.device,
+            A.comm,
+        )
+        return QR(Q, R)
+
+    # general path: XLA's QR over the (sharded) dense view
+    dense = A._dense()
+    if mode == "r":
+        r = jnp.linalg.qr(dense, mode="r")
+        return QR(None, DNDarray.from_dense(r, None if A.ndim == 2 else A.split, A.device, A.comm))
+    q, r = jnp.linalg.qr(dense, mode="reduced")
+    q_split = A.split
+    r_split = None if A.ndim == 2 and A.split == 0 else A.split
+    if A.ndim == 2 and A.split == 1:
+        r_split = 1
+    return QR(
+        DNDarray.from_dense(q, q_split, A.device, A.comm),
+        DNDarray.from_dense(r, r_split, A.device, A.comm),
+    )
+
+
+def _tsqr_shard_map(A: DNDarray, compute_q: bool = True):
+    """Single-level TS-QR as a shard_map collective (see module docstring).
+
+    Requires m divisible by p and m/p >= n (caller checks).
+    """
+    comm = A.comm
+    mesh = comm.mesh
+    axis = comm.axis_name
+    n = A.shape[1]
+    p = comm.size
+
+    def body(a_loc):
+        # a_loc: (m/p, n) local block
+        q1, r1 = jnp.linalg.qr(a_loc, mode="reduced")  # (m/p, n), (n, n)
+        r_all = jax.lax.all_gather(r1, axis, axis=0, tiled=True)  # (p*n, n)
+        q2, r2 = jnp.linalg.qr(r_all, mode="reduced")  # (p*n, n), (n, n)
+        idx = jax.lax.axis_index(axis)
+        q2_block = jax.lax.dynamic_slice_in_dim(q2, idx * n, n, axis=0)  # (n, n)
+        q_loc = jnp.matmul(q1, q2_block, precision=jax.lax.Precision.HIGHEST) if compute_q else q1
+        return q_loc, r2
+
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=(P(axis, None), P(None, None)),
+    )
+    q, r = f(A.larray_padded)
+    # r is replicated identically on all shards; take it as the global R
+    return q, r

@@ -1,0 +1,66 @@
+"""Exact SVD, analog of heat/core/linalg/svd.py (svd.py:14-203).
+
+Reference strategy: tall-skinny split=0 -> TS-QR then a local SVD of the
+small R factor; short-fat via transpose; otherwise torch locally.  The same
+factorization structure is kept here with the shard_map TS-QR from qr.py.
+Returns ``SVD(U, S, V)`` with A = U @ diag(S) @ V.T (V, not V^H, matching
+the reference).
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+
+from .. import types
+from ..dndarray import DNDarray
+from ..sanitation import sanitize_in
+from .basics import matmul, transpose
+from .qr import qr
+
+__all__ = ["svd"]
+
+SVD = collections.namedtuple("SVD", "U, S, V")
+
+
+def svd(A: DNDarray, full_matrices: bool = False, compute_uv: bool = True, qr_procs_to_merge: int = 2):
+    """Singular value decomposition (svd.py:14)."""
+    sanitize_in(A)
+    if full_matrices:
+        raise NotImplementedError("full_matrices=True is not supported (matching the reference, svd.py:49)")
+    if A.ndim != 2:
+        raise ValueError(f"A must be 2-dimensional, but is {A.ndim}-dimensional")
+    if not types.heat_type_is_inexact(A.dtype):
+        A = A.astype(types.float32)
+
+    m, n = A.shape
+
+    if A.split == 0 and m >= n:
+        # tall-skinny: QR then SVD of R (svd.py:81)
+        Q, R = qr(A, mode="reduced", procs_to_merge=qr_procs_to_merge)
+        u_r, s, vt = jnp.linalg.svd(R._dense(), full_matrices=False)
+        if not compute_uv:
+            return DNDarray.from_dense(s, None, A.device, A.comm)
+        U = matmul(Q, DNDarray.from_dense(u_r, None, A.device, A.comm))
+        V = DNDarray.from_dense(vt.T, None, A.device, A.comm)
+        S = DNDarray.from_dense(s, None, A.device, A.comm)
+        return SVD(U, S, V)
+
+    if A.split == 1 and n > m:
+        # short-fat: factor the transpose and swap (svd.py:150)
+        res = svd(transpose(A), full_matrices=full_matrices, compute_uv=compute_uv, qr_procs_to_merge=qr_procs_to_merge)
+        if not compute_uv:
+            return res
+        return SVD(res.V, res.S, res.U)
+
+    dense = A._dense()
+    if not compute_uv:
+        s = jnp.linalg.svd(dense, compute_uv=False)
+        return DNDarray.from_dense(s, None, A.device, A.comm)
+    u, s, vt = jnp.linalg.svd(dense, full_matrices=False)
+    return SVD(
+        DNDarray.from_dense(u, A.split if A.split == 0 else None, A.device, A.comm),
+        DNDarray.from_dense(s, None, A.device, A.comm),
+        DNDarray.from_dense(vt.T, A.split if A.split == 1 else None, A.device, A.comm),
+    )

@@ -1,0 +1,121 @@
+"""Logical operations, analog of heat/core/logical.py (logical.py:21-560).
+
+The reference reduces with custom MPI.LAND/LOR ops; here jnp.all/jnp.any on
+the neutral-masked global array compile to the same tree reductions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import __binary_op as _binary_op
+from ._operations import __local_op as _local_op
+from ._operations import __reduce_op as _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x, axis=None, out=None, keepdims=False):
+    """True where all elements along axes are truthy (logical.py:21)."""
+    return _reduce_op(
+        lambda a, axis=None, keepdims=False: jnp.all(a, axis=axis, keepdims=keepdims),
+        x,
+        axis,
+        neutral=True,
+        out=out,
+        keepdims=keepdims,
+    )
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Global closeness check (logical.py:135)."""
+    a = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
+    b = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def any(x, axis=None, out=None, keepdims=False):
+    """True where any element along axes is truthy (logical.py:200)."""
+    return _reduce_op(
+        lambda a, axis=None, keepdims=False: jnp.any(a, axis=axis, keepdims=keepdims),
+        x,
+        axis,
+        neutral=False,
+        out=out,
+        keepdims=keepdims,
+    )
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False):
+    """Element-wise closeness (logical.py:264)."""
+    return _binary_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def isfinite(x):
+    """Element-wise finiteness test (logical.py:318)."""
+    return _local_op(jnp.isfinite, x, no_cast=True)
+
+
+def isinf(x):
+    """Element-wise infinity test (logical.py:344)."""
+    return _local_op(jnp.isinf, x, no_cast=True)
+
+
+def isnan(x):
+    """Element-wise NaN test (logical.py:396)."""
+    return _local_op(jnp.isnan, x, no_cast=True)
+
+
+def isneginf(x, out=None):
+    """Element-wise -inf test (logical.py:422)."""
+    return _local_op(jnp.isneginf, x, out, no_cast=True)
+
+
+def isposinf(x, out=None):
+    """Element-wise +inf test (logical.py:448)."""
+    return _local_op(jnp.isposinf, x, out, no_cast=True)
+
+
+def logical_and(t1, t2):
+    """Element-wise logical AND (logical.py:474)."""
+    return _binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_not(t, out=None):
+    """Element-wise logical NOT (logical.py:500)."""
+    return _local_op(jnp.logical_not, t, out, no_cast=True)
+
+
+def logical_or(t1, t2):
+    """Element-wise logical OR (logical.py:526)."""
+    return _binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2):
+    """Element-wise logical XOR (logical.py:552)."""
+    return _binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x, out=None):
+    """True where the sign bit is set (logical.py:578)."""
+    return _local_op(jnp.signbit, x, out, no_cast=True)

@@ -1,0 +1,23 @@
+"""Memory layout helpers, analog of heat/core/memory.py."""
+
+from __future__ import annotations
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x: DNDarray) -> DNDarray:
+    """Deep copy (memory.py:13).  jax arrays are immutable; wrapping the same
+    buffer in a fresh DNDarray has copy semantics."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+    return DNDarray(x.larray_padded, x.gshape, x.dtype, x.split, x.device, x.comm)
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Memory order normalization (memory.py:43).  XLA owns physical layout;
+    'F' order is accepted for API parity and ignored."""
+    if order not in ("C", "F"):
+        raise ValueError(f"invalid memory layout order, expected 'C' or 'F', got {order!r}")
+    return x

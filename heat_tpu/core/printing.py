@@ -1,0 +1,83 @@
+"""Printing, analog of heat/core/printing.py.
+
+The reference gathers data to rank 0 via resplit(None) and prints there
+(printing.py:184-287); in single-controller JAX the driver process already
+addresses the global array, so printing is a numpy round-trip of the dense
+view (or just the edges when summarizing).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_printoptions", "global_printing", "local_printing", "print0", "set_printoptions"]
+
+_LOCAL_PRINTING = False
+
+# mirror torch-style defaults used by the reference (printing.py:150)
+__PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+
+
+def get_printoptions() -> dict:
+    """Current print options (printing.py:16)."""
+    return dict(__PRINT_OPTIONS)
+
+
+def global_printing() -> None:
+    """Print global arrays (default; printing.py:66)."""
+    global _LOCAL_PRINTING
+    _LOCAL_PRINTING = False
+
+
+def local_printing() -> None:
+    """Print only the process-local chunk (printing.py:30)."""
+    global _LOCAL_PRINTING
+    _LOCAL_PRINTING = True
+
+
+def print0(*args, **kwargs) -> None:
+    """Print once, on the root process only (printing.py:100)."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure formatting (printing.py:150)."""
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=np.inf, edgeitems=3, linewidth=120)
+    for k, v in dict(
+        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
+    ).items():
+        if v is not None:
+            __PRINT_OPTIONS[k] = v
+    np.set_printoptions(
+        precision=int(__PRINT_OPTIONS["precision"]),
+        threshold=__PRINT_OPTIONS["threshold"],
+        edgeitems=int(__PRINT_OPTIONS["edgeitems"]),
+        linewidth=int(__PRINT_OPTIONS["linewidth"]),
+    )
+
+
+def __str__(dndarray) -> str:
+    """Format a DNDarray (printing.py:184)."""
+    if _LOCAL_PRINTING:
+        data = np.asarray(dndarray.larray)
+        return (
+            f"DNDarray(local={data}, device={dndarray.device}, split={dndarray.split})"
+        )
+    data = dndarray.numpy()
+    body = np.array2string(
+        data,
+        precision=int(__PRINT_OPTIONS["precision"]),
+        threshold=__PRINT_OPTIONS["threshold"],
+        edgeitems=int(__PRINT_OPTIONS["edgeitems"]),
+        separator=", ",
+        prefix="DNDarray(",
+    )
+    return f"DNDarray({body}, dtype=ht.{dndarray.dtype.__name__}, device={dndarray.device}, split={dndarray.split})"

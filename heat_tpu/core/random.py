@@ -1,0 +1,201 @@
+"""Parallel random number generation, analog of heat/core/random.py.
+
+The reference implements two pRNGs (random.py:1-14): a torch-backed
+"Batchparallel" mode (per-rank seed = seed + rank, weakly reproducible) and
+a hand-written counter-based Threefry (:1016-1218) whose counter sequence
+(:75-221) makes draws bit-identical for any process count.
+
+JAX's native PRNG *is* counter-based Threefry, so the entire hand-rolled
+machinery (32/64-bit block generation, mantissa masking :242-271, Kundu /
+Box-Muller transforms :272-293) collapses: a single global
+``jax.random.*`` draw with a derived key is deterministic in the global
+seed and independent of the device count by construction — the stronger of
+the reference's two guarantees, for free.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.comm import sanitize_comm
+from . import types
+from .devices import sanitize_device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "randint",
+    "randn",
+    "random",
+    "random_integer",
+    "random_sample",
+    "randperm",
+    "ranf",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+    "uniform",
+]
+
+__seed: int = 0
+__counter: int = 0
+
+
+def seed(new_seed: Optional[int] = None) -> None:
+    """Seed the generator (random.py:885)."""
+    global __seed, __counter
+    if new_seed is None:
+        new_seed = int(time.time() * 1000) & 0x7FFFFFFF
+    __seed = int(new_seed)
+    __counter = 0
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Current RNG state tuple (random.py:222), shaped like the reference's
+    ('Threefry', seed, counter, _, _)."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple) -> None:
+    """Restore an RNG state (random.py:914)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise ValueError("state needs to be a 3- or 5-tuple")
+    if state[0] != "Threefry":
+        raise ValueError("this generator is based on Threefry")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def _next_key() -> jax.Array:
+    global __counter
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter)
+    __counter += 1
+    return key
+
+
+def _wrap(data, split, device, comm) -> DNDarray:
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    return DNDarray.from_dense(data, sanitize_axis(data.shape, split), device, comm)
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Normal distribution with given mean/std (random.py:293)."""
+    if shape is None:
+        shape = (1,)
+    shape = sanitize_shape(shape)
+    dtype = types.canonical_heat_type(dtype)
+    std_arr = std._dense() if isinstance(std, DNDarray) else jnp.asarray(std)
+    if bool(jnp.any(std_arr < 0)):
+        raise ValueError("std needs to be positive")
+    mean_arr = mean._dense() if isinstance(mean, DNDarray) else jnp.asarray(mean)
+    data = jax.random.normal(_next_key(), shape, dtype=dtype.jax_type())
+    data = data * std_arr + mean_arr
+    return _wrap(data, split, device, comm)
+
+
+def permutation(x, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of a sequence / shuffled copy (random.py:666)."""
+    key = _next_key()
+    if isinstance(x, int):
+        data = jax.random.permutation(key, x)
+        data = data.astype(jnp.int64)
+        return _wrap(data, split, device, comm)
+    if isinstance(x, DNDarray):
+        data = jax.random.permutation(key, x._dense(), axis=0)
+        return _wrap(data, split if split is not None else x.split, device or x.device, comm or x.comm)
+    data = jax.random.permutation(key, jnp.asarray(x), axis=0)
+    return _wrap(data, split, device, comm)
+
+
+def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples of the given shape (random.py:308)."""
+    if not d:
+        d = (1,)
+    shape = sanitize_shape(d)
+    dtype = types.canonical_heat_type(dtype)
+    data = jax.random.uniform(_next_key(), shape, dtype=dtype.jax_type())
+    return _wrap(data, split, device, comm)
+
+
+def randint(low, high=None, size=None, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Random integers in [low, high) (random.py:405)."""
+    if high is None:
+        low, high = 0, low
+    if low >= high:
+        raise ValueError("low >= high")
+    if size is None:
+        size = (1,)
+    if isinstance(size, int):
+        size = (size,)
+    size = sanitize_shape(size)
+    dtype = types.canonical_heat_type(dtype or types.int64)
+    if dtype not in (types.int64, types.int32):
+        raise ValueError(f"Unsupported dtype for randint, got {dtype}")
+    data = jax.random.randint(_next_key(), size, int(low), int(high), dtype=dtype.jax_type())
+    return _wrap(data, split, device, comm)
+
+
+random_integer = randint
+
+
+def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples of the given shape (random.py:474)."""
+    if not d:
+        d = (1,)
+    shape = sanitize_shape(d)
+    dtype = types.canonical_heat_type(dtype)
+    data = jax.random.normal(_next_key(), shape, dtype=dtype.jax_type())
+    return _wrap(data, split, device, comm)
+
+
+def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (random.py:530)."""
+    if shape is None:
+        shape = (1,)
+    return rand(*sanitize_shape(shape), dtype=dtype, split=split, device=device, comm=comm)
+
+
+random = random_sample
+ranf = random_sample
+sample = random_sample
+
+
+def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of range(n) (random.py:625)."""
+    if not isinstance(n, int):
+        raise TypeError(f"n must be an integer, got {type(n)}")
+    data = jax.random.permutation(_next_key(), n).astype(
+        types.canonical_heat_type(dtype).jax_type()
+    )
+    return _wrap(data, split, device, comm)
+
+
+def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (random.py:693)."""
+    if shape is None:
+        shape = (1,)
+    return randn(*sanitize_shape(shape), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [low, high) samples (random.py:761)."""
+    if size is None:
+        size = (1,)
+    size = sanitize_shape(size)
+    dtype = types.canonical_heat_type(dtype)
+    data = jax.random.uniform(
+        _next_key(), size, dtype=dtype.jax_type(), minval=float(low), maxval=float(high)
+    )
+    return _wrap(data, split, device, comm)

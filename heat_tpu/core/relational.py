@@ -1,0 +1,90 @@
+"""Relational operations, analog of heat/core/relational.py (12 exports)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import __binary_op as _binary_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "eq",
+    "equal",
+    "ge",
+    "greater_equal",
+    "gt",
+    "greater",
+    "le",
+    "less_equal",
+    "lt",
+    "less",
+    "ne",
+    "not_equal",
+]
+
+
+def eq(t1, t2):
+    """Element-wise == (relational.py:23)."""
+    return _binary_op(jnp.equal, t1, t2)
+
+
+def equal(t1, t2) -> bool:
+    """True iff both arrays are entirely equal (global scalar; relational.py:73).
+
+    The reference reduces a local comparison with MPI.LAND; here the global
+    jnp comparison + all() spans shards directly.
+    """
+    if isinstance(t1, DNDarray):
+        a = t1._dense()
+    else:
+        a = jnp.asarray(t1)
+    if isinstance(t2, DNDarray):
+        b = t2._dense()
+    else:
+        b = jnp.asarray(t2)
+    if tuple(a.shape) != tuple(b.shape):
+        try:
+            jnp.broadcast_shapes(a.shape, b.shape)
+        except ValueError:
+            return False
+    return bool(jnp.all(a == b))
+
+
+def ge(t1, t2):
+    """Element-wise >= (relational.py:150)."""
+    return _binary_op(jnp.greater_equal, t1, t2)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2):
+    """Element-wise > (relational.py:201)."""
+    return _binary_op(jnp.greater, t1, t2)
+
+
+greater = gt
+
+
+def le(t1, t2):
+    """Element-wise <= (relational.py:252)."""
+    return _binary_op(jnp.less_equal, t1, t2)
+
+
+less_equal = le
+
+
+def lt(t1, t2):
+    """Element-wise < (relational.py:303)."""
+    return _binary_op(jnp.less, t1, t2)
+
+
+less = lt
+
+
+def ne(t1, t2):
+    """Element-wise != (relational.py:354)."""
+    return _binary_op(jnp.not_equal, t1, t2)
+
+
+not_equal = ne

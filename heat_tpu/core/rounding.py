@@ -1,0 +1,97 @@
+"""Rounding/sign operations, analog of heat/core/rounding.py (11 exports)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import __local_op as _local_op
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None):
+    """Absolute value (rounding.py:21).  With ``out=``, values are cast into
+    the out buffer's dtype (numpy out= semantics)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.number):
+        raise TypeError("dtype must be a heat data type")
+    res = _local_op(jnp.abs, x, no_cast=True)
+    if dtype is not None:
+        res = res.astype(dtype)
+    if out is not None:
+        return _local_op(lambda a: a, res, out, no_cast=True)
+    return res
+
+
+absolute = abs
+
+
+def ceil(x, out=None):
+    """Ceiling (rounding.py:88)."""
+    return _local_op(jnp.ceil, x, out)
+
+
+def clip(x, min=None, max=None, out=None):
+    """Clamp values to [min, max] (rounding.py:124)."""
+    if min is None and max is None:
+        raise ValueError("either min or max must be set")
+    lo = min._dense() if isinstance(min, DNDarray) else min
+    hi = max._dense() if isinstance(max, DNDarray) else max
+    return _local_op(lambda a: jnp.clip(a, lo, hi), x, out, no_cast=True)
+
+
+def fabs(x, out=None):
+    """Float absolute value (rounding.py:170)."""
+    return _local_op(jnp.fabs, x, out)
+
+
+def floor(x, out=None):
+    """Floor (rounding.py:206)."""
+    return _local_op(jnp.floor, x, out)
+
+
+def modf(x, out=None):
+    """Fractional and integral parts (rounding.py:242)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("expected out to be None or a tuple of two DNDarrays")
+        frac = _local_op(lambda a: jnp.modf(a)[0], x, out[0])
+        intg = _local_op(lambda a: jnp.modf(a)[1], x, out[1])
+        return frac, intg
+    frac = _local_op(lambda a: jnp.modf(a)[0], x)
+    intg = _local_op(lambda a: jnp.modf(a)[1], x)
+    return frac, intg
+
+
+def round(x, decimals=0, out=None, dtype=None):
+    """Round to given decimals (rounding.py:288).  With ``out=``, values are
+    cast into the out buffer's dtype (numpy out= semantics)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.number):
+        raise TypeError("dtype must be a heat data type")
+    res = _local_op(lambda a: jnp.round(a, decimals), x)
+    if dtype is not None:
+        res = res.astype(dtype)
+    if out is not None:
+        return _local_op(lambda a: a, res, out, no_cast=True)
+    return res
+
+
+def sgn(x, out=None):
+    """Sign of elements (complex: z/|z|) (rounding.py:335)."""
+    return _local_op(jnp.sign, x, out, no_cast=True)
+
+
+def sign(x, out=None):
+    """Sign of elements; complex uses sign of real part (rounding.py:361,
+    matching torch.sign semantics)."""
+    if isinstance(x, DNDarray) and types.heat_type_is_complexfloating(x.dtype):
+        return _local_op(lambda a: jnp.sign(a.real).astype(a.dtype), x, out, no_cast=True)
+    return _local_op(jnp.sign, x, out, no_cast=True)
+
+
+def trunc(x, out=None):
+    """Truncate toward zero (rounding.py:407)."""
+    return _local_op(jnp.trunc, x, out)

@@ -1,0 +1,93 @@
+"""Input/output validation helpers, analog of heat/core/sanitation.py.
+
+Much of the reference file deals with redistributing operands to matching
+ragged lshape maps (sanitize_distribution, sanitation.py:32-158); under the
+canonical pad-and-mask distribution two arrays with equal (gshape, split,
+comm) are automatically co-located, so sanitize_distribution reduces to a
+resplit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .dndarray import DNDarray
+
+__all__ = [
+    "sanitize_distribution",
+    "sanitize_in",
+    "sanitize_in_nd_realfloating",
+    "sanitize_in_tensor",
+    "sanitize_lshape",
+    "sanitize_out",
+    "scalar_to_1d",
+]
+
+
+def sanitize_distribution(*args: DNDarray, target: DNDarray, diff_map=None) -> Union[DNDarray, Tuple[DNDarray, ...]]:
+    """Distribute all ``args`` like ``target`` (sanitation.py:32).
+
+    Canonical distribution means matching (split, comm) suffices.
+    """
+    out = []
+    for a in args:
+        sanitize_in(a)
+        if a.split != target.split and a.shape == target.shape:
+            a = a.resplit(target.split)
+        out.append(a)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def sanitize_in(x) -> None:
+    """Assert DNDarray input (sanitation.py:159)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_in_nd_realfloating(x, name: str, allowed_dims: Sequence[int]) -> None:
+    """Check dimensionality + real floating dtype (used by linalg)."""
+    sanitize_in(x)
+    if x.ndim not in allowed_dims:
+        raise ValueError(f"{name} must be {allowed_dims}-dimensional, but is {x.ndim}-dimensional")
+    if not types.heat_type_is_realfloating(x.dtype):
+        raise TypeError(f"{name} must be real floating, got {x.dtype.__name__}")
+
+
+def sanitize_in_tensor(x) -> None:
+    """Assert raw jax array input (sanitation.py:195)."""
+    import jax
+
+    if not isinstance(x, (jax.Array, jnp.ndarray)):
+        raise TypeError(f"input needs to be a jax array, but was {type(x)}")
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Check a local tensor fits the array's chunk (sanitation.py:213)."""
+    tshape = tuple(tensor.shape)
+    if tshape != array.lshape:
+        raise ValueError(f"local tensor must have shape {array.lshape}, got {tshape}")
+
+
+def sanitize_out(
+    out: DNDarray,
+    output_shape: Tuple[int, ...],
+    output_split: Optional[int],
+    output_device,
+    output_comm=None,
+) -> None:
+    """Validate an ``out=`` buffer (sanitation.py:255)."""
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {tuple(out.shape)}")
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Promote a 0-d DNDarray to 1-d (sanitation.py:338)."""
+    if x.ndim != 0:
+        return x
+    return DNDarray.from_dense(x._dense().reshape(1), None, x.device, x.comm)

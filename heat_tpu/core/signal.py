@@ -1,0 +1,75 @@
+"""Signal processing, analog of heat/core/signal.py.
+
+The reference's distributed 1-D convolution (signal.py:16-318) computes
+``halo_size = kernel//2`` neighbor rows via paired Isend/Irecv and, for a
+distributed kernel, Bcasts each rank's kernel chunk in turn while summing
+partial results.  Here the convolution is expressed once on the global
+sharded signal via ``jax.lax.conv_general_dilated``; XLA materializes the
+boundary (halo) exchange between shards over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["convolve"]
+
+
+def convolve(a, v, mode: str = "full") -> DNDarray:
+    """1-D discrete convolution of ``a`` with kernel ``v`` (signal.py:16).
+
+    Modes: 'full' (default), 'same', 'valid'.  ``same`` requires an odd
+    kernel, matching the reference (signal.py:84).
+    """
+    from . import factories, types
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v, comm=a.comm)
+    if a.ndim != 1 or v.ndim != 1:
+        raise ValueError("only 1-dimensional input DNDarrays are allowed")
+    if mode == "same" and v.shape[0] % 2 == 0:
+        raise ValueError("Mode 'same' cannot be used with even-sized kernel")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"Supported modes are 'full', 'same', 'valid', got {mode!r}")
+    if v.shape[0] > a.shape[0]:
+        if mode == "full":
+            a, v = v, a
+        else:
+            raise ValueError("filter size must not be greater than the signal size in mode 'same'/'valid'")
+
+    promoted = types.promote_types(a.dtype, v.dtype)
+    # the conv engine needs a floating compute type; exact (int/bool) inputs
+    # compute in f32 and are rounded back (matching the reference's
+    # cast-through-float behavior, signal.py:200)
+    if types.heat_type_is_exact(promoted):
+        compute_jdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    else:
+        compute_jdt = promoted.jax_type()
+    signal = a._dense().astype(compute_jdt)
+    kernel = v._dense().astype(compute_jdt)
+
+    k = kernel.shape[0]
+    if mode == "full":
+        pad_l = pad_r = k - 1
+    elif mode == "same":
+        pad_l = pad_r = k // 2
+    else:
+        pad_l = pad_r = 0
+    padded = jnp.pad(signal, (pad_l, pad_r))
+    # conv_general_dilated computes correlation; flip the kernel for
+    # convolution semantics
+    lhs = padded[None, None, :]
+    rhs = jnp.flip(kernel)[None, None, :]
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding="VALID",
+        precision=jax.lax.Precision.HIGHEST,
+    )[0, 0]
+    if types.heat_type_is_exact(promoted):
+        out = jnp.round(out)
+    out = out.astype(promoted.jax_type())
+    return DNDarray.from_dense(out, a.split, a.device, a.comm)

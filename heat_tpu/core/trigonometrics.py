@@ -1,0 +1,137 @@
+"""Trigonometric operations, analog of heat/core/trigonometrics.py (24 exports)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import __local_op as _local_op
+from ._operations import __binary_op as _binary_op
+
+__all__ = [
+    "arccos",
+    "acos",
+    "arccosh",
+    "acosh",
+    "arcsin",
+    "asin",
+    "arcsinh",
+    "asinh",
+    "arctan",
+    "atan",
+    "arctan2",
+    "atan2",
+    "arctanh",
+    "atanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def arccos(x, out=None):
+    """Inverse cosine (trigonometrics.py:30)."""
+    return _local_op(jnp.arccos, x, out)
+
+
+acos = arccos
+
+
+def arccosh(x, out=None):
+    """Inverse hyperbolic cosine (trigonometrics.py:66)."""
+    return _local_op(jnp.arccosh, x, out)
+
+
+acosh = arccosh
+
+
+def arcsin(x, out=None):
+    """Inverse sine (trigonometrics.py:102)."""
+    return _local_op(jnp.arcsin, x, out)
+
+
+asin = arcsin
+
+
+def arcsinh(x, out=None):
+    """Inverse hyperbolic sine (trigonometrics.py:138)."""
+    return _local_op(jnp.arcsinh, x, out)
+
+
+asinh = arcsinh
+
+
+def arctan(x, out=None):
+    """Inverse tangent (trigonometrics.py:174)."""
+    return _local_op(jnp.arctan, x, out)
+
+
+atan = arctan
+
+
+def arctan2(t1, t2):
+    """Quadrant-aware arctan(t1/t2) (trigonometrics.py:210)."""
+    return _binary_op(jnp.arctan2, t1, t2)
+
+
+atan2 = arctan2
+
+
+def arctanh(x, out=None):
+    """Inverse hyperbolic tangent (trigonometrics.py:247)."""
+    return _local_op(jnp.arctanh, x, out)
+
+
+atanh = arctanh
+
+
+def cos(x, out=None):
+    """Cosine (trigonometrics.py:283)."""
+    return _local_op(jnp.cos, x, out)
+
+
+def cosh(x, out=None):
+    """Hyperbolic cosine (trigonometrics.py:319)."""
+    return _local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x, out=None):
+    """Degrees to radians (trigonometrics.py:355)."""
+    return _local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None):
+    """Radians to degrees (trigonometrics.py:419)."""
+    return _local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None):
+    """Sine (trigonometrics.py:450)."""
+    return _local_op(jnp.sin, x, out)
+
+
+def sinh(x, out=None):
+    """Hyperbolic sine (trigonometrics.py:486)."""
+    return _local_op(jnp.sinh, x, out)
+
+
+def tan(x, out=None):
+    """Tangent (trigonometrics.py:522)."""
+    return _local_op(jnp.tan, x, out)
+
+
+def tanh(x, out=None):
+    """Hyperbolic tangent (trigonometrics.py:558)."""
+    return _local_op(jnp.tanh, x, out)

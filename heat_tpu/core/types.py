@@ -1,0 +1,492 @@
+"""Type system: a NumPy-style scalar type hierarchy backed by JAX dtypes.
+
+Analog of the reference's heat/core/types.py (hierarchy at types.py:66-415,
+``canonical_heat_type`` :496, ``heat_type_of`` :586, ``can_cast`` :692,
+``promote_types`` :857, ``result_type`` :889, ``finfo``/``iinfo`` :971-1062).
+
+TPU-first deltas from the reference:
+
+* ``bfloat16`` is a first-class public dtype (the reference only smuggles
+  bf16 through DASO transport, dp_optimizer.py:40); it is the preferred
+  matmul dtype on the MXU.
+* The full unsigned family (uint16/32/64) exists (torch lacks it, jnp has it).
+* float64/complex128 require ``jax.config.update("jax_enable_x64", True)``;
+  :func:`enable_x64` is provided. Defaults stay float32/int32 — the native
+  TPU widths.
+
+Instantiating a type casts, exactly like the reference: ``ht.float32(x)``
+returns a DNDarray of that dtype (types.py:237-258).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "datatype",
+    "generic",
+    "number",
+    "bool",
+    "bool_",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int64",
+    "long",
+    "uint8",
+    "ubyte",
+    "uint16",
+    "uint32",
+    "uint64",
+    "floating",
+    "float16",
+    "half",
+    "bfloat16",
+    "float32",
+    "float",
+    "float64",
+    "double",
+    "flexible",
+    "complexfloating",
+    "complex64",
+    "cfloat",
+    "csingle",
+    "complex128",
+    "cdouble",
+    "canonical_heat_type",
+    "heat_type_of",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "heat_type_is_realfloating",
+    "heat_type_is_complexfloating",
+    "issubdtype",
+    "can_cast",
+    "promote_types",
+    "result_type",
+    "iinfo",
+    "finfo",
+    "enable_x64",
+]
+
+
+class datatype:
+    """Base class of the scalar type hierarchy (types.py:66).
+
+    Subclasses are never instantiated as objects; calling one casts data into
+    a DNDarray of that type.
+    """
+
+    _jax_dtype: Any = None
+
+    def __new__(cls, *value, device=None, comm=None):
+        from . import factories
+
+        jdt = cls.jax_type()
+        if jdt is None:
+            raise TypeError(f"cannot instantiate abstract type {cls.__name__}")
+        if len(value) == 0:
+            value = (0,)
+        elif len(value) == 1:
+            value = value[0]
+            if isinstance(value, builtins.complex) and not issubclass(cls, complexfloating):
+                raise TypeError(f"cannot cast complex scalar to {cls.__name__}")
+        return factories.array(value, dtype=cls, device=device, comm=comm)
+
+    @classmethod
+    def jax_type(cls):
+        """The backing jnp dtype (analog of ``datatype.torch_type``, types.py:84)."""
+        return cls._jax_dtype
+
+    @classmethod
+    def char(cls) -> str:
+        """Short dtype name (types.py:92)."""
+        return cls.__name__
+
+    @classmethod
+    def dtype(cls) -> np.dtype:
+        return np.dtype(cls.jax_type())
+
+
+class bool(datatype):
+    """Boolean (types.py:119)."""
+
+    _jax_dtype = jnp.bool_
+
+
+bool_ = bool
+
+
+class number(datatype):
+    """Abstract numeric type (types.py:125)."""
+
+
+class integer(number):
+    """Abstract integer (types.py:131)."""
+
+
+class signedinteger(integer):
+    """Abstract signed integer (types.py:137)."""
+
+
+class unsignedinteger(integer):
+    """Abstract unsigned integer (types.py:143)."""
+
+
+class int8(signedinteger):
+    _jax_dtype = jnp.int8
+
+
+byte = int8
+
+
+class int16(signedinteger):
+    _jax_dtype = jnp.int16
+
+
+short = int16
+
+
+class int32(signedinteger):
+    _jax_dtype = jnp.int32
+
+
+int = int32
+
+
+class int64(signedinteger):
+    _jax_dtype = jnp.int64
+
+
+long = int64
+
+
+class uint8(unsignedinteger):
+    _jax_dtype = jnp.uint8
+
+
+ubyte = uint8
+
+
+class uint16(unsignedinteger):
+    _jax_dtype = jnp.uint16
+
+
+class uint32(unsignedinteger):
+    _jax_dtype = jnp.uint32
+
+
+class uint64(unsignedinteger):
+    _jax_dtype = jnp.uint64
+
+
+class floating(number):
+    """Abstract float (types.py:149)."""
+
+
+class float16(floating):
+    _jax_dtype = jnp.float16
+
+
+half = float16
+
+
+class bfloat16(floating):
+    """Brain float — first-class here; TPU MXU native."""
+
+    _jax_dtype = jnp.bfloat16
+
+
+class float32(floating):
+    _jax_dtype = jnp.float32
+
+
+float = float32
+
+
+class float64(floating):
+    _jax_dtype = jnp.float64
+
+
+double = float64
+
+
+class flexible(datatype):
+    """Abstract flexible type, kept for hierarchy parity (types.py:155)."""
+
+
+class complexfloating(number):
+    """Abstract complex (types.py:161)."""
+
+
+class complex64(complexfloating):
+    _jax_dtype = jnp.complex64
+
+
+cfloat = complex64
+csingle = complex64
+
+
+class complex128(complexfloating):
+    _jax_dtype = jnp.complex128
+
+
+cdouble = complex128
+
+
+# ----------------------------------------------------------------------
+# lookup tables
+# ----------------------------------------------------------------------
+_CONCRETE: Tuple[Type[datatype], ...] = (
+    bool,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+)
+
+__type_mappings = {}
+for _t in _CONCRETE:
+    __type_mappings[_t] = _t
+    __type_mappings[np.dtype(_t.jax_type())] = _t
+    __type_mappings[np.dtype(_t.jax_type()).name] = _t
+# python builtins / canonical aliases (types.py:418-496)
+__type_mappings.update(
+    {
+        builtins.bool: bool,
+        builtins.int: int32,
+        builtins.float: float32,
+        builtins.complex: complex64,
+        np.bool_: bool,
+        "bool": bool,
+        "int": int32,
+        "float": float32,
+        "complex": complex64,
+    }
+)
+for _np_t in (np.int8, np.int16, np.int32, np.int64, np.uint8, np.uint16, np.uint32, np.uint64, np.float16, np.float32, np.float64, np.complex64, np.complex128):
+    __type_mappings[_np_t] = __type_mappings[np.dtype(_np_t)]
+
+
+def canonical_heat_type(a_type: Union[str, Type[datatype], Any]) -> Type[datatype]:
+    """Resolve any dtype-ish object to the canonical heat type (types.py:496)."""
+    if isinstance(a_type, type) and issubclass(a_type, datatype):
+        if a_type.jax_type() is None:
+            raise TypeError(f"data type {a_type.__name__!r} is abstract")
+        return a_type
+    try:
+        return __type_mappings[a_type]
+    except (KeyError, TypeError):
+        pass
+    try:
+        return __type_mappings[np.dtype(a_type)]
+    except (KeyError, TypeError):
+        pass
+    # jax weak types / dtype objects like jnp.bfloat16
+    try:
+        return __type_mappings[np.dtype(jnp.dtype(a_type)).name]
+    except Exception:
+        raise TypeError(f"data type {a_type!r} is not understood")
+
+
+def heat_type_of(obj: Any) -> Type[datatype]:
+    """Infer the heat type of an arbitrary object (types.py:586)."""
+    from .dndarray import DNDarray
+
+    if isinstance(obj, DNDarray):
+        return obj.dtype
+    if isinstance(obj, (jnp.ndarray, jax.Array, np.ndarray)):
+        return canonical_heat_type(obj.dtype)
+    if hasattr(obj, "dtype"):
+        return canonical_heat_type(obj.dtype)
+    if isinstance(obj, builtins.bool):
+        return bool
+    if isinstance(obj, builtins.int):
+        return int32
+    if isinstance(obj, builtins.float):
+        return float32
+    if isinstance(obj, builtins.complex):
+        return complex64
+    if isinstance(obj, (list, tuple)):
+        return canonical_heat_type(np.asarray(obj).dtype)
+    raise TypeError(f"data type of {obj!r} is not understood")
+
+
+def issubdtype(arg1, arg2) -> builtins.bool:
+    """NumPy-style subtype check on the heat hierarchy (types.py:666)."""
+    if not (isinstance(arg1, type) and issubclass(arg1, datatype)):
+        arg1 = canonical_heat_type(arg1)
+    if not (isinstance(arg2, type) and issubclass(arg2, datatype)):
+        arg2 = canonical_heat_type(arg2)
+    return issubclass(arg1, arg2)
+
+
+generic = datatype
+
+
+def heat_type_is_exact(ht_dtype) -> builtins.bool:
+    """True for bool/integer types (types.py:640)."""
+    return issubclass(canonical_heat_type(ht_dtype), (integer, bool))
+
+
+def heat_type_is_inexact(ht_dtype) -> builtins.bool:
+    """True for floating/complex types (types.py:653)."""
+    return issubclass(canonical_heat_type(ht_dtype), (floating, complexfloating))
+
+
+def heat_type_is_realfloating(ht_dtype) -> builtins.bool:
+    return issubclass(canonical_heat_type(ht_dtype), floating)
+
+
+def heat_type_is_complexfloating(ht_dtype) -> builtins.bool:
+    return issubclass(canonical_heat_type(ht_dtype), complexfloating)
+
+
+# ----------------------------------------------------------------------
+# casting rules (types.py:692-969)
+# ----------------------------------------------------------------------
+_KIND = {
+    bool: "b",
+    int8: "i",
+    int16: "i",
+    int32: "i",
+    int64: "i",
+    uint8: "u",
+    uint16: "u",
+    uint32: "u",
+    uint64: "u",
+    float16: "f",
+    bfloat16: "f",
+    float32: "f",
+    float64: "f",
+    complex64: "c",
+    complex128: "c",
+}
+# np-compatible stand-ins for safe-cast queries (bfloat16 behaves like a
+# 16-bit float with float32's exponent; for "safe" purposes it can be cast
+# safely to float32+ like float16 can)
+_NP_PROXY = {bfloat16: np.float16}
+
+
+def can_cast(from_, to, casting: str = "intuitive") -> builtins.bool:
+    """Casting admissibility (types.py:692).
+
+    Supports the reference's modes: 'no', 'safe', 'same_kind', 'unsafe' and
+    its default 'intuitive' (= same_kind, but bool may only go up).
+    """
+    frm = canonical_heat_type(from_ if not _is_scalar(from_) else heat_type_of(from_))
+    to_t = canonical_heat_type(to)
+    if casting == "no":
+        return frm is to_t
+    if casting == "unsafe":
+        return True
+    np_f = np.dtype(_NP_PROXY.get(frm, frm.jax_type()))
+    np_t = np.dtype(_NP_PROXY.get(to_t, to_t.jax_type()))
+    if casting == "safe":
+        # bfloat16 <-> float16 are not safely interconvertible
+        if frm is bfloat16 and to_t is float16 or frm is float16 and to_t is bfloat16:
+            return False
+        return np.can_cast(np_f, np_t, casting="safe")
+    if casting in ("same_kind", "intuitive"):
+        ok = np.can_cast(np_f, np_t, casting="same_kind")
+        if casting == "intuitive" and _KIND[frm] == "b" and _KIND[to_t] == "b":
+            return True
+        return ok
+    raise ValueError(f"casting must be one of 'no', 'safe', 'same_kind', 'unsafe', 'intuitive', got {casting!r}")
+
+
+def _is_scalar(x) -> builtins.bool:
+    return isinstance(x, (builtins.bool, builtins.int, builtins.float, builtins.complex))
+
+
+def promote_types(type1, type2) -> Type[datatype]:
+    """Smallest type to which both can be safely cast (types.py:857).
+
+    Delegates to jnp's promotion lattice, which natively handles bfloat16
+    (bf16 + f16 -> f32, bf16 + f32 -> f32, ...).
+    """
+    t1 = canonical_heat_type(type1)
+    t2 = canonical_heat_type(type2)
+    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+
+
+def result_type(*arrays_and_types) -> Type[datatype]:
+    """Result type of an operation over the given operands (types.py:889)."""
+    from .dndarray import DNDarray
+
+    args = []
+    for a in arrays_and_types:
+        if isinstance(a, DNDarray):
+            args.append(np.dtype(a.dtype.jax_type()))
+        elif isinstance(a, type) and issubclass(a, datatype):
+            args.append(np.dtype(a.jax_type()))
+        elif _is_scalar(a):
+            args.append(a)
+        else:
+            try:
+                args.append(np.dtype(canonical_heat_type(a).jax_type()))
+            except TypeError:
+                args.append(a)
+    return canonical_heat_type(jnp.result_type(*args))
+
+
+class iinfo:
+    """Integer type info (types.py:971)."""
+
+    def __init__(self, dtype):
+        t = canonical_heat_type(dtype)
+        info = jnp.iinfo(t.jax_type())
+        self.bits = info.bits
+        self.min = info.min
+        self.max = info.max
+        self.dtype = t
+
+    def __repr__(self) -> str:
+        return f"iinfo(min={self.min}, max={self.max}, dtype={self.dtype.__name__})"
+
+
+class finfo:
+    """Float type info (types.py:1019)."""
+
+    def __init__(self, dtype):
+        t = canonical_heat_type(dtype)
+        info = jnp.finfo(t.jax_type())
+        self.bits = info.bits
+        self.eps = builtins.float(info.eps)
+        self.max = builtins.float(info.max)
+        self.min = builtins.float(info.min)
+        self.tiny = builtins.float(info.tiny)
+        self.resolution = builtins.float(getattr(info, "resolution", info.eps))
+        self.dtype = t
+
+    def __repr__(self) -> str:
+        return f"finfo(resolution={self.resolution}, min={self.min}, max={self.max}, dtype={self.dtype.__name__})"
+
+
+def enable_x64(enable: builtins.bool = True) -> None:
+    """Enable 64-bit dtypes (float64/complex128/int64 default semantics).
+
+    TPU MXU has no native f64; this exists for numerical-parity testing
+    against NumPy ground truth (SURVEY.md §7 decision 4).
+    """
+    jax.config.update("jax_enable_x64", enable)

@@ -1,0 +1,19 @@
+"""Mesh/communication layer (the TPU-native analog of heat/core/communication.py)."""
+
+from .comm import (
+    Communication,
+    WORLD,
+    SELF,
+    get_comm,
+    sanitize_comm,
+    use_comm,
+)
+
+__all__ = [
+    "Communication",
+    "WORLD",
+    "SELF",
+    "get_comm",
+    "sanitize_comm",
+    "use_comm",
+]

@@ -1,0 +1,285 @@
+"""TPU-native communication layer: device meshes instead of MPI communicators.
+
+This is the equivalent of the reference's L1 layer
+(``heat/core/communication.py``, ``Communication`` ABC at
+communication.py:84-113 and ``MPICommunication`` at :116).  Instead of
+wrapping an ``MPI.Comm`` and hand-writing Allreduce/Allgather/Alltoall over
+mpi4py buffers, a :class:`Communication` here wraps a 1-D
+:class:`jax.sharding.Mesh` over a set of devices.  Collective communication
+is never issued explicitly by the ops layer: arrays carry
+:class:`jax.sharding.NamedSharding` metadata and XLA/GSPMD inserts the
+collectives (psum/all-gather/all-to-all/collective-permute) over ICI/DCN.
+Explicit collectives (for halo exchanges, ring algorithms, TS-QR merge
+trees) are exposed as thin ``jax.lax`` wrappers intended for use inside
+``jax.shard_map`` bodies.
+
+Key translations from the reference:
+
+* ``MPI_WORLD``/``MPI_SELF`` (communication.py:2204-2205) -> :data:`WORLD`
+  (a mesh over all devices) / :data:`SELF` (a single-device mesh).
+* ``MPICommunication.chunk`` (communication.py:157-214), which computes the
+  (offset, local shape, slices) of one rank's block -> :meth:`Communication.chunk`,
+  which computes the same for the *canonical padded* distribution used by
+  this framework (see below).
+* ``Split()`` (communication.py:481) -> :meth:`Communication.split`,
+  returning a sub-mesh communication.
+* dtype/buffer bridges (communication.py:126-139, :258-333) -> gone; XLA
+  owns layout and transport.
+
+Canonical distribution (pad-and-mask)
+-------------------------------------
+XLA wants equal per-device shards, while the reference's ``chunk()`` hands
+out ragged remainder chunks.  We therefore define the canonical distribution
+of a global shape ``g`` split along axis ``s`` over ``n`` devices as: pad
+``g[s]`` up to the next multiple of ``n``, shard evenly, and keep the true
+(unpadded) global shape as metadata.  Real data is a contiguous prefix;
+padding is a suffix owned by the highest ranks.  Consumers that reduce or
+contract across the split axis mask the padding with their own neutral
+element.  For divisible shapes (the common case) no padding exists and no
+masking cost is paid.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "Communication",
+    "WORLD",
+    "SELF",
+    "get_comm",
+    "sanitize_comm",
+    "use_comm",
+]
+
+#: Name of the mesh axis used for the (single) split dimension, mirroring the
+#: reference's one-split-axis model (SURVEY.md L2).
+SPLIT_AXIS_NAME = "split"
+
+
+class Communication:
+    """A communication context: an ordered set of devices forming a 1-D mesh.
+
+    Plays the role of the reference's ``MPICommunication``
+    (communication.py:116): it defines how a global array is laid out across
+    participants and provides the collective primitives.  ``size`` is the
+    number of devices in the mesh (the analog of the number of MPI ranks);
+    ``rank`` is the index of the calling *process* (0 in single-controller
+    mode, where one Python program drives every device).
+    """
+
+    def __init__(
+        self,
+        devices: Optional[Sequence] = None,
+        axis_name: str = SPLIT_AXIS_NAME,
+    ):
+        if devices is None:
+            devices = jax.devices()
+        self._devices: List = list(devices)
+        self.axis_name = axis_name
+        self._mesh = Mesh(np.asarray(self._devices, dtype=object), (axis_name,))
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        """The underlying 1-D :class:`jax.sharding.Mesh`."""
+        return self._mesh
+
+    @property
+    def devices(self) -> List:
+        return list(self._devices)
+
+    @property
+    def size(self) -> int:
+        """Number of participants (devices), analog of ``MPI.Comm.size``."""
+        return len(self._devices)
+
+    @property
+    def rank(self) -> int:
+        """Index of the calling process (``jax.process_index``).
+
+        In the reference every MPI rank runs its own Python interpreter; in
+        single-controller JAX one process sees all devices, so ``rank`` is 0
+        and per-device data is accessed positionally (see
+        ``DNDarray.lshape_map``).
+        """
+        return jax.process_index()
+
+    @property
+    def is_distributed(self) -> bool:
+        """Analog of ``Communication.is_distributed`` (communication.py:95)."""
+        return self.size > 1
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Communication)
+            and self._devices == other._devices
+            and self.axis_name == other.axis_name
+        )
+
+    def __hash__(self) -> int:
+        return hash((tuple(id(d) for d in self._devices), self.axis_name))
+
+    def __repr__(self) -> str:
+        plat = self._devices[0].platform if self._devices else "?"
+        return f"Communication(size={self.size}, platform={plat!r})"
+
+    # ------------------------------------------------------------------
+    # sharding / chunking policy
+    # ------------------------------------------------------------------
+    def sharding(self, split: Optional[int], ndim: Optional[int] = None) -> NamedSharding:
+        """NamedSharding for an array split along ``split`` (None=replicated)."""
+        if split is None:
+            spec = PartitionSpec()
+        else:
+            spec = PartitionSpec(*((None,) * split), self.axis_name)
+        return NamedSharding(self._mesh, spec)
+
+    def pad_amount(self, extent: int) -> int:
+        """Padding needed to make ``extent`` divisible by ``size``."""
+        return (-extent) % self.size
+
+    def padded_extent(self, extent: int) -> int:
+        return extent + self.pad_amount(extent)
+
+    def chunk(
+        self, shape: Sequence[int], split: Optional[int], rank: Optional[int] = None
+    ) -> Tuple[int, Tuple[int, ...], Tuple[slice, ...]]:
+        """Compute one participant's block of the canonical distribution.
+
+        Returns ``(offset, local_shape, slices)`` like the reference's
+        ``MPICommunication.chunk`` (communication.py:157-214).  Unlike the
+        reference — which spreads the remainder over the low ranks — the
+        canonical distribution here gives every participant
+        ``ceil(extent / size)`` rows with trailing padding, so the *true*
+        local shape of high ranks may be smaller or zero.
+        """
+        shape = tuple(int(s) for s in shape)
+        if split is None:
+            return 0, shape, tuple(slice(0, s) for s in shape)
+        rank = self.rank if rank is None else rank
+        extent = shape[split]
+        per = self.padded_extent(extent) // self.size
+        start = min(rank * per, extent)
+        stop = min(start + per, extent)
+        lshape = shape[:split] + (stop - start,) + shape[split + 1 :]
+        slices = tuple(
+            slice(start, stop) if dim == split else slice(0, s)
+            for dim, s in enumerate(shape)
+        )
+        return start, lshape, slices
+
+    def lshape_map(self, shape: Sequence[int], split: Optional[int]) -> np.ndarray:
+        """(size, ndim) array of true local shapes per participant.
+
+        Analog of ``DNDarray.lshape_map`` (dndarray.py:304) but computed
+        purely from metadata — no communication is ever required because the
+        canonical distribution is a pure function of (shape, split, size).
+        """
+        shape = tuple(int(s) for s in shape)
+        out = np.empty((self.size, max(len(shape), 1)), dtype=np.int64)
+        for r in range(self.size):
+            _, lshape, _ = self.chunk(shape, split, rank=r)
+            out[r, : len(shape)] = lshape
+        return out[:, : len(shape)]
+
+    def counts_displs_shape(
+        self, shape: Sequence[int], axis: int
+    ) -> Tuple[Tuple[int, ...], Tuple[int, ...], Tuple[int, ...]]:
+        """Counts/displacements along ``axis``, analog of
+        communication.py:216-244 (used there to build Allgatherv/Scatterv
+        calls; kept here for lshape bookkeeping and io slab reads)."""
+        counts = []
+        displs = []
+        for r in range(self.size):
+            off, lsh, _ = self.chunk(shape, axis, rank=r)
+            counts.append(lsh[axis])
+            displs.append(off)
+        _, lshape, _ = self.chunk(shape, axis, rank=self.rank)
+        return tuple(counts), tuple(displs), tuple(lshape)
+
+    # ------------------------------------------------------------------
+    # sub-communicators
+    # ------------------------------------------------------------------
+    def split(self, color_ranks: Sequence[int], axis_name: Optional[str] = None) -> "Communication":
+        """Sub-communication over a subset of devices.
+
+        Analog of ``MPICommunication.Split`` (communication.py:481): instead
+        of a color/key pair, the caller names the member device indices
+        directly (SPMD single-controller has global knowledge).
+        """
+        devs = [self._devices[i] for i in color_ranks]
+        return Communication(devs, axis_name or self.axis_name)
+
+    # ------------------------------------------------------------------
+    # explicit collectives — for use inside jax.shard_map bodies only.
+    # The ops layer almost never needs these; GSPMD infers communication
+    # from shardings.  They exist for halo exchange, ring algorithms and
+    # merge trees (TS-QR / hSVD), replacing the reference's hand-written
+    # Send/Recv/Allreduce/... (communication.py:494-2186).
+    # ------------------------------------------------------------------
+    def psum(self, x, axis_name: Optional[str] = None):
+        return jax.lax.psum(x, axis_name or self.axis_name)
+
+    def pmax(self, x, axis_name: Optional[str] = None):
+        return jax.lax.pmax(x, axis_name or self.axis_name)
+
+    def pmin(self, x, axis_name: Optional[str] = None):
+        return jax.lax.pmin(x, axis_name or self.axis_name)
+
+    def all_gather(self, x, axis: int = 0, axis_name: Optional[str] = None, tiled: bool = True):
+        return jax.lax.all_gather(x, axis_name or self.axis_name, axis=axis, tiled=tiled)
+
+    def all_to_all(self, x, split_axis: int, concat_axis: int, axis_name: Optional[str] = None):
+        return jax.lax.all_to_all(
+            x, axis_name or self.axis_name, split_axis=split_axis,
+            concat_axis=concat_axis, tiled=True,
+        )
+
+    def ppermute(self, x, perm, axis_name: Optional[str] = None):
+        return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
+
+    def ring_shift(self, x, shift: int = 1, axis_name: Optional[str] = None):
+        """Cyclic shift by ``shift`` ranks (the ring primitive behind the
+        reference's spatial ring in distance.py:209 and roll)."""
+        n = self.size
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
+
+    def axis_index(self, axis_name: Optional[str] = None):
+        return jax.lax.axis_index(axis_name or self.axis_name)
+
+
+# ----------------------------------------------------------------------
+# module-level default communications, mirroring communication.py:2204-2251
+# ----------------------------------------------------------------------
+WORLD = Communication()
+SELF = Communication(jax.devices()[:1])
+
+__default_comm = WORLD
+
+
+def get_comm() -> Communication:
+    """The current default communication (communication.py:2211)."""
+    return __default_comm
+
+
+def sanitize_comm(comm: Optional[Communication]) -> Communication:
+    """Validate ``comm`` or fall back to the default (communication.py:2224)."""
+    if comm is None:
+        return get_comm()
+    if not isinstance(comm, Communication):
+        raise TypeError(f"Unknown communication, must be instance of Communication, got {type(comm)}")
+    return comm
+
+
+def use_comm(comm: Optional[Communication] = None) -> None:
+    """Set the default communication (communication.py:2241)."""
+    global __default_comm
+    __default_comm = sanitize_comm(comm)

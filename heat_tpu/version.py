@@ -1,0 +1,18 @@
+"""Version information for heat_tpu.
+
+Mirrors the role of the reference's heat/core/version.py:1-17.
+"""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro (patch) version number."""
+extension: str = "dev"
+"""Pre-release qualifier."""
+
+if not extension:
+    __version__ = f"{major}.{minor}.{micro}"
+else:
+    __version__ = f"{major}.{minor}.{micro}-{extension}"

@@ -1,0 +1,164 @@
+"""Smoke + core-runtime tests: comm, factories, DNDarray metadata,
+pad-and-mask correctness on non-divisible extents (the analog of the
+reference's mpirun -n 3 remainder coverage)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_devices_present():
+    import jax
+
+    assert len(jax.devices()) == 8
+    assert ht.get_comm().size == 8
+
+
+def test_smoke_arange_split0():
+    # BASELINE config 1: ht.arange(10, split=0) on a device mesh
+    a = ht.arange(10, split=0)
+    assert a.shape == (10,)
+    assert a.split == 0
+    assert a.dtype == ht.int32
+    np.testing.assert_array_equal(a.numpy(), np.arange(10, dtype=np.int32))
+
+
+def test_comm_chunk():
+    comm = ht.get_comm()
+    # 10 elements over 8 devices: padded to 16, 2 per rank; ranks 5-7 hold
+    # padding only
+    off, lshape, _ = comm.chunk((10,), 0, rank=0)
+    assert (off, lshape) == (0, (2,))
+    off, lshape, _ = comm.chunk((10,), 0, rank=4)
+    assert (off, lshape) == (8, (2,))
+    off, lshape, _ = comm.chunk((10,), 0, rank=5)
+    assert lshape == (0,)
+
+
+def test_lshape_map():
+    a = ht.arange(10, split=0)
+    lmap = a.lshape_map
+    assert lmap.shape == (8, 1)
+    assert lmap[:, 0].sum() == 10
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_factories_match_numpy(split):
+    for fn, np_fn in [(ht.zeros, np.zeros), (ht.ones, np.ones)]:
+        a = fn((5, 7), split=split)
+        np.testing.assert_array_equal(a.numpy(), np_fn((5, 7), dtype=np.float32))
+        assert a.split == split
+        assert a.dtype == ht.float32
+
+
+def test_full_eye_linspace():
+    np.testing.assert_array_equal(ht.full((3, 5), 7, split=0).numpy(), np.full((3, 5), 7))
+    np.testing.assert_array_equal(ht.eye(5, split=1).numpy(), np.eye(5, dtype=np.float32))
+    np.testing.assert_allclose(
+        ht.linspace(0, 1, 11, split=0).numpy(), np.linspace(0, 1, 11, dtype=np.float32), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        ht.logspace(0, 2, 5).numpy(), np.logspace(0, 2, 5), rtol=1e-5
+    )
+
+
+def test_array_is_split_roundtrip():
+    data = np.arange(12.0).reshape(3, 4)
+    a = ht.array(data, is_split=0)
+    np.testing.assert_array_equal(a.numpy(), data)
+    assert a.split == 0
+
+
+@pytest.mark.parametrize("n", [8, 10, 13])  # divisible, uneven, prime
+def test_pad_and_mask_sum(n):
+    a = ht.arange(n, dtype=ht.float32, split=0)
+    assert float(a.sum()) == float(np.arange(n).sum())
+    assert float(a.prod()) == pytest.approx(float(np.arange(n).prod()), rel=1e-6)
+
+
+def test_resplit_roundtrip():
+    data = np.arange(30.0).reshape(5, 6)
+    a = ht.array(data, split=0)
+    a2 = a.resplit(1)
+    assert a2.split == 1
+    np.testing.assert_array_equal(a2.numpy(), data)
+    a3 = a2.resplit(None)
+    assert a3.split is None
+    np.testing.assert_array_equal(a3.numpy(), data)
+    a.resplit_(1)
+    assert a.split == 1
+    np.testing.assert_array_equal(a.numpy(), data)
+
+
+def test_astype_and_types():
+    a = ht.arange(5, split=0)
+    b = a.astype(ht.float64)
+    assert b.dtype == ht.float64
+    assert ht.promote_types(ht.int32, ht.float32) == ht.float32
+    assert ht.promote_types(ht.bfloat16, ht.float32) == ht.float32
+    assert ht.result_type(a, 1.0) in (ht.float32, ht.float64)
+    assert ht.canonical_heat_type("float32") == ht.float32
+    assert ht.issubdtype(ht.int32, ht.integer)
+    assert not ht.issubdtype(ht.float32, ht.integer)
+    assert ht.can_cast(ht.int32, ht.float64)
+    assert not ht.can_cast(ht.float64, ht.int32, casting="safe")
+    info = ht.finfo(ht.bfloat16)
+    assert info.bits == 16
+
+
+def test_dtype_instantiation_casts():
+    a = ht.float32([1, 2, 3])
+    assert a.dtype == ht.float32
+    np.testing.assert_array_equal(a.numpy(), np.array([1, 2, 3], dtype=np.float32))
+
+
+def test_item_and_scalars():
+    a = ht.array(42)
+    assert a.item() == 42
+    assert int(ht.array([5])[0]) == 5
+
+
+def test_getitem_setitem():
+    data = np.arange(24.0).reshape(4, 6)
+    for split in (None, 0, 1):
+        a = ht.array(data, split=split)
+        np.testing.assert_array_equal(a[1].numpy(), data[1])
+        np.testing.assert_array_equal(a[:, 2].numpy(), data[:, 2])
+        np.testing.assert_array_equal(a[1:3, ::2].numpy(), data[1:3, ::2])
+        np.testing.assert_array_equal(a[a > 10].numpy(), data[data > 10])
+        b = ht.array(data.copy(), split=split)
+        b[0] = 0.0
+        expected = data.copy()
+        expected[0] = 0
+        np.testing.assert_array_equal(b.numpy(), expected)
+
+
+def test_partitioned_protocol():
+    a = ht.arange(16, split=0)
+    p = a.__partitioned__
+    assert p["shape"] == (16,)
+    assert len(p["partitions"]) == 8
+    b = ht.from_partition_dict(
+        {
+            "shape": (4,),
+            "partition_tiling": (1,),
+            "partitions": {(0,): {"data": np.arange(4), "start": (0,), "shape": (4,), "location": [0]}},
+        }
+    )
+    np.testing.assert_array_equal(b.numpy(), np.arange(4))
+
+
+def test_repr_smoke():
+    s = repr(ht.arange(5, split=0))
+    assert "DNDarray" in s and "split=0" in s
+
+
+def test_transpose_padded():
+    data = np.arange(30.0).reshape(5, 6)
+    for split in (None, 0, 1):
+        a = ht.array(data, split=split)
+        t = a.T
+        np.testing.assert_array_equal(t.numpy(), data.T)
+        if split is not None:
+            assert t.split == 1 - split

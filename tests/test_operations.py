@@ -1,0 +1,165 @@
+"""Ops-layer tests: arithmetics/trig/exp/rounding/relational/logical over
+the split sweep (reference idiom: test_arithmetics.py etc.)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(42)
+    return rng.standard_normal((6, 10)).astype(np.float32)  # 6, 10: uneven over 8
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_binary_ops(data, split):
+    other = (data * 2 + 1).astype(np.float32)
+    a = ht.array(data, split=split)
+    b = ht.array(other, split=split)
+    np.testing.assert_allclose((a + b).numpy(), data + other, rtol=1e-6)
+    np.testing.assert_allclose((a - b).numpy(), data - other, rtol=1e-6)
+    np.testing.assert_allclose((a * b).numpy(), data * other, rtol=1e-6)
+    np.testing.assert_allclose((a / b).numpy(), data / other, rtol=1e-5)
+    np.testing.assert_allclose(ht.pow(a, 2).numpy(), data**2, rtol=1e-5)
+    np.testing.assert_allclose((a + 1.5).numpy(), data + 1.5, rtol=1e-6)
+    np.testing.assert_allclose((2.0 - a).numpy(), 2.0 - data, rtol=1e-6)
+
+
+def test_binary_mixed_splits(data):
+    a = ht.array(data, split=0)
+    b = ht.array(data, split=1)
+    np.testing.assert_allclose((a + b).numpy(), data + data, rtol=1e-6)
+
+
+def test_binary_broadcast(data):
+    a = ht.array(data, split=0)
+    row = np.arange(10, dtype=np.float32)
+    b = ht.array(row)
+    np.testing.assert_allclose((a + b).numpy(), data + row, rtol=1e-6)
+    col = np.arange(6, dtype=np.float32)[:, None]
+    c = ht.array(col, split=0)
+    np.testing.assert_allclose((a * c).numpy(), data * col, rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_reductions(data, split, axis):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(ht.sum(a, axis=axis).numpy(), data.sum(axis=axis), rtol=1e-5)
+    np.testing.assert_allclose(ht.max(a, axis=axis).numpy(), data.max(axis=axis), rtol=1e-6)
+    np.testing.assert_allclose(ht.min(a, axis=axis).numpy(), data.min(axis=axis), rtol=1e-6)
+    np.testing.assert_allclose(ht.mean(a, axis=axis).numpy(), data.mean(axis=axis), rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_reduction_keepdims_and_prod(data, split):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(
+        ht.sum(a, axis=1, keepdims=True).numpy(), data.sum(axis=1, keepdims=True), rtol=1e-5
+    )
+    small = np.abs(data[:2, :3]) + 0.5
+    b = ht.array(small, split=split if split != 1 else 1)
+    np.testing.assert_allclose(ht.prod(b).numpy(), small.prod(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("axis", [0, 1])
+def test_cum_ops(data, split, axis):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(ht.cumsum(a, axis).numpy(), data.cumsum(axis=axis), rtol=1e-4, atol=1e-5)
+    assert ht.cumsum(a, axis).split == split
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_unary_ops(data, split):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(ht.exp(a).numpy(), np.exp(data), rtol=1e-5)
+    np.testing.assert_allclose(ht.sin(a).numpy(), np.sin(data), rtol=1e-5)
+    np.testing.assert_allclose(ht.tanh(a).numpy(), np.tanh(data), rtol=1e-5)
+    np.testing.assert_allclose(ht.floor(a).numpy(), np.floor(data))
+    np.testing.assert_allclose(ht.ceil(a).numpy(), np.ceil(data))
+    np.testing.assert_allclose(ht.abs(a).numpy(), np.abs(data), rtol=1e-6)
+    np.testing.assert_allclose(ht.sqrt(ht.abs(a)).numpy(), np.sqrt(np.abs(data)), rtol=1e-6)
+    np.testing.assert_allclose(ht.log(ht.abs(a) + 1).numpy(), np.log(np.abs(data) + 1), rtol=1e-5)
+
+
+def test_int_float_cast_local_op():
+    a = ht.arange(5, split=0)  # int32
+    assert ht.sin(a).dtype == ht.float32
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_relational_logical(data, split):
+    a = ht.array(data, split=split)
+    b = ht.array(np.zeros_like(data), split=split)
+    np.testing.assert_array_equal((a > b).numpy(), data > 0)
+    np.testing.assert_array_equal((a <= b).numpy(), data <= 0)
+    np.testing.assert_array_equal((a == a).numpy(), np.ones_like(data, dtype=bool))
+    assert ht.equal(a, a)
+    assert not ht.equal(a, b)
+    assert bool(ht.any(a > 100)) is False
+    assert bool(ht.all(ht.abs(a) < 100)) is True
+    np.testing.assert_array_equal(ht.all(a > 0, axis=0).numpy(), (data > 0).all(axis=0))
+    np.testing.assert_array_equal(ht.any(a > 0, axis=1).numpy(), (data > 0).any(axis=1))
+
+
+def test_isnan_isinf():
+    x = np.array([1.0, np.nan, np.inf, -np.inf], dtype=np.float32)
+    a = ht.array(x, split=0)
+    np.testing.assert_array_equal(ht.isnan(a).numpy(), np.isnan(x))
+    np.testing.assert_array_equal(ht.isinf(a).numpy(), np.isinf(x))
+    np.testing.assert_array_equal(ht.isfinite(a).numpy(), np.isfinite(x))
+    assert bool(ht.allclose(a, a, equal_nan=True))
+    np.testing.assert_allclose(ht.nansum(a[:2]).numpy(), 1.0)
+
+
+def test_bitwise_int_guard():
+    a = ht.arange(8, split=0)
+    b = ht.ones(8, dtype=ht.int32, split=0)
+    np.testing.assert_array_equal(ht.bitwise_and(a, b).numpy(), np.arange(8) & 1)
+    with pytest.raises(TypeError):
+        ht.bitwise_and(ht.ones(4), ht.ones(4))
+
+
+def test_mod_floordiv():
+    x = np.array([5, -5, 7, -7], dtype=np.int32)
+    y = np.array([3, 3, -3, -3], dtype=np.int32)
+    a, b = ht.array(x, split=0), ht.array(y, split=0)
+    np.testing.assert_array_equal(ht.mod(a, b).numpy(), np.mod(x, y))
+    np.testing.assert_array_equal(ht.fmod(a, b).numpy(), np.fmod(x, y))
+    np.testing.assert_array_equal(ht.floordiv(a, b).numpy(), x // y)
+
+
+def test_diff():
+    x = np.array([1.0, 3.0, 6.0, 10.0], dtype=np.float32)
+    a = ht.array(x, split=0)
+    np.testing.assert_allclose(ht.diff(a).numpy(), np.diff(x))
+    np.testing.assert_allclose(ht.diff(a, n=2).numpy(), np.diff(x, n=2))
+
+
+def test_inplace_ops(data):
+    a = ht.array(data.copy(), split=0)
+    a += 1.0
+    np.testing.assert_allclose(a.numpy(), data + 1.0, rtol=1e-6)
+    a *= 2.0
+    np.testing.assert_allclose(a.numpy(), (data + 1.0) * 2, rtol=1e-6)
+
+
+def test_out_param(data):
+    a = ht.array(data, split=0)
+    out = ht.zeros_like(a)
+    res = ht.add(a, a, out=out)
+    assert res is out
+    np.testing.assert_allclose(out.numpy(), data * 2, rtol=1e-6)
+
+
+def test_where_param(data):
+    a = ht.array(data, split=0)
+    cond = ht.array(data > 0, split=0)
+    res = ht.add(a, 1.0, where=cond)
+    expected = np.where(data > 0, data + 1.0, 0.0)
+    np.testing.assert_allclose(res.numpy(), expected, rtol=1e-6)

@@ -1,0 +1,178 @@
+"""Statistics + manipulations split-sweep tests (reference:
+test_statistics.py, test_manipulations.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((6, 10)).astype(np.float32)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_argminmax_var_std(data, split, axis):
+    a = ht.array(data, split=split)
+    np.testing.assert_array_equal(ht.argmax(a, axis=axis).numpy(), data.argmax(axis=axis))
+    np.testing.assert_array_equal(ht.argmin(a, axis=axis).numpy(), data.argmin(axis=axis))
+    np.testing.assert_allclose(ht.var(a, axis=axis).numpy(), data.var(axis=axis), rtol=1e-4)
+    np.testing.assert_allclose(ht.std(a, axis=axis).numpy(), data.std(axis=axis), rtol=1e-4)
+    np.testing.assert_allclose(
+        ht.var(a, axis=axis, ddof=1).numpy(), data.var(axis=axis, ddof=1), rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_statistics_misc(data, split):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(ht.median(a).numpy(), np.median(data), rtol=1e-5)
+    np.testing.assert_allclose(
+        ht.percentile(a, 30.0).numpy(), np.percentile(data, 30.0), rtol=1e-4
+    )
+    np.testing.assert_allclose(ht.average(a).numpy(), np.average(data), rtol=1e-5)
+    w = np.arange(1.0, 11.0, dtype=np.float32)
+    np.testing.assert_allclose(
+        ht.average(a, axis=1, weights=ht.array(w)).numpy(), np.average(data, axis=1, weights=w), rtol=1e-5
+    )
+    np.testing.assert_allclose(ht.maximum(a, -a).numpy(), np.maximum(data, -data))
+    np.testing.assert_allclose(ht.minimum(a, -a).numpy(), np.minimum(data, -data))
+
+
+def test_bincount_digitize_histogram():
+    x = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int32)
+    a = ht.array(x, split=0)
+    np.testing.assert_array_equal(ht.bincount(a).numpy(), np.bincount(x))
+    boundaries = np.array([1.0, 3.0, 5.0], dtype=np.float32)
+    v = np.array([0.5, 1.0, 2.5, 4.0, 6.0], dtype=np.float32)
+    b = ht.array(v, split=0)
+    np.testing.assert_array_equal(
+        ht.digitize(b, ht.array(boundaries)).numpy(), np.digitize(v, boundaries)
+    )
+    h, edges = ht.histogram(b, bins=4)
+    h_np, e_np = np.histogram(v, bins=4)
+    np.testing.assert_array_equal(h.numpy(), h_np)
+    np.testing.assert_allclose(edges.numpy(), e_np, rtol=1e-6)
+
+
+def test_skew_kurtosis_cov(data):
+    from scipy import stats
+
+    a = ht.array(data.ravel(), split=0)
+    np.testing.assert_allclose(
+        ht.skew(a, unbiased=False).numpy(), stats.skew(data.ravel(), bias=True), rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        ht.kurtosis(a, unbiased=False).numpy(),
+        stats.kurtosis(data.ravel(), bias=True),
+        rtol=1e-3,
+        atol=1e-4,
+    )
+    c = ht.cov(ht.array(data, split=0))
+    np.testing.assert_allclose(c.numpy(), np.cov(data), rtol=1e-4)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_concatenate_stack(data, split):
+    a = ht.array(data, split=split)
+    b = ht.array(data * 2, split=split)
+    np.testing.assert_allclose(
+        ht.concatenate([a, b], axis=0).numpy(), np.concatenate([data, data * 2], axis=0)
+    )
+    np.testing.assert_allclose(
+        ht.concatenate([a, b], axis=1).numpy(), np.concatenate([data, data * 2], axis=1)
+    )
+    np.testing.assert_allclose(ht.stack([a, b]).numpy(), np.stack([data, data * 2]))
+    np.testing.assert_allclose(ht.vstack([a, b]).numpy(), np.vstack([data, data * 2]))
+    np.testing.assert_allclose(ht.hstack([a, b]).numpy(), np.hstack([data, data * 2]))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_reshape_flatten_squeeze(data, split):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(ht.reshape(a, (10, 6)).numpy(), data.reshape(10, 6))
+    np.testing.assert_allclose(ht.reshape(a, (-1,)).numpy(), data.reshape(-1))
+    np.testing.assert_allclose(a.flatten().numpy(), data.flatten())
+    b = ht.array(data[None], split=None)
+    np.testing.assert_allclose(ht.squeeze(b, 0).numpy(), data)
+    np.testing.assert_allclose(ht.expand_dims(a, 0).numpy(), data[None])
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_flip_roll_rot90(data, split):
+    a = ht.array(data, split=split)
+    np.testing.assert_allclose(ht.flip(a, 0).numpy(), np.flip(data, 0))
+    np.testing.assert_allclose(ht.fliplr(a).numpy(), np.fliplr(data))
+    np.testing.assert_allclose(ht.roll(a, 3, axis=1).numpy(), np.roll(data, 3, axis=1))
+    np.testing.assert_allclose(ht.roll(a, -2, axis=0).numpy(), np.roll(data, -2, axis=0))
+    np.testing.assert_allclose(ht.rot90(a).numpy(), np.rot90(data))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_sort_unique_topk(split):
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, 20, size=17).astype(np.int32)  # uneven over 8
+    a = ht.array(x, split=split)
+    v, i = ht.sort(a)
+    np.testing.assert_array_equal(v.numpy(), np.sort(x))
+    np.testing.assert_array_equal(i.numpy(), np.argsort(x, kind="stable"))
+    u = ht.unique(a)
+    np.testing.assert_array_equal(u.numpy(), np.unique(x))
+    u2, inv = ht.unique(a, return_inverse=True)
+    np.testing.assert_array_equal(u2.numpy()[inv.numpy()], x)
+    tv, ti = ht.topk(a, 3)
+    np.testing.assert_array_equal(tv.numpy(), np.sort(x)[-3:][::-1])
+
+
+def test_pad_tile_repeat(data):
+    a = ht.array(data, split=0)
+    np.testing.assert_allclose(
+        ht.pad(a, ((1, 1), (2, 0))).numpy(), np.pad(data, ((1, 1), (2, 0)))
+    )
+    np.testing.assert_allclose(ht.tile(a, (2, 1)).numpy(), np.tile(data, (2, 1)))
+    np.testing.assert_allclose(ht.repeat(a, 2, axis=0).numpy(), np.repeat(data, 2, axis=0))
+
+
+def test_split_funcs(data):
+    a = ht.array(data, split=0)
+    parts = ht.split(a, 2, axis=0)
+    assert len(parts) == 2
+    np.testing.assert_allclose(parts[0].numpy(), data[:3])
+    h = ht.hsplit(a, 2)
+    np.testing.assert_allclose(h[1].numpy(), data[:, 5:])
+    v = ht.vsplit(a, 3)
+    np.testing.assert_allclose(v[2].numpy(), data[4:])
+
+
+def test_diag_unfold_nonzero():
+    m = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+    a = ht.array(m, split=0)
+    np.testing.assert_allclose(ht.diag(a).numpy(), np.diag(m))
+    v = ht.array(np.arange(3.0, dtype=np.float32))
+    np.testing.assert_allclose(ht.diag(v).numpy(), np.diag(np.arange(3.0)))
+    x = np.array([0.0, 1.0, 0.0, 2.0], dtype=np.float32)
+    nz = ht.nonzero(ht.array(x, split=0))
+    np.testing.assert_array_equal(nz.numpy(), np.nonzero(x)[0])
+    w = ht.where(ht.array(x, split=0) > 0, 1.0, -1.0)
+    np.testing.assert_array_equal(w.numpy(), np.where(x > 0, 1.0, -1.0))
+
+
+def test_unfold():
+    x = np.arange(10.0, dtype=np.float32)
+    a = ht.array(x, split=0)
+    u = ht.unfold(a, 0, 3, 2)
+    expected = np.stack([x[i : i + 3] for i in range(0, 8, 2)])
+    np.testing.assert_allclose(u.numpy(), expected)
+
+
+def test_broadcast_to_arrays(data):
+    a = ht.array(data[0], split=0)
+    b = ht.broadcast_to(a, (4, 10))
+    np.testing.assert_allclose(b.numpy(), np.broadcast_to(data[0], (4, 10)))
+    arrs = ht.broadcast_arrays(ht.array(data, split=0), a)
+    assert arrs[1].shape == (6, 10)

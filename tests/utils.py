@@ -1,0 +1,29 @@
+"""Shared test helpers, analog of the reference's test_suites/basic_test.py.
+
+The central idiom is kept: compare the distributed result against a
+single-process NumPy ground truth, for every split (basic_test.py:77+).
+"""
+
+import numpy as np
+
+
+def assert_array_equal(ht_array, expected, rtol=0, atol=0):
+    """Gathered global result must equal the numpy ground truth."""
+    expected = np.asarray(expected)
+    got = ht_array.numpy()
+    assert got.shape == expected.shape, f"shape {got.shape} != expected {expected.shape}"
+    if rtol or atol:
+        np.testing.assert_allclose(got, expected, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_array_equal(got, expected)
+
+
+def assert_func_equal(ht_func, np_func, np_args, splits=(None, 0), rtol=1e-6, atol=1e-6, **kwargs):
+    """Run a heat function against its numpy counterpart over all splits."""
+    import heat_tpu as ht
+
+    expected = np_func(*np_args)
+    for split in splits:
+        ht_args = [ht.array(a, split=split) for a in np_args]
+        result = ht_func(*ht_args, **kwargs)
+        np.testing.assert_allclose(result.numpy(), expected, rtol=rtol, atol=atol, err_msg=f"split={split}")
