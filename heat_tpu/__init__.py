@@ -19,4 +19,14 @@ from .core import io
 from .core import devices
 from .core import types
 
+from . import fft
+from . import spatial
+from . import graph
+from . import cluster
+from . import classification
+from . import decomposition
+from . import naive_bayes
+from . import preprocessing
+from . import regression
+
 communication = parallel  # API-parity alias for heat.core.communication

@@ -1,0 +1,5 @@
+"""Classification estimators (analog of heat/classification)."""
+
+from .kneighborsclassifier import KNeighborsClassifier, one_hot_encoding
+
+__all__ = ["KNeighborsClassifier", "one_hot_encoding"]

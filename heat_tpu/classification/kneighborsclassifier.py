@@ -1,0 +1,62 @@
+"""K-nearest-neighbors classifier, analog of
+heat/classification/kneighborsclassifier.py (kneighborsclassifier.py:10).
+
+Predict pipeline matches the reference (:114-132): cdist to the training
+set -> topk smallest -> gather one-hot labels -> sum over neighbors ->
+argmax.  All of it is sharded jnp; the MXU does the distance matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+from ..spatial import distance
+
+__all__ = ["KNeighborsClassifier"]
+
+
+def one_hot_encoding(labels: DNDarray, num_classes: Optional[int] = None) -> DNDarray:
+    """One-hot encode integer labels (kneighborsclassifier.py:46)."""
+    dense = labels._dense().astype(jnp.int32)
+    if num_classes is None:
+        num_classes = int(jnp.max(dense)) + 1
+    encoded = jax.nn.one_hot(dense, num_classes, dtype=jnp.float32)
+    return DNDarray.from_dense(encoded, labels.split, labels.device, labels.comm)
+
+
+class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
+    """Vote of the k nearest training samples (kneighborsclassifier.py:10)."""
+
+    def __init__(self, n_neighbors: int = 5):
+        self.n_neighbors = n_neighbors
+        self.x = None
+        self.y = None
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        """Store the training set (kneighborsclassifier.py:95)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        self.x = x
+        if y.ndim == 1:
+            y = one_hot_encoding(y)
+        self.y = y
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Majority vote over the k nearest neighbors
+        (kneighborsclassifier.py:114-132)."""
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before predict")
+        d = distance.cdist(x, self.x)._dense()
+        # k smallest distances -> neighbor indices
+        _, idx = jax.lax.top_k(-d, self.n_neighbors)
+        labels_oh = self.y._dense()
+        votes = jnp.sum(labels_oh[idx], axis=1)
+        pred = jnp.argmax(votes, axis=1).astype(jnp.int64)
+        return DNDarray.from_dense(pred, x.split, x.device, x.comm)

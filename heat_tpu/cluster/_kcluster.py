@@ -1,0 +1,127 @@
+"""Shared k-clustering base, analog of heat/cluster/_kcluster.py.
+
+``_KCluster`` (_kcluster.py:10) holds the iteration loop and the two
+initializations: random sampling and kmeans++ (``probability_based``,
+_kcluster.py:97-207).  All distributed behavior rides on the ops layer
+(cdist + argmin + masked reductions over the sharded sample axis).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["_KCluster"]
+
+
+class _KCluster(BaseEstimator, ClusteringMixin):
+    """Base class for k-statistics clustering (_kcluster.py:10)."""
+
+    def __init__(
+        self,
+        metric: Callable,
+        n_clusters: int,
+        init: Union[str, DNDarray],
+        max_iter: int,
+        tol: float,
+        random_state: Optional[int],
+    ):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+
+        self._metric = metric
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    def _initialize_cluster_centers(self, x: DNDarray, oversampling: float = None, iter_multiplier: float = None):
+        """Random / kmeans++ / explicit initialization (_kcluster.py:97)."""
+        if self.random_state is not None:
+            from ..core import random as ht_random
+
+            ht_random.seed(self.random_state)
+        from ..core import random as ht_random
+
+        dense = x._dense()
+        if not types.heat_type_is_inexact(x.dtype):
+            dense = dense.astype(jnp.float32)
+        n, f = dense.shape
+        k = self.n_clusters
+
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (k, f):
+                raise ValueError(f"passed centroids need to be of shape ({k}, {f}), but are {self.init.shape}")
+            centers = self.init._dense().astype(dense.dtype)
+        elif self.init == "random":
+            idx = ht_random.randint(0, n, size=(k,), comm=x.comm)._dense()
+            centers = dense[idx]
+        elif self.init in ("kmeans++", "probability_based", "++"):
+            # kmeans++ sampling (_kcluster.py:112-180): greedy D^2 weighting
+            key_arr = ht_random.randint(0, n, size=(1,), comm=x.comm)._dense()
+            centers = dense[key_arr[0]][None, :]
+            for _ in range(1, k):
+                d2 = jnp.min(
+                    jnp.sum((dense[:, None, :] - centers[None, :, :]) ** 2, axis=-1), axis=1
+                )
+                probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
+                u = ht_random.rand(1, comm=x.comm)._dense()[0]
+                next_idx = jnp.searchsorted(jnp.cumsum(probs), u)
+                next_idx = jnp.clip(next_idx, 0, n - 1)
+                centers = jnp.concatenate([centers, dense[next_idx][None, :]], axis=0)
+        elif self.init == "batchparallel":
+            raise NotImplementedError("batchparallel init: use BatchParallelKMeans")
+        else:
+            raise ValueError(
+                f'init needs to be one of "random", ht.DNDarray or "kmeans++", but was {self.init}'
+            )
+        self._cluster_centers = DNDarray.from_dense(centers, None, x.device, x.comm)
+
+    def _assign_to_cluster(self, x: DNDarray, eval_functional_value: bool = False):
+        """Label each sample with its nearest center (_kcluster.py:208)."""
+        distances = self._metric(x, self._cluster_centers)
+        from ..core import statistics
+
+        labels = statistics.argmin(distances, axis=1)
+        if eval_functional_value:
+            from ..core import arithmetics
+
+            self._inertia = float(arithmetics.sum(statistics.min(distances, axis=1) ** 2).item())
+        return labels
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
+        raise NotImplementedError()
+
+    def fit(self, x: DNDarray):
+        raise NotImplementedError()
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Nearest learned center for each sample (_kcluster.py:268)."""
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
+        return self._assign_to_cluster(x)

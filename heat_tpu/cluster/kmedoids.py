@@ -1,0 +1,84 @@
+"""KMedoids clustering, analog of heat/cluster/kmedoids.py (kmedoids.py:11).
+
+Centers snap to the closest actual data point (medoid) after a
+KMeans-style mean update, matching the reference's variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..spatial import distance
+from ._kcluster import _KCluster
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    """Manhattan-metric k-medoids (kmedoids.py:11)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        if init == "kmedoids++":
+            init = "probability_based"
+        super().__init__(
+            metric=lambda x, y: distance.manhattan(x, y),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=0.0,
+            random_state=random_state,
+        )
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+        """Mean update then snap to the nearest sample (kmedoids.py:70+)."""
+        dense = x._dense()
+        if not types.heat_type_is_inexact(x.dtype):
+            dense = dense.astype(jnp.float32)
+        labels = matching_centroids._dense()
+        old = self._cluster_centers._dense()
+        new_centers = []
+        for c in range(self.n_clusters):
+            mask = labels == c
+            cnt = jnp.sum(mask)
+            mean = jnp.where(
+                cnt > 0,
+                jnp.sum(jnp.where(mask[:, None], dense, 0.0), axis=0) / jnp.maximum(cnt, 1),
+                old[c],
+            )
+            # snap to closest member of the cluster (or global closest when empty)
+            d = jnp.sum(jnp.abs(dense - mean[None, :]), axis=1)
+            d = jnp.where(mask, d, jnp.inf)
+            d = jnp.where(cnt > 0, d, jnp.sum(jnp.abs(dense - mean[None, :]), axis=1))
+            new_centers.append(dense[jnp.argmin(d)])
+        new = jnp.stack(new_centers)
+        return DNDarray.from_dense(new, None, x.device, x.comm)
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        """Iterate until the medoids stop moving (kmedoids.py:~110)."""
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.ndim != 2:
+            raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
+        self._initialize_cluster_centers(x)
+
+        for i in range(self.max_iter):
+            matching_centroids = self._assign_to_cluster(x)
+            new_cluster_centers = self._update_centroids(x, matching_centroids)
+            shift = float(jnp.sum(jnp.abs(new_cluster_centers._dense() - self._cluster_centers._dense())))
+            self._cluster_centers = new_cluster_centers
+            if shift == 0.0:
+                break
+
+        self._n_iter = i + 1
+        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
+        return self

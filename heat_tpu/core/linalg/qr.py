@@ -117,6 +117,10 @@ def _tsqr_shard_map(A: DNDarray, compute_q: bool = True):
         mesh=mesh,
         in_specs=P(axis, None),
         out_specs=(P(axis, None), P(None, None)),
+        # r2 is computed redundantly from the all-gathered R stack, so it is
+        # replicated by construction; the static analyzer cannot see through
+        # the QR call to prove it
+        check_vma=False,
     )
     q, r = f(A.larray_padded)
     # r is replicated identically on all shards; take it as the global R

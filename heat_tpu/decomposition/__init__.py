@@ -1,0 +1,5 @@
+"""Matrix decomposition estimators (analog of heat/decomposition)."""
+
+from .pca import PCA
+
+__all__ = ["PCA"]

@@ -1,0 +1,154 @@
+"""Principal component analysis, analog of heat/decomposition/pca.py
+(pca.py:19-496).
+
+svd_solver options match the reference: 'full' (tall-skinny exact SVD),
+'hierarchical' (hsvd_rank / hsvd_rtol) and 'randomized' (rsvd).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, TransformMixin
+from ..core.dndarray import DNDarray
+from ..core.linalg.svd import svd as _exact_svd
+from ..core.linalg import svdtools
+
+__all__ = ["PCA"]
+
+
+class PCA(BaseEstimator, TransformMixin):
+    """Linear dimensionality reduction via SVD of centered data (pca.py:19)."""
+
+    def __init__(
+        self,
+        n_components: Optional[Union[int, float]] = None,
+        copy: bool = True,
+        whiten: bool = False,
+        svd_solver: str = "hierarchical",
+        tol: Optional[float] = None,
+        iterated_power: Union[str, int] = "auto",
+        n_oversamples: int = 10,
+        power_iteration_normalizer: str = "qr",
+        random_state: Optional[int] = None,
+    ):
+        if whiten:
+            raise NotImplementedError("whitening is not yet supported (matching pca.py:135)")
+        if svd_solver not in ("full", "hierarchical", "randomized"):
+            raise ValueError(f"svd_solver must be 'full', 'hierarchical' or 'randomized', got {svd_solver!r}")
+        if random_state is not None and not isinstance(random_state, int):
+            raise ValueError(f"random_state must be None or int, got {type(random_state)}")
+
+        self.n_components = n_components
+        self.copy = copy
+        self.whiten = whiten
+        self.svd_solver = svd_solver
+        self.tol = tol
+        self.iterated_power = iterated_power
+        self.n_oversamples = n_oversamples
+        self.power_iteration_normalizer = power_iteration_normalizer
+        self.random_state = random_state
+
+        self.components_ = None
+        self.explained_variance_ = None
+        self.explained_variance_ratio_ = None
+        self.singular_values_ = None
+        self.mean_ = None
+        self.n_components_ = None
+        self.total_explained_variance_ratio_ = None
+        self.noise_variance_ = None
+
+    def fit(self, X: DNDarray, y=None) -> "PCA":
+        """Estimate principal components (pca.py:210)."""
+        if not isinstance(X, DNDarray):
+            raise TypeError(f"X must be a DNDarray, got {type(X)}")
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2D, got {X.ndim}D")
+        if y is not None:
+            raise ValueError("PCA is an unsupervised transform; y must be None")
+        from ..core import statistics
+
+        n, f = X.shape
+        mean = statistics.mean(X, axis=0)
+        self.mean_ = mean
+        centered = X - mean
+
+        if self.random_state is not None:
+            from ..core import random as ht_random
+
+            ht_random.seed(self.random_state)
+
+        rank_cap = min(n, f)
+        if isinstance(self.n_components, float):
+            if not 0.0 < self.n_components <= 1.0:
+                raise ValueError("float n_components must be in (0, 1]")
+            k = None
+            rtol = (1 - self.n_components) ** 0.5
+        else:
+            k = min(self.n_components, rank_cap) if self.n_components else rank_cap
+            rtol = None
+
+        if self.svd_solver == "full":
+            U, S, V = _exact_svd(centered)
+            s = S._dense()
+            kk = k if k is not None else rank_cap
+            self.components_ = DNDarray.from_dense(V._dense()[:, :kk].T, None, X.device, X.comm)
+            self.singular_values_ = DNDarray.from_dense(s[:kk], None, X.device, X.comm)
+            ev = s**2 / max(n - 1, 1)
+            self.explained_variance_ = DNDarray.from_dense(ev[:kk], None, X.device, X.comm)
+            ratio = ev / jnp.maximum(jnp.sum(ev), 1e-30)
+            self.explained_variance_ratio_ = DNDarray.from_dense(ratio[:kk], None, X.device, X.comm)
+            self.total_explained_variance_ratio_ = float(jnp.sum(ratio[:kk]))
+            self.n_components_ = kk
+        elif self.svd_solver == "hierarchical":
+            if rtol is not None:
+                U, S, V, err = svdtools.hsvd_rtol(centered, rtol=rtol, compute_sv=True)
+            else:
+                U, S, V, err = svdtools.hsvd_rank(centered, maxrank=k, compute_sv=True)
+            self.components_ = DNDarray.from_dense(V._dense().T, None, X.device, X.comm)
+            self.singular_values_ = S
+            s = S._dense()
+            ev = s**2 / max(n - 1, 1)
+            self.explained_variance_ = DNDarray.from_dense(ev, None, X.device, X.comm)
+            total_var = float(jnp.sum(centered._dense() ** 2)) / max(n - 1, 1)
+            ratio = ev / max(total_var, 1e-30)
+            self.explained_variance_ratio_ = DNDarray.from_dense(ratio, None, X.device, X.comm)
+            self.total_explained_variance_ratio_ = 1.0 - err**2
+            self.n_components_ = int(s.shape[0])
+        else:  # randomized
+            if k is None:
+                raise ValueError("randomized solver requires an integer n_components")
+            p_iter = 0 if self.iterated_power == "auto" else int(self.iterated_power)
+            U, S, V = svdtools.rsvd(centered, rank=k, n_oversamples=self.n_oversamples, power_iter=p_iter)
+            self.components_ = DNDarray.from_dense(V._dense().T, None, X.device, X.comm)
+            self.singular_values_ = S
+            s = S._dense()
+            ev = s**2 / max(n - 1, 1)
+            self.explained_variance_ = DNDarray.from_dense(ev, None, X.device, X.comm)
+            total_var = float(jnp.sum(centered._dense() ** 2)) / max(n - 1, 1)
+            self.explained_variance_ratio_ = DNDarray.from_dense(ev / max(total_var, 1e-30), None, X.device, X.comm)
+            self.total_explained_variance_ratio_ = float(jnp.sum(ev)) / max(total_var, 1e-30)
+            self.n_components_ = k
+        return self
+
+    def transform(self, X: DNDarray) -> DNDarray:
+        """Project onto the principal axes (pca.py:380)."""
+        if self.components_ is None:
+            raise RuntimeError("fit needs to be called before transform")
+        if not isinstance(X, DNDarray):
+            raise TypeError(f"X must be a DNDarray, got {type(X)}")
+        from ..core.linalg import basics
+
+        centered = X - self.mean_
+        return basics.matmul(centered, self.components_.T)
+
+    def inverse_transform(self, X: DNDarray) -> DNDarray:
+        """Back-project to the original space (pca.py:430)."""
+        if self.components_ is None:
+            raise RuntimeError("fit needs to be called before inverse_transform")
+        from ..core.linalg import basics
+
+        return basics.matmul(X, self.components_) + self.mean_

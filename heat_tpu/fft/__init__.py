@@ -1,0 +1,3 @@
+"""Distributed FFT module (analog of heat/fft)."""
+
+from .fft import *

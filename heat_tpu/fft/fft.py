@@ -1,0 +1,269 @@
+"""Distributed FFT, analog of heat/fft/fft.py (22 exports).
+
+The reference implements pencil-decomposition FFT by hand: a transform
+along the split axis transposes that axis to 0, resplits to 1 (an MPI
+Alltoallw with subarray datatypes), runs the local torch FFT, and resplits
+back (``__fft_op`` fft.py:40-138, ``__fftn_op`` :139-298).  Under GSPMD a
+single ``jnp.fft.*`` call over the sharded global array compiles to exactly
+that pencil schedule (transpose-based distributed FFT with all-to-alls on
+the mesh) — SURVEY.md §3.6.  What remains here is axis/split bookkeeping
+and the real-transform Nyquist length arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.stride_tricks import sanitize_axis
+
+__all__ = [
+    "fft",
+    "fft2",
+    "fftfreq",
+    "fftn",
+    "fftshift",
+    "hfft",
+    "hfft2",
+    "hfftn",
+    "ifft",
+    "ifft2",
+    "ifftn",
+    "ifftshift",
+    "ihfft",
+    "ihfft2",
+    "ihfftn",
+    "irfft",
+    "irfft2",
+    "irfftn",
+    "rfft",
+    "rfft2",
+    "rfftfreq",
+    "rfftn",
+]
+
+
+def _wrap(x: DNDarray, result, out_split_hint: Optional[int] = "same"):
+    split = x.split if out_split_hint == "same" else out_split_hint
+    if split is not None and split >= result.ndim:
+        split = None
+    return DNDarray.from_dense(result, split, x.device, x.comm)
+
+
+def _check(x):
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"x must be a DNDarray, is {type(x)}")
+
+
+def _complex_dense(x: DNDarray):
+    dense = x._dense()
+    if types.heat_type_is_exact(x.dtype):
+        dense = dense.astype(jnp.float32)
+    return dense
+
+
+# ----------------------------------------------------------------------
+# 1-D transforms (fft.py:299-420)
+# ----------------------------------------------------------------------
+def fft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """1-D complex FFT along ``axis`` (fft.py:310)."""
+    _check(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = jnp.fft.fft(_complex_dense(x), n=n, axis=axis, norm=norm)
+    return _wrap(x, result)
+
+
+def ifft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """1-D inverse FFT (fft.py:575)."""
+    _check(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = jnp.fft.ifft(_complex_dense(x), n=n, axis=axis, norm=norm)
+    return _wrap(x, result)
+
+
+def rfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """Real-input FFT; output truncated at Nyquist (fft.py:878)."""
+    _check(x)
+    if types.heat_type_is_complexfloating(x.dtype):
+        raise TypeError(f"x must be a real-typed DNDarray, is {x.dtype.__name__}")
+    axis = sanitize_axis(x.shape, axis)
+    result = jnp.fft.rfft(_complex_dense(x), n=n, axis=axis, norm=norm)
+    return _wrap(x, result)
+
+
+def irfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """Inverse of rfft, real output (fft.py:700)."""
+    _check(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = jnp.fft.irfft(_complex_dense(x), n=n, axis=axis, norm=norm)
+    return _wrap(x, result)
+
+
+def hfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """FFT of a Hermitian-symmetric signal (fft.py:478)."""
+    _check(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = jnp.fft.hfft(_complex_dense(x), n=n, axis=axis, norm=norm)
+    return _wrap(x, result)
+
+
+def ihfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """Inverse Hermitian FFT (fft.py:651)."""
+    _check(x)
+    axis = sanitize_axis(x.shape, axis)
+    result = jnp.fft.ihfft(_complex_dense(x), n=n, axis=axis, norm=norm)
+    return _wrap(x, result)
+
+
+# ----------------------------------------------------------------------
+# 2-D / N-D transforms (fft.py:139-298 __fftn_op callers)
+# ----------------------------------------------------------------------
+def _axes2(x, axes):
+    if axes is None:
+        axes = (-2, -1)
+    return tuple(sanitize_axis(x.shape, a) for a in axes)
+
+
+def fft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    """2-D FFT (fft.py:352)."""
+    _check(x)
+    result = jnp.fft.fft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
+    return _wrap(x, result)
+
+
+def ifft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    """2-D inverse FFT (fft.py:606)."""
+    _check(x)
+    result = jnp.fft.ifft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
+    return _wrap(x, result)
+
+
+def fftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    """N-D FFT — the pencil-decomposition workhorse (fft.py:383)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    result = jnp.fft.fftn(_complex_dense(x), s=s, axes=axes, norm=norm)
+    return _wrap(x, result)
+
+
+def ifftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    """N-D inverse FFT (fft.py:628)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    result = jnp.fft.ifftn(_complex_dense(x), s=s, axes=axes, norm=norm)
+    return _wrap(x, result)
+
+
+def rfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    """2-D real FFT (fft.py:922)."""
+    _check(x)
+    result = jnp.fft.rfft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
+    return _wrap(x, result)
+
+
+def irfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    """2-D inverse real FFT (fft.py:744)."""
+    _check(x)
+    result = jnp.fft.irfft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
+    return _wrap(x, result)
+
+
+def rfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    """N-D real FFT (fft.py:953)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    result = jnp.fft.rfftn(_complex_dense(x), s=s, axes=axes, norm=norm)
+    return _wrap(x, result)
+
+
+def irfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    """N-D inverse real FFT (fft.py:775)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    result = jnp.fft.irfftn(_complex_dense(x), s=s, axes=axes, norm=norm)
+    return _wrap(x, result)
+
+
+def hfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    """2-D Hermitian FFT (fft.py:509)."""
+    _check(x)
+    result = jnp.fft.hfft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
+    return _wrap(x, result)
+
+
+def hfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    """N-D Hermitian FFT (fft.py:540)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    result = jnp.fft.hfftn(_complex_dense(x), s=s, axes=axes, norm=norm)
+    return _wrap(x, result)
+
+
+def ihfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
+    """2-D inverse Hermitian FFT (fft.py:672)."""
+    _check(x)
+    result = jnp.fft.ihfft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
+    return _wrap(x, result)
+
+
+def ihfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
+    """N-D inverse Hermitian FFT (fft.py:686)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    result = jnp.fft.ihfftn(_complex_dense(x), s=s, axes=axes, norm=norm)
+    return _wrap(x, result)
+
+
+# ----------------------------------------------------------------------
+# helpers (fft.py:421-477, 806-877)
+# ----------------------------------------------------------------------
+def fftfreq(n: int, d: float = 1.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Sample frequencies of fft (fft.py:421)."""
+    from ..core import factories
+
+    result = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    else:
+        result = result.astype(jnp.float32)
+    return factories.array(result, split=split, device=device, comm=comm)
+
+
+def rfftfreq(n: int, d: float = 1.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Sample frequencies of rfft (fft.py:846)."""
+    from ..core import factories
+
+    result = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    else:
+        result = result.astype(jnp.float32)
+    return factories.array(result, split=split, device=device, comm=comm)
+
+
+def fftshift(x: DNDarray, axes=None) -> DNDarray:
+    """Shift zero-frequency to the center (fft.py:450; implemented with
+    roll in the reference — XLA's collective permute here)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in (axes if isinstance(axes, (tuple, list)) else (axes,)))
+    result = jnp.fft.fftshift(x._dense(), axes=axes)
+    return _wrap(x, result)
+
+
+def ifftshift(x: DNDarray, axes=None) -> DNDarray:
+    """Inverse of fftshift (fft.py:570)."""
+    _check(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.shape, a) for a in (axes if isinstance(axes, (tuple, list)) else (axes,)))
+    result = jnp.fft.ifftshift(x._dense(), axes=axes)
+    return _wrap(x, result)

@@ -1,0 +1,3 @@
+"""Graph analysis (analog of heat/graph)."""
+
+from .laplacian import *

@@ -1,0 +1,73 @@
+"""Graph Laplacian, analog of heat/graph/laplacian.py (laplacian.py:13-222)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+
+__all__ = ["Laplacian"]
+
+
+class Laplacian:
+    """Graph Laplacian from a pairwise similarity (laplacian.py:13).
+
+    definition: 'simple' (L = D - A) or 'norm_sym'
+    (L = I - D^-1/2 A D^-1/2); mode: 'fully_connected' or 'eNeighbour'
+    with an upper/lower threshold on the similarity.
+    """
+
+    def __init__(
+        self,
+        similarity: Callable,
+        weighted: bool = True,
+        definition: str = "norm_sym",
+        mode: str = "fully_connected",
+        threshold_key: str = "upper",
+        threshold_value: float = 1.0,
+        neighbours: int = 10,
+    ):
+        self.similarity_metric = similarity
+        self.weighted = weighted
+        if definition not in ("simple", "norm_sym"):
+            raise NotImplementedError("Only simple and normalized symmetric Laplacians are supported, got " + definition)
+        if mode not in ("fully_connected", "eNeighbour"):
+            raise NotImplementedError("Only eNeighborhood and fully-connected graphs are supported, got " + mode)
+        if threshold_key not in ("upper", "lower"):
+            raise ValueError(f"threshold_key must be 'upper' or 'lower', got {threshold_key}")
+        self.definition = definition
+        self.mode = mode
+        self.epsilon = (threshold_key, threshold_value)
+        self.neighbours = neighbours
+
+    def _normalized_symmetric_L(self, A: jnp.ndarray) -> jnp.ndarray:
+        """L = I - D^-1/2 A D^-1/2 (laplacian.py:90)."""
+        d = jnp.sum(A, axis=1)
+        d_inv_sqrt = jnp.where(d > 0, 1.0 / jnp.sqrt(jnp.maximum(d, 1e-30)), 0.0)
+        L = -A * d_inv_sqrt[:, None] * d_inv_sqrt[None, :]
+        L = L + jnp.eye(A.shape[0], dtype=A.dtype)
+        return L
+
+    def _simple_L(self, A: jnp.ndarray) -> jnp.ndarray:
+        """L = D - A (laplacian.py:130)."""
+        return jnp.diag(jnp.sum(A, axis=1)) - A
+
+    def construct(self, X: DNDarray) -> DNDarray:
+        """Similarity -> adjacency -> Laplacian (laplacian.py:160)."""
+        S = self.similarity_metric(X)
+        A = S._dense()
+        if self.mode == "eNeighbour":
+            if self.epsilon[0] == "upper":
+                mask = A < self.epsilon[1]
+            else:
+                mask = A > self.epsilon[1]
+            A = jnp.where(mask, A if self.weighted else jnp.ones_like(A), 0.0)
+        # zero the self-loops (laplacian.py:185)
+        A = A - jnp.diag(jnp.diag(A))
+        if self.definition == "norm_sym":
+            L = self._normalized_symmetric_L(A)
+        else:
+            L = self._simple_L(A)
+        return DNDarray.from_dense(L, X.split, X.device, X.comm)

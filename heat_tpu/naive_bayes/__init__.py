@@ -1,0 +1,5 @@
+"""Naive Bayes estimators (analog of heat/naive_bayes)."""
+
+from .gaussianNB import GaussianNB
+
+__all__ = ["GaussianNB"]

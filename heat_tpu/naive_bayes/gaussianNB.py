@@ -1,0 +1,156 @@
+"""Gaussian naive Bayes, analog of heat/naive_bayes/gaussianNB.py
+(gaussianNB.py:13).
+
+Per-class mean/variance come from masked global reductions over the
+sharded sample axis; ``partial_fit`` keeps the reference's incremental
+moment-merge update (gaussianNB.py:180+).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["GaussianNB"]
+
+
+class GaussianNB(BaseEstimator, ClassificationMixin):
+    """Gaussian likelihood naive Bayes classifier (gaussianNB.py:13)."""
+
+    def __init__(self, priors: Optional[DNDarray] = None, var_smoothing: float = 1e-9):
+        self.priors = priors
+        self.var_smoothing = var_smoothing
+        self.classes_ = None
+        self.theta_ = None
+        self.var_ = None
+        self.class_count_ = None
+        self.class_prior_ = None
+        self.epsilon_ = None
+
+    sigma_ = property(lambda self: self.var_)  # alias kept by the reference
+
+    def fit(self, x: DNDarray, y: DNDarray, sample_weight: Optional[DNDarray] = None) -> "GaussianNB":
+        """Estimate per-class Gaussian parameters (gaussianNB.py:120)."""
+        self.classes_ = None
+        self.theta_ = None
+        return self.partial_fit(x, y, classes=None, sample_weight=sample_weight)
+
+    def partial_fit(
+        self,
+        x: DNDarray,
+        y: DNDarray,
+        classes: Optional[DNDarray] = None,
+        sample_weight: Optional[DNDarray] = None,
+    ) -> "GaussianNB":
+        """Incremental fit on a batch (gaussianNB.py:180), merging moments
+        with the reference's count-weighted update."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        if x.ndim != 2:
+            raise ValueError(f"expected x to be 2D, got {x.ndim}D")
+        xd = x._dense()
+        if not types.heat_type_is_inexact(x.dtype):
+            xd = xd.astype(jnp.float32)
+        yd = y._dense().reshape(-1).astype(jnp.int32)
+        if sample_weight is not None:
+            w = sample_weight._dense().reshape(-1).astype(xd.dtype)
+        else:
+            w = jnp.ones((xd.shape[0],), xd.dtype)
+
+        if self.classes_ is None:
+            if classes is not None:
+                cls = np.asarray(classes._dense() if isinstance(classes, DNDarray) else classes)
+            else:
+                cls = np.unique(np.asarray(yd))
+            self.classes_ = DNDarray.from_dense(jnp.asarray(cls), None, x.device, x.comm)
+            n_cls = len(cls)
+            n_feat = xd.shape[1]
+            self.theta_ = jnp.zeros((n_cls, n_feat), xd.dtype)
+            self.var_ = jnp.zeros((n_cls, n_feat), xd.dtype)
+            self.class_count_ = jnp.zeros((n_cls,), xd.dtype)
+
+        cls_arr = self.classes_._dense()
+        self.epsilon_ = self.var_smoothing * float(jnp.max(jnp.var(xd, axis=0)))
+
+        theta = jnp.asarray(self.theta_) if not isinstance(self.theta_, DNDarray) else self.theta_._dense()
+        var = jnp.asarray(self.var_) if not isinstance(self.var_, DNDarray) else self.var_._dense()
+        counts = jnp.asarray(self.class_count_) if not isinstance(self.class_count_, DNDarray) else self.class_count_._dense()
+
+        new_theta, new_var, new_counts = [], [], []
+        for i in range(cls_arr.shape[0]):
+            mask = (yd == cls_arr[i]).astype(xd.dtype) * w
+            n_new = jnp.sum(mask)
+            safe = jnp.maximum(n_new, 1e-30)
+            mu_new = jnp.sum(xd * mask[:, None], axis=0) / safe
+            var_new = jnp.sum(((xd - mu_new[None, :]) ** 2) * mask[:, None], axis=0) / safe
+            n_old = counts[i]
+            mu_old = theta[i]
+            var_old = var[i]
+            n_tot = n_old + n_new
+            safe_tot = jnp.maximum(n_tot, 1e-30)
+            mu_tot = (n_old * mu_old + n_new * mu_new) / safe_tot
+            # merged second moment (gaussianNB.py ~_update_mean_variance)
+            ssd = (
+                n_old * var_old
+                + n_new * var_new
+                + (n_old * n_new / safe_tot) * (mu_old - mu_new) ** 2
+            )
+            var_tot = ssd / safe_tot
+            has_new = n_new > 0
+            new_theta.append(jnp.where(n_tot > 0, mu_tot, mu_old))
+            new_var.append(jnp.where(n_tot > 0, var_tot, var_old))
+            new_counts.append(n_tot)
+        self.theta_ = jnp.stack(new_theta)
+        self.var_ = jnp.stack(new_var) + self.epsilon_
+        self.class_count_ = jnp.stack(new_counts)
+
+        if self.priors is not None:
+            pri = self.priors._dense() if isinstance(self.priors, DNDarray) else jnp.asarray(self.priors)
+            self.class_prior_ = pri
+        else:
+            self.class_prior_ = self.class_count_ / jnp.maximum(jnp.sum(self.class_count_), 1e-30)
+        return self
+
+    def _joint_log_likelihood(self, x: DNDarray) -> jnp.ndarray:
+        """Per-class joint log likelihood (gaussianNB.py:320)."""
+        xd = x._dense()
+        if not types.heat_type_is_inexact(x.dtype):
+            xd = xd.astype(jnp.float32)
+        jll = []
+        for i in range(self.theta_.shape[0]):
+            prior = jnp.log(jnp.maximum(self.class_prior_[i], 1e-30))
+            n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * self.var_[i]))
+            n_ij = n_ij - 0.5 * jnp.sum(((xd - self.theta_[i]) ** 2) / self.var_[i], axis=1)
+            jll.append(prior + n_ij)
+        return jnp.stack(jll, axis=1)
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Most probable class per sample (gaussianNB.py:360)."""
+        if self.theta_ is None:
+            raise RuntimeError("fit needs to be called before predict")
+        jll = self._joint_log_likelihood(x)
+        cls = self.classes_._dense()
+        pred = cls[jnp.argmax(jll, axis=1)]
+        return DNDarray.from_dense(pred, x.split, x.device, x.comm)
+
+    def predict_proba(self, x: DNDarray) -> DNDarray:
+        """Class probabilities (gaussianNB.py:390)."""
+        jll = self._joint_log_likelihood(x)
+        log_prob = jll - jax_logsumexp(jll, axis=1, keepdims=True)
+        return DNDarray.from_dense(jnp.exp(log_prob), x.split, x.device, x.comm)
+
+    def predict_log_proba(self, x: DNDarray) -> DNDarray:
+        jll = self._joint_log_likelihood(x)
+        return DNDarray.from_dense(jll - jax_logsumexp(jll, axis=1, keepdims=True), x.split, x.device, x.comm)
+
+
+def jax_logsumexp(a, axis=None, keepdims=False):
+    from jax.scipy.special import logsumexp
+
+    return logsumexp(a, axis=axis, keepdims=keepdims)

@@ -1,0 +1,11 @@
+"""Preprocessing transforms (analog of heat/preprocessing)."""
+
+from .preprocessing import (
+    MaxAbsScaler,
+    MinMaxScaler,
+    Normalizer,
+    RobustScaler,
+    StandardScaler,
+)
+
+__all__ = ["StandardScaler", "MinMaxScaler", "Normalizer", "MaxAbsScaler", "RobustScaler"]

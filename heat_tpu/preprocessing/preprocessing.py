@@ -1,0 +1,217 @@
+"""Data scalers, analog of heat/preprocessing/preprocessing.py
+(StandardScaler :49, MinMaxScaler :158, Normalizer :284, MaxAbsScaler
+:358, RobustScaler :444).  All are pure compositions of the distributed
+ops layer (mean/var/min/max/percentile over the sharded sample axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..core import statistics, types
+from ..core.base import BaseEstimator, TransformMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["StandardScaler", "MinMaxScaler", "Normalizer", "MaxAbsScaler", "RobustScaler"]
+
+
+def _check_2d_float(x, name="X"):
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"{name} must be a DNDarray, got {type(x)}")
+    if not types.heat_type_is_inexact(x.dtype):
+        return x.astype(types.float32)
+    return x
+
+
+class StandardScaler(BaseEstimator, TransformMixin):
+    """Zero-mean unit-variance standardization (preprocessing.py:49)."""
+
+    def __init__(self, copy: bool = True, with_mean: bool = True, with_std: bool = True):
+        self.copy = copy
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.mean_ = None
+        self.var_ = None
+
+    def fit(self, x: DNDarray, sample_weight=None) -> "StandardScaler":
+        if sample_weight is not None:
+            raise NotImplementedError("sample_weight is not yet supported (matching preprocessing.py:95)")
+        x = _check_2d_float(x)
+        self.mean_ = statistics.mean(x, axis=0) if self.with_mean else None
+        if self.with_std:
+            v = statistics.var(x, axis=0)
+            # guard zero-variance features (preprocessing.py:120)
+            vd = v._dense()
+            v = DNDarray.from_dense(jnp.where(vd == 0, 1.0, vd), v.split, v.device, v.comm)
+            self.var_ = v
+        else:
+            self.var_ = None
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        x = _check_2d_float(x)
+        if self.with_mean and self.mean_ is not None:
+            x = x - self.mean_
+        if self.with_std and self.var_ is not None:
+            from ..core import exponential
+
+            x = x / exponential.sqrt(self.var_)
+        return x
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        y = _check_2d_float(y, "Y")
+        if self.with_std and self.var_ is not None:
+            from ..core import exponential
+
+            y = y * exponential.sqrt(self.var_)
+        if self.with_mean and self.mean_ is not None:
+            y = y + self.mean_
+        return y
+
+
+class MinMaxScaler(BaseEstimator, TransformMixin):
+    """Rescale features to a range (preprocessing.py:158)."""
+
+    def __init__(self, feature_range: Tuple[float, float] = (0.0, 1.0), copy: bool = True, clip: bool = False):
+        if feature_range[0] >= feature_range[1]:
+            raise ValueError(f"Minimum of desired feature range must be smaller than maximum, got {feature_range}")
+        self.feature_range = feature_range
+        self.copy = copy
+        self.clip = clip
+        self.data_min_ = None
+        self.data_max_ = None
+        self.scale_ = None
+        self.min_ = None
+
+    def fit(self, x: DNDarray) -> "MinMaxScaler":
+        x = _check_2d_float(x)
+        self.data_min_ = statistics.min(x, axis=0)
+        self.data_max_ = statistics.max(x, axis=0)
+        rng = self.data_max_._dense() - self.data_min_._dense()
+        rng = jnp.where(rng == 0, 1.0, rng)
+        lo, hi = self.feature_range
+        scale = (hi - lo) / rng
+        self.scale_ = DNDarray.from_dense(scale, None, x.device, x.comm)
+        self.min_ = DNDarray.from_dense(lo - self.data_min_._dense() * scale, None, x.device, x.comm)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        x = _check_2d_float(x)
+        y = x * self.scale_ + self.min_
+        if self.clip:
+            from ..core import rounding
+
+            y = rounding.clip(y, self.feature_range[0], self.feature_range[1])
+        return y
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        y = _check_2d_float(y, "Y")
+        return (y - self.min_) / self.scale_
+
+
+class Normalizer(BaseEstimator, TransformMixin):
+    """Scale each sample to unit norm (preprocessing.py:284)."""
+
+    def __init__(self, norm: str = "l2", copy: bool = True):
+        if norm not in ("l1", "l2", "max"):
+            raise NotImplementedError(f"norm must be 'l1', 'l2' or 'max', got {norm!r}")
+        self.norm = norm
+        self.copy = copy
+
+    def fit(self, x: DNDarray) -> "Normalizer":
+        return self  # stateless (preprocessing.py:320)
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        x = _check_2d_float(x)
+        dense = x._dense()
+        if self.norm == "l2":
+            n = jnp.sqrt(jnp.sum(dense * dense, axis=1, keepdims=True))
+        elif self.norm == "l1":
+            n = jnp.sum(jnp.abs(dense), axis=1, keepdims=True)
+        else:
+            n = jnp.max(jnp.abs(dense), axis=1, keepdims=True)
+        n = jnp.where(n == 0, 1.0, n)
+        return DNDarray.from_dense(dense / n, x.split, x.device, x.comm)
+
+
+class MaxAbsScaler(BaseEstimator, TransformMixin):
+    """Scale by the per-feature maximum absolute value (preprocessing.py:358)."""
+
+    def __init__(self, copy: bool = True):
+        self.copy = copy
+        self.max_abs_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray) -> "MaxAbsScaler":
+        x = _check_2d_float(x)
+        from ..core import rounding
+
+        m = statistics.max(rounding.abs(x), axis=0)
+        md = jnp.where(m._dense() == 0, 1.0, m._dense())
+        self.max_abs_ = m
+        self.scale_ = DNDarray.from_dense(md, None, x.device, x.comm)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        x = _check_2d_float(x)
+        return x / self.scale_
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        y = _check_2d_float(y, "Y")
+        return y * self.scale_
+
+
+class RobustScaler(BaseEstimator, TransformMixin):
+    """Median/IQR scaling (preprocessing.py:444)."""
+
+    def __init__(
+        self,
+        quantile_range: Tuple[float, float] = (25.0, 75.0),
+        copy: bool = True,
+        with_centering: bool = True,
+        with_scaling: bool = True,
+        unit_variance: bool = False,
+    ):
+        if unit_variance:
+            raise NotImplementedError("unit_variance is not yet supported (matching preprocessing.py:500)")
+        lo, hi = quantile_range
+        if not 0 <= lo <= hi <= 100:
+            raise ValueError(f"Invalid quantile range: {quantile_range}")
+        self.quantile_range = quantile_range
+        self.copy = copy
+        self.with_centering = with_centering
+        self.with_scaling = with_scaling
+        self.unit_variance = unit_variance
+        self.center_ = None
+        self.iqr_ = None
+
+    def fit(self, x: DNDarray) -> "RobustScaler":
+        x = _check_2d_float(x)
+        if self.with_centering:
+            self.center_ = statistics.median(x, axis=0)
+        if self.with_scaling:
+            lo, hi = self.quantile_range
+            q_lo = statistics.percentile(x, lo, axis=0)
+            q_hi = statistics.percentile(x, hi, axis=0)
+            iqr = q_hi._dense() - q_lo._dense()
+            iqr = jnp.where(iqr == 0, 1.0, iqr)
+            self.iqr_ = DNDarray.from_dense(iqr, None, x.device, x.comm)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        x = _check_2d_float(x)
+        if self.with_centering and self.center_ is not None:
+            x = x - self.center_
+        if self.with_scaling and self.iqr_ is not None:
+            x = x / self.iqr_
+        return x
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        y = _check_2d_float(y, "Y")
+        if self.with_scaling and self.iqr_ is not None:
+            y = y * self.iqr_
+        if self.with_centering and self.center_ is not None:
+            y = y + self.center_
+        return y

@@ -1,0 +1,5 @@
+"""Regression estimators (analog of heat/regression)."""
+
+from .lasso import Lasso
+
+__all__ = ["Lasso"]

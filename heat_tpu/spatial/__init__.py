@@ -1,0 +1,3 @@
+"""Distance computations (analog of heat/spatial)."""
+
+from .distance import *

@@ -1,0 +1,85 @@
+"""Pairwise distance computations, analog of heat/spatial/distance.py.
+
+The reference's ``_dist`` (distance.py:209-747) is an explicit ring: each of
+ceil(p/2) rounds sends a standing row-block to rank+iter and computes one
+tile, exploiting symmetry when Y is X.  Under GSPMD the same schedule falls
+out of one sharded expression: with X row-split, ``cdist`` keeps the output
+row-split and XLA streams the replicated/other operand across shards over
+ICI.  Metrics mirror _euclidian/_gaussian/_manhattan (distance.py:17-135).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["cdist", "cdist_small", "manhattan", "rbf"]
+
+
+def _pairwise_sqeuclidean(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """||x_i - y_j||^2 via the expanded form (one MXU matmul instead of the
+    reference's broadcast-subtract tile, distance.py:17)."""
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    y_sq = jnp.sum(y * y, axis=1, keepdims=True).T
+    cross = jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
+    d = x_sq + y_sq - 2.0 * cross
+    return jnp.maximum(d, 0.0)
+
+
+def _prep(X: DNDarray, Y: Optional[DNDarray]):
+    sanitize_in(X)
+    if X.ndim != 2:
+        raise NotImplementedError(f"X should be a 2D DNDarray, but is {X.ndim}D")
+    if X.split is not None and X.split != 0:
+        raise NotImplementedError(f"Splittings other than 0 or None currently not supported, got {X.split}")
+    xd = X._dense()
+    if not types.heat_type_is_inexact(X.dtype):
+        xd = xd.astype(jnp.float32)
+    if Y is None:
+        return xd, xd
+    sanitize_in(Y)
+    if Y.ndim != 2:
+        raise NotImplementedError(f"Y should be a 2D DNDarray, but is {Y.ndim}D")
+    if X.shape[1] != Y.shape[1]:
+        raise ValueError(f"X and Y must have the same number of features, got {X.shape[1]} and {Y.shape[1]}")
+    yd = Y._dense()
+    if not types.heat_type_is_inexact(Y.dtype):
+        yd = yd.astype(jnp.float32)
+    return xd, yd
+
+
+def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
+    """Euclidean distance matrix (distance.py:136)."""
+    xd, yd = _prep(X, Y)
+    if quadratic_expansion:
+        d = jnp.sqrt(_pairwise_sqeuclidean(xd, yd))
+    else:
+        d = jnp.sqrt(_pairwise_sqeuclidean(xd, yd))
+    split = 0 if X.split is not None else None
+    return DNDarray.from_dense(d, split, X.device, X.comm)
+
+
+cdist_small = cdist
+
+
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
+    """City-block distance matrix (distance.py:182)."""
+    xd, yd = _prep(X, Y)
+    d = jnp.sum(jnp.abs(xd[:, None, :] - yd[None, :, :]), axis=-1)
+    split = 0 if X.split is not None else None
+    return DNDarray.from_dense(d, split, X.device, X.comm)
+
+
+def rbf(X: DNDarray, Y: Optional[DNDarray] = None, sigma: float = 1.0, quadratic_expansion: bool = False) -> DNDarray:
+    """Gaussian (RBF) kernel matrix exp(-d^2 / (2 sigma^2)) (distance.py:158)."""
+    xd, yd = _prep(X, Y)
+    d2 = _pairwise_sqeuclidean(xd, yd)
+    k = jnp.exp(-d2 / (2.0 * sigma * sigma))
+    split = 0 if X.split is not None else None
+    return DNDarray.from_dense(k, split, X.device, X.comm)

@@ -1,0 +1,158 @@
+"""Linalg tests across splits (reference: heat/core/linalg/tests)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture
+def mats():
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((16, 12)).astype(np.float32)
+    b = rng.standard_normal((12, 10)).astype(np.float32)
+    return a, b
+
+
+@pytest.mark.parametrize("sa", SPLITS)
+@pytest.mark.parametrize("sb", SPLITS)
+def test_matmul_all_split_combos(mats, sa, sb):
+    a, b = mats
+    A = ht.array(a, split=sa)
+    B = ht.array(b, split=sb)
+    C = ht.matmul(A, B)
+    np.testing.assert_allclose(C.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_matmul_batched(mats):
+    rng = np.random.default_rng(12)
+    a = rng.standard_normal((4, 8, 6)).astype(np.float32)
+    b = rng.standard_normal((4, 6, 5)).astype(np.float32)
+    for split in (None, 0, 1):
+        C = ht.matmul(ht.array(a, split=split), ht.array(b, split=split if split == 0 else None))
+        np.testing.assert_allclose(C.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_qr(split):
+    rng = np.random.default_rng(13)
+    # 16 rows over 8 devices = 2/shard >= would fail n=12; TSQR needs m/p>=n,
+    # so use a tall matrix for split=0
+    a = rng.standard_normal((64, 8)).astype(np.float32) if split == 0 else rng.standard_normal((16, 12)).astype(np.float32)
+    A = ht.array(a, split=split)
+    q, r = ht.qr(A)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(q.shape[1]), atol=1e-4)
+    # R upper triangular
+    np.testing.assert_allclose(np.tril(r.numpy(), -1), 0.0, atol=1e-5)
+    r_only = ht.qr(A, mode="r")
+    assert r_only.Q is None
+    np.testing.assert_allclose(np.abs(r_only.R.numpy()), np.abs(r.numpy()), rtol=1e-4, atol=1e-4)
+
+
+def test_tsqr_uses_shard_map():
+    # divisible tall-skinny split-0 -> TS-QR collective path
+    rng = np.random.default_rng(14)
+    a = rng.standard_normal((64, 4)).astype(np.float32)
+    A = ht.array(a, split=0)
+    q, r = ht.qr(A)
+    assert q.split == 0 and r.split is None
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_svd(split):
+    rng = np.random.default_rng(15)
+    a = rng.standard_normal((40, 8)).astype(np.float32)
+    A = ht.array(a, split=split)
+    u, s, v = ht.svd(A)
+    np.testing.assert_allclose(u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+
+
+def test_hsvd_lowrank():
+    rng = np.random.default_rng(16)
+    u = np.linalg.qr(rng.standard_normal((64, 5)))[0]
+    v = np.linalg.qr(rng.standard_normal((24, 5)))[0]
+    s = np.array([10.0, 5.0, 2.0, 1.0, 0.5])
+    a = (u * s) @ v.T
+    a = a.astype(np.float32)
+    for split in (None, 0, 1):
+        A = ht.array(a, split=split)
+        U, err = ht.linalg.hsvd_rank(A, 5)
+        assert err < 1e-3
+        proj = U.numpy() @ (U.numpy().T @ a)
+        np.testing.assert_allclose(proj, a, rtol=1e-3, atol=1e-3)
+        U2, S2, V2, err2 = ht.linalg.hsvd_rtol(A, 1e-3, compute_sv=True)
+        np.testing.assert_allclose(S2.numpy(), s[: S2.shape[0]], rtol=1e-3)
+
+
+def test_rsvd():
+    rng = np.random.default_rng(17)
+    a = (rng.standard_normal((50, 6)) @ rng.standard_normal((6, 30))).astype(np.float32)
+    U, S, V = ht.linalg.rsvd(ht.array(a, split=0), rank=6, power_iter=1)
+    np.testing.assert_allclose(U.numpy() @ np.diag(S.numpy()) @ V.numpy().T, a, rtol=1e-3, atol=1e-3)
+
+
+def test_det_inv_trace():
+    rng = np.random.default_rng(18)
+    a = (rng.standard_normal((6, 6)) + 6 * np.eye(6)).astype(np.float32)
+    for split in SPLITS:
+        A = ht.array(a, split=split)
+        np.testing.assert_allclose(ht.linalg.det(A).numpy(), np.linalg.det(a), rtol=1e-3)
+        np.testing.assert_allclose(ht.linalg.inv(A).numpy(), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(ht.linalg.trace(A), np.trace(a), rtol=1e-5)
+
+
+def test_norms_outer_dot():
+    x = np.array([3.0, 4.0], dtype=np.float32)
+    y = np.array([1.0, 2.0], dtype=np.float32)
+    X = ht.array(x, split=0)
+    Y = ht.array(y, split=0)
+    assert float(ht.linalg.norm(X).numpy()) == pytest.approx(5.0, rel=1e-6)
+    np.testing.assert_allclose(ht.linalg.outer(X, Y).numpy(), np.outer(x, y))
+    np.testing.assert_allclose(ht.dot(X, Y).numpy(), np.dot(x, y))
+    np.testing.assert_allclose(ht.vdot(X, Y).numpy(), np.vdot(x, y))
+    np.testing.assert_allclose(
+        ht.linalg.projection(X, Y).numpy(), (np.dot(x, y) / np.dot(y, y)) * y, rtol=1e-5
+    )
+    c1 = np.array([1.0, 0.0, 0.0], dtype=np.float32)
+    c2 = np.array([0.0, 1.0, 0.0], dtype=np.float32)
+    np.testing.assert_allclose(ht.cross(ht.array(c1), ht.array(c2)).numpy(), np.cross(c1, c2))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_tril_triu_transpose(split):
+    rng = np.random.default_rng(19)
+    a = rng.standard_normal((9, 7)).astype(np.float32)
+    A = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.tril(A).numpy(), np.tril(a))
+    np.testing.assert_allclose(ht.triu(A, 1).numpy(), np.triu(a, 1))
+    np.testing.assert_allclose(ht.linalg.transpose(A).numpy(), a.T)
+
+
+def test_cg_solve_triangular():
+    rng = np.random.default_rng(20)
+    n = 10
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = (a @ a.T + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x = ht.linalg.cg(ht.array(spd, split=0), ht.array(b, split=0), ht.zeros(n, split=0))
+    np.testing.assert_allclose(spd @ x.numpy(), b, rtol=1e-3, atol=1e-3)
+
+    r = np.triu(rng.standard_normal((n, n)) + 3 * np.eye(n)).astype(np.float32)
+    sol = ht.linalg.solve_triangular(ht.array(r), ht.array(b[:, None]))
+    np.testing.assert_allclose(r @ sol.numpy().ravel(), b, rtol=1e-3, atol=1e-3)
+
+
+def test_lanczos_eigs():
+    rng = np.random.default_rng(21)
+    a = rng.standard_normal((24, 24)).astype(np.float32)
+    sym = ((a + a.T) / 2).astype(np.float32)
+    A = ht.array(sym, split=0)
+    V, T = ht.linalg.lanczos(A, 24)
+    evals = np.sort(np.linalg.eigvalsh(T.numpy()))
+    expected = np.sort(np.linalg.eigvalsh(sym))
+    np.testing.assert_allclose(evals[-3:], expected[-3:], rtol=1e-2, atol=1e-2)

@@ -1,0 +1,207 @@
+"""ML-layer tests (reference: heat/cluster/tests, heat/decomposition/tests,
+heat/preprocessing/tests, ...)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture
+def blobs():
+    rng = np.random.default_rng(0)
+    c = np.array([[0.0, 0.0], [6.0, 6.0], [0.0, 7.0]], dtype=np.float32)
+    pts = np.concatenate([rng.normal(c[i], 0.4, size=(40, 2)) for i in range(3)]).astype(np.float32)
+    labels = np.repeat(np.arange(3), 40)
+    perm = rng.permutation(len(pts))
+    return pts[perm], labels[perm]
+
+
+def _cluster_accuracy(true, pred, k=3):
+    # best label matching accuracy
+    from itertools import permutations
+
+    best = 0.0
+    for p in permutations(range(k)):
+        mapped = np.array([p[int(t)] for t in true])
+        best = max(best, float(np.mean(mapped == pred)))
+    return best
+
+
+def test_cdist_rbf():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((10, 3)).astype(np.float32)
+    y = rng.standard_normal((7, 3)).astype(np.float32)
+    from scipy.spatial.distance import cdist as sp_cdist
+
+    d = ht.spatial.cdist(ht.array(x, split=0), ht.array(y))
+    np.testing.assert_allclose(d.numpy(), sp_cdist(x, y), rtol=1e-4, atol=1e-4)
+    m = ht.spatial.manhattan(ht.array(x, split=0), ht.array(y))
+    np.testing.assert_allclose(m.numpy(), sp_cdist(x, y, "cityblock"), rtol=1e-4, atol=1e-4)
+    k = ht.spatial.rbf(ht.array(x, split=0), sigma=2.0)
+    expected = np.exp(-sp_cdist(x, x) ** 2 / 8.0)
+    np.testing.assert_allclose(k.numpy(), expected, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("Cls", ["KMeans", "KMedians", "KMedoids"])
+def test_kcluster(blobs, Cls):
+    pts, labels = blobs
+    x = ht.array(pts, split=0)
+    model = getattr(ht.cluster, Cls)(n_clusters=3, init="kmeans++" if Cls == "KMeans" else "random", random_state=42)
+    model.fit(x)
+    assert model.cluster_centers_.shape == (3, 2)
+    pred = model.labels_.numpy()
+    acc = _cluster_accuracy(labels, pred)
+    assert acc > 0.9, f"{Cls} accuracy {acc}"
+    # predict on the same data matches labels_
+    np.testing.assert_array_equal(model.predict(x).numpy(), pred)
+
+
+def test_batchparallel_kmeans(blobs):
+    pts, labels = blobs
+    x = ht.array(pts, split=0)
+    model = ht.cluster.BatchParallelKMeans(n_clusters=3, random_state=1)
+    model.fit(x)
+    acc = _cluster_accuracy(labels, model.labels_.numpy())
+    assert acc > 0.85, f"BatchParallelKMeans accuracy {acc}"
+
+
+def test_spectral(blobs):
+    pts, labels = blobs
+    x = ht.array(pts, split=0)
+    model = ht.cluster.Spectral(n_clusters=3, gamma=0.5, n_lanczos=30)
+    model.fit(x)
+    acc = _cluster_accuracy(labels, model.labels_.numpy())
+    assert acc > 0.8, f"Spectral accuracy {acc}"
+
+
+def test_knn(blobs):
+    pts, labels = blobs
+    x = ht.array(pts[:100], split=0)
+    y = ht.array(labels[:100].astype(np.int32), split=0)
+    clf = ht.classification.KNeighborsClassifier(n_neighbors=5)
+    clf.fit(x, y)
+    pred = clf.predict(ht.array(pts[100:], split=0)).numpy()
+    assert np.mean(pred == labels[100:]) > 0.9
+
+
+@pytest.mark.parametrize("solver", ["full", "hierarchical", "randomized"])
+def test_pca(solver):
+    rng = np.random.default_rng(3)
+    basis = rng.standard_normal((3, 10)).astype(np.float32)
+    coef = rng.standard_normal((200, 3)).astype(np.float32)
+    data = (coef @ basis + 0.01 * rng.standard_normal((200, 10))).astype(np.float32)
+    x = ht.array(data, split=0)
+    pca = ht.decomposition.PCA(n_components=3, svd_solver=solver, random_state=0)
+    t = pca.fit_transform(x)
+    assert t.shape == (200, 3)
+    rec = pca.inverse_transform(t)
+    rel = np.linalg.norm(rec.numpy() - data) / np.linalg.norm(data)
+    assert rel < 0.05, f"{solver} reconstruction rel err {rel}"
+    assert pca.total_explained_variance_ratio_ > 0.95
+
+
+def test_gaussian_nb(blobs):
+    pts, labels = blobs
+    x = ht.array(pts, split=0)
+    y = ht.array(labels.astype(np.int32), split=0)
+    nb = ht.naive_bayes.GaussianNB()
+    nb.fit(x, y)
+    pred = nb.predict(x).numpy()
+    assert np.mean(pred == labels) > 0.95
+    proba = nb.predict_proba(x).numpy()
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+    # partial_fit in two halves approximates the single fit
+    nb2 = ht.naive_bayes.GaussianNB()
+    nb2.partial_fit(ht.array(pts[:60], split=0), ht.array(labels[:60].astype(np.int32)), classes=ht.array(np.arange(3, dtype=np.int32)))
+    nb2.partial_fit(ht.array(pts[60:], split=0), ht.array(labels[60:].astype(np.int32)))
+    assert np.mean(nb2.predict(x).numpy() == labels) > 0.95
+
+
+def test_scalers():
+    rng = np.random.default_rng(4)
+    data = (rng.standard_normal((50, 4)) * np.array([1, 5, 0.1, 10]) + np.array([0, 3, -2, 7])).astype(np.float32)
+    x = ht.array(data, split=0)
+
+    s = ht.preprocessing.StandardScaler().fit(x)
+    t = s.transform(x)
+    np.testing.assert_allclose(t.numpy().mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(t.numpy().std(axis=0), 1.0, atol=1e-4)
+    np.testing.assert_allclose(s.inverse_transform(t).numpy(), data, rtol=1e-4, atol=1e-4)
+
+    mm = ht.preprocessing.MinMaxScaler().fit(x)
+    t = mm.transform(x)
+    np.testing.assert_allclose(t.numpy().min(axis=0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(t.numpy().max(axis=0), 1.0, atol=1e-6)
+    np.testing.assert_allclose(mm.inverse_transform(t).numpy(), data, rtol=1e-4, atol=1e-4)
+
+    nrm = ht.preprocessing.Normalizer().fit_transform(x)
+    np.testing.assert_allclose(np.linalg.norm(nrm.numpy(), axis=1), 1.0, rtol=1e-5)
+
+    ma = ht.preprocessing.MaxAbsScaler().fit(x)
+    t = ma.transform(x)
+    assert np.abs(t.numpy()).max() <= 1.0 + 1e-6
+
+    rs = ht.preprocessing.RobustScaler().fit(x)
+    t = rs.transform(x)
+    np.testing.assert_allclose(np.median(t.numpy(), axis=0), 0.0, atol=1e-5)
+
+
+def test_lasso():
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((100, 5)).astype(np.float32)
+    true_coef = np.array([2.0, 0.0, -3.0, 0.0, 1.0], dtype=np.float32)
+    y = (X @ true_coef + 0.5 + 0.01 * rng.standard_normal(100)).astype(np.float32)
+    model = ht.regression.Lasso(lam=0.01, max_iter=200)
+    model.fit(ht.array(X, split=0), ht.array(y[:, None], split=0))
+    pred = model.predict(ht.array(X, split=0))
+    rmse = model.rmse(ht.array(y[:, None]), pred)
+    assert rmse < 0.1, f"lasso rmse {rmse}"
+    coefs = model.coef_.numpy().ravel()
+    np.testing.assert_allclose(coefs, true_coef, atol=0.1)
+
+
+def test_laplacian(blobs):
+    pts, _ = blobs
+    x = ht.array(pts[:20], split=0)
+    lap = ht.graph.Laplacian(lambda z: ht.spatial.rbf(z, sigma=1.0), definition="norm_sym")
+    L = lap.construct(x)
+    Ln = L.numpy()
+    np.testing.assert_allclose(np.diag(Ln), 1.0, atol=1e-5)
+    np.testing.assert_allclose(Ln, Ln.T, atol=1e-5)
+    ev = np.linalg.eigvalsh(Ln)
+    assert ev.min() > -1e-4
+
+
+def test_fft_suite():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    for split in (None, 0, 1):
+        a = ht.array(x, split=split)
+        np.testing.assert_allclose(ht.fft.fft(a).numpy(), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(ht.fft.fft2(a).numpy(), np.fft.fft2(x), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(ht.fft.rfft(a).numpy(), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            ht.fft.irfft(ht.fft.rfft(a)).numpy(), x, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(ht.fft.fftshift(a).numpy(), np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(ht.fft.fftfreq(10, 0.1).numpy(), np.fft.fftfreq(10, 0.1).astype(np.float32), rtol=1e-6)
+    # 3-D pencil FFT (BASELINE config 5 shape, tiny)
+    vol = rng.standard_normal((8, 8, 8)).astype(np.float32)
+    v = ht.array(vol, split=0)
+    np.testing.assert_allclose(ht.fft.fftn(v).numpy(), np.fft.fftn(vol), rtol=1e-3, atol=1e-3)
+
+
+def test_convolve():
+    sig = np.array([0.0, 1.0, 2.0, 3.0, 2.0, 1.0, 0.0], dtype=np.float32)
+    ker = np.array([1.0, 2.0, 1.0], dtype=np.float32)
+    for mode in ("full", "same", "valid"):
+        res = ht.convolve(ht.array(sig, split=0), ht.array(ker), mode=mode)
+        np.testing.assert_allclose(res.numpy(), np.convolve(sig, ker, mode=mode), rtol=1e-5)
+
+
+def test_vmap():
+    x = np.arange(24.0, dtype=np.float32).reshape(6, 4)
+    a = ht.array(x, split=0)
+    f = ht.vmap(lambda row: row * 2.0)
+    np.testing.assert_allclose(f(a).numpy(), x * 2)
