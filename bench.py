@@ -1,0 +1,101 @@
+"""Benchmark driver: KMeans iteration throughput on the real chip.
+
+BASELINE config 2: "heat.cluster.KMeans on 10^8 x 16 split-0 DNDarray
+(Allreduce centroids over ICI)".  One Lloyd iteration = cdist (an MXU
+matmul), argmin, and a segment-sum centroid update; the reference measures
+the same workload in benchmarks/cb/cluster.py.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` divides by the reference's per-process compute path
+(the same iteration in torch on CPU, measured in-process on a subset),
+so >1 means faster than one reference process on this host.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _measure_reference_baseline(f: int, k: int) -> float:
+    """Throughput of the reference's per-process compute path (torch CPU),
+    measured on a 2^20-point subset of the same workload.
+
+    The reference's KMeans iteration is torch ops on the local chunk
+    (cdist via the same quadratic expansion, argmin, one-hot matmul
+    update — cluster/kmeans.py) plus MPI reductions; this measures the
+    torch-CPU compute side, which dominates at this scale.
+    """
+    import torch
+
+    n_b = 1 << 20
+    xb = torch.randn(n_b, f)
+    cb = torch.randn(k, f)
+    # warmup
+    for _ in range(2):
+        d = torch.cdist(xb[:4096], cb)
+    t0 = time.perf_counter()
+    d = (
+        (xb * xb).sum(1, keepdim=True)
+        + (cb * cb).sum(1)[None, :]
+        - 2.0 * xb @ cb.T
+    )
+    labels = d.argmin(1)
+    one_hot = torch.nn.functional.one_hot(labels, k).to(xb.dtype)
+    centers = (one_hot.T @ xb) / one_hot.sum(0)[:, None].clamp(min=1.0)
+    el = time.perf_counter() - t0
+    _ = centers.sum().item()
+    return n_b / el
+
+
+def main() -> None:
+    import heat_tpu as ht
+
+    # Scale the workload to the available memory: 2^24 x 16 f32 = 1 GiB.
+    n, f, k = 1 << 24, 16, 8
+    n_iter = 10
+
+    ht.random.seed(0)
+    x = ht.random.randn(n, f, split=0)
+    jax.block_until_ready(x.larray_padded)
+
+    model = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=1, random_state=0)
+    model._initialize_cluster_centers(x)
+
+    def one_iteration():
+        labels = model._assign_to_cluster(x)
+        centers = model._update_centroids(x, labels)
+        model._cluster_centers = centers
+        return centers
+
+    # warmup/compile
+    jax.block_until_ready(one_iteration().larray_padded)
+
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        centers = one_iteration()
+    jax.block_until_ready(centers.larray_padded)
+    elapsed = (time.perf_counter() - t0) / n_iter
+
+    pts_per_sec = n / elapsed
+
+    baseline_pts_per_sec = _measure_reference_baseline(f, k)
+
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_iteration_throughput_2^24x16_k8",
+                "value": round(pts_per_sec / 1e6, 3),
+                "unit": "Mpts/s",
+                "vs_baseline": round(pts_per_sec / baseline_pts_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -28,5 +28,8 @@ from . import decomposition
 from . import naive_bayes
 from . import preprocessing
 from . import regression
+from . import nn
+from . import optim
+from . import utils
 
 communication = parallel  # API-parity alias for heat.core.communication

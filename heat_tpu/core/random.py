@@ -140,7 +140,9 @@ def randint(low, high=None, size=None, dtype=None, split=None, device=None, comm
     if isinstance(size, int):
         size = (size,)
     size = sanitize_shape(size)
-    dtype = types.canonical_heat_type(dtype or types.int64)
+    if dtype is None:
+        dtype = types.int64 if jax.config.jax_enable_x64 else types.int32
+    dtype = types.canonical_heat_type(dtype)
     if dtype not in (types.int64, types.int32):
         raise ValueError(f"Unsupported dtype for randint, got {dtype}")
     data = jax.random.randint(_next_key(), size, int(low), int(high), dtype=dtype.jax_type())
@@ -172,10 +174,12 @@ ranf = random_sample
 sample = random_sample
 
 
-def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+def randperm(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray:
     """Random permutation of range(n) (random.py:625)."""
     if not isinstance(n, int):
         raise TypeError(f"n must be an integer, got {type(n)}")
+    if dtype is None:
+        dtype = types.int64 if jax.config.jax_enable_x64 else types.int32
     data = jax.random.permutation(_next_key(), n).astype(
         types.canonical_heat_type(dtype).jax_type()
     )

@@ -1,0 +1,24 @@
+"""Neural network layer, analog of heat/nn.
+
+The reference mounts ``torch.nn`` behind a module ``__getattr__`` fallback
+(nn/__init__.py:19-31) so any layer not overridden resolves to torch.  The
+TPU-native substrate is flax.linen: ``heat_tpu.nn.Dense`` etc. resolve to
+``flax.linen`` layers the same way, with :class:`DataParallel` layered on
+top.  ``heat_tpu.nn.functional`` falls through to ``jax.nn`` (the analog of
+heat/nn/functional.py).
+"""
+
+from . import functional
+from .data_parallel import DataParallel, DataParallelMultiGPU
+
+__all__ = ["DataParallel", "DataParallelMultiGPU", "functional"]
+
+
+def __getattr__(name):
+    """Fall back to flax.linen for unoverridden layers (nn/__init__.py:19)."""
+    import flax.linen as _linen
+
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn' has no attribute {name!r}")

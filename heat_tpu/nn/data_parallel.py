@@ -1,0 +1,160 @@
+"""Data-parallel NN training, analog of heat/nn/data_parallel.py.
+
+The reference's ``DataParallel`` (data_parallel.py:22) wraps a torch module
+and registers per-parameter backward hooks that Allreduce gradients —
+blocking (``_blocking_hook`` :220) or non-blocking with just-in-time Waits
+(``_nonblocking_hook`` :240, ``_forward_hook`` :278) — plus a fixed shared
+seed so every rank starts from identical parameters (:105-106, :299-311).
+
+TPU-native inversion: parameters live REPLICATED on the mesh and the batch
+is sharded along the mesh axis; the gradient of a mean loss then *is* the
+cross-replica average, with XLA inserting (and overlapping) the psum in the
+backward pass.  The blocking/non-blocking distinction, the per-layer hook
+ordering, and the identical-initialization dance all disappear: one jit'd
+train step is the whole protocol.  Any flax ``linen.Module`` (or a bare
+``apply(params, x)`` function) can be wrapped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.dndarray import DNDarray
+from ..parallel.comm import Communication, sanitize_comm
+
+__all__ = ["DataParallel", "DataParallelMultiGPU"]
+
+
+class DataParallel:
+    """Distributed data-parallel wrapper (data_parallel.py:22).
+
+    Parameters
+    ----------
+    module : flax.linen.Module or Callable
+        A flax module, or an ``apply(params, x)`` function.
+    comm : Communication, optional
+        Mesh over which the batch is sharded (default: world).
+    optimizer : optional
+        An optax gradient transformation; enables :meth:`step`.
+    blocking_parameter_updates : bool
+        Accepted for API parity; both modes compile to the same overlapped
+        psum schedule under XLA (the reference's :240 non-blocking pipeline
+        is the compiler's default here).
+    """
+
+    def __init__(
+        self,
+        module: Any,
+        comm: Optional[Communication] = None,
+        optimizer: Any = None,
+        blocking_parameter_updates: bool = False,
+    ):
+        self.module = module
+        self.comm = sanitize_comm(comm)
+        self.blocking_parameter_updates = blocking_parameter_updates
+        self._optimizer = optimizer
+        self._opt_state = None
+        self.params = None
+        self._apply = module.apply if hasattr(module, "apply") else module
+        self._train_step = None
+
+    # ------------------------------------------------------------------
+    def init(self, key, sample_input) -> "DataParallel":
+        """Initialize parameters, replicated on the mesh (the analog of the
+        reference's shared-seed ``_reset_parameters``, :299)."""
+        if isinstance(sample_input, DNDarray):
+            sample_input = sample_input._dense()
+        if hasattr(self.module, "init"):
+            params = self.module.init(key, sample_input)
+        else:
+            raise TypeError("module has no .init; pass explicit params to set_params")
+        self.set_params(params)
+        return self
+
+    def set_params(self, params) -> None:
+        rep = NamedSharding(self.comm.mesh, P())
+        self.params = jax.device_put(params, rep)
+        if self._optimizer is not None:
+            self._opt_state = jax.device_put(self._optimizer.init(self.params), rep)
+        self._train_step = None
+
+    # ------------------------------------------------------------------
+    def __call__(self, x):
+        """Forward pass on a (batch-sharded) input (data_parallel.py:150)."""
+        if self.params is None:
+            raise RuntimeError("call init() or set_params() first")
+        wrap = isinstance(x, DNDarray)
+        xd = x._dense() if wrap else x
+        out = self._apply(self.params, xd)
+        if wrap:
+            return DNDarray.from_dense(out, x.split, x.device, x.comm)
+        return out
+
+    forward = __call__
+
+    # ------------------------------------------------------------------
+    def value_and_grad(self, loss_fn: Callable, x, y) -> Tuple[jnp.ndarray, Any]:
+        """Loss and cross-replica-averaged parameter gradients.
+
+        ``loss_fn(pred, target) -> scalar`` must reduce with a mean over the
+        batch; the mean over the sharded batch axis is exactly the
+        reference's Allreduce(SUM)/size per-layer hook (:220), emitted once
+        by XLA instead of per tensor.
+        """
+        xd = x._dense() if isinstance(x, DNDarray) else x
+        yd = y._dense() if isinstance(y, DNDarray) else y
+
+        def total_loss(params):
+            return loss_fn(self._apply(params, xd), yd)
+
+        return jax.value_and_grad(total_loss)(self.params)
+
+    def step(self, loss_fn: Callable, x, y) -> float:
+        """One fused train step: forward, backward, optimizer update —
+        compiled once and cached (the whole of the reference's hook
+        machinery plus DataParallelOptimizer.step, dp_optimizer.py:851)."""
+        if self._optimizer is None:
+            raise RuntimeError("construct DataParallel with an optimizer to use step()")
+        if self._train_step is None:
+            batch_sharding = NamedSharding(self.comm.mesh, P(self.comm.axis_name))
+            rep = NamedSharding(self.comm.mesh, P())
+            apply = self._apply
+            optimizer = self._optimizer
+
+            @jax.jit
+            def train_step(params, opt_state, xb, yb):
+                def total_loss(p):
+                    return loss_fn(apply(p, xb), yb)
+
+                loss, grads = jax.value_and_grad(total_loss)(params)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                import optax
+
+                params = optax.apply_updates(params, updates)
+                return loss, params, opt_state
+
+            self._train_step = train_step
+            self._batch_sharding = batch_sharding
+
+        xd = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
+        yd = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)
+        if xd.shape[0] % self.comm.size == 0:
+            xd = jax.device_put(xd, self._batch_sharding)
+            yd = jax.device_put(yd, NamedSharding(self.comm.mesh, P(self.comm.axis_name)))
+        loss, self.params, self._opt_state = self._train_step(self.params, self._opt_state, xd, yd)
+        return float(loss)
+
+
+class DataParallelMultiGPU(DataParallel):
+    """Hierarchical DP (data_parallel.py:313): torch-DDP-intra-node + DASO
+    inter-node in the reference.  On TPU the hierarchy is a property of the
+    mesh (ICI within a slice, DCN across slices); this subclass exists for
+    API parity and to pair with :class:`heat_tpu.optim.DASO`, which manages
+    the skipped/delayed global synchronization."""
+
+    def __init__(self, module, comm: Optional[Communication] = None, optimizer: Any = None):
+        super().__init__(module, comm=comm, optimizer=optimizer)

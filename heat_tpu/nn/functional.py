@@ -1,0 +1,11 @@
+"""Functional NN ops, analog of heat/nn/functional.py (falls through to
+jax.nn the way the reference falls through to torch.nn.functional)."""
+
+
+def __getattr__(name):
+    import jax.nn as _jnn
+
+    try:
+        return getattr(_jnn, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn.functional' has no attribute {name!r}")

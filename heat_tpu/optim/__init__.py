@@ -1,0 +1,35 @@
+"""Optimizers, analog of heat/optim.
+
+The reference falls through to ``torch.optim.*`` (optim/__init__.py:16-31);
+the TPU-native substrate is optax, so ``heat_tpu.optim.SGD`` / ``Adam`` /
+any optax transform name resolves accordingly, alongside the distributed
+optimizers (DataParallelOptimizer, DASO).
+"""
+
+from . import lr_scheduler
+from .dp_optimizer import DASO, DataParallelOptimizer
+from .utils import DetectMetricPlateau
+
+__all__ = ["DASO", "DataParallelOptimizer", "DetectMetricPlateau", "lr_scheduler"]
+
+_TORCH_TO_OPTAX = {
+    "SGD": "sgd",
+    "Adam": "adam",
+    "AdamW": "adamw",
+    "Adagrad": "adagrad",
+    "Adadelta": "adadelta",
+    "RMSprop": "rmsprop",
+    "Adamax": "adamax",
+    "LBFGS": "lbfgs",
+}
+
+
+def __getattr__(name):
+    """Fall back to optax (optim/__init__.py:16 fallback analog)."""
+    import optax as _optax
+
+    target = _TORCH_TO_OPTAX.get(name, name)
+    try:
+        return getattr(_optax, target)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.optim' has no attribute {name!r}")

@@ -1,0 +1,22 @@
+"""Learning-rate schedulers, analog of heat/optim/lr_scheduler.py (which
+passes through to torch.optim.lr_scheduler, lr_scheduler.py:9).  The
+TPU-native substrate is optax's schedule library; any unoverridden name
+resolves there."""
+
+
+def __getattr__(name):
+    import optax as _optax
+
+    # optax uses snake_case; accept both torch-style and optax-style names
+    torch_to_optax = {
+        "StepLR": "exponential_decay",
+        "ExponentialLR": "exponential_decay",
+        "CosineAnnealingLR": "cosine_decay_schedule",
+        "LinearLR": "linear_schedule",
+        "ConstantLR": "constant_schedule",
+    }
+    target = torch_to_optax.get(name, name)
+    try:
+        return getattr(_optax, target)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.optim.lr_scheduler' has no attribute {name!r}")

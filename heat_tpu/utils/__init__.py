@@ -1,0 +1,5 @@
+"""Utilities (analog of heat/utils)."""
+
+from . import data
+
+__all__ = ["data"]

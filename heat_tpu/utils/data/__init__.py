@@ -1,0 +1,21 @@
+"""Data tooling (analog of heat/utils/data)."""
+
+from . import matrixgallery
+from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
+from .mnist import MNISTDataset, synthetic_mnist
+from .partial_dataset import PartialH5DataLoaderIter, PartialH5Dataset
+from .spherical import create_clusters, create_spherical_dataset
+
+__all__ = [
+    "DataLoader",
+    "Dataset",
+    "MNISTDataset",
+    "PartialH5DataLoaderIter",
+    "PartialH5Dataset",
+    "create_clusters",
+    "create_spherical_dataset",
+    "dataset_ishuffle",
+    "dataset_shuffle",
+    "matrixgallery",
+    "synthetic_mnist",
+]

@@ -1,0 +1,117 @@
+"""Data loading tools, analog of heat/utils/data/datatools.py.
+
+The reference wraps torch's DataLoader over the process-local chunk and
+implements post-epoch cross-rank shuffles with pairwise Alltoalls
+(``dataset_shuffle``/``dataset_ishuffle``, datatools.py:247-343).  Here a
+:class:`Dataset` wraps the global sharded DNDarray and :class:`DataLoader`
+iterates minibatches of it; the epoch shuffle is a single global
+permutation (gather-free for XLA: one all-to-all under the hood).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """Dataset over one or more aligned DNDarrays (datatools.py:144)."""
+
+    def __init__(self, array: Union[DNDarray, Sequence[DNDarray]], transforms=None, ishuffle: bool = False):
+        arrays = [array] if isinstance(array, DNDarray) else list(array)
+        if not arrays:
+            raise ValueError("Dataset needs at least one array")
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the sample dimension")
+        self.arrays = arrays
+        self.transforms = transforms if transforms is not None else []
+        self.ishuffle = ishuffle
+
+    def __len__(self) -> int:
+        return self.arrays[0].shape[0]
+
+    def __getitem__(self, index):
+        items = []
+        for i, a in enumerate(self.arrays):
+            item = a._dense()[index]
+            t = self.transforms[i] if i < len(self.transforms) and self.transforms else None
+            items.append(t(item) if callable(t) else item)
+        return items[0] if len(items) == 1 else tuple(items)
+
+    def Shuffle(self) -> None:
+        """Global random permutation of the sample axis (the analog of the
+        reference's cross-rank Alltoall shuffle; method name matches
+        ``Dataset.Shuffle``, datatools.py:200)."""
+        dataset_shuffle(self)
+
+    def Ishuffle(self) -> None:
+        """Non-blocking shuffle (``Dataset.Ishuffle``, datatools.py:210)."""
+        dataset_ishuffle(self)
+
+
+class DataLoader:
+    """Minibatch iterator over a Dataset (datatools.py:16)."""
+
+    def __init__(
+        self,
+        dataset: Union[Dataset, DNDarray],
+        batch_size: int = 1,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        ishuffle: bool = False,
+    ):
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.ishuffle = ishuffle
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator:
+        n = len(self.dataset)
+        if self.shuffle:
+            from ...core import random as ht_random
+
+            perm = np.asarray(ht_random.randperm(n)._dense())
+        else:
+            perm = np.arange(n)
+        self._epoch += 1
+        for start in range(0, n, self.batch_size):
+            idx = perm[start : start + self.batch_size]
+            if self.drop_last and len(idx) < self.batch_size:
+                return
+            yield self.dataset[jnp.asarray(idx)]
+
+
+def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Shuffle the dataset's sample axis in place (datatools.py:247)."""
+    from ...core import random as ht_random
+
+    n = len(dataset)
+    perm = ht_random.randperm(n)._dense()
+    for i, a in enumerate(dataset.arrays):
+        shuffled = a._dense()[perm]
+        dataset.arrays[i] = DNDarray.from_dense(shuffled, a.split, a.device, a.comm)
+
+
+def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Non-blocking shuffle (datatools.py:305).  JAX dispatch is async, so
+    the blocking and non-blocking variants coincide."""
+    dataset_shuffle(dataset, attrs)

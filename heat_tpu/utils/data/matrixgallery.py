@@ -1,0 +1,83 @@
+"""Test/benchmark matrix generators, analog of
+heat/utils/data/matrixgallery.py (matrixgallery.py:19-204)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core import types
+from ...core.dndarray import DNDarray
+from ...core import random as ht_random
+
+__all__ = [
+    "hermitian",
+    "parter",
+    "random_known_rank",
+    "random_known_singularvalues",
+    "random_orthogonal",
+]
+
+
+def hermitian(n: int, dtype=types.complex64, split=None, device=None, comm=None, positive_definite: bool = False) -> DNDarray:
+    """Random (complex) Hermitian matrix (matrixgallery.py:19)."""
+    dtype = types.canonical_heat_type(dtype)
+    if types.heat_type_is_complexfloating(dtype):
+        re = ht_random.randn(n, n, comm=comm)._dense()
+        im = ht_random.randn(n, n, comm=comm)._dense()
+        a = (re + 1j * im).astype(dtype.jax_type())
+    else:
+        a = ht_random.randn(n, n, comm=comm)._dense().astype(dtype.jax_type())
+    if positive_definite:
+        h = a @ jnp.conj(a).T + n * jnp.eye(n, dtype=a.dtype)
+    else:
+        h = (a + jnp.conj(a).T) / 2
+    return DNDarray.from_dense(h, split, None, None) if comm is None else DNDarray.from_dense(h, split, None, comm)
+
+
+def parter(n: int, split=None, device=None, comm=None) -> DNDarray:
+    """Parter matrix: 1 / (i - j + 0.5) Cauchy matrix (matrixgallery.py:60)."""
+    i = jnp.arange(n, dtype=jnp.float32)[:, None]
+    j = jnp.arange(n, dtype=jnp.float32)[None, :]
+    m = 1.0 / (i - j + 0.5)
+    from ...core import factories
+
+    return factories.array(m, split=split, device=device, comm=comm)
+
+
+def random_orthogonal(m: int, n: int, split=None, device=None, comm=None) -> DNDarray:
+    """Random matrix with orthonormal columns (matrixgallery.py:90)."""
+    if m < n:
+        raise ValueError(f"m must be >= n, got {m} < {n}")
+    a = ht_random.randn(m, n, comm=comm)._dense()
+    q, _ = jnp.linalg.qr(a)
+    return DNDarray.from_dense(q, split, None, comm)
+
+
+def random_known_singularvalues(
+    m: int, n: int, singular_values, split=None, device=None, comm=None
+) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray, DNDarray]]:
+    """Random matrix with prescribed singular values (matrixgallery.py:130)."""
+    sv = singular_values._dense() if isinstance(singular_values, DNDarray) else jnp.asarray(singular_values)
+    k = sv.shape[0]
+    if k > min(m, n):
+        raise ValueError(f"number of singular values ({k}) must be <= min(m, n)")
+    U = random_orthogonal(m, k, comm=comm)
+    V = random_orthogonal(n, k, comm=comm)
+    a = (U._dense() * sv[None, :]) @ V._dense().T
+    A = DNDarray.from_dense(a, split, None, comm)
+    from ...core import factories
+
+    return A, (U, factories.array(sv, comm=comm), V)
+
+
+def random_known_rank(
+    m: int, n: int, rank: int, split=None, device=None, comm=None
+) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray, DNDarray]]:
+    """Random matrix of prescribed rank (matrixgallery.py:170)."""
+    if rank > min(m, n):
+        raise ValueError(f"rank must be <= min(m, n), got {rank}")
+    sv = jnp.sort(ht_random.rand(rank, comm=comm)._dense())[::-1] + 0.1
+    return random_known_singularvalues(m, n, sv, split=split, device=device, comm=comm)

@@ -1,0 +1,64 @@
+"""MNIST dataset split across the mesh, analog of heat/utils/data/mnist.py.
+
+The reference subclasses torchvision's MNIST and slices the raw tensors
+per rank.  torchvision may be absent here; when it is, a synthetic
+MNIST-shaped dataset generator is provided so the DP training example and
+benchmarks run hermetically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dndarray import DNDarray
+from .datatools import Dataset
+
+__all__ = ["MNISTDataset", "synthetic_mnist"]
+
+try:  # pragma: no cover - optional dependency
+    from torchvision import datasets as _tv_datasets
+
+    _TORCHVISION = True
+except Exception:
+    _TORCHVISION = False
+
+
+def synthetic_mnist(n: int = 1024, seed: int = 0) -> Tuple[DNDarray, DNDarray]:
+    """Deterministic MNIST-shaped synthetic digits (28x28 images, 10
+    classes) for hermetic benchmarks."""
+    from ...core import factories
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    base = rng.standard_normal((10, 28, 28)).astype(np.float32)
+    imgs = base[labels] + 0.3 * rng.standard_normal((n, 28, 28)).astype(np.float32)
+    return factories.array(imgs[..., None], split=0), factories.array(labels, split=0)
+
+
+class MNISTDataset(Dataset):
+    """MNIST over the mesh (mnist.py:15)."""
+
+    def __init__(self, root: str, train: bool = True, transform=None, ishuffle: bool = False, test_set: bool = False, download: bool = True):
+        from ...core import factories
+
+        if _TORCHVISION:  # pragma: no cover - depends on torchvision presence
+            tv = _tv_datasets.MNIST(root, train=train and not test_set, download=download)
+            imgs = np.asarray(tv.data, dtype=np.float32)[..., None] / 255.0
+            labels = np.asarray(tv.targets, dtype=np.int32)
+            x = factories.array(imgs, split=0)
+            y = factories.array(labels, split=0)
+        else:
+            x, y = synthetic_mnist()
+        super().__init__([x, y], transforms=[transform, None], ishuffle=ishuffle)
+        self.train = train
+
+    @property
+    def images(self) -> DNDarray:
+        return self.arrays[0]
+
+    @property
+    def labels(self) -> DNDarray:
+        return self.arrays[1]

@@ -1,0 +1,84 @@
+"""Out-of-core HDF5 dataset, analog of heat/utils/data/partial_dataset.py.
+
+The reference's ``PartialH5Dataset`` (partial_dataset.py:32) threads HDF5
+chunk reads and overlaps load/convert with training via a custom loader
+iterator (:224).  Here the same overlap comes from JAX's asynchronous
+dispatch: each `__iter__` round reads the next HDF5 slab on host while the
+device still executes the previous batch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dndarray import DNDarray
+
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter"]
+
+try:
+    import h5py
+
+    _H5 = True
+except ImportError:  # pragma: no cover
+    _H5 = False
+
+
+class PartialH5Dataset:
+    """Stream a large HDF5 dataset in windows (partial_dataset.py:32)."""
+
+    def __init__(
+        self,
+        file: str,
+        comm=None,
+        dataset_names: Optional[List[str]] = None,
+        initial_load: int = 7000,
+        load_length: int = 1000,
+        use_gpu: bool = True,
+        np_buffer: bool = True,
+        np_buffer_dataset_names: Optional[List[str]] = None,
+        transforms=None,
+    ):
+        if not _H5:
+            raise RuntimeError("h5py is not available")
+        self.file = file
+        self.dataset_names = dataset_names or ["data"]
+        self.initial_load = initial_load
+        self.load_length = load_length
+        self.transforms = transforms
+        with h5py.File(file, "r") as f:
+            self.length = f[self.dataset_names[0]].shape[0]
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __iter__(self) -> "PartialH5DataLoaderIter":
+        return PartialH5DataLoaderIter(self)
+
+
+class PartialH5DataLoaderIter:
+    """Windowed loader iterator (partial_dataset.py:224)."""
+
+    def __init__(self, dataset: PartialH5Dataset):
+        self._ds = dataset
+        self._pos = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._pos >= self._ds.length:
+            raise StopIteration
+        stop = min(self._pos + self._ds.load_length, self._ds.length)
+        out = []
+        with h5py.File(self._ds.file, "r") as f:
+            for name in self._ds.dataset_names:
+                chunk = np.asarray(f[name][self._pos : stop])
+                arr = jnp.asarray(chunk)
+                if self._ds.transforms is not None and callable(self._ds.transforms):
+                    arr = self._ds.transforms(arr)
+                out.append(arr)
+        self._pos = stop
+        return out[0] if len(out) == 1 else tuple(out)

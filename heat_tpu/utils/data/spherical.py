@@ -1,0 +1,75 @@
+"""Synthetic clustered-data generators, analog of heat/utils/data/spherical.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import types
+from ...core.dndarray import DNDarray
+from ...core import random as ht_random
+
+__all__ = ["create_spherical_dataset", "create_clusters"]
+
+
+def create_spherical_dataset(
+    num_samples_cluster: int,
+    radius: float = 1.0,
+    offset: float = 4.0,
+    dtype=types.float32,
+    random_state: int = 1,
+) -> DNDarray:
+    """Four Gaussian clusters at +-offset on the diagonal (spherical.py:7)."""
+    ht_random.seed(random_state)
+    dtype = types.canonical_heat_type(dtype)
+    centers = jnp.asarray(
+        [[-offset, -offset, -offset], [-offset, offset, -offset], [offset, -offset, offset], [offset, offset, offset]],
+        dtype=dtype.jax_type(),
+    )
+    parts = []
+    for c in range(4):
+        pts = ht_random.randn(num_samples_cluster, 3, dtype=dtype)._dense() * radius + centers[c]
+        parts.append(pts)
+    data = jnp.concatenate(parts, axis=0)
+    return DNDarray.from_dense(data, 0, None, None) if False else _wrap0(data)
+
+
+def _wrap0(data):
+    from ...core import factories
+
+    return factories.array(data, split=0)
+
+
+def create_clusters(
+    n_samples: int,
+    n_features: int,
+    n_clusters: int,
+    cluster_mean,
+    cluster_std,
+    cluster_weight=None,
+    device=None,
+    random_state: int = 1,
+) -> DNDarray:
+    """Gaussian clusters with given means/stds/weights (spherical.py:57)."""
+    import numpy as np
+
+    ht_random.seed(random_state)
+    means = jnp.asarray(cluster_mean._dense() if isinstance(cluster_mean, DNDarray) else cluster_mean)
+    stds = jnp.asarray(cluster_std._dense() if isinstance(cluster_std, DNDarray) else cluster_std)
+    if cluster_weight is None:
+        counts = [n_samples // n_clusters] * n_clusters
+        counts[-1] += n_samples - sum(counts)
+    else:
+        w = np.asarray(cluster_weight, dtype=np.float64)
+        counts = (w / w.sum() * n_samples).astype(int).tolist()
+        counts[-1] += n_samples - sum(counts)
+    parts = []
+    for c in range(n_clusters):
+        std_c = stds[c]
+        pts = ht_random.randn(counts[c], n_features)._dense()
+        if std_c.ndim == 2:
+            pts = pts @ std_c
+        else:
+            pts = pts * std_c
+        parts.append(pts + means[c])
+    data = jnp.concatenate(parts, axis=0)
+    return _wrap0(data)

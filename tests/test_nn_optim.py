@@ -1,0 +1,165 @@
+"""NN / optimizer / data-tooling tests (reference:
+heat/nn/tests/test_data_parallel.py, heat/optim/tests)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def mlp():
+    import flax.linen as lnn
+
+    class MLP(lnn.Module):
+        @lnn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            x = lnn.Dense(32)(x)
+            x = lnn.relu(x)
+            return lnn.Dense(2)(x)
+
+    return MLP()
+
+
+def test_nn_fallthrough():
+    import flax.linen as lnn
+
+    assert ht.nn.Dense is lnn.Dense
+    import jax.nn
+
+    assert ht.nn.functional.relu is jax.nn.relu
+    import optax
+
+    assert ht.optim.SGD is optax.sgd
+    assert ht.optim.Adam is optax.adam
+
+
+def test_data_parallel_forward(mlp):
+    import jax
+
+    dp = ht.nn.DataParallel(mlp)
+    x = ht.random.randn(16, 4, split=0)
+    dp.init(jax.random.PRNGKey(0), x)
+    out = dp(x)
+    assert out.shape == (16, 2)
+    assert out.split == 0
+
+
+def test_data_parallel_training(mlp):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], dtype=np.float32)
+    y = (X @ w > 0).astype(np.int32)
+
+    dp = ht.nn.DataParallel(mlp, optimizer=optax.adam(1e-2))
+    dp.init(jax.random.PRNGKey(0), ht.array(X, split=0))
+
+    def loss_fn(pred, target):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
+
+    xs = ht.array(X, split=0)
+    ys = ht.array(y, split=0)
+    losses = [dp.step(loss_fn, xs, ys) for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.3, f"loss did not drop: {losses[0]} -> {losses[-1]}"
+    pred = np.argmax(dp(xs).numpy(), axis=1)
+    assert np.mean(pred == y) > 0.9
+
+
+def test_daso_step(mlp):
+    import jax
+    import optax
+
+    params = {"w": np.ones((4,), dtype=np.float32)}
+    daso = ht.optim.DASO(local_optimizer=optax.sgd(0.1), total_epochs=10, warmup_epochs=1, cooldown_epochs=1)
+    grads = {"w": np.full((4,), 0.5, dtype=np.float32)}
+    p = params
+    for _ in range(5):
+        p = daso.step(p, grads)
+    assert p["w"].shape == (4,)
+    assert float(np.asarray(p["w"])[0]) < 1.0
+    # phase logic moves skips
+    daso.epoch = 5
+    daso.epoch_loss_logic(1.0)
+    assert daso.global_skip > 0
+    st = daso.get_state()
+    daso.set_state(st)
+    p = daso.last_batch(p)
+
+
+def test_dp_optimizer():
+    import optax
+
+    opt = ht.optim.DataParallelOptimizer(optax.sgd(0.5))
+    params = {"a": np.array([2.0], dtype=np.float32)}
+    grads = {"a": np.array([1.0], dtype=np.float32)}
+    new = opt.step(params, grads)
+    np.testing.assert_allclose(np.asarray(new["a"]), [1.5])
+
+
+def test_detect_plateau():
+    d = ht.optim.DetectMetricPlateau(patience=2)
+    assert not d.test_if_improving(1.0)
+    assert not d.test_if_improving(1.0)
+    assert not d.test_if_improving(1.0)
+    assert d.test_if_improving(1.0)  # patience exceeded -> plateau signal
+
+
+def test_dataset_dataloader():
+    x = ht.arange(20, dtype=ht.float32, split=0).reshape((10, 2))
+    y = ht.arange(10, split=0)
+    ds = ht.utils.data.Dataset([x, y])
+    assert len(ds) == 10
+    loader = ht.utils.data.DataLoader(ds, batch_size=4, shuffle=True, drop_last=False)
+    seen = []
+    for xb, yb in loader:
+        assert xb.shape[1] == 2
+        seen.extend(np.asarray(yb).tolist())
+    assert sorted(seen) == list(range(10))
+    ht.utils.data.dataset_shuffle(ds)
+    assert sorted(ds.arrays[1].numpy().tolist()) == list(range(10))
+
+
+def test_matrixgallery():
+    g = ht.utils.data.matrixgallery
+    q = g.random_orthogonal(12, 4)
+    np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(4), atol=1e-5)
+    A, (U, S, V) = g.random_known_singularvalues(10, 8, [3.0, 2.0, 1.0])
+    np.testing.assert_allclose(
+        np.linalg.svd(A.numpy(), compute_uv=False)[:3], [3.0, 2.0, 1.0], rtol=1e-4
+    )
+    A2, _ = g.random_known_rank(10, 8, 3)
+    assert np.linalg.matrix_rank(A2.numpy(), tol=1e-4) == 3
+    p = g.parter(5)
+    assert p.shape == (5, 5)
+    h = g.hermitian(6, dtype=ht.float32, positive_definite=True)
+    ev = np.linalg.eigvalsh(h.numpy())
+    assert ev.min() > 0
+
+
+def test_spherical_generators():
+    d = ht.utils.data.create_spherical_dataset(10, radius=0.5, offset=3.0)
+    assert d.shape == (40, 3)
+    c = ht.utils.data.create_clusters(30, 2, 3, np.zeros((3, 2)), np.ones((3, 2)))
+    assert c.shape == (30, 2)
+
+
+def test_synthetic_mnist_and_partial_h5(tmp_path):
+    x, y = ht.utils.data.synthetic_mnist(64)
+    assert x.shape == (64, 28, 28, 1)
+    assert y.shape == (64,)
+
+    import h5py
+
+    f = tmp_path / "part.h5"
+    with h5py.File(f, "w") as h:
+        h.create_dataset("data", data=np.arange(100.0).reshape(25, 4))
+    ds = ht.utils.data.PartialH5Dataset(str(f), dataset_names=["data"], load_length=10)
+    chunks = list(iter(ds))
+    assert len(chunks) == 3
+    total = np.concatenate([np.asarray(c) for c in chunks])
+    np.testing.assert_allclose(total, np.arange(100.0).reshape(25, 4))
