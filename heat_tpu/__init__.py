@@ -30,6 +30,7 @@ from . import preprocessing
 from . import regression
 from . import nn
 from . import optim
+from . import sparse
 from . import utils
 
 communication = parallel  # API-parity alias for heat.core.communication

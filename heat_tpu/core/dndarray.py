@@ -832,23 +832,63 @@ class DNDarray:
     # ------------------------------------------------------------------
     # halo exchange (dndarray.py:387-464)
     # ------------------------------------------------------------------
-    def get_halo(self, halo_size: int):
-        """Validate halo size; halos materialize lazily in
-        ``array_with_halos`` (the reference's paired Isend/Irecv become
-        slicing on the global array — XLA emits the boundary exchange)."""
-        if not isinstance(halo_size, int) or halo_size < 0:
-            raise (TypeError if not isinstance(halo_size, int) else ValueError)(
-                f"halo_size needs to be a non-negative Python int, got {halo_size}"
+    def get_halo(self, halo_size: int) -> None:
+        """Fetch ``halo_size`` rows from the ring neighbors along the split
+        axis (dndarray.py:387-464).  The paired Isend/Irecv of the
+        reference become slicing against the neighbor chunks of the global
+        array; see :mod:`heat_tpu.parallel.halo` for the in-shard_map
+        ppermute variant used by collective consumers."""
+        if not isinstance(halo_size, int):
+            raise TypeError(f"halo_size needs to be an integer, found {type(halo_size)}")
+        if halo_size < 0:
+            raise ValueError(f"halo_size needs to be a non-negative integer, got {halo_size}")
+        if self.__split is None:
+            self.__halo_size = 0
+            self.__halo_prev = None
+            self.__halo_next = None
+            return
+        if halo_size > int(self.lshape_map[:, self.__split].min()):
+            raise ValueError(
+                f"halo_size {halo_size} needs to be smaller than the smallest local chunk "
+                f"{int(self.lshape_map[:, self.__split].min())}"
             )
         self.__halo_size = halo_size
+        dense = self._dense()
+        start, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=self.__comm.rank)
+        stop = start + lshape[self.__split]
+        s = self.__split
+
+        def _sl(a, b):
+            return tuple(slice(a, b) if d == s else slice(None) for d in range(self.ndim))
+
+        self.__halo_prev = dense[_sl(max(start - halo_size, 0), start)] if start > 0 else None
+        self.__halo_next = (
+            dense[_sl(stop, min(stop + halo_size, self.__gshape[s]))]
+            if stop < self.__gshape[s]
+            else None
+        )
+
+    @property
+    def halo_prev(self) -> Optional[jax.Array]:
+        return getattr(self, "_DNDarray__halo_prev", None)
+
+    @property
+    def halo_next(self) -> Optional[jax.Array]:
+        return getattr(self, "_DNDarray__halo_next", None)
 
     @property
     def array_with_halos(self) -> jax.Array:
-        """Local chunk extended by halo rows from ring neighbors
-        (dndarray.py:360).  Single-controller: per-shard halos are formed
-        inside shard_map consumers (see core/signal.py); here we return the
-        dense local block padded with the neighbor rows."""
-        return self.larray
+        """Local chunk extended by the fetched halos (dndarray.py:360,
+        ``__cat_halo`` :465)."""
+        pieces = []
+        if self.halo_prev is not None:
+            pieces.append(self.halo_prev)
+        pieces.append(self.larray)
+        if self.halo_next is not None:
+            pieces.append(self.halo_next)
+        if len(pieces) == 1:
+            return pieces[0]
+        return jnp.concatenate(pieces, axis=self.__split if self.__split is not None else 0)
 
     def __reduce__(self):
         # pickle via numpy round-trip (the mesh is process-global state)

@@ -1,0 +1,19 @@
+"""Distributed sparse matrices (analog of heat/sparse)."""
+
+from .arithmetics import add, mul
+from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
+from .factories import sparse_csc_matrix, sparse_csr_matrix
+from .manipulations import to_dense, to_sparse, to_sparse_csc, to_sparse_csr
+
+__all__ = [
+    "DCSC_matrix",
+    "DCSR_matrix",
+    "add",
+    "mul",
+    "sparse_csc_matrix",
+    "sparse_csr_matrix",
+    "to_dense",
+    "to_sparse",
+    "to_sparse_csc",
+    "to_sparse_csr",
+]

@@ -1,0 +1,53 @@
+"""Sparse elementwise arithmetic, analog of heat/sparse/arithmetics.py
+(add :17, mul :58 via ``__binary_op_csx``, sparse/_operations.py:17-209).
+
+The reference applies local torch sparse ops per chunk and re-syncs nnz;
+here the global BCOO op (union for add, intersection for mul) is one XLA
+expression.
+"""
+
+from __future__ import annotations
+
+from jax.experimental import sparse as jsparse
+
+from ..core.dndarray import DNDarray
+from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
+
+__all__ = ["add", "mul"]
+
+
+def _binary_op_csx(op_name, t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
+    """Generic sparse-sparse elementwise op (sparse/_operations.py:17)."""
+    if not isinstance(t1, DCSX_matrix) or not isinstance(t2, DCSX_matrix):
+        raise TypeError(f"both operands must be sparse matrices, got {type(t1)}, {type(t2)}")
+    if type(t1) is not type(t2):
+        raise TypeError(f"operands must share the sparse format, got {type(t1).__name__} and {type(t2).__name__}")
+    if t1.shape != t2.shape:
+        raise ValueError(f"shapes must match, got {t1.shape} and {t2.shape}")
+    a, b = t1.larray, t2.larray
+    if op_name == "add":
+        res = jsparse.bcoo_sum_duplicates(_bcoo_union_add(a, b))
+    else:
+        res = jsparse.bcoo_sum_duplicates(jsparse.bcoo_sort_indices(jsparse.bcoo_multiply_sparse(a, b)))
+    from ..core import types
+
+    dtype = types.canonical_heat_type(res.data.dtype)
+    return type(t1)(res, int(res.nse), t1.shape, dtype, t1.split, t1.device, t1.comm)
+
+
+def _bcoo_union_add(a, b):
+    import jax.numpy as jnp
+
+    data = jnp.concatenate([a.data, b.data])
+    idx = jnp.concatenate([a.indices, b.indices], axis=0)
+    return jsparse.bcoo_sort_indices(jsparse.BCOO((data, idx), shape=a.shape))
+
+
+def add(t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
+    """Element-wise sparse addition (sparse/arithmetics.py:17)."""
+    return _binary_op_csx("add", t1, t2)
+
+
+def mul(t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
+    """Element-wise sparse multiplication (sparse/arithmetics.py:58)."""
+    return _binary_op_csx("mul", t1, t2)

@@ -1,0 +1,89 @@
+"""Sparse factories, analog of heat/sparse/factories.py
+(sparse_csr_matrix/sparse_csc_matrix, factories.py:25-376)."""
+
+from __future__ import annotations
+
+from typing import Optional, Type, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core import types
+from ..core.devices import sanitize_device
+from ..core.dndarray import DNDarray
+from ..parallel.comm import sanitize_comm
+from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
+
+__all__ = ["sparse_csr_matrix", "sparse_csc_matrix"]
+
+
+def _ingest(obj, dtype):
+    """Accept dense arrays/DNDarrays, scipy sparse, torch sparse, or jax
+    BCOO/BCSR (the reference accepts torch/scipy, factories.py:60-200)."""
+    if isinstance(obj, DCSX_matrix):
+        return obj.larray
+    if isinstance(obj, jsparse.BCOO):
+        return obj
+    if isinstance(obj, jsparse.BCSR):
+        return obj.to_bcoo()
+    if isinstance(obj, DNDarray):
+        return jsparse.BCOO.fromdense(obj._dense())
+    # scipy sparse
+    if hasattr(obj, "tocoo") and callable(obj.tocoo):
+        coo = obj.tocoo()
+        idx = jnp.stack([jnp.asarray(coo.row), jnp.asarray(coo.col)], axis=1)
+        return jsparse.BCOO((jnp.asarray(coo.data), idx), shape=coo.shape)
+    # torch sparse
+    if hasattr(obj, "is_sparse") and getattr(obj, "is_sparse", False):
+        coo = obj.coalesce()
+        idx = jnp.asarray(np.asarray(coo.indices()).T)
+        return jsparse.BCOO((jnp.asarray(np.asarray(coo.values())), idx), shape=tuple(obj.shape))
+    if hasattr(obj, "layout"):  # torch CSR/CSC
+        dense = np.asarray(obj.to_dense())
+        return jsparse.BCOO.fromdense(jnp.asarray(dense))
+    arr = jnp.asarray(np.asarray(obj))
+    return jsparse.BCOO.fromdense(arr)
+
+
+def _make(
+    cls: Type[DCSX_matrix],
+    obj,
+    dtype=None,
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DCSX_matrix:
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    if split is not None and is_split is not None:
+        raise ValueError("split and is_split are mutually exclusive")
+    split = split if split is not None else is_split
+    allowed = 0 if cls is DCSR_matrix else 1
+    if split is not None and split != allowed:
+        raise ValueError(
+            f"{cls.__name__} only supports split={allowed} or None, got {split} "
+            "(matching the reference, dcsx_matrix.py:30)"
+        )
+    bcoo = _ingest(obj, dtype)
+    if bcoo.ndim != 2:
+        raise ValueError(f"sparse matrices must be 2-dimensional, got {bcoo.ndim}")
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        bcoo = jsparse.BCOO((bcoo.data.astype(dtype.jax_type()), bcoo.indices), shape=bcoo.shape)
+    else:
+        dtype = types.canonical_heat_type(bcoo.data.dtype)
+    bcoo = jsparse.bcoo_sum_duplicates(jsparse.bcoo_sort_indices(bcoo))
+    gnnz = int(bcoo.nse)
+    return cls(bcoo, gnnz, tuple(bcoo.shape), dtype, split, device, comm)
+
+
+def sparse_csr_matrix(obj, dtype=None, copy=None, ndmin: int = 0, order=None, split=None, is_split=None, device=None, comm=None) -> DCSR_matrix:
+    """Create a DCSR_matrix (factories.py:25)."""
+    return _make(DCSR_matrix, obj, dtype, split, is_split, device, comm)
+
+
+def sparse_csc_matrix(obj, dtype=None, copy=None, ndmin: int = 0, order=None, split=None, is_split=None, device=None, comm=None) -> DCSC_matrix:
+    """Create a DCSC_matrix (factories.py:200)."""
+    return _make(DCSC_matrix, obj, dtype, split, is_split, device, comm)

@@ -1,0 +1,38 @@
+"""Sparse<->dense conversions, analog of heat/sparse/manipulations.py
+(to_dense :105, to_sparse_csr/csc :51-104)."""
+
+from __future__ import annotations
+
+from ..core.dndarray import DNDarray
+from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
+from .factories import sparse_csc_matrix, sparse_csr_matrix
+
+__all__ = ["to_dense", "to_sparse", "to_sparse_csc", "to_sparse_csr"]
+
+
+def to_dense(sparse_matrix: DCSX_matrix, order=None, out=None) -> DNDarray:
+    """Dense DNDarray from a sparse matrix (sparse/manipulations.py:105)."""
+    if not isinstance(sparse_matrix, DCSX_matrix):
+        raise TypeError(f"expected a sparse matrix, got {type(sparse_matrix)}")
+    res = sparse_matrix.todense()
+    if out is not None:
+        out._replace(res.larray_padded)
+        return out
+    return res
+
+
+def to_sparse_csr(array: DNDarray) -> DCSR_matrix:
+    """DCSR from a dense DNDarray (sparse/manipulations.py:51)."""
+    if not isinstance(array, DNDarray):
+        raise TypeError(f"expected a DNDarray, got {type(array)}")
+    return sparse_csr_matrix(array, split=0 if array.split == 0 else None, comm=array.comm)
+
+
+def to_sparse_csc(array: DNDarray) -> DCSC_matrix:
+    """DCSC from a dense DNDarray (sparse/manipulations.py:78)."""
+    if not isinstance(array, DNDarray):
+        raise TypeError(f"expected a DNDarray, got {type(array)}")
+    return sparse_csc_matrix(array, split=1 if array.split == 1 else None, comm=array.comm)
+
+
+to_sparse = to_sparse_csr

@@ -1,5 +1,8 @@
-"""Utilities (analog of heat/utils)."""
+"""Utilities (analog of heat/utils, plus the TPU-build aux subsystems:
+checkpoint/resume and profiling, SURVEY.md §5)."""
 
+from . import checkpoint
 from . import data
+from . import profiling
 
-__all__ = ["data"]
+__all__ = ["checkpoint", "data", "profiling"]

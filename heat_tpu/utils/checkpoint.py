@@ -1,0 +1,92 @@
+"""Checkpoint/resume for sharded arrays and training state.
+
+The reference has no dedicated checkpoint subsystem (SURVEY.md §5):
+persistence is the io layer writing global arrays, plus
+``DetectMetricPlateau.get_state/set_state`` for optimizer state
+(optim/utils.py:72-108).  The TPU-native equivalent is orbax-backed
+checkpointing of sharded jax arrays — each host writes its own shards,
+restore re-places them on the mesh — exposed here for DNDarrays, pytrees
+(model params / optax state), and DASO's state dicts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..core.dndarray import DNDarray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class Checkpointer:
+    """Directory-per-step checkpoint manager over orbax."""
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        ocp = _orbax()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Any, extra_metadata: Optional[Dict] = None) -> None:
+        """Save a pytree (params/opt state/DNDarray-free metadata)."""
+        ocp = _orbax()
+        state = _strip_dndarrays(state)
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        self._mngr.wait_until_finished()
+        if extra_metadata is not None:
+            with open(os.path.join(self.directory, f"meta_{step}.json"), "w") as f:
+                json.dump(extra_metadata, f)
+
+    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        ocp = _orbax()
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if template is not None:
+            template = _strip_dndarrays(template)
+            return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+        return self._mngr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def metadata(self, step: int) -> Optional[Dict]:
+        path = os.path.join(self.directory, f"meta_{step}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        return None
+
+
+def _strip_dndarrays(tree: Any) -> Any:
+    """DNDarrays are stored as their dense global arrays (sharding is a
+    property of the restoring mesh, not the payload)."""
+    return jax.tree_util.tree_map(
+        lambda x: x._dense() if isinstance(x, DNDarray) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, DNDarray),
+    )
+
+
+def save_checkpoint(path: str, state: Any, step: int = 0) -> None:
+    """One-shot checkpoint save (convenience wrapper)."""
+    Checkpointer(path).save(step, state)
+
+
+def load_checkpoint(path: str, step: Optional[int] = None, template: Any = None) -> Any:
+    """One-shot checkpoint restore."""
+    return Checkpointer(path).restore(step, template)

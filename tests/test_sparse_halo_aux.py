@@ -1,0 +1,129 @@
+"""Sparse matrices, halo exchange, checkpoint + profiling subsystems
+(reference: heat/sparse/tests, dndarray halo tests)."""
+
+import numpy as np
+import pytest
+from scipy import sparse as sp
+
+import heat_tpu as ht
+
+
+@pytest.fixture
+def spdata():
+    rng = np.random.default_rng(33)
+    dense = rng.standard_normal((8, 6)).astype(np.float32)
+    dense[dense < 0.4] = 0.0
+    return dense
+
+
+def test_sparse_csr_roundtrip(spdata):
+    s = ht.sparse.sparse_csr_matrix(spdata, split=0)
+    assert s.shape == (8, 6)
+    assert s.gnnz == np.count_nonzero(spdata)
+    np.testing.assert_allclose(s.todense().numpy(), spdata)
+    # scipy ingestion
+    s2 = ht.sparse.sparse_csr_matrix(sp.csr_matrix(spdata))
+    np.testing.assert_allclose(s2.todense().numpy(), spdata)
+    # CSR triple matches scipy
+    ref = sp.csr_matrix(spdata)
+    np.testing.assert_array_equal(np.asarray(s.indptr), ref.indptr)
+    np.testing.assert_array_equal(np.asarray(s.indices), ref.indices)
+    np.testing.assert_allclose(np.asarray(s.data), ref.data)
+
+
+def test_sparse_csc(spdata):
+    s = ht.sparse.sparse_csc_matrix(spdata, split=1)
+    ref = sp.csc_matrix(spdata)
+    np.testing.assert_array_equal(np.asarray(s.indptr), ref.indptr)
+    np.testing.assert_allclose(s.todense().numpy(), spdata)
+    with pytest.raises(ValueError):
+        ht.sparse.sparse_csc_matrix(spdata, split=0)
+
+
+def test_sparse_arithmetic(spdata):
+    other = spdata.T.copy().T  # same shape
+    other = np.roll(spdata, 1, axis=0)
+    a = ht.sparse.sparse_csr_matrix(spdata)
+    b = ht.sparse.sparse_csr_matrix(other)
+    np.testing.assert_allclose((a + b).todense().numpy(), spdata + other, rtol=1e-6)
+    np.testing.assert_allclose((a * b).todense().numpy(), spdata * other, rtol=1e-6)
+    np.testing.assert_allclose(ht.sparse.add(a, b).todense().numpy(), spdata + other, rtol=1e-6)
+
+
+def test_sparse_transpose_lnnz(spdata):
+    s = ht.sparse.sparse_csr_matrix(spdata, split=0)
+    t = s.T
+    assert isinstance(t, ht.sparse.DCSC_matrix)
+    np.testing.assert_allclose(t.todense().numpy(), spdata.T)
+    assert s.lnnz == s.gnnz  # single process holds everything
+    assert s.lindptr.shape[0] == s.lshape[0] + 1
+
+
+def test_to_sparse_to_dense(spdata):
+    d = ht.array(spdata, split=0)
+    s = ht.sparse.to_sparse_csr(d)
+    assert s.split == 0
+    back = ht.sparse.to_dense(s)
+    np.testing.assert_allclose(back.numpy(), spdata)
+
+
+def test_halo():
+    data = np.arange(32.0, dtype=np.float32).reshape(16, 2)
+    a = ht.array(data, split=0)
+    a.get_halo(1)
+    # single process: whole array is local, halos are None
+    assert a.array_with_halos.shape[0] >= a.lshape[0]
+    with pytest.raises(TypeError):
+        a.get_halo(1.5)
+    with pytest.raises(ValueError):
+        a.get_halo(-1)
+
+
+def test_halo_shard_map():
+    import jax.numpy as jnp
+
+    from heat_tpu.parallel.halo import with_halos
+
+    comm = ht.get_comm()
+    data = jnp.arange(32.0).reshape(16, 2)
+    a = ht.array(data, split=0)
+    out = np.asarray(with_halos(comm, a.larray_padded, 1, 0))
+    assert out.shape == (8, 4, 2)  # 8 shards of 2 rows + 2 halo rows
+    # middle shard r: rows [2r-1 .. 2r+2]
+    np.testing.assert_allclose(out[3, 1:3], np.asarray(data[6:8]))
+    np.testing.assert_allclose(out[3, 0], np.asarray(data[5]))
+    np.testing.assert_allclose(out[3, 3], np.asarray(data[8]))
+    # edges zero-filled
+    np.testing.assert_allclose(out[0, 0], 0.0)
+    np.testing.assert_allclose(out[7, 3], 0.0)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    state = {
+        "params": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)},
+        "arr": ht.arange(10, dtype=ht.float32, split=0),
+        "step": jnp.asarray(7),
+    }
+    ckpt = ht.utils.checkpoint.Checkpointer(str(tmp_path / "ckpt"))
+    ckpt.save(0, state, extra_metadata={"epoch": 3})
+    restored = ckpt.restore(0)
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), np.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(np.asarray(restored["arr"]), np.arange(10.0))
+    assert ckpt.metadata(0) == {"epoch": 3}
+    assert ckpt.latest_step() == 0
+
+
+def test_profiling_monitor():
+    import jax.numpy as jnp
+
+    @ht.utils.profiling.monitor("bench_op")
+    def op():
+        return jnp.sum(jnp.ones((100, 100)))
+
+    out = op()
+    assert float(out) == 10000.0
+    assert op.last_runtime is not None and op.last_runtime >= 0
+    with ht.utils.profiling.annotate("region"):
+        pass
