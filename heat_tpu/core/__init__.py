@@ -22,10 +22,12 @@ from .manipulations import *
 from .indexing import *
 from .signal import *
 from .vmap import *
+from .tiling import *
 from . import devices
 from . import types
 from . import random
 from . import io
+from . import tiling
 from . import linalg
 from .linalg import *
 from ..version import __version__  # noqa: F401

@@ -281,3 +281,190 @@ subtract = sub
 def sum(a, axis=None, out=None, keepdims=False):
     """Sum of elements over axes (arithmetics.py:2248)."""
     return _reduce_op(jnp.sum, a, axis, neutral=0, out=out, keepdims=keepdims)
+
+
+# ----------------------------------------------------------------------
+# in-place variants (reference: `_`-suffixed functions bound as DNDarray
+# methods and `__i*__` dunders, e.g. add_ arithmetics.py:135,195-196).
+# Functional substrate underneath: compute out-of-place, then swap the
+# backing array with a cast-safety check (dndarray._iop).
+# ----------------------------------------------------------------------
+from .dndarray import _iop as __iop  # noqa: E402
+
+
+def _inplace(t1, result) -> DNDarray:
+    if not isinstance(t1, DNDarray):
+        raise TypeError(f"in-place operations require a DNDarray target, got {type(t1)}")
+    return __iop(t1, result)
+
+
+def add_(t1, t2):
+    """In-place element-wise addition (arithmetics.py:135)."""
+    return _inplace(t1, add(t1, t2))
+
+
+def bitwise_and_(t1, t2):
+    """In-place bitwise AND (arithmetics.py:265)."""
+    return _inplace(t1, bitwise_and(t1, t2))
+
+
+def bitwise_or_(t1, t2):
+    """In-place bitwise OR (arithmetics.py:415)."""
+    return _inplace(t1, bitwise_or(t1, t2))
+
+
+def bitwise_xor_(t1, t2):
+    """In-place bitwise XOR (arithmetics.py:556)."""
+    return _inplace(t1, bitwise_xor(t1, t2))
+
+
+def copysign_(t1, t2):
+    """In-place copysign (arithmetics.py:676)."""
+    return _inplace(t1, copysign(t1, t2))
+
+
+def cumprod_(t, axis):
+    """In-place cumulative product (arithmetics.py:~800)."""
+    return _inplace(t, cumprod(t, axis))
+
+
+cumproduct_ = cumprod_
+
+
+def cumsum_(t, axis):
+    """In-place cumulative sum (arithmetics.py:~870)."""
+    return _inplace(t, cumsum(t, axis))
+
+
+def div_(t1, t2):
+    """In-place true division (arithmetics.py:~1100)."""
+    return _inplace(t1, div(t1, t2))
+
+
+divide_ = div_
+
+
+def floordiv_(t1, t2):
+    """In-place floor division (arithmetics.py:~1330)."""
+    return _inplace(t1, floordiv(t1, t2))
+
+
+floor_divide_ = floordiv_
+
+
+def fmod_(t1, t2):
+    """In-place C-style remainder (arithmetics.py:~1000)."""
+    return _inplace(t1, fmod(t1, t2))
+
+
+def gcd_(t1, t2):
+    """In-place greatest common divisor (arithmetics.py:~1070)."""
+    return _inplace(t1, gcd(t1, t2))
+
+
+def hypot_(t1, t2):
+    """In-place hypot (arithmetics.py:~1140)."""
+    return _inplace(t1, hypot(t1, t2))
+
+
+def invert_(t):
+    """In-place bitwise NOT (arithmetics.py:~1410)."""
+    return _inplace(t, invert(t))
+
+
+bitwise_not_ = invert_
+
+
+def lcm_(t1, t2):
+    """In-place least common multiple (arithmetics.py:~1480)."""
+    return _inplace(t1, lcm(t1, t2))
+
+
+def left_shift_(t1, t2):
+    """In-place left shift (arithmetics.py:~1550)."""
+    return _inplace(t1, left_shift(t1, t2))
+
+
+def mod_(t1, t2):
+    """In-place modulo (arithmetics.py:~1620)."""
+    return _inplace(t1, mod(t1, t2))
+
+
+remainder_ = mod_
+
+
+def mul_(t1, t2):
+    """In-place multiplication (arithmetics.py:~1700)."""
+    return _inplace(t1, mul(t1, t2))
+
+
+multiply_ = mul_
+
+
+def nan_to_num_(t, nan: float = 0.0, posinf=None, neginf=None):
+    """In-place NaN/Inf replacement (arithmetics.py:~1780)."""
+    return _inplace(t, nan_to_num(t, nan, posinf, neginf))
+
+
+def neg_(t):
+    """In-place negation (arithmetics.py:~1900)."""
+    return _inplace(t, neg(t))
+
+
+negative_ = neg_
+
+
+def pos_(t):
+    """In-place +t (arithmetics.py:~1950)."""
+    return _inplace(t, pos(t))
+
+
+positive_ = pos_
+
+
+def pow_(t1, t2):
+    """In-place power (arithmetics.py:~2010)."""
+    return _inplace(t1, pow(t1, t2))
+
+
+power_ = pow_
+
+
+def right_shift_(t1, t2):
+    """In-place right shift (arithmetics.py:~2140)."""
+    return _inplace(t1, right_shift(t1, t2))
+
+
+def sub_(t1, t2):
+    """In-place subtraction (arithmetics.py:~2210)."""
+    return _inplace(t1, sub(t1, t2))
+
+
+subtract_ = sub_
+
+
+# method + dunder bindings, mirroring the reference's module-bottom
+# assignments (arithmetics.py:195-196 etc.)
+for _name in (
+    "add_", "bitwise_and_", "bitwise_not_", "bitwise_or_", "bitwise_xor_",
+    "copysign_", "cumprod_", "cumproduct_", "cumsum_", "div_", "divide_",
+    "floordiv_", "floor_divide_", "fmod_", "gcd_", "hypot_", "invert_",
+    "lcm_", "left_shift_", "mod_", "mul_", "multiply_", "nan_to_num_",
+    "neg_", "negative_", "pos_", "positive_", "pow_", "power_",
+    "remainder_", "right_shift_", "sub_", "subtract_",
+):
+    setattr(DNDarray, _name, globals()[_name])
+DNDarray.__ilshift__ = left_shift_
+DNDarray.__irshift__ = right_shift_
+DNDarray.__iand__ = bitwise_and_
+DNDarray.__ior__ = bitwise_or_
+DNDarray.__ixor__ = bitwise_xor_
+
+__all__ += [
+    "add_", "bitwise_and_", "bitwise_not_", "bitwise_or_", "bitwise_xor_",
+    "copysign_", "cumprod_", "cumproduct_", "cumsum_", "div_", "divide_",
+    "floordiv_", "floor_divide_", "fmod_", "gcd_", "hypot_", "invert_",
+    "lcm_", "left_shift_", "mod_", "mul_", "multiply_", "nan_to_num_",
+    "neg_", "negative_", "pos_", "positive_", "pow_", "power_",
+    "remainder_", "right_shift_", "sub_", "subtract_",
+]

@@ -361,6 +361,25 @@ class DNDarray:
         """Always True: only the canonical distribution exists (dndarray.py:1155)."""
         return True
 
+    def is_distributed(self) -> bool:
+        """Whether data lives on more than one participant (dndarray.py:1166)."""
+        return self.__split is not None and self.__comm.size > 1
+
+    def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """(counts, displacements) along the split axis per participant
+        (dndarray.py:~630): pure sharding metadata."""
+        if self.__split is None:
+            raise ValueError("Non-distributed DNDarray has no counts and displacements")
+        counts, displs, _ = self.__comm.counts_displs_shape(self.__gshape, self.__split)
+        return tuple(int(c) for c in counts), tuple(int(d) for d in displs)
+
+    def create_lshape_map(self, force_check: bool = False) -> np.ndarray:
+        """Recompute the (size, ndim) local-shape map (dndarray.py:~660).
+
+        Metadata-only here: the canonical distribution is fully determined by
+        (gshape, split, comm), so no communication happens."""
+        return self.lshape_map
+
     def balance_(self) -> "DNDarray":
         """No-op (dndarray.py:509): arrays are always canonically balanced."""
         return self

@@ -1,0 +1,364 @@
+"""Tile decompositions, analog of heat/core/tiling.py.
+
+The reference uses these classes to derive MPI subarray datatypes for the
+one-shot ``Alltoallw`` resplit (``SplitTiles.get_subarray_params``
+tiling.py:331) and for the legacy tile-wise QR/Cholesky algorithms
+(``SquareDiagTiles`` tiling.py:415).  On TPU the resplit is a single
+``device_put`` with a new ``NamedSharding`` (XLA emits the all-to-all), so
+the subarray machinery disappears; what remains useful — and is kept here —
+is the *metadata*: the theoretical per-participant tile grid in every
+dimension, tile lookups, and the square-diagonal decomposition.
+
+Data access happens against the global dense array (single-controller SPMD:
+every participant can address any tile); ``tile_locations`` still reports
+the owning participant so collective algorithms can be written against the
+same grid the reference uses.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+def _even_chunks(size: int, parts: int) -> np.ndarray:
+    """Remainder-spread chunk sizes, used to carve tile rows *within* one
+    participant's block (tiling.py:~650 column creation)."""
+    chunk, rem = divmod(size, parts)
+    out = np.full(parts, chunk, dtype=np.int64)
+    out[:rem] += 1
+    return out
+
+
+def _addressable(arr: DNDarray, owners) -> bool:
+    """Whether the calling process controls any of the owning participants.
+
+    The reference gates tile access on ``comm.rank`` because every MPI rank
+    is its own process; here a participant is a mesh device, so the analog
+    is "one of my devices owns this tile" — in single-controller mode that
+    is every tile."""
+    comm = arr.comm
+    me = jax.process_index()
+    return any(comm.devices[int(o)].process_index == me for o in np.atleast_1d(owners).ravel())
+
+
+class SplitTiles:
+    """Tiles of a DNDarray: the chunk grid obtained by chunking *every*
+    dimension over ``comm.size`` (tiling.py:17-370).
+
+    The split dimension uses the array's actual local shapes; every other
+    dimension uses the theoretical remainder-spread chunking.
+    """
+
+    def __init__(self, arr: DNDarray) -> None:
+        self.__arr = arr
+        lshape_map = arr.lshape_map
+        ndim, size = arr.ndim, arr.comm.size
+        # one chunk policy for every dimension — the canonical (padded)
+        # distribution the comm layer actually uses — so the grid is
+        # identical however the array is currently split.
+        tile_dims = np.zeros((ndim, size), dtype=np.int64)
+        for ax in range(ndim):
+            tile_dims[ax] = arr.comm.lshape_map(arr.gshape, ax)[:, ax]
+        self.__tile_dims = tile_dims
+        self.__tile_ends_g = np.cumsum(tile_dims, axis=1).astype(np.int64)
+        self.__tile_locations = self.set_tile_locations(arr.split, tile_dims, arr)
+        self.__lshape_map = lshape_map
+
+    @staticmethod
+    def set_tile_locations(split: Optional[int], tile_dims: np.ndarray, arr: DNDarray) -> np.ndarray:
+        """Grid (size ^ ndim) of owning participant per tile (tiling.py:111)."""
+        grid_shape = [tile_dims[d].size for d in range(arr.ndim)]
+        locations = np.zeros(grid_shape, dtype=np.int64)
+        if split is None:
+            locations += arr.comm.rank
+            return locations
+        sl = [slice(None)] * arr.ndim
+        for pr in range(1, arr.comm.size):
+            sl[split] = pr
+            locations[tuple(sl)] = pr
+        return locations
+
+    @property
+    def arr(self) -> DNDarray:
+        """The tiled DNDarray (tiling.py:140)."""
+        return self.__arr
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        """(size, ndim) local shapes (tiling.py:147)."""
+        return self.__lshape_map
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Owning participant of each tile (tiling.py:154)."""
+        return self.__tile_locations
+
+    @property
+    def tile_ends_g(self) -> np.ndarray:
+        """Global end index of each tile per dimension (tiling.py:165)."""
+        return self.__tile_ends_g
+
+    @property
+    def tile_dimensions(self) -> np.ndarray:
+        """Tile extents per dimension (tiling.py:176)."""
+        return self.__tile_dims
+
+    def __tile_slices(self, key) -> Tuple[slice, ...]:
+        """Convert tile-grid indices to global index slices."""
+        arr = self.__arr
+        if isinstance(key, (int, np.integer, slice)):
+            key = (key,)
+        key = tuple(key) + (slice(None),) * (arr.ndim - len(key))
+        out = []
+        for d, k in enumerate(key):
+            ends = self.__tile_ends_g[d]
+            if isinstance(k, (int, np.integer)):
+                if k < 0:
+                    k += ends.size
+                start = int(ends[k - 1]) if k > 0 else 0
+                stop = int(ends[k])
+            elif isinstance(k, slice):
+                idx = np.arange(ends.size)[k]
+                if idx.size == 0:
+                    start = stop = 0
+                else:
+                    start = int(ends[idx[0] - 1]) if idx[0] > 0 else 0
+                    stop = int(ends[idx[-1]])
+            else:
+                raise TypeError(f"key type not supported: {type(k)}")
+            out.append(slice(start, stop))
+        return tuple(out)
+
+    def get_tile_size(self, key) -> Tuple[int, ...]:
+        """Extent of the tile(s) selected by ``key`` (tiling.py:285)."""
+        return tuple(sl.stop - sl.start for sl in self.__tile_slices(key))
+
+    def __getitem__(self, key) -> Optional[jnp.ndarray]:
+        """The tile's data (tiling.py:182) — global indexing against the
+        dense array; ``None`` when none of this process's devices own any
+        part of it."""
+        if not _addressable(self.__arr, self.__tile_locations[key]):
+            return None
+        return self.__arr._dense()[self.__tile_slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        """Overwrite the tile's data (tiling.py:300)."""
+        if jax.process_count() > 1:  # pragma: no cover - multi-host
+            # every controller must issue identical updates on the shared
+            # global array; a rank-gated write would diverge the replicas
+            raise NotImplementedError("tile writes across hosts: use global __setitem__")
+        if not _addressable(self.__arr, self.__tile_locations[key]):
+            return
+        sl = self.__tile_slices(key)
+        dense = self.__arr._dense()
+        value = jnp.asarray(value, dense.dtype)
+        new = dense.at[sl].set(jnp.broadcast_to(value, dense[sl].shape))
+        from .dndarray import _pad_to_canonical
+
+        self.__arr._replace(_pad_to_canonical(new, self.__arr.gshape, self.__arr.split, self.__arr.comm))
+
+
+class SquareDiagTiles:
+    """Tile decomposition with square tiles on the diagonal
+    (tiling.py:371-1100), the layout used by tile-wise QR/Cholesky.
+
+    ``tiles_per_proc`` row-tiles are carved from every participant's row
+    block; column boundaries mirror the row boundaries up to ``min(m, n)``
+    so diagonal tiles are square, with one remainder column tile.
+    Only 2-D arrays with ``split in (0, 1)`` are supported (as in the
+    reference, tiling.py:430-447).
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2) -> None:
+        if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
+            raise ValueError(f"tiles_per_proc must be a positive int, got {tiles_per_proc}")
+        if arr.ndim != 2:
+            raise ValueError(f"SquareDiagTiles requires a 2-D DNDarray, got {arr.ndim}-D")
+        if arr.split not in (0, 1):
+            raise ValueError(f"SquareDiagTiles requires split 0 or 1, got {arr.split}")
+        m, n = arr.gshape
+        lshape_map = arr.lshape_map
+        split = arr.split
+
+        # row/col boundaries: tiles_per_proc tiles per participant block
+        # along the split dim; the other dim mirrors them to stay square on
+        # the diagonal, with a single remainder tile past min(m, n).
+        block_sizes = lshape_map[:, split]
+        bounds: List[int] = []
+        pos = 0
+        for b in block_sizes:
+            for c in _even_chunks(int(b), tiles_per_proc):
+                if c > 0:
+                    pos += int(c)
+                    bounds.append(pos)
+        split_idx = bounds
+        diag_len = min(m, n)
+        split_len = m if split == 0 else n
+        other_len = n if split == 0 else m
+
+        def _diag_cut(cuts: List[int], extent: int) -> List[int]:
+            """Keep cuts inside the diagonal block, force a cut exactly at
+            the diagonal edge, and one remainder tile past it — so every
+            diagonal tile is square (the invariant tile-wise QR/Cholesky
+            needs; reference redistributes rows for the same effect,
+            tiling.py:589-646)."""
+            out = [b for b in cuts if b < diag_len] + [diag_len]
+            if extent > diag_len:
+                out.append(extent)
+            return out
+
+        if split == 0:
+            row_bounds = _diag_cut(split_idx, split_len) if m > n else split_idx
+            col_bounds = _diag_cut(split_idx, other_len)
+        else:
+            col_bounds = _diag_cut(split_idx, split_len) if n > m else split_idx
+            row_bounds = _diag_cut(split_idx, other_len)
+        self.__row_inds = [0] + row_bounds[:-1]
+        self.__col_inds = [0] + col_bounds[:-1]
+        self.__row_bounds = row_bounds
+        self.__col_bounds = col_bounds
+        self.__arr = arr
+        self.__lshape_map = lshape_map
+
+        # tile_map[r, c] = (row_start, col_start, owner)
+        nrows, ncols = len(row_bounds), len(col_bounds)
+        tmap = np.zeros((nrows, ncols, 3), dtype=np.int64)
+        ends = np.cumsum(block_sizes)
+        for r in range(nrows):
+            for c in range(ncols):
+                rs = self.__row_inds[r]
+                cs = self.__col_inds[c]
+                along = rs if split == 0 else cs
+                owner = int(np.searchsorted(ends, along, side="right"))
+                tmap[r, c] = (rs, cs, owner)
+        self.__tile_map = tmap
+        per_proc = np.zeros(arr.comm.size, dtype=np.int64)
+        starts = [t[2] for t in tmap[:, 0]] if split == 0 else [t[2] for t in tmap[0, :]]
+        for o in starts:
+            per_proc[o] += 1
+        self.__tiles_per_proc = per_proc
+        diag_bound = next((i for i, b in enumerate(ends) if b >= diag_len), arr.comm.size - 1)
+        self.__last_diag_pr = diag_bound
+
+    @property
+    def arr(self) -> DNDarray:
+        """The tiled DNDarray (tiling.py:763)."""
+        return self.__arr
+
+    @property
+    def col_indices(self) -> List[int]:
+        """Global start column of each tile column (tiling.py:770)."""
+        return list(self.__col_inds)
+
+    @property
+    def row_indices(self) -> List[int]:
+        """Global start row of each tile row (tiling.py:792)."""
+        return list(self.__row_inds)
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        """(size, 2) local shapes (tiling.py:777)."""
+        return self.__lshape_map
+
+    @property
+    def last_diagonal_process(self) -> int:
+        """Rank of the last participant holding diagonal tiles (tiling.py:785)."""
+        return self.__last_diag_pr
+
+    @property
+    def tile_columns(self) -> int:
+        """Number of tile columns (tiling.py:799)."""
+        return len(self.__col_bounds)
+
+    @property
+    def tile_columns_per_process(self) -> List[int]:
+        """Tile columns owned per participant (tiling.py:806)."""
+        if self.__arr.split == 1:
+            return [int(x) for x in self.__tiles_per_proc]
+        return [self.tile_columns] * self.__arr.comm.size
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        """(rows, cols, 3) array of (row_start, col_start, owner) (tiling.py:813)."""
+        return self.__tile_map
+
+    @property
+    def tile_rows(self) -> int:
+        """Number of tile rows (tiling.py:849)."""
+        return len(self.__row_bounds)
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        """Tile rows owned per participant (tiling.py:856)."""
+        if self.__arr.split == 0:
+            return [int(x) for x in self.__tiles_per_proc]
+        return [self.tile_rows] * self.__arr.comm.size
+
+    def get_start_stop(self, key) -> Tuple[int, int, int, int]:
+        """(row_start, row_stop, col_start, col_stop) in *global* indices for
+        the tile(s) at ``key`` (tiling.py:862; the reference returns
+        process-local indices — global is the single-controller analog)."""
+        r, c = key if isinstance(key, tuple) else (key, slice(None))
+
+        def _bounds(k, inds, bounds):
+            if isinstance(k, (int, np.integer)):
+                if k < 0:
+                    k += len(bounds)
+                return inds[k], bounds[k]
+            idx = list(range(len(bounds)))[k]
+            return inds[idx[0]], bounds[idx[-1]]
+
+        r0, r1 = _bounds(r, self.__row_inds, self.__row_bounds)
+        c0, c1 = _bounds(c, self.__col_inds, self.__col_bounds)
+        return r0, r1, c0, c1
+
+    def __getitem__(self, key) -> Optional[jnp.ndarray]:
+        """Tile data on the owning participant, else ``None`` (tiling.py:928)."""
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        if not _addressable(self.__arr, self.__owners(key)):
+            return None
+        return self.__arr._dense()[r0:r1, c0:c1]
+
+    def __owners(self, key) -> np.ndarray:
+        r, c = key if isinstance(key, tuple) else (key, slice(None))
+        return np.atleast_1d(self.__tile_map[r, c][..., 2]).ravel()
+
+    def local_get(self, key) -> jnp.ndarray:
+        """Tile data addressed in this participant's local tile grid
+        (tiling.py:975) — single-controller: same global grid."""
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        return self.__arr._dense()[r0:r1, c0:c1]
+
+    def local_set(self, key, value) -> None:
+        """Set a tile addressed in the local grid (tiling.py:995)."""
+        self.__setitem__(key, value)
+
+    def local_to_global(self, key, rank: int) -> Tuple[int, int]:
+        """Translate a participant-local tile index into the global tile
+        grid (tiling.py:1058)."""
+        r, c = key if isinstance(key, tuple) else (key, 0)
+        if self.__arr.split == 0:
+            offset = int(np.sum(self.__tiles_per_proc[:rank]))
+            return r + offset, c
+        offset = int(np.sum(self.__tiles_per_proc[:rank]))
+        return r, c + offset
+
+    def __setitem__(self, key, value) -> None:
+        """Overwrite tile data (tiling.py:1246)."""
+        if jax.process_count() > 1:  # pragma: no cover - multi-host
+            raise NotImplementedError("tile writes across hosts: use global __setitem__")
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        dense = self.__arr._dense()
+        value = jnp.asarray(value, dense.dtype)
+        new = dense.at[r0:r1, c0:c1].set(jnp.broadcast_to(value, dense[r0:r1, c0:c1].shape))
+        from .dndarray import _pad_to_canonical
+
+        self.__arr._replace(_pad_to_canonical(new, self.__arr.gshape, self.__arr.split, self.__arr.comm))

@@ -77,6 +77,9 @@ __all__ = [
     "iinfo",
     "finfo",
     "enable_x64",
+    "float_",
+    "iscomplex",
+    "isreal",
 ]
 
 
@@ -212,6 +215,7 @@ class float32(floating):
 
 
 float = float32
+float_ = float32  # NumPy-style alias (types.py:425)
 
 
 class float64(floating):
@@ -481,6 +485,28 @@ class finfo:
 
     def __repr__(self) -> str:
         return f"finfo(resolution={self.resolution}, min={self.min}, max={self.max}, dtype={self.dtype.__name__})"
+
+
+def iscomplex(x):
+    """Test element-wise if input is complex (types.py:785)."""
+    from . import factories
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    if issubclass(canonical_heat_type(x.dtype), complexfloating):
+        return x.imag != 0
+    return factories.zeros(x.shape, bool, split=x.split, device=x.device, comm=x.comm)
+
+
+def isreal(x):
+    """Test element-wise if input is real (types.py:807)."""
+    from . import factories
+    from .sanitation import sanitize_in
+
+    sanitize_in(x)
+    if issubclass(canonical_heat_type(x.dtype), complexfloating):
+        return x.imag == 0
+    return factories.ones(x.shape, bool, split=x.split, device=x.device, comm=x.comm)
 
 
 def enable_x64(enable: builtins.bool = True) -> None:

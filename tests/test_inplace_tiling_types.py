@@ -1,0 +1,206 @@
+"""In-place op variants, tiling metadata, and type predicates.
+
+Reference coverage model: heat/core/tests/test_arithmetics.py (in-place
+sections), test_tiling.py, test_types.py.
+"""
+
+import numpy as np
+import pytest
+
+
+class TestInplaceOps:
+    def test_arithmetic_roundtrip(self, ht):
+        a_np = np.arange(42, dtype=np.float32).reshape(6, 7)
+        for split in (None, 0, 1):
+            x = ht.array(a_np, split=split)
+            y = x  # aliasing must be preserved by in-place ops
+            x.add_(1.0)
+            x.sub_(2.0)
+            x.mul_(3.0)
+            x.div_(3.0)
+            np.testing.assert_allclose(x.numpy(), a_np - 1.0, rtol=1e-6)
+            assert y is x
+
+    def test_module_level_functions(self, ht):
+        a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+        x = ht.array(a_np, split=0)
+        out = ht.add_(x, ht.array(np.ones_like(a_np), split=0))
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), a_np + 1)
+        ht.pow_(x, 2.0)
+        np.testing.assert_allclose(x.numpy(), (a_np + 1) ** 2, rtol=1e-6)
+        ht.neg_(x)
+        np.testing.assert_allclose(x.numpy(), -((a_np + 1) ** 2), rtol=1e-6)
+
+    def test_bitwise_and_shift(self, ht):
+        v = np.arange(8)
+        x = ht.array(v, split=0)
+        x.left_shift_(2)
+        np.testing.assert_array_equal(x.numpy(), v << 2)
+        x.right_shift_(1)
+        np.testing.assert_array_equal(x.numpy(), v << 1)
+        x.bitwise_and_(6)
+        np.testing.assert_array_equal(x.numpy(), (v << 1) & 6)
+        x.bitwise_or_(1)
+        x.bitwise_xor_(3)
+        np.testing.assert_array_equal(x.numpy(), (((v << 1) & 6) | 1) ^ 3)
+
+    def test_cum_inplace(self, ht):
+        a_np = np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+        x = ht.array(a_np, split=0)
+        x.cumsum_(0)
+        np.testing.assert_allclose(x.numpy(), np.cumsum(a_np, 0), rtol=1e-6)
+        y = ht.array(a_np, split=1)
+        y.cumprod_(1)
+        np.testing.assert_allclose(y.numpy(), np.cumprod(a_np, 1), rtol=1e-5)
+
+    def test_cast_safety(self, ht):
+        x = ht.array(np.arange(4), split=0)
+        with pytest.raises(TypeError):
+            x.add_(1.5)
+        with pytest.raises(TypeError):
+            x.div_(2)  # true division produces floats
+
+    def test_dunder_inplace_aliases(self, ht):
+        a_np = np.arange(6, dtype=np.float32)
+        x = ht.array(a_np, split=0)
+        x += 1
+        x *= 2
+        np.testing.assert_allclose(x.numpy(), (a_np + 1) * 2)
+        y = ht.array(np.arange(6), split=0)
+        y <<= 1
+        np.testing.assert_array_equal(y.numpy(), np.arange(6) << 1)
+
+    def test_nan_to_num_inplace(self, ht):
+        x = ht.array(np.array([1.0, np.nan, np.inf]), split=0)
+        x.nan_to_num_()
+        assert np.isfinite(x.numpy()).all()
+
+
+class TestSplitTiles:
+    def test_grid_metadata(self, ht):
+        a = ht.arange(42, dtype=ht.float32, split=0).reshape((6, 7))
+        t = ht.SplitTiles(a)
+        size = a.comm.size
+        assert t.tile_dimensions.shape == (2, size)
+        # each dim's tile extents sum to the global extent
+        np.testing.assert_array_equal(t.tile_dimensions.sum(axis=1), [6, 7])
+        np.testing.assert_array_equal(t.tile_ends_g[:, -1], [6, 7])
+        assert t.tile_locations.shape == (size, size)
+        # along split 0, the owner is the row-tile coordinate
+        for r in range(size):
+            assert (t.tile_locations[r] == r).all()
+
+    def test_tile_data_and_size(self, ht):
+        a_np = np.arange(42, dtype=np.float32).reshape(6, 7)
+        a = ht.array(a_np, split=0)
+        t = ht.SplitTiles(a)
+        # whole first row-stripe of tiles
+        got = t[0]
+        assert got is not None
+        h = int(t.tile_dimensions[0][0])
+        np.testing.assert_array_equal(np.asarray(got), a_np[:h])
+        assert t.get_tile_size((0, 0)) == tuple(int(t.tile_dimensions[d][0]) for d in (0, 1))
+
+    def test_setitem(self, ht):
+        a_np = np.arange(42, dtype=np.float32).reshape(6, 7)
+        a = ht.array(a_np, split=0)
+        t = ht.SplitTiles(a)
+        t[0, 0] = 99.0
+        h = int(t.tile_dimensions[0][0])
+        w = int(t.tile_dimensions[1][0])
+        exp = a_np.copy()
+        exp[:h, :w] = 99.0
+        np.testing.assert_array_equal(a.numpy(), exp)
+
+    def test_replicated_locations(self, ht):
+        a = ht.arange(24, dtype=ht.float32).reshape((4, 6))
+        t = ht.SplitTiles(a)
+        assert (t.tile_locations == a.comm.rank).all()
+
+
+class TestSquareDiagTiles:
+    def test_square_decomposition(self, ht):
+        a_np = np.arange(64, dtype=np.float32).reshape(8, 8)
+        a = ht.array(a_np, split=0)
+        sq = ht.SquareDiagTiles(a, tiles_per_proc=1)
+        assert sq.tile_rows >= a.comm.size or sq.tile_rows == 8
+        # diagonal tiles are square
+        for i in range(min(sq.tile_rows, sq.tile_columns)):
+            r0, r1, c0, c1 = sq.get_start_stop((i, i))
+            assert (r1 - r0) == (c1 - c0)
+        # full cover
+        r0, r1, c0, c1 = sq.get_start_stop((slice(None), slice(None)))
+        assert (r0, r1, c0, c1) == (0, 8, 0, 8)
+
+    def test_square_diagonal_tall_and_wide(self, ht):
+        # diagonal tiles must stay square even when the split-dim extent
+        # exceeds the other dim (tall, split=0) and vice versa (wide, split=1)
+        for shape, split in (((10, 8), 0), ((8, 10), 1), ((12, 5), 0), ((5, 12), 1)):
+            a_np = np.arange(shape[0] * shape[1], dtype=np.float32).reshape(shape)
+            a = ht.array(a_np, split=split)
+            sq = ht.SquareDiagTiles(a, tiles_per_proc=2)
+            for i in range(min(sq.tile_rows, sq.tile_columns)):
+                r0, r1, c0, c1 = sq.get_start_stop((i, i))
+                if r0 < min(shape) and c0 < min(shape):
+                    assert (r1 - r0) == (c1 - c0), (shape, split, i, (r0, r1, c0, c1))
+            r0, r1, c0, c1 = sq.get_start_stop((slice(None), slice(None)))
+            assert (r0, r1, c0, c1) == (0, shape[0], 0, shape[1])
+
+    def test_iscomplex_rejects_non_dndarray(self, ht):
+        import numpy as _np
+        import pytest as _pytest
+
+        with _pytest.raises(TypeError):
+            ht.iscomplex(_np.arange(3.0))
+        with _pytest.raises(TypeError):
+            ht.isreal([1.0, 2.0])
+
+    def test_getitem_matches_numpy(self, ht):
+        a_np = np.arange(80, dtype=np.float32).reshape(10, 8)
+        a = ht.array(a_np, split=0)
+        sq = ht.SquareDiagTiles(a, tiles_per_proc=1)
+        r0, r1, c0, c1 = sq.get_start_stop((0, 1))
+        got = sq[0, 1]
+        if got is not None:
+            np.testing.assert_array_equal(np.asarray(got), a_np[r0:r1, c0:c1])
+
+    def test_rejects_bad_input(self, ht):
+        with pytest.raises(ValueError):
+            ht.SquareDiagTiles(ht.arange(10, split=0), tiles_per_proc=1)
+        a = ht.arange(16, dtype=ht.float32, split=0).reshape((4, 4))
+        with pytest.raises(ValueError):
+            ht.SquareDiagTiles(a, tiles_per_proc=0)
+
+
+class TestTypePredicates:
+    def test_iscomplex_isreal(self, ht):
+        x = ht.array(np.array([1 + 1j, 1 + 0j, 0 + 2j]), split=0)
+        np.testing.assert_array_equal(ht.iscomplex(x).numpy(), [True, False, True])
+        np.testing.assert_array_equal(ht.isreal(x).numpy(), [False, True, False])
+        r = ht.array(np.arange(3.0), split=0)
+        np.testing.assert_array_equal(ht.iscomplex(r).numpy(), [False] * 3)
+        np.testing.assert_array_equal(ht.isreal(r).numpy(), [True] * 3)
+
+    def test_float_alias(self, ht):
+        assert ht.float_ is ht.float32
+
+
+class TestNewDNDarrayMethods:
+    def test_counts_displs(self, ht):
+        a = ht.arange(10, split=0)
+        counts, displs = a.counts_displs()
+        assert sum(counts) >= 10  # padded canonical counts cover the extent
+        assert displs[0] == 0
+        with pytest.raises(ValueError):
+            ht.arange(10).counts_displs()
+
+    def test_is_distributed(self, ht):
+        assert ht.arange(10, split=0).is_distributed() or ht.arange(10, split=0).comm.size == 1
+        assert not ht.arange(10).is_distributed()
+
+    def test_create_lshape_map(self, ht):
+        a = ht.arange(10, split=0)
+        m = a.create_lshape_map()
+        assert m.shape == (a.comm.size, 1)
+        assert m.sum() == 10
