@@ -7,6 +7,7 @@ segment-sum expression on the sharded global array; XLA emits the psum.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Union
 
 import jax
@@ -18,6 +19,36 @@ from ..spatial import distance
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
+
+
+@partial(jax.jit, static_argnames=("n_true", "k"))
+def _lloyd_step(xp: jax.Array, centers: jax.Array, n_true: int, k: int):
+    """One fused Lloyd iteration on the padded sharded array.
+
+    The reference runs this as three separate distributed ops (ring cdist
+    distance.py:209, argmin with a custom MPI op statistics.py:1372, one-hot
+    matmul + Allreduce kmeans.py:80-120).  Fusing into one jitted program
+    keeps the whole iteration on-device: assignment needs only
+    ``|c|^2 - 2 x@c.T`` (the ``|x|^2`` row term cannot change the argmin),
+    both matmuls ride the MXU, and under a sharded ``xp`` GSPMD turns the
+    segment sums into a single psum over the sample axis.
+
+    Returns (labels_padded, new_centers, shift, inertia).
+    """
+    xc = xp @ centers.T  # (N, k) — MXU
+    c2 = jnp.sum(centers * centers, axis=1)
+    half_d2 = c2[None, :] - 2.0 * xc  # squared distance minus |x|^2 row term
+    labels = jnp.argmin(half_d2, axis=1)
+    valid = jax.lax.broadcasted_iota(jnp.int32, (xp.shape[0],), 0) < n_true
+    w = valid.astype(xp.dtype)
+    oh = jax.nn.one_hot(labels, k, dtype=xp.dtype) * w[:, None]
+    sums = oh.T @ xp  # (k, f) — MXU; GSPMD: psum across shards
+    counts = jnp.sum(oh, axis=0)
+    new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
+    shift = jnp.sum((new - centers) ** 2)
+    x2 = jnp.sum(xp * xp, axis=1)
+    inertia = jnp.sum(w * (x2 + jnp.min(half_d2, axis=1)))
+    return labels, new, shift, inertia
 
 
 class KMeans(_KCluster):
@@ -55,6 +86,17 @@ class KMeans(_KCluster):
         new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), old)
         return DNDarray.from_dense(new, None, x.device, x.comm)
 
+    def _fused_step(self, x: DNDarray):
+        """Run one fused Lloyd iteration; returns (labels_padded, shift, inertia)
+        and updates ``self._cluster_centers``."""
+        xp = x.larray_padded
+        if not types.heat_type_is_inexact(x.dtype):
+            xp = xp.astype(jnp.float32)
+        centers = self._cluster_centers._dense().astype(xp.dtype)
+        labels, new, shift, inertia = _lloyd_step(xp, centers, x.shape[0], self.n_clusters)
+        self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
+        return labels, shift, inertia
+
     def fit(self, x: DNDarray) -> "KMeans":
         """Lloyd iterations until center shift < tol (kmeans.py:~100)."""
         if not isinstance(x, DNDarray):
@@ -62,18 +104,18 @@ class KMeans(_KCluster):
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
         self._initialize_cluster_centers(x)
-        new_cluster_centers = self._cluster_centers
 
         for i in range(self.max_iter):
-            matching_centroids = self._assign_to_cluster(x)
-            new_cluster_centers = self._update_centroids(x, matching_centroids)
-            shift = float(
-                jnp.sum((new_cluster_centers._dense() - self._cluster_centers._dense()) ** 2)
-            )
-            self._cluster_centers = new_cluster_centers
-            if shift <= self.tol:
+            labels, shift, inertia = self._fused_step(x)
+            if float(shift) <= self.tol:
                 break
 
         self._n_iter = i + 1
-        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
+        # final assignment against the converged centers (the step's centroid
+        # update is discarded — the reference's last pass only assigns)
+        converged = self._cluster_centers
+        labels, _, inertia = self._fused_step(x)
+        self._cluster_centers = converged
+        self._inertia = float(inertia)
+        self._labels = DNDarray.from_dense(labels[: x.shape[0]], x.split, x.device, x.comm)
         return self
