@@ -23,6 +23,7 @@ from .indexing import *
 from .signal import *
 from .vmap import *
 from .tiling import *
+from .io import *
 from . import devices
 from . import types
 from . import random

@@ -1,0 +1,233 @@
+"""Data-driven parity sweep: every op family vs NumPy across splits.
+
+This is the analog of the reference's assert_func_equal idiom
+(test_suites/basic_test.py): one ground truth, all distributions.
+Shapes are non-divisible by the 8-device mesh to exercise pad-and-mask.
+"""
+
+import numpy as np
+import pytest
+
+RNG = np.random.default_rng(42)
+A = RNG.standard_normal((13, 7)).astype(np.float32)
+B = RNG.standard_normal((13, 7)).astype(np.float32)
+P = np.abs(A) + 0.5  # strictly positive
+I1 = RNG.integers(1, 20, (13, 7)).astype(np.int32)
+I2 = RNG.integers(1, 20, (13, 7)).astype(np.int32)
+
+UNARY = [
+    ("sin", A), ("cos", A), ("tan", A), ("arcsin", np.clip(A, -0.9, 0.9)),
+    ("arccos", np.clip(A, -0.9, 0.9)), ("arctan", A), ("sinh", A), ("cosh", A),
+    ("tanh", A), ("arcsinh", A), ("arctanh", np.clip(A, -0.9, 0.9)),
+    ("exp", A), ("expm1", A), ("exp2", A), ("log", P), ("log2", P),
+    ("log10", P), ("log1p", P), ("sqrt", P), ("abs", A), ("ceil", A),
+    ("floor", A), ("trunc", A), ("sign", A), ("negative", A),
+    ("deg2rad", A), ("rad2deg", A), ("isnan", A), ("isinf", A), ("isfinite", A),
+    ("signbit", A), ("square", A),
+]
+
+NP_ALIASES = {}
+
+BINARY = [
+    ("add", A, B), ("subtract", A, B), ("multiply", A, B),
+    ("divide", A, P), ("floor_divide", A, P), ("mod", A, P),
+    ("fmod", A, P), ("power", P, B), ("copysign", A, B), ("hypot", P, np.abs(B)),
+    ("maximum", A, B), ("minimum", A, B), ("arctan2", A, B),
+    ("gcd", I1, I2), ("lcm", I1, I2),
+    ("logaddexp", A, B), ("logaddexp2", A, B),
+]
+
+REDUCTIONS = [
+    ("sum", A, {}), ("prod", np.sign(A) * 1.01, {}), ("mean", A, {}),
+    ("std", A, {}), ("var", A, {}), ("min", A, {}), ("max", A, {}),
+    ("sum", A, {"axis": 0}), ("sum", A, {"axis": 1}),
+    ("mean", A, {"axis": 0}), ("var", A, {"axis": 1}),
+    ("min", A, {"axis": 0}), ("max", A, {"axis": 1}),
+    ("nansum", np.where(A > 1, np.nan, A), {}),
+    ("nanprod", np.where(A > 1, np.nan, np.sign(A) * 1.01), {}),
+]
+
+LOGICAL = [
+    ("logical_and", A > 0, B > 0), ("logical_or", A > 0, B > 0),
+    ("logical_xor", A > 0, B > 0),
+]
+
+MANIP = [
+    ("flipud", A, {}), ("fliplr", A, {}), ("transpose", A, {}),
+    ("ravel", A, {}), ("squeeze", A[None], {}), ("rot90", A, {}),
+    ("swapaxes", A, {"axis1": 0, "axis2": 1}),
+    ("moveaxis", A, {"source": 0, "destination": 1}),
+]
+
+
+def _splits_for(arr):
+    return (None, 0, 1) if arr.ndim >= 2 else (None, 0)
+
+
+class TestUnarySweep:
+    @pytest.mark.parametrize("name,data", UNARY, ids=[u[0] for u in UNARY])
+    def test_unary(self, ht, name, data):
+        np_fn = NP_ALIASES.get(name, getattr(np, name, None))
+        if np_fn is None:
+            pytest.skip(f"no ground truth for {name}")
+        expected = np_fn(data.astype(np.float64)) if data.dtype.kind == "f" else np_fn(data)
+        fn = getattr(ht, name)
+        for split in _splits_for(data):
+            got = fn(ht.array(data, split=split)).numpy()
+            np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5, err_msg=f"{name} split={split}")
+
+
+class TestBinarySweep:
+    @pytest.mark.parametrize("name,x,y", BINARY, ids=[b[0] for b in BINARY])
+    def test_binary(self, ht, name, x, y):
+        np_fn = getattr(np, name)
+        expected = np_fn(x, y)
+        fn = getattr(ht, name)
+        for split in _splits_for(x):
+            got = fn(ht.array(x, split=split), ht.array(y, split=split)).numpy()
+            np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5, err_msg=f"{name} split={split}")
+
+    def test_mixed_split_binary(self, ht):
+        """Operands with different splits must still combine correctly
+        (_operations.py:22 split-matching via sanitize_distribution)."""
+        for s1 in (None, 0, 1):
+            for s2 in (None, 0, 1):
+                got = (ht.array(A, split=s1) + ht.array(B, split=s2)).numpy()
+                np.testing.assert_allclose(got, A + B, rtol=1e-6, err_msg=f"{s1}+{s2}")
+
+    def test_broadcasting(self, ht):
+        row = B[0]
+        for split in (None, 0, 1):
+            got = (ht.array(A, split=split) * ht.array(row)).numpy()
+            np.testing.assert_allclose(got, A * row, rtol=1e-6)
+        col = B[:, :1]
+        got = (ht.array(A, split=0) + ht.array(col, split=0)).numpy()
+        np.testing.assert_allclose(got, A + col, rtol=1e-6)
+
+
+class TestReductionSweep:
+    @pytest.mark.parametrize(
+        "name,data,kw", REDUCTIONS, ids=[f"{r[0]}-{r[2].get('axis','all')}" for r in REDUCTIONS]
+    )
+    def test_reduction(self, ht, name, data, kw):
+        expected = getattr(np, name)(data.astype(np.float64), **kw)
+        fn = getattr(ht, name)
+        for split in _splits_for(data):
+            got = fn(ht.array(data, split=split), **kw)
+            got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+            np.testing.assert_allclose(
+                got.astype(np.float64), expected, rtol=1e-4, atol=1e-5, err_msg=f"{name} split={split} {kw}"
+            )
+
+    def test_all_any_keepdims(self, ht):
+        m = A > 0
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            assert bool(ht.all(x)) == bool(m.all())
+            assert bool(ht.any(x)) == bool(m.any())
+            np.testing.assert_array_equal(
+                ht.all(x, axis=0, keepdims=True).numpy(), m.all(0, keepdims=True)
+            )
+            np.testing.assert_array_equal(
+                ht.any(x, axis=1, keepdims=True).numpy(), m.any(1, keepdims=True)
+            )
+
+    def test_allclose_isclose_equal(self, ht):
+        for split in (None, 0, 1):
+            x = ht.array(A, split=split)
+            y = ht.array(A + 1e-8, split=split)
+            assert ht.allclose(x, y)
+            assert bool(ht.isclose(x, y).all())
+            assert ht.equal(x, ht.array(A, split=split))
+            assert not ht.equal(x, ht.array(B, split=split))
+
+
+class TestLogicalSweep:
+    @pytest.mark.parametrize("name,x,y", LOGICAL, ids=[b[0] for b in LOGICAL])
+    def test_logical(self, ht, name, x, y):
+        expected = getattr(np, name)(x, y)
+        fn = getattr(ht, name)
+        for split in _splits_for(x):
+            got = fn(ht.array(x, split=split), ht.array(y, split=split)).numpy()
+            np.testing.assert_array_equal(got, expected)
+
+    def test_logical_not(self, ht):
+        m = A > 0
+        for split in (None, 0, 1):
+            np.testing.assert_array_equal(
+                ht.logical_not(ht.array(m, split=split)).numpy(), ~m
+            )
+
+
+class TestManipulationSweep:
+    @pytest.mark.parametrize("name,data,kw", MANIP, ids=[m[0] for m in MANIP])
+    def test_manip(self, ht, name, data, kw):
+        expected = getattr(np, name)(data, **kw)
+        fn = getattr(ht, name)
+        for split in _splits_for(data):
+            got = fn(ht.array(data, split=split), **kw).numpy()
+            np.testing.assert_allclose(got, expected, rtol=1e-6, err_msg=f"{name} split={split}")
+
+    def test_where_nonzero(self, ht):
+        for split in (None, 0, 1):
+            x = ht.array(A, split=split)
+            np.testing.assert_allclose(
+                ht.where(x > 0, x, 0.0).numpy(), np.where(A > 0, A, 0.0), rtol=1e-6
+            )
+            nz = ht.nonzero(x > 0)
+            np_nz = np.nonzero(A > 0)
+            if isinstance(nz, (tuple, list)):
+                for g, e in zip(nz, np_nz):
+                    np.testing.assert_array_equal(g.numpy(), e)
+            else:
+                np.testing.assert_array_equal(nz.numpy(), np.stack(np_nz, 1))
+
+
+class TestLinalgSweep:
+    def test_norms(self, ht):
+        for split in (None, 0, 1):
+            x = ht.array(A, split=split)
+            np.testing.assert_allclose(float(ht.norm(x)), np.linalg.norm(A), rtol=1e-5)
+            np.testing.assert_allclose(
+                ht.vector_norm(x, axis=1).numpy(), np.linalg.norm(A, axis=1), rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                float(ht.matrix_norm(x, ord="fro")), np.linalg.norm(A, "fro"), rtol=1e-5
+            )
+
+    def test_dot_outer_trace(self, ht):
+        v = A[:, 0].copy()
+        w = B[:, 0].copy()
+        for split in (None, 0):
+            hv, hw = ht.array(v, split=split), ht.array(w, split=split)
+            np.testing.assert_allclose(float(ht.dot(hv, hw)), v @ w, rtol=1e-5)
+            np.testing.assert_allclose(ht.outer(hv, hw).numpy(), np.outer(v, w), rtol=1e-5)
+            np.testing.assert_allclose(float(ht.vdot(hv, hw)), np.vdot(v, w), rtol=1e-5)
+        sq = A[:7, :7]
+        for split in (None, 0, 1):
+            np.testing.assert_allclose(
+                float(ht.trace(ht.array(sq, split=split))), np.trace(sq), rtol=1e-5
+            )
+
+    def test_matmul_all_split_combos(self, ht):
+        X = RNG.standard_normal((9, 5)).astype(np.float32)
+        Y = RNG.standard_normal((5, 11)).astype(np.float32)
+        expected = X @ Y
+        for s1 in (None, 0, 1):
+            for s2 in (None, 0, 1):
+                got = ht.matmul(ht.array(X, split=s1), ht.array(Y, split=s2)).numpy()
+                np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4, err_msg=f"{s1}x{s2}")
+
+    def test_cross_tril_triu(self, ht):
+        u = RNG.standard_normal((6, 3)).astype(np.float32)
+        v = RNG.standard_normal((6, 3)).astype(np.float32)
+        for split in (None, 0):
+            np.testing.assert_allclose(
+                ht.cross(ht.array(u, split=split), ht.array(v, split=split)).numpy(),
+                np.cross(u, v),
+                rtol=1e-5,
+            )
+        for split in (None, 0, 1):
+            x = ht.array(A, split=split)
+            np.testing.assert_allclose(ht.tril(x).numpy(), np.tril(A), rtol=1e-6)
+            np.testing.assert_allclose(ht.triu(x, k=1).numpy(), np.triu(A, 1), rtol=1e-6)
