@@ -9,9 +9,17 @@ heat/nn/functional.py).
 """
 
 from . import functional
+from .attention import ring_attention, scaled_dot_product_attention, ulysses_attention
 from .data_parallel import DataParallel, DataParallelMultiGPU
 
-__all__ = ["DataParallel", "DataParallelMultiGPU", "functional"]
+__all__ = [
+    "DataParallel",
+    "DataParallelMultiGPU",
+    "functional",
+    "ring_attention",
+    "scaled_dot_product_attention",
+    "ulysses_attention",
+]
 
 
 def __getattr__(name):
