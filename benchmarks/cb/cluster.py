@@ -1,0 +1,40 @@
+"""Clustering continuous benchmarks (reference: benchmarks/cb/cluster.py)."""
+
+# flake8: noqa
+import heat_tpu as ht
+from monitor import monitor
+
+
+@monitor()
+def kmeans(data):
+    model = ht.cluster.KMeans(n_clusters=4, init="kmeans++")
+    model.fit(data)
+
+
+@monitor()
+def kmedians(data):
+    model = ht.cluster.KMedians(n_clusters=4, init="kmedians++")
+    model.fit(data)
+
+
+@monitor()
+def kmedoids(data):
+    model = ht.cluster.KMedoids(n_clusters=4, init="kmedoids++")
+    model.fit(data)
+
+
+@monitor()
+def batchparallel_kmeans(data):
+    model = ht.cluster.BatchParallelKMeans(n_clusters=4, init="k-means++")
+    model.fit(data)
+
+
+def run_cluster_benchmarks(scale: float = 1.0):
+    n = max(int(5000 * scale), 256)
+    data = ht.utils.data.spherical.create_spherical_dataset(
+        num_samples_cluster=n, radius=1.0, offset=4.0, dtype=ht.float32, random_state=1
+    )
+    kmeans(data)
+    kmedians(data)
+    kmedoids(data)
+    batchparallel_kmeans(data)

@@ -1,0 +1,86 @@
+"""Linear-algebra continuous benchmarks (reference: benchmarks/cb/linalg.py).
+
+Workload shapes follow the reference's definitions (matmul n x n split 0/1
+:42-52, tall-skinny QR with ~4e6 elements per participant :54-58, square
+QR split 1 :60-63, lanczos on an n=50 f64 Gram matrix :65-69, hsvd of a
+1000 x 500p rank-10 matrix :71-76), scaled by the BENCH_SCALE env var so
+the same script runs on one chip or a pod slice.
+"""
+
+# flake8: noqa
+import heat_tpu as ht
+from monitor import monitor
+
+
+@monitor()
+def matmul_split_0(a, b):
+    return a @ b
+
+
+@monitor()
+def matmul_split_1(a, b):
+    return a @ b
+
+
+@monitor()
+def qr_split_0(a):
+    return ht.linalg.qr(a)
+
+
+@monitor()
+def qr_split_1(a):
+    return ht.linalg.qr(a)
+
+
+@monitor()
+def hierachical_svd_rank(data, r):
+    return ht.linalg.hsvd_rank(data, maxrank=r, compute_sv=True, silent=True)
+
+
+@monitor()
+def hierachical_svd_tol(data, tol):
+    return ht.linalg.hsvd_rtol(data, rtol=tol, compute_sv=True, silent=True)
+
+
+@monitor()
+def lanczos(B):
+    return ht.linalg.lanczos(B, m=B.shape[0])
+
+
+def run_linalg_benchmarks(scale: float = 1.0):
+    p = ht.get_comm().size
+
+    n = max(int(3000 * scale), 64)
+    a = ht.random.rand(n, n, split=0)
+    b = ht.random.rand(n, n, split=0)
+    matmul_split_0(a, b)
+    del a, b
+
+    a = ht.random.rand(n, n, split=1)
+    b = ht.random.rand(n, n, split=1)
+    matmul_split_1(a, b)
+    del a, b
+
+    n = max(int((4000000 * scale // p) ** 0.5), 32)
+    m = p * n
+    a_0 = ht.random.rand(m, n, split=0)
+    qr_split_0(a_0)
+    del a_0
+
+    n = max(int(2000 * scale), 64)
+    a_1 = ht.random.rand(n, n, split=1)
+    qr_split_1(a_1)
+    del a_1
+
+    n = 50
+    A = ht.random.rand(n, n, dtype=ht.float64, split=0)
+    B = A @ A.T
+    lanczos(B)
+    del A, B
+
+    data = ht.utils.data.matrixgallery.random_known_rank(
+        max(int(1000 * scale), 64), max(int(500 * scale), 32) * p, 10, split=1, dtype=ht.float32
+    )[0]
+    hierachical_svd_rank(data, 10)
+    hierachical_svd_tol(data, 1e-2)
+    del data

@@ -1,0 +1,44 @@
+"""Continuous-benchmark runner (reference: benchmarks/cb/main.py).
+
+Usage::
+
+    python benchmarks/cb/main.py              # full suite on the default device
+    BENCH_SCALE=0.1 python benchmarks/cb/main.py   # scaled-down smoke run
+
+Emits one JSON line per benchmark ({"bench", "seconds"}) plus a final
+summary line; the reference pushes the same workloads through perun to a
+Grafana dashboard (README.md:24).
+"""
+
+# flake8: noqa
+import json
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(os.path.dirname(_here)))  # repo root
+
+import heat_tpu as ht
+
+ht.random.seed(12345)
+
+from cluster import run_cluster_benchmarks
+from linalg import run_linalg_benchmarks
+from manipulations import run_manipulation_benchmarks
+from monitor import RESULTS
+from preprocessing import run_preprocessing_benchmarks
+
+
+def main():
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    run_linalg_benchmarks(scale)
+    run_cluster_benchmarks(scale)
+    run_manipulation_benchmarks(scale)
+    run_preprocessing_benchmarks(scale)
+    total = sum(r["seconds"] for r in RESULTS)
+    print(json.dumps({"bench": "TOTAL", "seconds": round(total, 3), "count": len(RESULTS)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Manipulation continuous benchmarks (reference: benchmarks/cb/manipulations.py)."""
+
+# flake8: noqa
+from typing import List, Optional
+
+import heat_tpu as ht
+from monitor import monitor
+
+
+@monitor()
+def concatenate(arrays):
+    return ht.concatenate(arrays, axis=1)
+
+
+@monitor()
+def reshape(arrays, row_target: int):
+    out = []
+    for array in arrays:
+        out.append(ht.reshape(array, (row_target, -1), new_split=1))
+    return out
+
+
+@monitor()
+def resplit(array, new_splits: List[Optional[int]]):
+    out = []
+    for new_split in new_splits:
+        out.append(ht.resplit(array, axis=new_split))
+    return out
+
+
+def run_manipulation_benchmarks(scale: float = 1.0):
+    sizes = [max(int(s * scale), 128) for s in (10000, 20000, 40000)]
+    rows = max(int(1000 * scale), 64)
+
+    # reference reshapes every (1000, s) array to 1e7 rows; the scale-free
+    # invariant is "rows x smallest size" so the -1 column count stays integral
+    arrays = [ht.zeros((rows, size), split=1) for size in sizes]
+    reshape(arrays, rows * sizes[0])
+
+    arrays = [
+        ht.zeros((rows, size), split=None if i == 1 else 1) for i, size in enumerate(sizes)
+    ]
+    concatenate(arrays)
+
+    if ht.get_comm().size > 1:
+        shape = [
+            max(int(100 * scale), 8),
+            max(int(50 * scale), 4),
+            max(int(50 * scale), 4),
+            max(int(20 * scale), 4),
+            max(int(86 * scale), 8),
+        ]
+        n_elements = 1
+        for s in shape:
+            n_elements *= s
+        array = ht.reshape(ht.arange(0, n_elements, split=0, dtype=ht.float32), shape)
+        resplit(array, [None, 2, 4])

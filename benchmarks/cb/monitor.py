@@ -1,0 +1,53 @@
+"""Timing monitor for the continuous-benchmark suite.
+
+The reference instruments its cb functions with the external ``perun``
+energy/runtime monitor (benchmarks/cb/linalg.py:4, setup.py extras
+``cb=perun``).  perun is MPI-bound; the TPU-native stand-in measures
+wall time around a fully-synchronized call (``jax.block_until_ready`` on
+every jax array in the result) and emits one JSON line per benchmark —
+the same shape the round driver's bench.py reports.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+from typing import Any
+
+import jax
+
+RESULTS = []
+
+
+def _sync(obj: Any) -> None:
+    if hasattr(obj, "larray_padded"):
+        jax.block_until_ready(obj.larray_padded)
+    elif isinstance(obj, jax.Array):
+        jax.block_until_ready(obj)
+    elif isinstance(obj, (tuple, list)):
+        for o in obj:
+            _sync(o)
+    elif isinstance(obj, dict):
+        for o in obj.values():
+            _sync(o)
+
+
+def monitor():
+    """Decorator mirroring perun's ``@monitor()`` (benchmarks/cb usage)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            _sync(out)
+            elapsed = time.perf_counter() - t0
+            record = {"bench": fn.__name__, "seconds": round(elapsed, 6)}
+            RESULTS.append(record)
+            print(json.dumps(record), flush=True)
+            return out
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,47 @@
+"""Preprocessing continuous benchmarks (reference: benchmarks/cb/preprocessing.py).
+
+The reference benchmarks the in-place (`copy=False`) forward + inverse
+transformations of every scaler."""
+
+# flake8: noqa
+import heat_tpu as ht
+from monitor import monitor
+
+
+@monitor()
+def apply_inplace_standard_scaler_and_inverse(X):
+    scaler = ht.preprocessing.StandardScaler(copy=False)
+    scaler.inverse_transform(scaler.fit_transform(X))
+
+
+@monitor()
+def apply_inplace_min_max_scaler_and_inverse(X):
+    scaler = ht.preprocessing.MinMaxScaler(copy=False)
+    scaler.inverse_transform(scaler.fit_transform(X))
+
+
+@monitor()
+def apply_inplace_max_abs_scaler_and_inverse(X):
+    scaler = ht.preprocessing.MaxAbsScaler(copy=False)
+    scaler.inverse_transform(scaler.fit_transform(X))
+
+
+@monitor()
+def apply_inplace_robust_scaler_and_inverse(X):
+    scaler = ht.preprocessing.RobustScaler(copy=False)
+    scaler.inverse_transform(scaler.fit_transform(X))
+
+
+@monitor()
+def apply_inplace_normalizer(X):
+    ht.preprocessing.Normalizer(copy=False).fit_transform(X)
+
+
+def run_preprocessing_benchmarks(scale: float = 1.0):
+    n = max(int(5000 * scale), 256)
+    X = ht.random.randn(n, 50, split=0)
+    apply_inplace_standard_scaler_and_inverse(X)
+    apply_inplace_min_max_scaler_and_inverse(X)
+    apply_inplace_max_abs_scaler_and_inverse(X)
+    apply_inplace_robust_scaler_and_inverse(X)
+    apply_inplace_normalizer(X)

@@ -82,18 +82,23 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             idx = ht_random.randint(0, n, size=(k,), comm=x.comm)._dense()
             centers = dense[idx]
         elif self.init in ("kmeans++", "probability_based", "++"):
-            # kmeans++ sampling (_kcluster.py:112-180): greedy D^2 weighting
+            # kmeans++ sampling (_kcluster.py:112-180): greedy D^2 weighting.
+            # Centers are preallocated at (k, f) and filled progressively so
+            # every iteration has identical shapes (one XLA program, not k),
+            # with unfilled slots masked to +inf in the distance min.
             key_arr = ht_random.randint(0, n, size=(1,), comm=x.comm)._dense()
-            centers = dense[key_arr[0]][None, :]
-            for _ in range(1, k):
-                d2 = jnp.min(
-                    jnp.sum((dense[:, None, :] - centers[None, :, :]) ** 2, axis=-1), axis=1
-                )
+            centers = jnp.zeros((k, f), dense.dtype).at[0].set(dense[key_arr[0]])
+            x2 = jnp.sum(dense * dense, axis=1)
+            for i in range(1, k):
+                c2 = jnp.sum(centers * centers, axis=1)
+                d_all = x2[:, None] + c2[None, :] - 2.0 * (dense @ centers.T)
+                d_all = d_all + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0)
+                d2 = jnp.maximum(jnp.min(d_all, axis=1), 0.0)
                 probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
                 u = ht_random.rand(1, comm=x.comm)._dense()[0]
                 next_idx = jnp.searchsorted(jnp.cumsum(probs), u)
                 next_idx = jnp.clip(next_idx, 0, n - 1)
-                centers = jnp.concatenate([centers, dense[next_idx][None, :]], axis=0)
+                centers = centers.at[i].set(dense[next_idx])
         elif self.init == "batchparallel":
             raise NotImplementedError("batchparallel init: use BatchParallelKMeans")
         else:

@@ -144,12 +144,22 @@ class _BatchParallelKCluster(BaseEstimator, ClusteringMixin):
 class BatchParallelKMeans(_BatchParallelKCluster):
     """Batch-parallel K-Means (batchparallelclustering.py:329)."""
 
-    def __init__(self, n_clusters=8, max_iter=300, tol=1e-4, random_state=None, n_procs_to_merge=None):
+    def __init__(self, n_clusters=8, init="k-means++", max_iter=300, tol=1e-4, random_state=None, n_procs_to_merge=None):
+        if not isinstance(init, str):
+            raise TypeError(f"init must be str, but was {type(init)}")
+        if init not in ("k-means++", "++", "random"):
+            raise ValueError(f'init must be "k-means++" or "random", but was {init}')
         super().__init__(n_clusters, max_iter, tol, random_state, n_procs_to_merge, medians=False)
+        self.init = init
 
 
 class BatchParallelKMedians(_BatchParallelKCluster):
     """Batch-parallel K-Medians (batchparallelclustering.py:392)."""
 
-    def __init__(self, n_clusters=8, max_iter=300, tol=1e-4, random_state=None, n_procs_to_merge=None):
+    def __init__(self, n_clusters=8, init="k-medians++", max_iter=300, tol=1e-4, random_state=None, n_procs_to_merge=None):
+        if not isinstance(init, str):
+            raise TypeError(f"init must be str, but was {type(init)}")
+        if init not in ("k-medians++", "++", "random"):
+            raise ValueError(f'init must be "k-medians++" or "random", but was {init}')
         super().__init__(n_clusters, max_iter, tol, random_state, n_procs_to_merge, medians=True)
+        self.init = init

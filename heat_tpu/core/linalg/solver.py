@@ -87,25 +87,7 @@ def lanczos(
     else:
         v = v0._dense().astype(dtype)
 
-    V = jnp.zeros((n, m), dtype=dtype)
-    T = jnp.zeros((m, m), dtype=jnp.float32)
-    V = V.at[:, 0].set(v)
-
-    beta = 0.0
-    v_prev = jnp.zeros_like(v)
-    for j in range(m):
-        w = jnp.matmul(dense_A, V[:, j], precision=jax.lax.Precision.HIGHEST)
-        alpha = jnp.real(jnp.vdot(V[:, j], w)) if is_complex else jnp.vdot(V[:, j], w)
-        w = w - alpha * V[:, j] - beta * v_prev
-        # full reorthogonalization (solver.py:153+)
-        w = w - jnp.matmul(V[:, : j + 1], jnp.matmul(jnp.conj(V[:, : j + 1]).T, w, precision=jax.lax.Precision.HIGHEST), precision=jax.lax.Precision.HIGHEST)
-        T = T.at[j, j].set(alpha.astype(jnp.float32))
-        if j < m - 1:
-            beta = jnp.linalg.norm(w)
-            T = T.at[j, j + 1].set(beta.astype(jnp.float32))
-            T = T.at[j + 1, j].set(beta.astype(jnp.float32))
-            v_prev = V[:, j]
-            V = V.at[:, j + 1].set(jnp.where(beta > 1e-10, w / jnp.maximum(beta, 1e-30), w))
+    V, T = _lanczos_impl(dense_A, v, m, is_complex)
 
     V_res = DNDarray.from_dense(V, A.split, A.device, A.comm)
     T_res = DNDarray.from_dense(T, None, A.device, A.comm)
@@ -116,6 +98,55 @@ def lanczos(
         T_out._replace(T_res.larray_padded)
         T_res = T_out
     return V_res, T_res
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("m", "is_complex"))
+def _lanczos_impl(dense_A: jax.Array, v: jax.Array, m: int, is_complex: bool):
+    """Krylov loop with static shapes, compiled once.
+
+    Reorthogonalization projects against the FULL (n, m) basis every step:
+    the not-yet-filled columns are zero, so ``V (V^H w)`` is identical to
+    the reference's growing ``V[:, :j+1]`` product (solver.py:153+) while
+    keeping every iteration the same shape — one compilation instead of m.
+    """
+    n = dense_A.shape[0]
+    dtype = dense_A.dtype
+    hi = jax.lax.Precision.HIGHEST
+
+    V0 = jnp.zeros((n, m), dtype=dtype).at[:, 0].set(v)
+    T0 = jnp.zeros((m, m), dtype=jnp.float32)
+
+    def alpha_of(vj, w):
+        a = jnp.vdot(vj, w)
+        return jnp.real(a) if is_complex else a
+
+    def body(j, carry):
+        V, T, beta, v_prev = carry
+        vj = jax.lax.dynamic_slice_in_dim(V, j, 1, axis=1)[:, 0]
+        w = jnp.matmul(dense_A, vj, precision=hi)
+        alpha = alpha_of(vj, w)
+        w = w - alpha * vj - beta * v_prev
+        w = w - jnp.matmul(V, jnp.matmul(jnp.conj(V).T, w, precision=hi), precision=hi)
+        T = T.at[j, j].set(alpha.astype(jnp.float32))
+        beta_new = jnp.linalg.norm(w)
+        T = T.at[j, j + 1].set(beta_new.astype(jnp.float32))
+        T = T.at[j + 1, j].set(beta_new.astype(jnp.float32))
+        v_next = jnp.where(beta_new > 1e-10, w / jnp.maximum(beta_new, 1e-30).astype(dtype), w)
+        V = V.at[:, j + 1].set(v_next)
+        return V, T, beta_new.astype(dtype if not is_complex else jnp.float32), vj
+
+    beta0 = jnp.zeros((), jnp.float32 if is_complex else dtype)
+    V, T, beta, v_prev = jax.lax.fori_loop(
+        0, m - 1, body, (V0, T0, beta0, jnp.zeros_like(v))
+    )
+    # final step: diagonal entry only (no j+1 column to fill)
+    vj = V[:, m - 1]
+    w = jnp.matmul(dense_A, vj, precision=hi)
+    T = T.at[m - 1, m - 1].set(alpha_of(vj, w).astype(jnp.float32))
+    return V, T
 
 
 def solve_triangular(A: DNDarray, b: DNDarray) -> DNDarray:

@@ -57,16 +57,17 @@ def random_orthogonal(m: int, n: int, split=None, device=None, comm=None) -> DND
 
 
 def random_known_singularvalues(
-    m: int, n: int, singular_values, split=None, device=None, comm=None
+    m: int, n: int, singular_values, split=None, device=None, comm=None, dtype=types.float32
 ) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray, DNDarray]]:
     """Random matrix with prescribed singular values (matrixgallery.py:130)."""
     sv = singular_values._dense() if isinstance(singular_values, DNDarray) else jnp.asarray(singular_values)
     k = sv.shape[0]
     if k > min(m, n):
         raise ValueError(f"number of singular values ({k}) must be <= min(m, n)")
+    jt = types.canonical_heat_type(dtype).jax_type()
     U = random_orthogonal(m, k, comm=comm)
     V = random_orthogonal(n, k, comm=comm)
-    a = (U._dense() * sv[None, :]) @ V._dense().T
+    a = ((U._dense() * sv[None, :]) @ V._dense().T).astype(jt)
     A = DNDarray.from_dense(a, split, None, comm)
     from ...core import factories
 
@@ -74,10 +75,18 @@ def random_known_singularvalues(
 
 
 def random_known_rank(
-    m: int, n: int, rank: int, split=None, device=None, comm=None
+    m: int, n: int, rank: int, quantile_function=None, split=None, device=None, comm=None, dtype=types.float32
 ) -> Tuple[DNDarray, Tuple[DNDarray, DNDarray, DNDarray]]:
-    """Random matrix of prescribed rank (matrixgallery.py:170)."""
+    """Random matrix of prescribed rank (matrixgallery.py:170,180-186).
+
+    ``quantile_function`` maps uniform draws to the singular-value
+    distribution (reference default: -log(x))."""
     if rank > min(m, n):
         raise ValueError(f"rank must be <= min(m, n), got {rank}")
-    sv = jnp.sort(ht_random.rand(rank, comm=comm)._dense())[::-1] + 0.1
-    return random_known_singularvalues(m, n, sv, split=split, device=device, comm=comm)
+    u = ht_random.rand(rank, comm=comm)._dense()
+    if quantile_function is None:
+        sv = -jnp.log(jnp.maximum(u, 1e-30))
+    else:
+        sv = jnp.asarray([quantile_function(float(x)) for x in u])
+    sv = jnp.sort(sv)[::-1]
+    return random_known_singularvalues(m, n, sv, split=split, device=device, comm=comm, dtype=dtype)
