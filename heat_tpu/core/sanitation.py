@@ -22,10 +22,32 @@ __all__ = [
     "sanitize_in",
     "sanitize_in_nd_realfloating",
     "sanitize_in_tensor",
+    "sanitize_infinity",
     "sanitize_lshape",
     "sanitize_out",
+    "sanitize_sequence",
     "scalar_to_1d",
 ]
+
+
+def sanitize_infinity(x):
+    """Largest representable value of the input's dtype (sanitation.py:177)."""
+    import jax.numpy as jnp
+
+    dtype = x.larray.dtype if hasattr(x, "larray") else jnp.asarray(x).dtype
+    try:
+        return jnp.finfo(dtype).max
+    except ValueError:
+        return jnp.iinfo(dtype).max
+
+
+def sanitize_sequence(seq):
+    """Validate a list/tuple sequence, returning a list (sanitation.py:314)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    raise TypeError(f"seq must be a list or a tuple, got {type(seq)}")
 
 
 def sanitize_distribution(*args: DNDarray, target: DNDarray, diff_map=None) -> Union[DNDarray, Tuple[DNDarray, ...]]:
