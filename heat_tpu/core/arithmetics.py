@@ -468,3 +468,93 @@ __all__ += [
     "neg_", "negative_", "pos_", "positive_", "pow_", "power_",
     "remainder_", "right_shift_", "sub_", "subtract_",
 ]
+
+
+# ---- numpy extensions beyond the reference's checklist -------------------
+
+true_divide = div
+
+
+def float_power(t1, t2, out=None, where=True):
+    """t1**t2 computed in at least float64 precision (numpy extension)."""
+    return _binary_op(jnp.float_power, t1, t2, out, where)
+
+
+def heaviside(t1, t2, out=None, where=True):
+    """Heaviside step function with value t2 at 0 (numpy extension)."""
+    return _binary_op(jnp.heaviside, t1, t2, out, where)
+
+
+def nancumsum(t, axis, dtype=None, out=None):
+    """Cumulative sum treating NaN as zero (numpy extension)."""
+    return _cum_op(lambda a, axis: jnp.nancumsum(a, axis=axis), t, axis, 0, out, dtype)
+
+
+def nancumprod(t, axis, dtype=None, out=None):
+    """Cumulative product treating NaN as one (numpy extension)."""
+    return _cum_op(lambda a, axis: jnp.nancumprod(a, axis=axis), t, axis, 1, out, dtype)
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    """Differences of the flattened array, with optional end caps (numpy
+    extension).  1-D result; distributed along axis 0 when the input is
+    split."""
+    if not isinstance(ary, DNDarray):
+        raise TypeError(f"expected ary to be a DNDarray, but was {type(ary)}")
+    te = to_end._dense() if isinstance(to_end, DNDarray) else to_end
+    tb = to_begin._dense() if isinstance(to_begin, DNDarray) else to_begin
+    res = jnp.ediff1d(ary._dense().ravel(), to_end=te, to_begin=tb)
+    return DNDarray.from_dense(res, 0 if ary.split is not None else None, ary.device, ary.comm)
+
+
+def gradient(f, *varargs, axis=None, edge_order: int = 1):
+    """Second-order central differences (numpy extension).
+
+    Supports scalar spacing per axis (``varargs``); returns one DNDarray
+    per requested axis (a single DNDarray for a single axis).
+    """
+    if not isinstance(f, DNDarray):
+        raise TypeError(f"expected f to be a DNDarray, but was {type(f)}")
+    if edge_order != 1:
+        raise NotImplementedError("gradient: only edge_order=1 is supported")
+    spacing = [v._dense() if isinstance(v, DNDarray) else v for v in varargs]
+    res = jnp.gradient(f._dense(), *spacing, axis=axis)
+    single = not isinstance(res, (list, tuple))
+    outs = [DNDarray.from_dense(r, f.split, f.device, f.comm) for r in ([res] if single else res)]
+    return outs[0] if single else outs
+
+
+def trapz(y, x=None, dx: float = 1.0, axis: int = -1):
+    """Trapezoidal-rule integral along an axis (numpy extension)."""
+    if not isinstance(y, DNDarray):
+        raise TypeError(f"expected y to be a DNDarray, but was {type(y)}")
+    xs = x._dense() if isinstance(x, DNDarray) else x
+    trapezoid = getattr(jnp, "trapezoid", None) or jnp.trapz
+    res = trapezoid(y._dense(), x=xs, dx=dx, axis=axis)
+    ax = axis % y.ndim
+    if y.split is None or y.split == ax:
+        out_split = None
+    else:
+        out_split = y.split - (1 if ax < y.split else 0)
+    return DNDarray.from_dense(res, out_split, y.device, y.comm)
+
+
+trapezoid = trapz
+
+
+def interp(x, xp, fp, left=None, right=None, period=None):
+    """1-D linear interpolation of x into sample points (xp, fp) (numpy
+    extension).  The sample table is replicated; the query array keeps its
+    distribution."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    xpd = xp._dense() if isinstance(xp, DNDarray) else jnp.asarray(xp)
+    fpd = fp._dense() if isinstance(fp, DNDarray) else jnp.asarray(fp)
+    res = jnp.interp(x._dense(), xpd, fpd, left=left, right=right, period=period)
+    return DNDarray.from_dense(res, x.split, x.device, x.comm)
+
+
+__all__ += [
+    "ediff1d", "float_power", "gradient", "heaviside", "interp",
+    "nancumprod", "nancumsum", "trapezoid", "trapz", "true_divide",
+]

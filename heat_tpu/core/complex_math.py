@@ -7,7 +7,7 @@ import jax.numpy as jnp
 from ._operations import __local_op as _local_op
 from .dndarray import DNDarray
 
-__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+__all__ = ["angle", "conj", "conjugate", "imag", "real", "real_if_close"]
 
 
 def angle(x, deg: bool = False, out=None):
@@ -31,3 +31,22 @@ def imag(x, out=None):
 def real(x, out=None):
     """Real part (complex_math.py:98)."""
     return _local_op(jnp.real, x, out, no_cast=True)
+
+
+def real_if_close(x, tol: float = 100.0):
+    """Return the real part when all imaginary components are within
+    ``tol`` machine epsilons of zero (numpy extension beyond the
+    reference's checklist).  The all-close check is a global reduction."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    from . import types
+
+    if not types.heat_type_is_complexfloating(x.dtype):
+        return x
+    import numpy as np
+
+    if tol > 1:  # numpy semantics: tol > 1 scales machine eps, else absolute
+        tol = tol * float(np.finfo(x._dense().real.dtype).eps)
+    if bool(jnp.all(jnp.abs(jnp.imag(x._dense())) < tol)):
+        return real(x)
+    return x

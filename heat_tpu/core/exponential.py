@@ -8,15 +8,21 @@ from ._operations import __binary_op as _binary_op
 from ._operations import __local_op as _local_op
 
 __all__ = [
+    "cbrt",
     "exp",
     "expm1",
     "exp2",
+    "frexp",
+    "ldexp",
     "log",
     "log2",
     "log10",
     "log1p",
     "logaddexp",
     "logaddexp2",
+    "nextafter",
+    "reciprocal",
+    "spacing",
     "sqrt",
     "square",
 ]
@@ -82,3 +88,50 @@ def pow_scalar_base(base, exponent):
     from . import arithmetics
 
     return arithmetics.pow(base, exponent)
+
+
+def cbrt(x, out=None):
+    """Cube root (numpy extension beyond the reference's checklist)."""
+    return _local_op(jnp.cbrt, x, out)
+
+
+def reciprocal(x, out=None):
+    """1/x elementwise (numpy extension beyond the reference)."""
+    return _local_op(jnp.reciprocal, x, out)
+
+
+def frexp(x, out=None):
+    """Decompose x into mantissa and twos exponent (numpy extension).
+
+    Returns ``(mantissa, exponent)`` DNDarrays with the input's split."""
+    if out is not None:
+        raise NotImplementedError("frexp does not support out=")
+    from . import types
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    arr = x.larray_padded
+    if not types.heat_type_is_inexact(x.dtype):
+        arr = arr.astype(jnp.float32)
+    mant, expo = jnp.frexp(arr)
+
+    def _wrap(r):
+        return DNDarray(r, x.shape, types.canonical_heat_type(r.dtype), x.split, x.device, x.comm)
+
+    return _wrap(mant), _wrap(expo)
+
+
+def ldexp(t1, t2):
+    """t1 * 2**t2 (numpy extension beyond the reference)."""
+    return _binary_op(jnp.ldexp, t1, t2)
+
+
+def nextafter(t1, t2):
+    """Next representable float after t1 towards t2 (numpy extension)."""
+    return _binary_op(jnp.nextafter, t1, t2)
+
+
+def spacing(x, out=None):
+    """Distance to the nearest adjacent float (numpy extension)."""
+    return _local_op(jnp.spacing, x, out)

@@ -8,7 +8,22 @@ from . import types
 from ._operations import __local_op as _local_op
 from .dndarray import DNDarray
 
-__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+__all__ = [
+    "abs",
+    "absolute",
+    "around",
+    "ceil",
+    "clip",
+    "fabs",
+    "fix",
+    "floor",
+    "modf",
+    "rint",
+    "round",
+    "sgn",
+    "sign",
+    "trunc",
+]
 
 
 def abs(x, out=None, dtype=None):
@@ -77,6 +92,20 @@ def round(x, decimals=0, out=None, dtype=None):
     if out is not None:
         return _local_op(lambda a: a, res, out, no_cast=True)
     return res
+
+
+around = round
+
+
+def rint(x, out=None):
+    """Round to the nearest integer, keeping the floating dtype (numpy
+    extension beyond the reference's checklist)."""
+    return _local_op(jnp.rint, x, out)
+
+
+def fix(x, out=None):
+    """Round towards zero (numpy extension beyond the reference)."""
+    return _local_op(jnp.trunc, x, out)
 
 
 def sgn(x, out=None):

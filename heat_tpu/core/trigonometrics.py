@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from . import types
 from ._operations import __local_op as _local_op
 from ._operations import __binary_op as _binary_op
 
@@ -28,10 +29,13 @@ __all__ = [
     "degrees",
     "rad2deg",
     "radians",
+    "i0",
     "sin",
+    "sinc",
     "sinh",
     "tan",
     "tanh",
+    "unwrap",
 ]
 
 
@@ -135,3 +139,32 @@ def tan(x, out=None):
 def tanh(x, out=None):
     """Hyperbolic tangent (trigonometrics.py:558)."""
     return _local_op(jnp.tanh, x, out)
+
+
+def sinc(x, out=None):
+    """Normalized sinc sin(pi x)/(pi x) (numpy extension beyond the
+    reference's checklist)."""
+    return _local_op(jnp.sinc, x, out)
+
+
+def i0(x, out=None):
+    """Modified Bessel function of the first kind, order 0 (numpy
+    extension beyond the reference)."""
+    return _local_op(jnp.i0, x, out)
+
+
+def unwrap(p, discont=None, axis: int = -1, period: float = 6.283185307179586):
+    """Unwrap a phase signal along ``axis`` (numpy extension).
+
+    A cumulative correction along the axis: computed on the dense global
+    view so split-axis padding can never leak into the scan.
+    """
+    from .dndarray import DNDarray
+
+    if not isinstance(p, DNDarray):
+        raise TypeError(f"expected p to be a DNDarray, but was {type(p)}")
+    arr = p._dense()
+    if not types.heat_type_is_inexact(p.dtype):
+        arr = arr.astype(jnp.result_type(arr.dtype, float))
+    res = jnp.unwrap(arr, discont=discont, axis=axis, period=period)
+    return DNDarray.from_dense(res, p.split, p.device, p.comm)
