@@ -58,6 +58,7 @@ __all__ = [
     "float64",
     "double",
     "flexible",
+    "complex",
     "complexfloating",
     "complex64",
     "cfloat",
@@ -231,6 +232,11 @@ class flexible(datatype):
 
 class complexfloating(number):
     """Abstract complex (types.py:161)."""
+
+
+# the reference names its abstract complex class plain ``complex``
+# (types.py:368); keep that spelling available alongside the NumPy-style one
+complex = complexfloating
 
 
 class complex64(complexfloating):

@@ -1,11 +1,23 @@
 """Functional NN ops, analog of heat/nn/functional.py (falls through to
-jax.nn the way the reference falls through to torch.nn.functional)."""
+jax.nn the way the reference falls through to torch.nn.functional via
+``func_getattr``, nn/functional.py:9)."""
+
+__all__ = ["func_getattr"]
 
 
-def __getattr__(name):
+def func_getattr(name):
+    """Resolve ``name`` against the local framework's functional namespace.
+
+    The reference's ``func_getattr`` (nn/functional.py:9) forwards to
+    ``torch.nn.functional``; here the substrate is ``jax.nn``.
+    """
     import jax.nn as _jnn
 
     try:
         return getattr(_jnn, name)
     except AttributeError:
         raise AttributeError(f"module 'heat_tpu.nn.functional' has no attribute {name!r}")
+
+
+def __getattr__(name):
+    return func_getattr(name)

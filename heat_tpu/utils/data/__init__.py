@@ -1,7 +1,7 @@
 """Data tooling (analog of heat/utils/data)."""
 
 from . import matrixgallery
-from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
+from .datatools import DataLoader, Dataset, dataset_irecv, dataset_ishuffle, dataset_shuffle
 from .mnist import MNISTDataset, synthetic_mnist
 from .partial_dataset import PartialH5DataLoaderIter, PartialH5Dataset
 from .spherical import create_clusters, create_spherical_dataset
@@ -14,6 +14,7 @@ __all__ = [
     "PartialH5Dataset",
     "create_clusters",
     "create_spherical_dataset",
+    "dataset_irecv",
     "dataset_ishuffle",
     "dataset_shuffle",
     "matrixgallery",

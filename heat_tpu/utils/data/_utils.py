@@ -1,0 +1,101 @@
+"""Standalone data-preparation utilities (analog of heat/utils/data/_utils.py).
+
+The reference ships two untested helper scripts for its ImageNet/DASO example
+(_utils.py:13, :47): a TFRecord index builder for NVIDIA DALI and a
+TFRecord→HDF5 merger.  On TPU there is no DALI; the index builder here emits
+the same ``"<offset> <length>"`` line format, which is equally useful for
+byte-range sharded reads by per-host input pipelines, and the merger
+produces one HDF5 file per split that :class:`PartialH5Dataset` can stream.
+
+Like the reference's originals these are data-prep conveniences, not part of
+the supported API surface.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["dali_tfrecord2idx", "merge_files_imagenet_tfrecord", "tfrecord_index"]
+
+
+def tfrecord_index(path: str) -> List[tuple]:
+    """Return ``[(offset, length), ...]`` for every record in a TFRecord file.
+
+    TFRecord framing is public: u64-LE payload length, u32 length-crc,
+    payload, u32 payload-crc.  No TensorFlow required.
+    """
+    spans = []
+    with open(path, "rb") as f:
+        while True:
+            start = f.tell()
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (payload_len,) = struct.unpack("<Q", header)
+            f.seek(4 + payload_len + 4, os.SEEK_CUR)
+            if f.tell() > os.path.getsize(path):
+                raise ValueError(f"{path}: truncated TFRecord at offset {start}")
+            spans.append((start, f.tell() - start))
+    return spans
+
+
+def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
+    """Write ``<name>.idx`` index files for every TFRecord in the train/val
+    directories (reference _utils.py:13).
+
+    Each output line is ``"<offset> <length>"`` — the format DALI consumes,
+    and the natural unit for byte-range sharding a record file across hosts.
+    """
+    for src_dir, idx_dir in ((train_dir, train_idx_dir), (val_dir, val_idx_dir)):
+        os.makedirs(idx_dir, exist_ok=True)
+        for name in sorted(os.listdir(src_dir)):
+            src = os.path.join(src_dir, name)
+            if not os.path.isfile(src):
+                continue
+            with open(os.path.join(idx_dir, name), "w") as idx:
+                for offset, length in tfrecord_index(src):
+                    idx.write(f"{offset} {length}\n")
+
+
+def merge_files_imagenet_tfrecord(folder_name, output_folder=None):
+    """Merge preprocessed ImageNet TFRecord shards into two HDF5 files
+    (``imagenet_merged.h5`` / ``imagenet_merged_validation.h5``), the layout
+    :class:`PartialH5Dataset` streams (reference _utils.py:47).
+
+    Records are stored raw (variable-length uint8 payloads) plus a
+    ``(offset, length)`` table, so decoding stays in the input pipeline
+    where the TPU host can overlap it with device compute.
+    """
+    try:
+        import h5py
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("merge_files_imagenet_tfrecord requires h5py") from e
+
+    output_folder = output_folder or "."
+    names = sorted(os.listdir(folder_name))
+    splits = {
+        "imagenet_merged.h5": [n for n in names if n.startswith("train")],
+        "imagenet_merged_validation.h5": [n for n in names if n.startswith("val")],
+    }
+    for out_name, files in splits.items():
+        if not files:
+            continue
+        payloads = []
+        for name in files:
+            src = os.path.join(folder_name, name)
+            with open(src, "rb") as f:
+                data = f.read()
+            for offset, length in tfrecord_index(src):
+                payloads.append(np.frombuffer(data, np.uint8, count=length, offset=offset))
+        table = np.zeros((len(payloads), 2), np.int64)
+        pos = 0
+        for i, p in enumerate(payloads):
+            table[i] = (pos, len(p))
+            pos += len(p)
+        with h5py.File(os.path.join(output_folder, out_name), "w") as f:
+            f.create_dataset("records", data=np.concatenate(payloads) if payloads else np.zeros(0, np.uint8))
+            f.create_dataset("index", data=table)

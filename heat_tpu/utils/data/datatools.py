@@ -18,7 +18,7 @@ import numpy as np
 
 from ...core.dndarray import DNDarray
 
-__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle", "dataset_irecv"]
 
 
 class Dataset:
@@ -85,6 +85,12 @@ class DataLoader:
         return -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator:
+        if self.ishuffle or getattr(self.dataset, "ishuffle", False):
+            # complete the shuffle started at the end of the previous epoch
+            # (the reference's DataLoader does the same Irecv-then-Ishuffle
+            # cycle, datatools.py:87-101)
+            dataset_irecv(self.dataset)
+            dataset_ishuffle(self.dataset)
         n = len(self.dataset)
         if self.shuffle:
             from ...core import random as ht_random
@@ -101,17 +107,40 @@ class DataLoader:
 
 
 def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
-    """Shuffle the dataset's sample axis in place (datatools.py:247)."""
+    """Shuffle the dataset's sample axis in place (datatools.py:247): the
+    blocking form is the start/complete pair run back to back."""
+    dataset_ishuffle(dataset, attrs)
+    dataset_irecv(dataset)
+
+
+def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Start a non-blocking shuffle (datatools.py:305).
+
+    JAX dispatch is asynchronous: the permutation gather below is enqueued on
+    the device and this call returns before it completes.  The shuffled
+    arrays are stashed on the dataset and installed by :func:`dataset_irecv`
+    — the same start/complete split the reference implements with
+    ``Isend``/``Irecv`` pairs.
+    """
     from ...core import random as ht_random
 
     n = len(dataset)
     perm = ht_random.randperm(n)._dense()
-    for i, a in enumerate(dataset.arrays):
-        shuffled = a._dense()[perm]
-        dataset.arrays[i] = DNDarray.from_dense(shuffled, a.split, a.device, a.comm)
+    pending = []
+    for a in dataset.arrays:
+        shuffled = a._dense()[perm]  # enqueued, not yet materialized
+        pending.append(DNDarray.from_dense(shuffled, a.split, a.device, a.comm))
+    dataset._pending_shuffle = pending
 
 
-def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
-    """Non-blocking shuffle (datatools.py:305).  JAX dispatch is async, so
-    the blocking and non-blocking variants coincide."""
-    dataset_shuffle(dataset, attrs)
+def dataset_irecv(dataset: Dataset) -> None:
+    """Complete a shuffle started by :func:`dataset_ishuffle`
+    (datatools.py:344): wait for the enqueued permutation and install the
+    shuffled arrays in place."""
+    pending = getattr(dataset, "_pending_shuffle", None)
+    if pending is None:
+        return
+    for i, a in enumerate(pending):
+        jax.block_until_ready(a.larray_padded)
+        dataset.arrays[i] = a
+    dataset._pending_shuffle = None

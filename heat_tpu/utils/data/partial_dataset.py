@@ -2,13 +2,16 @@
 
 The reference's ``PartialH5Dataset`` (partial_dataset.py:32) threads HDF5
 chunk reads and overlaps load/convert with training via a custom loader
-iterator (:224).  Here the same overlap comes from JAX's asynchronous
-dispatch: each `__iter__` round reads the next HDF5 slab on host while the
-device still executes the previous batch.
+iterator (:224) fed by daemon threads running :func:`queue_thread`
+(partial_dataset.py:20).  Here the same structure holds — a loader thread
+reads the next HDF5 slab while the device executes the previous batch —
+and JAX's asynchronous dispatch overlaps the host→device copy as well.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Iterator, List, Optional
 
 import jax.numpy as jnp
@@ -16,7 +19,7 @@ import numpy as np
 
 from ...core.dndarray import DNDarray
 
-__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter"]
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
 
 try:
     import h5py
@@ -24,6 +27,22 @@ try:
     _H5 = True
 except ImportError:  # pragma: no cover
     _H5 = False
+
+
+def queue_thread(q: "queue.Queue") -> None:
+    """Worker loop for loader threads (partial_dataset.py:20): pop either a
+    ``(func, *args)`` tuple or a bare callable off the queue, run it, and
+    mark the item done.  ``None`` shuts the worker down."""
+    while True:
+        items = q.get()
+        if items is None:
+            q.task_done()
+            return
+        if isinstance(items, tuple):
+            items[0](*items[1:])
+        else:
+            items()
+        q.task_done()
 
 
 class PartialH5Dataset:
@@ -59,26 +78,65 @@ class PartialH5Dataset:
 
 
 class PartialH5DataLoaderIter:
-    """Windowed loader iterator (partial_dataset.py:224)."""
+    """Windowed loader iterator (partial_dataset.py:224).
+
+    A daemon thread running :func:`queue_thread` reads window ``i+1`` from
+    the HDF5 file while window ``i`` is being consumed, so disk latency
+    hides behind compute the way the reference's loader/convert threads do.
+    """
 
     def __init__(self, dataset: PartialH5Dataset):
         self._ds = dataset
         self._pos = 0
+        self._work: "queue.Queue" = queue.Queue()
+        self._ready: "queue.Queue" = queue.Queue(maxsize=2)
+        self._thread = threading.Thread(target=queue_thread, args=(self._work,), daemon=True)
+        self._thread.start()
+        self._windows_queued = 0
+        self._queue_next_read()  # prime the pipeline
+
+    def _read_window(self, start: int, stop: int) -> None:
+        try:
+            out = []
+            with h5py.File(self._ds.file, "r") as f:
+                for name in self._ds.dataset_names:
+                    chunk = np.asarray(f[name][start:stop])
+                    arr = jnp.asarray(chunk)
+                    if self._ds.transforms is not None and callable(self._ds.transforms):
+                        arr = self._ds.transforms(arr)
+                    out.append(arr)
+            self._ready.put(out[0] if len(out) == 1 else tuple(out))
+        except BaseException as e:  # surface loader errors on the consumer side
+            self._ready.put(e)
+
+    def _queue_next_read(self) -> None:
+        if self._pos >= self._ds.length:
+            return
+        stop = min(self._pos + self._ds.load_length, self._ds.length)
+        self._work.put((self._read_window, self._pos, stop))
+        self._pos = stop
+        self._windows_queued += 1
+
+    def close(self) -> None:
+        """Retire the worker thread (safe to call more than once)."""
+        if self._thread is not None:
+            self._work.put(None)
+            self._thread = None
+
+    def __del__(self):
+        self.close()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if self._pos >= self._ds.length:
+        if self._windows_queued == 0 or self._thread is None:
+            self.close()
             raise StopIteration
-        stop = min(self._pos + self._ds.load_length, self._ds.length)
-        out = []
-        with h5py.File(self._ds.file, "r") as f:
-            for name in self._ds.dataset_names:
-                chunk = np.asarray(f[name][self._pos : stop])
-                arr = jnp.asarray(chunk)
-                if self._ds.transforms is not None and callable(self._ds.transforms):
-                    arr = self._ds.transforms(arr)
-                out.append(arr)
-        self._pos = stop
-        return out[0] if len(out) == 1 else tuple(out)
+        batch = self._ready.get()
+        self._windows_queued -= 1
+        if isinstance(batch, BaseException):
+            self.close()
+            raise batch
+        self._queue_next_read()  # overlap the next read with consumption
+        return batch

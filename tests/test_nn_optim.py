@@ -163,3 +163,82 @@ def test_synthetic_mnist_and_partial_h5(tmp_path):
     assert len(chunks) == 3
     total = np.concatenate([np.asarray(c) for c in chunks])
     np.testing.assert_allclose(total, np.arange(100.0).reshape(25, 4))
+
+
+def test_func_getattr():
+    # reference nn/functional.py:9 — falls through to the substrate's functional ns
+    import jax.numpy as jnp
+
+    from heat_tpu.nn.functional import func_getattr
+
+    relu = func_getattr("relu")
+    np.testing.assert_allclose(np.asarray(relu(jnp.array([-1.0, 2.0]))), [0.0, 2.0])
+    with pytest.raises(AttributeError):
+        func_getattr("definitely_not_a_function")
+
+
+def test_dataset_ishuffle_irecv_cycle():
+    # reference datatools.py:305/:344 — start/complete split of the epoch shuffle
+    x = ht.random.randn(12, 3, split=0)
+    before = x.numpy().copy()
+    ds = ht.utils.data.Dataset(x, ishuffle=True)
+    ht.utils.data.dataset_ishuffle(ds)
+    assert ds._pending_shuffle is not None
+    ht.utils.data.dataset_irecv(ds)
+    assert ds._pending_shuffle is None
+    after = ds.arrays[0].numpy()
+    # same multiset of rows, (almost surely) different order
+    np.testing.assert_allclose(np.sort(before, axis=0), np.sort(after, axis=0))
+    # irecv with nothing pending is a no-op
+    ht.utils.data.dataset_irecv(ds)
+
+
+def test_tfrecord_index_tools(tmp_path):
+    # reference _utils.py:13 — offset/length index over TFRecord framing
+    import struct
+
+    from heat_tpu.utils.data._utils import dali_tfrecord2idx, tfrecord_index
+
+    train = tmp_path / "train"
+    val = tmp_path / "val"
+    ti, vi = tmp_path / "ti", tmp_path / "vi"
+    train.mkdir()
+    val.mkdir()
+    for d, name in ((train, "train-0"), (val, "val-0")):
+        with open(d / name, "wb") as f:
+            for payload in (b"abc", b"defgh", b"x" * 11):
+                f.write(struct.pack("<Q", len(payload)) + b"\0" * 4 + payload + b"\0" * 4)
+    spans = tfrecord_index(str(train / "train-0"))
+    assert spans == [(0, 19), (19, 21), (40, 27)]
+    dali_tfrecord2idx(str(train), str(ti), str(val), str(vi))
+    assert (ti / "train-0").read_text().splitlines() == ["0 19", "19 21", "40 27"]
+    assert (vi / "val-0").read_text().splitlines()[0] == "0 19"
+
+
+def test_types_complex_alias():
+    # reference types.py:368 names the abstract complex class plain `complex`
+    assert ht.complex is ht.types.complexfloating
+    assert issubclass(ht.complex64, ht.complex)
+
+
+def test_partial_h5_error_propagation_and_early_break(tmp_path):
+    import h5py
+
+    f = tmp_path / "err.h5"
+    with h5py.File(f, "w") as h:
+        h.create_dataset("data", data=np.arange(40.0).reshape(10, 4))
+
+    def bad_transform(x):
+        raise ValueError("boom")
+
+    ds = ht.utils.data.PartialH5Dataset(str(f), load_length=3, transforms=bad_transform)
+    with pytest.raises(ValueError, match="boom"):
+        next(iter(ds))
+
+    # breaking out early retires the worker thread instead of leaking it
+    ds2 = ht.utils.data.PartialH5Dataset(str(f), load_length=3)
+    it = iter(ds2)
+    next(it)
+    it.close()
+    with pytest.raises(StopIteration):
+        next(it)
