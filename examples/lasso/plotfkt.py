@@ -1,0 +1,26 @@
+"""Plot helper for the lasso demo (analog of examples/lasso/plotfkt.py)."""
+
+import numpy as np
+
+
+def plot_lasso_path(lambdas, theta_lasso, out: str = "lasso_path.png") -> None:
+    """Plot each feature's coefficient against the regularization strength."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from matplotlib import pyplot as plt
+    except ImportError:
+        print("matplotlib not available; skipping plot")
+        return
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for i in range(theta_lasso.shape[0]):
+        ax.plot(np.log10(lambdas), theta_lasso[i], label=f"feature {i}")
+    ax.set_xlabel(r"$\log_{10}\,\lambda$")
+    ax.set_ylabel("coefficient")
+    ax.set_title("Lasso regularization path (diabetes)")
+    ax.legend(fontsize=7, ncol=2)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"saved {out}")

@@ -32,5 +32,6 @@ from . import nn
 from . import optim
 from . import sparse
 from . import utils
+from . import datasets
 
 communication = parallel  # API-parity alias for heat.core.communication

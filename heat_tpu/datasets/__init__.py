@@ -1,0 +1,25 @@
+"""Bundled demo datasets (analog of heat/datasets).
+
+The reference ships Fisher's iris and the diabetes regression set as
+HDF5/CSV files for its examples and io tests; the copies here are generated
+from the same public datasets via scikit-learn (see examples/).  Use
+:func:`path` to locate a bundled file:
+
+    import heat_tpu as ht
+    X = ht.load_hdf5(ht.datasets.path("iris.h5"), dataset="data", split=0)
+"""
+
+import os
+
+__all__ = ["path"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def path(name: str) -> str:
+    """Absolute path of a bundled dataset file (e.g. ``"iris.h5"``)."""
+    p = os.path.join(_HERE, name)
+    if not os.path.isfile(p):
+        available = sorted(f for f in os.listdir(_HERE) if not f.endswith(".py"))
+        raise FileNotFoundError(f"no bundled dataset {name!r}; available: {available}")
+    return p

@@ -1,0 +1,40 @@
+"""Installation smoke test (analog of scripts/heat_test.py).
+
+The reference's smoke test builds ``ht.arange(10, split=0)`` under mpirun
+and prints the local chunk and the global array on every rank.  The mesh
+analog: build the same split array over whatever devices are visible,
+print each device's shard and the global result.
+
+    python scripts/heat_test.py                      # one TPU chip
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        HEAT_TPU_SMOKE_CPU=1 python scripts/heat_test.py   # 8-device mesh
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+if os.environ.get("HEAT_TPU_SMOKE_CPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import heat_tpu as ht
+
+
+def main() -> None:
+    comm = ht.get_comm()
+    print(f"mesh: {comm.size} device(s): {[str(d) for d in comm.devices]}")
+
+    x = ht.arange(10, split=0)
+    for rank in range(comm.size):
+        _, _, slices = comm.chunk((10,), 0, rank=rank)
+        print(f"rank {rank}: local shard {x.numpy()[slices].tolist()}")
+    print(f"global: {x.numpy().tolist()}")
+    assert float(x.sum()) == 45.0
+    print("smoke test OK")
+
+
+if __name__ == "__main__":
+    main()

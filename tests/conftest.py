@@ -8,9 +8,12 @@ the tests standing in for the reference's n=3 remainder chunks.
 
 import os
 
-# must be set before jax initializes its backends
+# must be set before jax initializes its backends; HEAT_TPU_TEST_DEVICES
+# lets CI sweep mesh sizes (3 and 8) the way the reference sweeps mpirun -n
+_N_DEVICES = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_N_DEVICES}"
 )
 
 import jax

@@ -177,3 +177,28 @@ class TestFFTParity:
         np.testing.assert_allclose(
             ht.fft.ifftshift(ht.fft.fftshift(x)).numpy(), data, rtol=1e-9
         )
+
+
+class TestBundledDatasets:
+    """The datasets package (analog of heat/datasets: iris/diabetes files)."""
+
+    def test_iris_h5(self, ht):
+        X = ht.load_hdf5(ht.datasets.path("iris.h5"), dataset="data", split=0)
+        assert X.shape == (150, 4)
+        assert float(X.min()) > 0.0
+
+    def test_diabetes_h5(self, ht):
+        X = ht.load_hdf5(ht.datasets.path("diabetes.h5"), dataset="x", split=0)
+        y = ht.load_hdf5(ht.datasets.path("diabetes.h5"), dataset="y", split=0)
+        assert X.shape == (442, 10)
+        assert y.shape == (442, 1)
+
+    def test_iris_csv(self, ht):
+        X = ht.load_csv(ht.datasets.path("iris.csv"), sep=";", split=0)
+        assert X.shape == (150, 4)
+
+    def test_missing_dataset(self, ht):
+        import pytest as _pytest
+
+        with _pytest.raises(FileNotFoundError, match="iris.h5"):
+            ht.datasets.path("nope.h5")
