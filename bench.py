@@ -56,12 +56,28 @@ def _measure_reference_baseline(f: int, k: int) -> float:
     return n_b / best
 
 
+def _measure_sync_floor() -> float:
+    """Round-trip cost of a host fetch (large over the tunneled chip), to be
+    subtracted so the measurement reflects device time, not link latency.
+    A device->host scalar fetch is the only reliable synchronization here:
+    block_until_ready can return before remote execution completes."""
+    f = jax.jit(lambda x: x + 1.0)
+    z = jnp.zeros(())
+    float(f(z))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f(z))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def main() -> None:
     import heat_tpu as ht
 
     # Scale the workload to the available memory: 2^24 x 16 f32 = 1 GiB.
     n, f, k = 1 << 24, 16, 8
-    n_iter = 10
+    n_iter = 50
 
     ht.random.seed(0)
     x = ht.random.randn(n, f, split=0)
@@ -71,17 +87,19 @@ def main() -> None:
     model._initialize_cluster_centers(x)
 
     def one_iteration():
-        labels, shift, inertia = model._fused_step(x)
+        model._fused_step(x)
         return model._cluster_centers
 
-    # warmup/compile
-    jax.block_until_ready(one_iteration().larray_padded)
+    # warmup/compile; scalar fetch = real synchronization point
+    float(one_iteration().sum())
+
+    sync_floor = _measure_sync_floor()
 
     t0 = time.perf_counter()
     for _ in range(n_iter):
         centers = one_iteration()
-    jax.block_until_ready(centers.larray_padded)
-    elapsed = (time.perf_counter() - t0) / n_iter
+    float(centers.sum())  # force execution of the whole chain
+    elapsed = max(time.perf_counter() - t0 - sync_floor, 1e-9) / n_iter
 
     pts_per_sec = n / elapsed
 

@@ -13,12 +13,34 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 
-from ..core import types
+from ..core import kernels, types
 from ..core.dndarray import DNDarray
 from ..spatial import distance
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
+
+
+@partial(jax.jit, static_argnames=("n_true", "k"))
+def _lloyd_update(xp: jax.Array, centers: jax.Array, n_true: int, k: int):
+    """Trimmed Lloyd iteration: centroid update + shift ONLY.
+
+    Measured on v5e: materializing labels/inertia/|x|^2 inside the
+    iteration costs ~6x (extra HBM passes); the fit loop needs none of
+    them until convergence, so the hot step computes exactly two passes
+    over x (distance matmul, one-hot sums matmul) and two (N, k)
+    intermediates.  Labels and inertia come from one final `_lloyd_step`.
+    """
+    xc = xp @ centers.T  # (N, k) — MXU
+    c2 = jnp.sum(centers * centers, axis=1)
+    labels = jnp.argmin(c2[None, :] - 2.0 * xc, axis=1)
+    valid = jax.lax.broadcasted_iota(jnp.int32, (xp.shape[0],), 0) < n_true
+    oh = jax.nn.one_hot(labels, k, dtype=xp.dtype) * valid.astype(xp.dtype)[:, None]
+    sums = oh.T @ xp  # (k, f) — MXU; GSPMD: psum across shards
+    counts = jnp.sum(oh, axis=0)
+    new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
+    shift = jnp.sum((new - centers) ** 2)
+    return new, shift
 
 
 @partial(jax.jit, static_argnames=("n_true", "k"))
@@ -87,15 +109,35 @@ class KMeans(_KCluster):
         return DNDarray.from_dense(new, None, x.device, x.comm)
 
     def _fused_step(self, x: DNDarray):
-        """Run one fused Lloyd iteration; returns (labels_padded, shift, inertia)
-        and updates ``self._cluster_centers``."""
+        """Run one fused Lloyd iteration; returns the center shift and
+        updates ``self._cluster_centers``.
+
+        Default path is the trimmed XLA program (`_lloyd_update`); the
+        single-HBM-pass Pallas kernel (core/kernels.py) is opt-in via
+        HEAT_TPU_LLOYD_KERNEL=1 — on v5e it measures VPU-bound and loses
+        to XLA's multi-pass (see kernels.py for the numbers).  Labels are
+        deliberately not produced — the fit loop only needs them once,
+        after convergence (``_assign_padded``).
+        """
         xp = x.larray_padded
         if not types.heat_type_is_inexact(x.dtype):
             xp = xp.astype(jnp.float32)
         centers = self._cluster_centers._dense().astype(xp.dtype)
-        labels, new, shift, inertia = _lloyd_step(xp, centers, x.shape[0], self.n_clusters)
+        if kernels.LLOYD_KERNEL and kernels.lloyd_supported(xp.shape[1], self.n_clusters):
+            new, shift, _ = kernels.lloyd_update(x, centers)
+        else:
+            new, shift = _lloyd_update(xp, centers, x.shape[0], self.n_clusters)
         self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
-        return labels, shift, inertia
+        return shift
+
+    def _assign_padded(self, x: DNDarray):
+        """Labels + inertia against the current centers (one cheap pass)."""
+        xp = x.larray_padded
+        if not types.heat_type_is_inexact(x.dtype):
+            xp = xp.astype(jnp.float32)
+        centers = self._cluster_centers._dense().astype(xp.dtype)
+        labels, _, _, inertia = _lloyd_step(xp, centers, x.shape[0], self.n_clusters)
+        return labels, inertia
 
     def fit(self, x: DNDarray) -> "KMeans":
         """Lloyd iterations until center shift < tol (kmeans.py:~100)."""
@@ -106,16 +148,14 @@ class KMeans(_KCluster):
         self._initialize_cluster_centers(x)
 
         for i in range(self.max_iter):
-            labels, shift, inertia = self._fused_step(x)
+            shift = self._fused_step(x)
             if float(shift) <= self.tol:
                 break
 
         self._n_iter = i + 1
-        # final assignment against the converged centers (the step's centroid
-        # update is discarded — the reference's last pass only assigns)
-        converged = self._cluster_centers
-        labels, _, inertia = self._fused_step(x)
-        self._cluster_centers = converged
+        # final assignment against the converged centers (the reference's
+        # last pass only assigns, it does not move centers)
+        labels, inertia = self._assign_padded(x)
         self._inertia = float(inertia)
         self._labels = DNDarray.from_dense(labels[: x.shape[0]], x.split, x.device, x.comm)
         return self
