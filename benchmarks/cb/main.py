@@ -26,12 +26,13 @@ ht.random.seed(12345)
 from cluster import run_cluster_benchmarks
 from linalg import run_linalg_benchmarks
 from manipulations import run_manipulation_benchmarks
-from monitor import RESULTS
+from monitor import RESULTS, sync_floor
 from preprocessing import run_preprocessing_benchmarks
 
 
 def main():
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    print(json.dumps({"bench": "SYNC_FLOOR", "seconds": round(sync_floor(), 6)}))
     run_linalg_benchmarks(scale)
     run_cluster_benchmarks(scale)
     run_manipulation_benchmarks(scale)

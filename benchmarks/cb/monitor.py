@@ -3,9 +3,14 @@
 The reference instruments its cb functions with the external ``perun``
 energy/runtime monitor (benchmarks/cb/linalg.py:4, setup.py extras
 ``cb=perun``).  perun is MPI-bound; the TPU-native stand-in measures
-wall time around a fully-synchronized call (``jax.block_until_ready`` on
-every jax array in the result) and emits one JSON line per benchmark —
-the same shape the round driver's bench.py reports.
+wall time around a fully-synchronized call and emits one JSON line per
+benchmark — the same shape the round driver's bench.py reports.
+
+Synchronization is a device->host fetch of one element, NOT
+``block_until_ready``: through a tunneled remote chip the latter can
+return before remote execution completes, silently measuring dispatch
+time.  The fetch adds one link round-trip to every measurement; the
+runner reports that floor so dashboards can subtract it.
 """
 
 from __future__ import annotations
@@ -16,21 +21,37 @@ import time
 from typing import Any
 
 import jax
+import numpy as np
 
 RESULTS = []
 
 
 def _sync(obj: Any) -> None:
+    """Force execution of everything reachable from ``obj`` (one scalar
+    fetch per distinct jax array)."""
     if hasattr(obj, "larray_padded"):
-        jax.block_until_ready(obj.larray_padded)
+        _sync(obj.larray_padded)
     elif isinstance(obj, jax.Array):
-        jax.block_until_ready(obj)
+        np.asarray(jax.device_get(obj.ravel()[:1]))
     elif isinstance(obj, (tuple, list)):
         for o in obj:
             _sync(o)
     elif isinstance(obj, dict):
         for o in obj.values():
             _sync(o)
+
+
+def sync_floor() -> float:
+    """Measured cost of the scalar-fetch synchronization itself."""
+    f = jax.jit(lambda x: x + 1.0)
+    z = jax.numpy.zeros(())
+    _sync(f(z))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(f(z))
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def monitor():

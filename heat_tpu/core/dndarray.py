@@ -202,6 +202,11 @@ class DNDarray:
         return self.gnbytes
 
     @property
+    def itemsize(self) -> int:
+        """Bytes per element (NumPy parity)."""
+        return np.dtype(self.__dtype.jax_type()).itemsize
+
+    @property
     def larray(self) -> jax.Array:
         """This process's local chunk of the TRUE array (dndarray.py:140).
 

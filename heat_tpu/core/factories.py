@@ -32,6 +32,8 @@ __all__ = [
     "from_partition_dict",
     "full",
     "full_like",
+    "geomspace",
+    "identity",
     "linspace",
     "logspace",
     "meshgrid",
@@ -253,6 +255,37 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=No
     if dtype is not None:
         return result.astype(dtype)
     return result
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Geometrically spaced samples (NumPy extension beyond the reference's
+    factory set; numbers spaced so that each is a constant multiple of the
+    previous, like np.geomspace)."""
+    import math
+
+    if start == 0 or stop == 0:
+        raise ValueError("geometric sequence cannot include zero")
+    sign = -1.0 if start < 0 else 1.0
+    if (start < 0) != (stop < 0):
+        raise ValueError("start and stop must have the same sign")
+    y = logspace(
+        math.log10(abs(start)),
+        math.log10(abs(stop)),
+        num=num,
+        endpoint=endpoint,
+        split=split,
+        device=device,
+        comm=comm,
+    )
+    result = y if sign > 0 else -y
+    if dtype is not None:
+        return result.astype(dtype)
+    return result
+
+
+def identity(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """The n x n identity matrix (NumPy parity wrapper over :func:`eye`)."""
+    return eye(int(n), dtype=dtype, split=split, device=device, comm=comm)
 
 
 def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:

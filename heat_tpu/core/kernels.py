@@ -43,7 +43,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 try:  # pallas TPU backend (present in all jax>=0.4.30 installs)
@@ -318,7 +321,9 @@ def _lloyd_sharded(mesh, axis_name: str, n_true: int):
             mesh=mesh,
             in_specs=(P(axis_name), P()),
             out_specs=(P(), P(), P()),
-            check_rep=False,
+            # pallas_call outputs don't carry vma metadata for the new
+            # shard_map varying-axes check
+            check_vma=False,
         )(xp, centers)
         return _postprocess(sums, counts, inertia, centers)
 
