@@ -32,7 +32,9 @@ def _sync(obj: Any) -> None:
     if hasattr(obj, "larray_padded"):
         _sync(obj.larray_padded)
     elif isinstance(obj, jax.Array):
-        np.asarray(jax.device_get(obj.ravel()[:1]))
+        # fetch ONE element lazily — ravel()/reshape would dispatch a
+        # full-size on-device copy inside the timed region
+        np.asarray(jax.device_get(obj[(0,) * obj.ndim]))
     elif isinstance(obj, (tuple, list)):
         for o in obj:
             _sync(o)

@@ -53,7 +53,7 @@ def make_model(classes: int = 10):
     return SmallResNetish()
 
 
-def main(epochs: int = 2, batch_size: int = 64, window: int = 128) -> None:
+def main(epochs: int = 5, batch_size: int = 64, window: int = 128) -> None:
     import jax
     import jax.numpy as jnp
     import optax
@@ -90,6 +90,7 @@ def main(epochs: int = 2, batch_size: int = 64, window: int = 128) -> None:
                     params = daso.step(params, grads)
                     losses.append(float(loss))
             daso.epoch_loss_logic(float(np.mean(losses)))
+            daso.next_epoch()  # advances the warmup/cycling/cooldown phases
             print(
                 f"epoch {epoch}: mean loss {np.mean(losses):.4f}, "
                 f"global_skip {daso.global_skip}"

@@ -84,6 +84,8 @@ class KMeans(_KCluster):
         tol: float = 1e-4,
         random_state: Optional[int] = None,
     ):
+        if max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {max_iter}")
         if init == "kmeans++":
             init = "probability_based"
         super().__init__(
