@@ -156,3 +156,27 @@ def test_lanczos_eigs():
     evals = np.sort(np.linalg.eigvalsh(T.numpy()))
     expected = np.sort(np.linalg.eigvalsh(sym))
     np.testing.assert_allclose(evals[-3:], expected[-3:], rtol=1e-2, atol=1e-2)
+
+
+def test_hsvd_rank_deficient(ht):
+    # Gram-based fast path must drop noise-floor directions, not amplify
+    # them (they live inside the dominant subspace and double-count energy)
+    rng = np.random.default_rng(0)
+    A = (rng.standard_normal((2000, 5)) @ rng.standard_normal((5, 64))).astype(np.float32)
+    x = ht.array(A, split=0)
+    u, s, v, err = ht.linalg.hsvd_rank(x, 10, compute_sv=True, safetyshift=5)
+    U, S, V = u.numpy(), np.asarray(s._dense()), v.numpy()
+    assert np.isfinite(U).all() and np.isfinite(V).all()
+    rec = U @ np.diag(S) @ V.T
+    rel = np.linalg.norm(A - rec) / np.linalg.norm(A)
+    assert rel < 1e-4, rel
+
+
+def test_rsvd_rank_deficient(ht):
+    rng = np.random.default_rng(1)
+    A = (rng.standard_normal((500, 4)) @ rng.standard_normal((4, 40))).astype(np.float32)
+    x = ht.array(A, split=0)
+    u, s, v = ht.linalg.rsvd(x, 6, n_oversamples=6)
+    rec = u.numpy() @ np.diag(np.asarray(s._dense())) @ v.numpy().T
+    rel = np.linalg.norm(A - rec) / np.linalg.norm(A)
+    assert rel < 1e-4, rel
