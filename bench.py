@@ -1,14 +1,20 @@
-"""Benchmark driver: KMeans iteration throughput on the real chip.
+"""Benchmark driver: hierarchical SVD GFLOP/s per chip (the north star).
 
-BASELINE config 2: "heat.cluster.KMeans on 10^8 x 16 split-0 DNDarray
-(Allreduce centroids over ICI)".  One Lloyd iteration = cdist (an MXU
-matmul), argmin, and a segment-sum centroid update; the reference measures
-the same workload in benchmarks/cb/cluster.py.
+BASELINE config 3: "heat.decomposition hierarchical SVD on 200GB
+tall-skinny matrix".  One chip factorizes a 2^22 x 128 f32 split-0 matrix
+(2 GiB) to rank 10 via ``ht.linalg.hsvd_rank`` — on a pod the same call
+scales the sample axis over the mesh, so per-chip GFLOP/s is the number
+that multiplies out to the 200 GB configuration.
+
+FLOP accounting is the standard 2*n*f^2 for a tall-skinny factorization;
+``vs_baseline`` divides by the reference's per-process compute path (the
+same truncated factorization in torch on CPU, measured on a subset), so
+>1 means one chip beats one reference process on this host.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` divides by the reference's per-process compute path
-(the same iteration in torch on CPU, measured in-process on a subset),
-so >1 means faster than one reference process on this host.
+Synchronization is a device->host scalar fetch minus the measured
+round-trip floor — block_until_ready does not synchronize through a
+tunneled remote chip.
 """
 
 from __future__ import annotations
@@ -18,49 +24,9 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-
-
-def _measure_reference_baseline(f: int, k: int) -> float:
-    """Throughput of the reference's per-process compute path (torch CPU),
-    measured on a 2^20-point subset of the same workload.
-
-    The reference's KMeans iteration is torch ops on the local chunk
-    (cdist via the same quadratic expansion, argmin, one-hot matmul
-    update — cluster/kmeans.py) plus MPI reductions; this measures the
-    torch-CPU compute side, which dominates at this scale.
-    """
-    import torch
-
-    n_b = 1 << 20
-    xb = torch.randn(n_b, f)
-    cb = torch.randn(k, f)
-
-    def iteration():
-        d = (
-            (xb * xb).sum(1, keepdim=True)
-            + (cb * cb).sum(1)[None, :]
-            - 2.0 * xb @ cb.T
-        )
-        labels = d.argmin(1)
-        one_hot = torch.nn.functional.one_hot(labels, k).to(xb.dtype)
-        return (one_hot.T @ xb) / one_hot.sum(0)[:, None].clamp(min=1.0)
-
-    iteration()  # warmup (allocator, thread pool)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        centers = iteration()
-        _ = centers.sum().item()
-        best = min(best, time.perf_counter() - t0)
-    return n_b / best
 
 
 def _measure_sync_floor() -> float:
-    """Round-trip cost of a host fetch (large over the tunneled chip), to be
-    subtracted so the measurement reflects device time, not link latency.
-    A device->host scalar fetch is the only reliable synchronization here:
-    block_until_ready can return before remote execution completes."""
     f = jax.jit(lambda x: x + 1.0)
     z = jnp.zeros(())
     float(f(z))
@@ -72,46 +38,64 @@ def _measure_sync_floor() -> float:
     return best
 
 
+def _measure_reference_baseline(f: int, rank: int) -> float:
+    """GFLOP/s of the reference's per-process compute path: torch CPU
+    doing the same truncated factorization (its hsvd leaves are
+    torch.linalg.svd of the local block, svdtools.py:474), measured on a
+    2^18-row subset."""
+    import torch
+
+    n_b = 1 << 18
+    xb = torch.randn(n_b, f)
+
+    def factorize():
+        u, s, v = torch.linalg.svd(xb, full_matrices=False)
+        return u[:, :rank] * s[:rank]
+
+    factorize()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        us = factorize()
+        _ = us.sum().item()
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n_b * f * f / best / 1e9
+
+
 def main() -> None:
     import heat_tpu as ht
 
-    # Scale the workload to the available memory: 2^24 x 16 f32 = 1 GiB.
-    n, f, k = 1 << 24, 16, 8
-    n_iter = 50
+    n, f, rank = 1 << 22, 128, 10  # 2 GiB f32 tall-skinny
+    n_iter = 5
 
     ht.random.seed(0)
     x = ht.random.randn(n, f, split=0)
-    jax.block_until_ready(x.larray_padded)
+    float(x.sum())  # materialize
 
-    model = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=1, random_state=0)
-    model._initialize_cluster_centers(x)
+    def factorize():
+        u, s, v, err = ht.linalg.hsvd_rank(x, rank, compute_sv=True, safetyshift=5)
+        return s
 
-    def one_iteration():
-        model._fused_step(x)
-        return model._cluster_centers
-
-    # warmup/compile; scalar fetch = real synchronization point
-    float(one_iteration().sum())
-
+    float(factorize().sum())  # warmup/compile
     sync_floor = _measure_sync_floor()
 
-    t0 = time.perf_counter()
+    best = float("inf")
     for _ in range(n_iter):
-        centers = one_iteration()
-    float(centers.sum())  # force execution of the whole chain
-    elapsed = max(time.perf_counter() - t0 - sync_floor, 1e-9) / n_iter
+        t0 = time.perf_counter()
+        s = factorize()
+        float(s.sum())
+        best = min(best, time.perf_counter() - t0 - sync_floor)
 
-    pts_per_sec = n / elapsed
-
-    baseline_pts_per_sec = _measure_reference_baseline(f, k)
+    gflops = 2.0 * n * f * f / best / 1e9
+    baseline = _measure_reference_baseline(f, rank)
 
     print(
         json.dumps(
             {
-                "metric": "kmeans_iteration_throughput_2^24x16_k8",
-                "value": round(pts_per_sec / 1e6, 3),
-                "unit": "Mpts/s",
-                "vs_baseline": round(pts_per_sec / baseline_pts_per_sec, 2),
+                "metric": "hsvd_rank10_gflops_per_chip_2^22x128",
+                "value": round(gflops, 1),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(gflops / baseline, 2),
             }
         )
     )

@@ -82,27 +82,20 @@ def hsvd(
     return _hsvd(A, maxrank=maxrank, rtol=rtol, compute_sv=compute_sv, safetyshift=safetyshift, silent=silent, no_of_merges=no_of_merges)
 
 
-def _hsvd(
-    A: DNDarray,
-    maxrank: Optional[int],
-    rtol: Optional[float],
-    compute_sv: bool,
-    safetyshift: int,
-    silent: bool,
-    no_of_merges: int = 2,
-):
-    m, n = A.shape
-    comm = A.comm
-    dtype = jnp.float32 if not types.heat_type_is_inexact(A.dtype) else A.dtype.jax_type()
-    dense = A._dense().astype(dtype)
+from functools import partial as _partial
 
-    if maxrank is None:
-        maxrank = min(m, n)
-    trunc = min(maxrank + safetyshift, m)
+
+@_partial(jax.jit, static_argnames=("trunc", "p", "no_of_merges"))
+def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int):
+    """The whole hierarchical factorization as ONE compiled program —
+    eager op-by-op dispatch of the same pipeline measures ~7x slower
+    through a remote chip.  Returns (u_fin (m, w), s_fin (w,), v_fin
+    (n, w), discarded_sq, total_sq) at full working width w; the host
+    slices to the final rank (shape decisions stay outside jit)."""
+    m, n = dense.shape
 
     # leaf level: column blocks = the canonical shards of the split axis
     # (split=1 in the reference's flagship use; any split or none works)
-    p = comm.size if A.split == 1 else 1
     if p > 1 and n >= p:
         block_cols = [dense[:, s.start : s.stop] for s in _col_slices(n, p)]
     else:
@@ -147,14 +140,43 @@ def _hsvd(
         u_fin = jnp.matmul(us, v_eig, precision=jax.lax.Precision.HIGHEST) * inv_s[None, :]
     else:
         u_fin, s_fin, _ = jnp.linalg.svd(us, full_matrices=False)
+
+    # V = A^T U diag(1/s) at full width (sliced by the host)
+    inv_sv = jnp.where(s_fin > 0, 1.0 / jnp.maximum(s_fin, 1e-30), 0.0)
+    v_fin = jnp.matmul(dense.T, u_fin, precision=jax.lax.Precision.HIGHEST) * inv_sv[None, :]
+
+    total_sq = jnp.sum(dense.astype(jnp.float32) ** 2)
+    return u_fin, s_fin, v_fin, discarded_sq, total_sq
+
+
+def _hsvd(
+    A: DNDarray,
+    maxrank: Optional[int],
+    rtol: Optional[float],
+    compute_sv: bool,
+    safetyshift: int,
+    silent: bool,
+    no_of_merges: int = 2,
+):
+    m, n = A.shape
+    comm = A.comm
+    dtype = jnp.float32 if not types.heat_type_is_inexact(A.dtype) else A.dtype.jax_type()
+    dense = A._dense().astype(dtype)
+
+    if maxrank is None:
+        maxrank = min(m, n)
+    trunc = min(maxrank + safetyshift, m)
+    p = comm.size if A.split == 1 else 1
+
+    u_fin, s_fin, v_fin, discarded_sq, total_sq = _hsvd_core(dense, trunc, p, no_of_merges)
+
     # final truncation to maxrank (drop safetyshift) or rtol bound
     if rtol is not None:
         # smallest k with (energy discarded by leaf/merge truncations +
         # energy of the dropped tail of s_fin) <= rtol^2 * ||A||_F^2
-        total_sq_f = jnp.sum(dense.astype(jnp.float32) ** 2)
         kept = jnp.cumsum(s_fin.astype(jnp.float32) ** 2)
         resid = jnp.sum(s_fin.astype(jnp.float32) ** 2) - kept + discarded_sq
-        ok = np.asarray(resid <= (rtol**2) * total_sq_f)
+        ok = np.asarray(resid <= (rtol**2) * total_sq)
         k = int(np.argmax(ok)) + 1 if ok.any() else int(s_fin.shape[0])
         k = min(k, maxrank)
     else:
@@ -164,17 +186,16 @@ def _hsvd(
 
     # relative error estimate ||A - U U^T A||_F / ||A||_F (svdtools.py:430+)
     approx_sq = jnp.sum(sv**2)
-    total_sq = jnp.sum(dense.astype(jnp.float32) ** 2)
     rel_err = jnp.sqrt(jnp.maximum(total_sq - approx_sq, 0.0) / jnp.maximum(total_sq, 1e-30))
 
+    # the error estimate stays a lazy 0-d jax scalar: float()-ing it here
+    # would force a device->host round trip inside every hsvd call (one
+    # full link RTT on a tunneled chip); callers convert on use
     if compute_sv:
         S = DNDarray.from_dense(sv, None, A.device, comm)
-        # V = A^T U diag(1/s)
-        v = jnp.matmul(dense.T, u_fin[:, :k], precision=jax.lax.Precision.HIGHEST)
-        v = v / jnp.maximum(sv[None, :], 1e-30)
-        V = DNDarray.from_dense(v, A.split if A.split == 1 else None, A.device, comm)
-        return U, S, V, float(rel_err)
-    return U, float(rel_err)
+        V = DNDarray.from_dense(v_fin[:, :k], A.split if A.split == 1 else None, A.device, comm)
+        return U, S, V, rel_err
+    return U, rel_err
 
 
 def _gram_orthonormalize(y: jnp.ndarray, passes: int = 2) -> jnp.ndarray:
