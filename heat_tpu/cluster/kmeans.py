@@ -31,15 +31,43 @@ def _lloyd_update(xp: jax.Array, centers: jax.Array, n_true: int, k: int):
     over x (distance matmul, one-hot sums matmul) and two (N, k)
     intermediates.  Labels and inertia come from one final `_lloyd_step`.
     """
-    xc = xp @ centers.T  # (N, k) — MXU
+    return _lloyd_body(xp, centers, n_true, k)
+
+
+@partial(jax.jit, static_argnames=("n_true", "k", "max_iter", "tol"))
+def _lloyd_loop(xp: jax.Array, centers: jax.Array, n_true: int, k: int, max_iter: int, tol: float):
+    """The whole Lloyd fit loop as one on-device ``lax.while_loop``.
+
+    A Python loop checking ``float(shift) <= tol`` costs one device->host
+    round trip per iteration (a full link RTT on a tunneled chip); here
+    the convergence test runs on-device and the host syncs exactly once,
+    after the loop.  Returns (centers, n_iter, last_shift).
+    """
+
+    def cond(carry):
+        c, i, shift = carry
+        return jnp.logical_and(i < max_iter, shift > tol)
+
+    def body(carry):
+        c, i, _ = carry
+        new, shift = _lloyd_body(xp, c, n_true, k)
+        return new, i + 1, shift
+
+    init = (centers, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+    c, i, shift = jax.lax.while_loop(cond, body, init)
+    return c, i, shift
+
+
+def _lloyd_body(xp, centers, n_true, k):
+    xc = xp @ centers.T
     c2 = jnp.sum(centers * centers, axis=1)
     labels = jnp.argmin(c2[None, :] - 2.0 * xc, axis=1)
     valid = jax.lax.broadcasted_iota(jnp.int32, (xp.shape[0],), 0) < n_true
     oh = jax.nn.one_hot(labels, k, dtype=xp.dtype) * valid.astype(xp.dtype)[:, None]
-    sums = oh.T @ xp  # (k, f) — MXU; GSPMD: psum across shards
+    sums = oh.T @ xp
     counts = jnp.sum(oh, axis=0)
     new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
-    shift = jnp.sum((new - centers) ** 2)
+    shift = jnp.sum((new - centers) ** 2).astype(jnp.float32)
     return new, shift
 
 
@@ -149,12 +177,27 @@ class KMeans(_KCluster):
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
         self._initialize_cluster_centers(x)
 
-        for i in range(self.max_iter):
-            shift = self._fused_step(x)
-            if float(shift) <= self.tol:
-                break
+        xp = x.larray_padded
+        if not types.heat_type_is_inexact(x.dtype):
+            xp = xp.astype(jnp.float32)
+        centers = self._cluster_centers._dense().astype(xp.dtype)
+        use_kernel = kernels.LLOYD_KERNEL and kernels.lloyd_supported(xp.shape[1], self.n_clusters)
+        if use_kernel:
+            # the opt-in Pallas path iterates from the host (one sync/iter)
+            for i in range(self.max_iter):
+                shift = self._fused_step(x)
+                if float(shift) <= self.tol:
+                    break
+            n_iter = i + 1
+        else:
+            # whole fit loop on-device: exactly one host sync for the count
+            new, n_iter_dev, _ = _lloyd_loop(
+                xp, centers, x.shape[0], self.n_clusters, self.max_iter, float(self.tol)
+            )
+            self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
+            n_iter = int(n_iter_dev)
 
-        self._n_iter = i + 1
+        self._n_iter = n_iter
         # final assignment against the converged centers (the reference's
         # last pass only assigns, it does not move centers)
         labels, inertia = self._assign_padded(x)

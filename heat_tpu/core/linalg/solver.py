@@ -21,6 +21,36 @@ from .basics import matmul, transpose
 __all__ = ["cg", "lanczos", "solve_triangular"]
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _cg_loop(Ad: jax.Array, bd: jax.Array, x0d: jax.Array, max_iter: int) -> jax.Array:
+    """Conjugate-gradient iteration compiled as one program (tol 1e-10 on
+    the residual norm, matching the reference's stop test solver.py:46)."""
+    hp = jax.lax.Precision.HIGHEST
+
+    r0 = bd - jnp.matmul(Ad, x0d, precision=hp)
+    init = (x0d, r0, r0, jnp.vdot(r0, r0), jnp.int32(0))
+
+    def cond(carry):
+        x, r, p, rs, it = carry
+        return jnp.logical_and(it < max_iter, jnp.sqrt(rs) >= 1e-10)
+
+    def body(carry):
+        x, r, p, rs, it = carry
+        Ap = jnp.matmul(Ad, p, precision=hp)
+        alpha = rs / jnp.vdot(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = jnp.vdot(r, r)
+        p = r + (rsnew / rs) * p
+        return x, r, p, rsnew, it + 1
+
+    x, _, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return x
+
+
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
     """Conjugate gradients for SPD systems (solver.py:16)."""
     if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
@@ -32,29 +62,20 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("c needs to be a 1D vector")
 
-    r = b - matmul(A, x0)
-    p = r
-    rsold = matmul(r, r)
-    x = x0
-
-    for _ in range(len(b)):
-        Ap = matmul(A, p)
-        alpha = rsold / matmul(p, Ap)
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rsnew = matmul(r, r)
-        if float(jnp.sqrt(rsnew._dense())) < 1e-10:
-            if out is not None:
-                out._replace(x.larray_padded)
-                return out
-            return x
-        p = r + (rsnew / rsold) * p
-        rsold = rsnew
-
+    # whole Krylov iteration as one on-device while_loop: a Python loop
+    # with a float() residual check costs one device->host round trip per
+    # step (a full link RTT on a tunneled chip)
+    Ad = A._dense()
+    if not types.heat_type_is_inexact(A.dtype):
+        Ad = Ad.astype(jnp.float32)
+    bd = b._dense().astype(Ad.dtype)
+    x0d = x0._dense().astype(Ad.dtype)
+    xd = _cg_loop(Ad, bd, x0d, len(b))
+    result = DNDarray.from_dense(xd, b.split, b.device, b.comm)
     if out is not None:
-        out._replace(x.larray_padded)
+        out._replace(result.larray_padded)
         return out
-    return x
+    return result
 
 
 def lanczos(

@@ -11,9 +11,52 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 
+from functools import partial
+
 from ..core import types
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _cd_loop(X, yd, col_sq, lam, tol, max_iter):
+    """Whole cyclic-coordinate-descent fit as one on-device while_loop.
+
+    A host-side sweep loop costs a device->host sync per sweep (a full
+    link RTT on a tunneled chip); lam/tol are traced so a regularization-
+    path sweep (examples/lasso) reuses one compiled executable.
+    Returns (theta, sweeps_run).
+    """
+    m = X.shape[1]
+    hp = jax.lax.Precision.HIGHEST
+
+    def one_sweep(th):
+        def body(j, t):
+            resid = yd - jnp.matmul(X, t, precision=hp) + X[:, j] * t[j]
+            rho = jnp.matmul(X[:, j], resid, precision=hp)
+            new_j = jnp.where(
+                j == 0,
+                rho / jnp.maximum(col_sq[0], 1e-30),  # intercept not penalized
+                (jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam, 0.0))
+                / jnp.maximum(col_sq[j], 1e-30),
+            )
+            return t.at[j].set(new_j)
+
+        return jax.lax.fori_loop(0, m, body, th)
+
+    def cond(carry):
+        th, it, delta = carry
+        return jnp.logical_and(it < max_iter, delta >= tol)
+
+    def body(carry):
+        th, it, _ = carry
+        new = one_sweep(th)
+        delta = jnp.max(jnp.abs(new - th)).astype(jnp.float32)
+        return new, it + 1, delta
+
+    init = (jnp.zeros((m,), X.dtype), jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+    theta, it, _ = jax.lax.while_loop(cond, body, init)
+    return theta, it
 
 __all__ = ["Lasso"]
 
@@ -74,34 +117,17 @@ class Lasso(BaseEstimator, RegressionMixin):
         n, f = xd.shape
         # prepend intercept column (lasso.py:135)
         X = jnp.concatenate([jnp.ones((n, 1), xd.dtype), xd], axis=1)
-        m = f + 1
-        theta = jnp.zeros((m,), xd.dtype)
         col_sq = jnp.sum(X * X, axis=0)
 
-        hp = jax.lax.Precision.HIGHEST
-
-        def one_sweep(theta):
-            def body(j, th):
-                resid = yd - jnp.matmul(X, th, precision=hp) + X[:, j] * th[j]
-                rho = jnp.matmul(X[:, j], resid, precision=hp)
-                new_j = jnp.where(
-                    j == 0,
-                    rho / jnp.maximum(col_sq[0], 1e-30),  # intercept not penalized
-                    (jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - self.__lam, 0.0))
-                    / jnp.maximum(col_sq[j], 1e-30),
-                )
-                return th.at[j].set(new_j)
-
-            return jax.lax.fori_loop(0, m, body, theta)
-
-        sweep = jax.jit(one_sweep)
-        for it in range(self.max_iter):
-            new_theta = sweep(theta)
-            delta = float(jnp.max(jnp.abs(new_theta - theta)))
-            theta = new_theta
-            if delta < self.tol:
-                break
-        self.n_iter = it + 1
+        theta, it = _cd_loop(
+            X,
+            yd,
+            col_sq,
+            jnp.asarray(self.__lam, xd.dtype),
+            jnp.asarray(self.tol, jnp.float32),
+            self.max_iter,
+        )
+        self.n_iter = int(it)  # the loop's only host sync
         self.__theta = DNDarray.from_dense(theta.reshape(-1, 1), None, x.device, x.comm)
         return self
 
