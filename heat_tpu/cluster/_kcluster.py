@@ -8,16 +8,43 @@ _kcluster.py:97-207).  All distributed behavior rides on the ops layer
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
+
+_jit_partial = functools.partial(jax.jit, static_argnames=("k",))
 
 from ..core import types
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
 
 __all__ = ["_KCluster"]
+
+
+@_jit_partial
+def _kmeanspp_init(dense: jax.Array, first_idx: jax.Array, u_all: jax.Array, k: int) -> jax.Array:
+    """Greedy D^2-weighted kmeans++ seeding as one compiled program.
+
+    ``u_all`` holds the k-1 pre-drawn uniforms (one per added center), so
+    the library RNG stream is consumed outside and the loop is pure.
+    """
+    n, f = dense.shape
+    x2 = jnp.sum(dense * dense, axis=1)
+    centers0 = jnp.zeros((k, f), dense.dtype).at[0].set(dense[first_idx])
+
+    def body(i, centers):
+        c2 = jnp.sum(centers * centers, axis=1)
+        d_all = x2[:, None] + c2[None, :] - 2.0 * (dense @ centers.T)
+        d_all = d_all + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0)
+        d2 = jnp.maximum(jnp.min(d_all, axis=1), 0.0)
+        probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
+        u = u_all[i - 1]
+        next_idx = jnp.clip(jnp.searchsorted(jnp.cumsum(probs), u), 0, n - 1)
+        return centers.at[i].set(dense[next_idx])
+
+    return jax.lax.fori_loop(1, k, body, centers0)
 
 
 class _KCluster(BaseEstimator, ClusteringMixin):
@@ -83,22 +110,13 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             centers = dense[idx]
         elif self.init in ("kmeans++", "probability_based", "++"):
             # kmeans++ sampling (_kcluster.py:112-180): greedy D^2 weighting.
-            # Centers are preallocated at (k, f) and filled progressively so
-            # every iteration has identical shapes (one XLA program, not k),
-            # with unfilled slots masked to +inf in the distance min.
+            # The uniforms are pre-drawn from the library RNG (stream
+            # semantics unchanged), then the whole greedy loop compiles as
+            # one program — centers preallocated at (k, f) with unfilled
+            # slots masked to +inf so every round has identical shapes.
             key_arr = ht_random.randint(0, n, size=(1,), comm=x.comm)._dense()
-            centers = jnp.zeros((k, f), dense.dtype).at[0].set(dense[key_arr[0]])
-            x2 = jnp.sum(dense * dense, axis=1)
-            for i in range(1, k):
-                c2 = jnp.sum(centers * centers, axis=1)
-                d_all = x2[:, None] + c2[None, :] - 2.0 * (dense @ centers.T)
-                d_all = d_all + jnp.where(jnp.arange(k)[None, :] >= i, jnp.inf, 0.0)
-                d2 = jnp.maximum(jnp.min(d_all, axis=1), 0.0)
-                probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
-                u = ht_random.rand(1, comm=x.comm)._dense()[0]
-                next_idx = jnp.searchsorted(jnp.cumsum(probs), u)
-                next_idx = jnp.clip(next_idx, 0, n - 1)
-                centers = centers.at[i].set(dense[next_idx])
+            u_all = ht_random.rand(max(k - 1, 1), comm=x.comm)._dense()
+            centers = _kmeanspp_init(dense, key_arr[0], u_all, k)
         elif self.init == "batchparallel":
             raise NotImplementedError("batchparallel init: use BatchParallelKMeans")
         else:

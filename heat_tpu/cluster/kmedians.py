@@ -5,8 +5,10 @@ Centers update to the per-cluster feature-wise median instead of the mean.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Union
 
+import jax
 import jax.numpy as jnp
 
 from ..core import types
@@ -15,6 +17,38 @@ from ..spatial import distance
 from ._kcluster import _KCluster
 
 __all__ = ["KMedians"]
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter", "tol"))
+def _kmedians_loop(dense: jax.Array, centers: jax.Array, k: int, max_iter: int, tol: float):
+    """Whole KMedians fit as one on-device while_loop (one host sync
+    total instead of one per iteration)."""
+
+    def update(c):
+        d = jnp.sum(jnp.abs(dense[:, None, :] - c[None, :, :]), axis=-1)
+        labels = jnp.argmin(d, axis=1)
+        new_rows = []
+        for j in range(k):
+            mask = labels == j
+            cnt = jnp.sum(mask)
+            masked = jnp.where(mask[:, None], dense, jnp.nan)
+            med = jnp.nanmedian(masked, axis=0)
+            new_rows.append(jnp.where(cnt > 0, med, c[j]))
+        return jnp.stack(new_rows)
+
+    def cond(carry):
+        c, i, shift = carry
+        return jnp.logical_and(i < max_iter, shift > tol)
+
+    def body(carry):
+        c, i, _ = carry
+        new = update(c)
+        shift = jnp.sum((new - c) ** 2).astype(jnp.float32)
+        return new, i + 1, shift
+
+    init = (centers, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+    c, i, _ = jax.lax.while_loop(cond, body, init)
+    return c, i
 
 
 class KMedians(_KCluster):
@@ -66,14 +100,14 @@ class KMedians(_KCluster):
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
         self._initialize_cluster_centers(x)
 
-        for i in range(self.max_iter):
-            matching_centroids = self._assign_to_cluster(x)
-            new_cluster_centers = self._update_centroids(x, matching_centroids)
-            shift = float(jnp.sum((new_cluster_centers._dense() - self._cluster_centers._dense()) ** 2))
-            self._cluster_centers = new_cluster_centers
-            if shift <= self.tol:
-                break
-
-        self._n_iter = i + 1
+        dense = x._dense()
+        if not types.heat_type_is_inexact(x.dtype):
+            dense = dense.astype(jnp.float32)
+        centers = self._cluster_centers._dense().astype(dense.dtype)
+        new, n_iter = _kmedians_loop(
+            dense, centers, self.n_clusters, self.max_iter, float(self.tol)
+        )
+        self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
+        self._n_iter = int(n_iter)
         self._labels = self._assign_to_cluster(x, eval_functional_value=True)
         return self

@@ -6,8 +6,10 @@ KMeans-style mean update, matching the reference's variant.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Union
 
+import jax
 import jax.numpy as jnp
 
 from ..core import types
@@ -16,6 +18,44 @@ from ..spatial import distance
 from ._kcluster import _KCluster
 
 __all__ = ["KMedoids"]
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter"))
+def _kmedoids_loop(dense: jax.Array, centers: jax.Array, k: int, max_iter: int):
+    """Whole KMedoids fit as one on-device while_loop (medoids are data
+    points, so the stop test is exact zero movement)."""
+
+    def update(c):
+        d = jnp.sum(jnp.abs(dense[:, None, :] - c[None, :, :]), axis=-1)
+        labels = jnp.argmin(d, axis=1)
+        new_rows = []
+        for j in range(k):
+            mask = labels == j
+            cnt = jnp.sum(mask)
+            mean = jnp.where(
+                cnt > 0,
+                jnp.sum(jnp.where(mask[:, None], dense, 0.0), axis=0) / jnp.maximum(cnt, 1),
+                c[j],
+            )
+            dm = jnp.sum(jnp.abs(dense - mean[None, :]), axis=1)
+            dm_in = jnp.where(mask, dm, jnp.inf)
+            dm = jnp.where(cnt > 0, dm_in, dm)
+            new_rows.append(dense[jnp.argmin(dm)])
+        return jnp.stack(new_rows)
+
+    def cond(carry):
+        c, i, shift = carry
+        return jnp.logical_and(i < max_iter, shift > 0.0)
+
+    def body(carry):
+        c, i, _ = carry
+        new = update(c)
+        shift = jnp.sum(jnp.abs(new - c)).astype(jnp.float32)
+        return new, i + 1, shift
+
+    init = (centers, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+    c, i, _ = jax.lax.while_loop(cond, body, init)
+    return c, i
 
 
 class KMedoids(_KCluster):
@@ -71,14 +111,12 @@ class KMedoids(_KCluster):
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
         self._initialize_cluster_centers(x)
 
-        for i in range(self.max_iter):
-            matching_centroids = self._assign_to_cluster(x)
-            new_cluster_centers = self._update_centroids(x, matching_centroids)
-            shift = float(jnp.sum(jnp.abs(new_cluster_centers._dense() - self._cluster_centers._dense())))
-            self._cluster_centers = new_cluster_centers
-            if shift == 0.0:
-                break
-
-        self._n_iter = i + 1
+        dense = x._dense()
+        if not types.heat_type_is_inexact(x.dtype):
+            dense = dense.astype(jnp.float32)
+        centers = self._cluster_centers._dense().astype(dense.dtype)
+        new, n_iter = _kmedoids_loop(dense, centers, self.n_clusters, self.max_iter)
+        self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
+        self._n_iter = int(n_iter)
         self._labels = self._assign_to_cluster(x, eval_functional_value=True)
         return self
