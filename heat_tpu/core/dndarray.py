@@ -44,6 +44,20 @@ __all__ = ["DNDarray"]
 Scalar = Union[int, float, bool, complex]
 
 
+def _np_fetch(arr: jax.Array) -> np.ndarray:
+    """Device->host fetch that tolerates backends with incomplete complex
+    transfer support (observed on tunneled TPU runtimes): native transfer
+    first, then a real/imag pair of real transfers.  No state is cached —
+    a failure may come from the upstream computation rather than the
+    transfer path, so each call retries natively."""
+    if not jnp.issubdtype(arr.dtype, jnp.complexfloating) or jax.default_backend() != "tpu":
+        return np.asarray(arr)
+    try:
+        return np.asarray(arr)
+    except jax.errors.JaxRuntimeError:
+        return np.asarray(jnp.real(arr)) + 1j * np.asarray(jnp.imag(arr))
+
+
 class LocalIndex:
     """Indexing proxy mirroring ``DNDarray.lloc`` semantics (dndarray.py:244)."""
 
@@ -298,7 +312,15 @@ class DNDarray:
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
         """Cast to ``dtype`` (dndarray.py:482)."""
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jax_type())
+        src = self.__array
+        if (
+            jnp.issubdtype(dtype.jax_type(), jnp.complexfloating)
+            and jax.default_backend() == "tpu"
+            and not _tpu_complex_ok()
+        ):
+            # complex-less TPU runtime: cast on the host CPU backend
+            src = jax.device_put(src, jax.devices("cpu")[0])
+        casted = src.astype(dtype.jax_type())
         out = DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm)
         if not copy:
             self.__array = casted
@@ -308,7 +330,7 @@ class DNDarray:
 
     def numpy(self) -> np.ndarray:
         """Gather the full array to host numpy (dndarray.py:1177)."""
-        return np.asarray(self._dense())
+        return _np_fetch(self._dense())
 
     def __array__(self, dtype=None) -> np.ndarray:
         a = self.numpy()
@@ -321,7 +343,7 @@ class DNDarray:
         """Scalar value of a single-element array (dndarray.py:1152)."""
         if self.size != 1:
             raise ValueError(f"only one-element arrays can be converted to Python scalars, got shape {self.__gshape}")
-        return self._dense().reshape(()).item()
+        return _np_fetch(self._dense().reshape(())).item()
 
     def cpu(self) -> "DNDarray":
         """Kept for API parity (dndarray.py:646); placement is mesh-owned."""
@@ -941,10 +963,44 @@ def _iop(self: DNDarray, result: DNDarray) -> DNDarray:
     return self
 
 
+_TPU_COMPLEX_OK: Optional[bool] = None
+
+
+def _tpu_complex_ok() -> bool:
+    """Whether the TPU runtime supports complex64 compute + transfer.
+
+    Tunneled TPU runtimes vary: some reject every complex op/transfer with
+    UNIMPLEMENTED.  Probed once per process with a tiny multiply+fetch;
+    when unsupported, complex arrays stay on the in-process CPU backend
+    (jax ops follow operand placement, so complex math still works — at
+    host speed — instead of crashing)."""
+    global _TPU_COMPLEX_OK
+    if _TPU_COMPLEX_OK is None:
+        try:
+            probe = jax.device_put(np.ones((2,), np.complex64), jax.devices()[0])
+            _TPU_COMPLEX_OK = bool(np.asarray(probe * probe)[0] == 1.0)
+        except Exception:
+            _TPU_COMPLEX_OK = False
+    return _TPU_COMPLEX_OK
+
+
 def _pad_to_canonical(
     dense: jax.Array, gshape: Tuple[int, ...], split: Optional[int], comm: Communication
 ) -> jax.Array:
     """Pad a true-shape array along ``split`` and place with canonical sharding."""
+    if (
+        jnp.issubdtype(dense.dtype, jnp.complexfloating)
+        and jax.default_backend() == "tpu"
+        and not _tpu_complex_ok()
+    ):
+        # complex-less TPU runtime: keep the array on the host CPU backend
+        cpu = jax.devices("cpu")[0]
+        if split is not None:
+            pad = comm.pad_amount(gshape[split])
+            if pad:
+                widths = [(0, pad if d == split else 0) for d in range(dense.ndim)]
+                dense = jnp.pad(jax.device_put(dense, cpu), widths)
+        return jax.device_put(dense, cpu)
     if split is None:
         return jax.device_put(dense, comm.sharding(None))
     pad = comm.pad_amount(gshape[split])
