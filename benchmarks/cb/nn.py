@@ -1,0 +1,62 @@
+"""DP-SGD training-throughput benchmark (BASELINE config 4: the
+examples/nn MNIST CNN under data parallelism; the reference measures the
+same workload through perun in its DASO/DataParallel examples)."""
+
+import time
+
+import numpy as np
+
+from monitor import RESULTS, _sync, monitor
+
+
+def run_nn_benchmarks(scale: float = 1.0) -> None:
+    import jax
+    import optax
+
+    import heat_tpu as ht
+    from heat_tpu.utils.data import synthetic_mnist
+
+    n = max(int(2048 * scale), 256)
+    batch = 128
+
+    x, y = synthetic_mnist(n)
+
+    import flax.linen as lnn
+
+    class CNN(lnn.Module):
+        @lnn.compact
+        def __call__(self, t):
+            t = lnn.Conv(16, (3, 3))(t)
+            t = lnn.relu(t)
+            t = lnn.avg_pool(t, (2, 2), strides=(2, 2))
+            t = t.reshape((t.shape[0], -1))
+            t = lnn.Dense(64)(t)
+            t = lnn.relu(t)
+            return lnn.Dense(10)(t)
+
+    dp = ht.nn.DataParallel(CNN(), optimizer=optax.adam(1e-3))
+    xb0 = ht.array(x.numpy()[:batch], split=0)
+    dp.init(jax.random.PRNGKey(0), xb0)
+
+    def loss_fn(pred, target):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
+
+    xd, yd = x.numpy(), y.numpy()
+    # warmup/compile one step
+    dp.step(loss_fn, ht.array(xd[:batch], split=0), ht.array(yd[:batch], split=0))
+
+    @monitor()
+    def dp_sgd_epoch():
+        losses = []
+        for start in range(0, n - batch + 1, batch):
+            xb = ht.array(xd[start : start + batch], split=0)
+            yb = ht.array(yd[start : start + batch], split=0)
+            losses.append(dp.step(loss_fn, xb, yb))
+        return losses[-1]
+
+    t0 = time.perf_counter()
+    dp_sgd_epoch()
+    elapsed = RESULTS[-1]["seconds"]
+    steps = n // batch
+    RESULTS[-1]["steps_per_s"] = round(steps / max(elapsed, 1e-9), 2)
+    print(f'# dp_sgd: {RESULTS[-1]["steps_per_s"]} steps/s at batch {batch}')

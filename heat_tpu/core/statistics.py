@@ -314,18 +314,42 @@ def minimum(x1, x2, out=None):
     return _binary_op(jnp.minimum, x1, x2, out)
 
 
-def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False):
+def percentile(
+    x,
+    q,
+    axis=None,
+    out=None,
+    interpolation: str = "linear",
+    keepdims: bool = False,
+    sketched: bool = False,
+    sketch_size: Optional[int] = None,
+):
     """q-th percentile (statistics.py:1443).
 
     The reference runs a distributed sample-sort plus fractional-index
     interpolation; the global jnp.percentile over the sharded dense view
-    compiles to the equivalent sort + gather.
+    compiles to the equivalent sort + gather.  ``sketched=True`` estimates
+    the percentile on a random subset of ``sketch_size`` samples along the
+    reduction axis (statistics.py:1490-1532) — O(sketch_size log) instead
+    of a full sort, with sampling error ~1/sqrt(sketch_size).
     """
     qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     dense = x._dense()
     if not types.heat_type_is_inexact(x.dtype):
         dense = dense.astype(jnp.float32)
     axis_s = sanitize_axis(x.shape, axis)
+    if sketched:
+        import builtins
+
+        from . import random as ht_random
+
+        # NB: min/max in this module are the DNDarray reductions
+        n = dense.size if axis_s is None else dense.shape[axis_s]
+        size = builtins.min(sketch_size or builtins.max(int(np.sqrt(n)) * 32, 1024), n)
+        if size < n:
+            idx = ht_random.randint(0, n, size=(size,), comm=x.comm)._dense()
+            dense = dense.ravel()[idx] if axis_s is None else jnp.take(dense, idx, axis=axis_s)
+            axis_s = None if axis_s is None else axis_s
     result = jnp.percentile(dense, qa, axis=axis_s, method=interpolation, keepdims=keepdims)
     res = DNDarray.from_dense(result, None, x.device, x.comm)
     return _to_out(res, out)

@@ -81,6 +81,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         theta = jnp.asarray(self.theta_) if not isinstance(self.theta_, DNDarray) else self.theta_._dense()
         var = jnp.asarray(self.var_) if not isinstance(self.var_, DNDarray) else self.var_._dense()
         counts = jnp.asarray(self.class_count_) if not isinstance(self.class_count_, DNDarray) else self.class_count_._dense()
+        # remove the smoothing added by the previous partial_fit before
+        # merging (sklearn/reference semantics), else epsilon compounds
+        var = var - getattr(self, "_eps_applied", 0.0)
 
         new_theta, new_var, new_counts = [], [], []
         for i in range(cls_arr.shape[0]):
@@ -106,15 +109,19 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
             new_theta.append(jnp.where(n_tot > 0, mu_tot, mu_old))
             new_var.append(jnp.where(n_tot > 0, var_tot, var_old))
             new_counts.append(n_tot)
-        self.theta_ = jnp.stack(new_theta)
-        self.var_ = jnp.stack(new_var) + self.epsilon_
-        self.class_count_ = jnp.stack(new_counts)
-
+        counts_new = jnp.stack(new_counts)
         if self.priors is not None:
             pri = self.priors._dense() if isinstance(self.priors, DNDarray) else jnp.asarray(self.priors)
-            self.class_prior_ = pri
         else:
-            self.class_prior_ = self.class_count_ / jnp.maximum(jnp.sum(self.class_count_), 1e-30)
+            pri = counts_new / jnp.maximum(jnp.sum(counts_new), 1e-30)
+
+        # public attributes are DNDarrays (reference parity)
+        wrap = lambda a: DNDarray.from_dense(a, None, x.device, x.comm)
+        self.theta_ = wrap(jnp.stack(new_theta))
+        self.var_ = wrap(jnp.stack(new_var) + self.epsilon_)
+        self._eps_applied = self.epsilon_
+        self.class_count_ = wrap(counts_new)
+        self.class_prior_ = wrap(pri)
         return self
 
     def _joint_log_likelihood(self, x: DNDarray) -> jnp.ndarray:
@@ -122,11 +129,18 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         xd = x._dense()
         if not types.heat_type_is_inexact(x.dtype):
             xd = xd.astype(jnp.float32)
+        theta = self.theta_._dense() if isinstance(self.theta_, DNDarray) else jnp.asarray(self.theta_)
+        var = self.var_._dense() if isinstance(self.var_, DNDarray) else jnp.asarray(self.var_)
+        prior_a = (
+            self.class_prior_._dense()
+            if isinstance(self.class_prior_, DNDarray)
+            else jnp.asarray(self.class_prior_)
+        )
         jll = []
-        for i in range(self.theta_.shape[0]):
-            prior = jnp.log(jnp.maximum(self.class_prior_[i], 1e-30))
-            n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * self.var_[i]))
-            n_ij = n_ij - 0.5 * jnp.sum(((xd - self.theta_[i]) ** 2) / self.var_[i], axis=1)
+        for i in range(theta.shape[0]):
+            prior = jnp.log(jnp.maximum(prior_a[i], 1e-30))
+            n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * var[i]))
+            n_ij = n_ij - 0.5 * jnp.sum(((xd - theta[i]) ** 2) / var[i], axis=1)
             jll.append(prior + n_ij)
         return jnp.stack(jll, axis=1)
 

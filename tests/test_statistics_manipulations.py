@@ -176,3 +176,34 @@ def test_broadcast_to_arrays(data):
     np.testing.assert_allclose(b.numpy(), np.broadcast_to(data[0], (4, 10)))
     arrs = ht.broadcast_arrays(ht.array(data, split=0), a)
     assert arrs[1].shape == (6, 10)
+
+
+def test_percentile_sketched(ht):
+    # reference statistics.py:1490-1532 — estimate on a random subset
+    ht.random.seed(0)
+    x = ht.random.randn(50_000, split=0)
+    exact = float(ht.percentile(x, 50.0))
+    sk = float(ht.percentile(x, 50.0, sketched=True, sketch_size=8192))
+    assert abs(sk - exact) < 0.1, (sk, exact)
+    # tiny arrays: sketch covers everything, exact result
+    y = ht.arange(10, dtype=ht.float32, split=0)
+    np.testing.assert_allclose(
+        float(ht.percentile(y, 30.0, sketched=True, sketch_size=100)),
+        float(ht.percentile(y, 30.0)),
+    )
+
+
+def test_gaussian_nb_partial_fit_matches_fit(ht):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((120, 4)).astype(np.float32)
+    y = rng.integers(0, 3, 120)
+    full = ht.naive_bayes.GaussianNB()
+    full.fit(ht.array(X, split=0), ht.array(y, split=0))
+    inc = ht.naive_bayes.GaussianNB()
+    inc.partial_fit(ht.array(X[:60], split=0), ht.array(y[:60], split=0), classes=ht.array([0, 1, 2]))
+    inc.partial_fit(ht.array(X[60:], split=0), ht.array(y[60:], split=0))
+    np.testing.assert_allclose(inc.theta_.numpy(), full.theta_.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(inc.var_.numpy(), full.var_.numpy(), rtol=1e-3, atol=1e-4)
+    p1 = inc.predict(ht.array(X, split=0)).numpy()
+    p2 = full.predict(ht.array(X, split=0)).numpy()
+    assert (p1 == p2).mean() > 0.97
