@@ -1,0 +1,416 @@
+"""NumPy API extensions beyond the reference's checklist.
+
+The reference's coverage_tables.md stops at 185 NumPy functions; everything
+here widens the surface further so a NumPy user finds what they expect.
+All functions follow the library's standard recipe: operate on the dense
+global view (XLA/GSPMD distributes), wrap results with a conservative
+split (preserved when the shape survives, replicated otherwise).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dndarray import DNDarray
+from . import types
+
+__all__ = [
+    "append",
+    "argpartition",
+    "argsort",
+    "argwhere",
+    "array_equal",
+    "array_equiv",
+    "array_split",
+    "atleast_1d",
+    "atleast_2d",
+    "atleast_3d",
+    "copyto",
+    "corrcoef",
+    "count_nonzero",
+    "delete",
+    "dstack",
+    "einsum",
+    "extract",
+    "flatnonzero",
+    "fmax",
+    "fmin",
+    "histogram2d",
+    "histogram_bin_edges",
+    "histogramdd",
+    "inner",
+    "insert",
+    "iscomplexobj",
+    "isrealobj",
+    "isscalar",
+    "kron",
+    "lexsort",
+    "nanargmax",
+    "nanargmin",
+    "nanmax",
+    "nanmean",
+    "nanmedian",
+    "nanmin",
+    "nanpercentile",
+    "nanquantile",
+    "nanstd",
+    "nanvar",
+    "partition",
+    "ptp",
+    "quantile",
+    "resize",
+    "rollaxis",
+    "searchsorted",
+    "sort_complex",
+    "tensordot",
+    "tri",
+    "trim_zeros",
+    "vander",
+]
+
+
+def _d(x):
+    """Dense global view of a DNDarray / array-like."""
+    if isinstance(x, DNDarray):
+        return x._dense()
+    return jnp.asarray(x)
+
+
+def _ref(*xs) -> Optional[DNDarray]:
+    for x in xs:
+        if isinstance(x, DNDarray):
+            return x
+    return None
+
+
+def _pick(*xs):
+    """First DNDarray among xs, else the first operand (never uses ``or``,
+    which would invoke DNDarray.__bool__)."""
+    r = _ref(*xs)
+    return r if r is not None else xs[0]
+
+
+def _wrap(result, *operands, split="auto"):
+    """Wrap a dense result; split is preserved when any DNDarray operand
+    has the same shape, else replicated."""
+    ref = _ref(*operands)
+    if ref is None:
+        return DNDarray.from_dense(result, None, None, None)
+    if split == "auto":
+        split = ref.split if (ref.split is not None and result.shape == ref.shape) else None
+    return DNDarray.from_dense(result, split, ref.device, ref.comm)
+
+
+# ---------------------------------------------------------------- sorting
+
+
+def argsort(a, axis: int = -1, descending: bool = False):
+    """Indices that would sort ``a`` along ``axis``."""
+    idx = jnp.argsort(_d(a), axis=axis, descending=descending)
+    return _wrap(idx, a)
+
+
+def partition(a, kth: int, axis: int = -1):
+    """Partial sort: element ``kth`` in final position along ``axis``."""
+    return _wrap(jnp.partition(_d(a), kth, axis=axis), a)
+
+
+def argpartition(a, kth: int, axis: int = -1):
+    return _wrap(jnp.argpartition(_d(a), kth, axis=axis), a)
+
+
+def lexsort(keys, axis: int = -1):
+    """Indirect sort with multiple keys (last key is primary)."""
+    dense_keys = tuple(_d(k) for k in keys)
+    return _wrap(jnp.lexsort(dense_keys, axis=axis), *list(keys))
+
+
+def searchsorted(a, v, side: str = "left", sorter=None):
+    """Insertion indices keeping ``a`` sorted."""
+    ad = _d(a)
+    if sorter is not None:
+        ad = jnp.take(ad, _d(sorter))
+    return _wrap(jnp.searchsorted(ad, _d(v), side=side), _pick(v, a), split=None)
+
+
+def sort_complex(a):
+    """Sort by real part, ties broken by imaginary part; complex output."""
+    ad = _d(a)
+    if not jnp.issubdtype(ad.dtype, jnp.complexfloating):
+        ad = ad.astype(jnp.complex64)
+    order = jnp.lexsort((jnp.imag(ad), jnp.real(ad)))
+    return _wrap(jnp.take(ad, order), a, split=None)
+
+
+# ------------------------------------------------------------- nan family
+
+
+def _nan_reduce(fn, a, axis=None, keepdims=False, ddof=None):
+    kwargs = {"axis": axis, "keepdims": keepdims}
+    if ddof is not None:
+        kwargs["ddof"] = ddof
+    d = _d(a)
+    if not types.heat_type_is_inexact(a.dtype) if isinstance(a, DNDarray) else not jnp.issubdtype(d.dtype, jnp.inexact):
+        d = d.astype(jnp.float32)
+    return _wrap(fn(d, **kwargs), a, split=None)
+
+
+def nanmax(a, axis=None, keepdims=False):
+    return _nan_reduce(jnp.nanmax, a, axis, keepdims)
+
+
+def nanmin(a, axis=None, keepdims=False):
+    return _nan_reduce(jnp.nanmin, a, axis, keepdims)
+
+
+def nanmean(a, axis=None, keepdims=False):
+    return _nan_reduce(jnp.nanmean, a, axis, keepdims)
+
+
+def nanmedian(a, axis=None, keepdims=False):
+    return _nan_reduce(jnp.nanmedian, a, axis, keepdims)
+
+
+def nanstd(a, axis=None, ddof: int = 0, keepdims=False):
+    return _nan_reduce(jnp.nanstd, a, axis, keepdims, ddof=ddof)
+
+
+def nanvar(a, axis=None, ddof: int = 0, keepdims=False):
+    return _nan_reduce(jnp.nanvar, a, axis, keepdims, ddof=ddof)
+
+
+def nanargmax(a, axis=None):
+    return _wrap(jnp.nanargmax(_d(a), axis=axis), a, split=None)
+
+
+def nanargmin(a, axis=None):
+    return _wrap(jnp.nanargmin(_d(a), axis=axis), a, split=None)
+
+
+def quantile(a, q, axis=None, interpolation: str = "linear", keepdims=False):
+    d = _d(a)
+    if not jnp.issubdtype(d.dtype, jnp.inexact):
+        d = d.astype(jnp.float32)
+    return _wrap(jnp.quantile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims), a, split=None)
+
+
+def nanquantile(a, q, axis=None, interpolation: str = "linear", keepdims=False):
+    d = _d(a)
+    if not jnp.issubdtype(d.dtype, jnp.inexact):
+        d = d.astype(jnp.float32)
+    return _wrap(jnp.nanquantile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims), a, split=None)
+
+
+def nanpercentile(a, q, axis=None, interpolation: str = "linear", keepdims=False):
+    d = _d(a)
+    if not jnp.issubdtype(d.dtype, jnp.inexact):
+        d = d.astype(jnp.float32)
+    return _wrap(
+        jnp.nanpercentile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims),
+        a,
+        split=None,
+    )
+
+
+# ------------------------------------------------------------- statistics
+
+
+def ptp(a, axis=None, keepdims=False):
+    """Peak-to-peak (max - min)."""
+    return _wrap(jnp.ptp(_d(a), axis=axis, keepdims=keepdims), a, split=None)
+
+
+def corrcoef(x, y=None, rowvar: bool = True):
+    xd = _d(x)
+    if not jnp.issubdtype(xd.dtype, jnp.inexact):
+        xd = xd.astype(jnp.float32)
+    yd = None if y is None else _d(y)
+    if yd is not None and not jnp.issubdtype(yd.dtype, jnp.inexact):
+        yd = yd.astype(jnp.float32)
+    return _wrap(jnp.corrcoef(xd, yd, rowvar=rowvar), x, split=None)
+
+
+def histogram2d(x, y, bins=10, range=None, density=None, weights=None):
+    h, xe, ye = jnp.histogram2d(_d(x), _d(y), bins=bins, range=range, density=density, weights=None if weights is None else _d(weights))
+    return _wrap(h, x, split=None), _wrap(xe, x, split=None), _wrap(ye, x, split=None)
+
+
+def histogramdd(sample, bins=10, range=None, density=None, weights=None):
+    h, edges = jnp.histogramdd(_d(sample), bins=bins, range=range, density=density, weights=None if weights is None else _d(weights))
+    return _wrap(h, sample, split=None), [_wrap(e, sample, split=None) for e in edges]
+
+
+def histogram_bin_edges(a, bins=10, range=None, weights=None):
+    return _wrap(jnp.histogram_bin_edges(_d(a), bins=bins, range=range, weights=weights), a, split=None)
+
+
+def count_nonzero(a, axis=None, keepdims=False):
+    return _wrap(jnp.count_nonzero(_d(a), axis=axis, keepdims=keepdims), a, split=None)
+
+
+# ------------------------------------------------------------ manipulations
+
+
+def append(arr, values, axis=None):
+    return _wrap(jnp.append(_d(arr), _d(values), axis=axis), _pick(arr, values), split=None)
+
+
+def delete(arr, obj, axis=None):
+    return _wrap(jnp.delete(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), axis=axis), arr, split=None)
+
+
+def insert(arr, obj, values, axis=None):
+    return _wrap(
+        jnp.insert(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), _d(values), axis=axis),
+        arr,
+        split=None,
+    )
+
+
+def resize(a, new_shape):
+    return _wrap(jnp.resize(_d(a), new_shape), a, split=None)
+
+
+def rollaxis(a, axis: int, start: int = 0):
+    return _wrap(jnp.rollaxis(_d(a), axis, start), a, split=None)
+
+
+def trim_zeros(filt, trim: str = "fb"):
+    # data-dependent output shape: host-side trim (eager semantics)
+    arr = np.asarray(filt.numpy() if isinstance(filt, DNDarray) else filt)
+    trimmed = np.trim_zeros(arr, trim)
+    return _wrap(jnp.asarray(trimmed), filt, split=None)
+
+
+def array_split(ary, indices_or_sections, axis: int = 0):
+    parts = jnp.array_split(_d(ary), indices_or_sections, axis=axis)
+    ref = _ref(ary)
+    return [_wrap(p, ary, split=None) for p in parts]
+
+
+def dstack(tup):
+    return _wrap(jnp.dstack([_d(t) for t in tup]), *list(tup), split=None)
+
+
+def atleast_1d(*arys):
+    out = [_wrap(jnp.atleast_1d(_d(a)), a, split=None) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*arys):
+    out = [_wrap(jnp.atleast_2d(_d(a)), a, split=None) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*arys):
+    out = [_wrap(jnp.atleast_3d(_d(a)), a, split=None) for a in arys]
+    return out[0] if len(out) == 1 else out
+
+
+def copyto(dst, src, where=True):
+    """Copy ``src`` into ``dst`` in place (broadcasting, optional mask)."""
+    if not isinstance(dst, DNDarray):
+        raise TypeError("copyto destination must be a DNDarray")
+    sd = jnp.broadcast_to(_d(src), dst.shape).astype(dst.dtype.jax_type())
+    wd = where if isinstance(where, bool) else jnp.broadcast_to(_d(where), dst.shape)
+    new = jnp.where(wd, sd, dst._dense()) if wd is not True else sd
+    dst._replace_local(new)
+
+
+# ---------------------------------------------------------------- indexing
+
+
+def argwhere(a):
+    return _wrap(jnp.argwhere(_d(a)), a, split=None)
+
+
+def flatnonzero(a):
+    return _wrap(jnp.flatnonzero(_d(a)), a, split=None)
+
+
+def extract(condition, arr):
+    return _wrap(jnp.extract(_d(condition), _d(arr)), _pick(arr, condition), split=None)
+
+
+# --------------------------------------------------------------- predicates
+
+
+def isscalar(element) -> bool:
+    if isinstance(element, DNDarray):
+        return False
+    return bool(np.isscalar(element))
+
+
+def iscomplexobj(x) -> bool:
+    if isinstance(x, DNDarray):
+        return types.heat_type_is_complexfloating(x.dtype)
+    return bool(np.iscomplexobj(x))
+
+
+def isrealobj(x) -> bool:
+    return not iscomplexobj(x)
+
+
+# --------------------------------------------------------- elementwise pair
+
+
+def fmax(x1, x2):
+    """Elementwise maximum ignoring NaNs."""
+    return _wrap(jnp.fmax(_d(x1), _d(x2)), _pick(x1, x2))
+
+
+def fmin(x1, x2):
+    return _wrap(jnp.fmin(_d(x1), _d(x2)), _pick(x1, x2))
+
+
+# ------------------------------------------------------------------ linalg
+
+
+def inner(a, b):
+    return _wrap(jnp.inner(_d(a), _d(b)), _pick(a, b), split=None)
+
+
+def tensordot(a, b, axes=2):
+    return _wrap(jnp.tensordot(_d(a), _d(b), axes=axes), _pick(a, b), split=None)
+
+
+def kron(a, b):
+    return _wrap(jnp.kron(_d(a), _d(b)), _pick(a, b), split=None)
+
+
+# ---------------------------------------------------------------- factories
+
+
+def tri(N: int, M: Optional[int] = None, k: int = 0, dtype=None, split=None, device=None, comm=None):
+    d = types.canonical_heat_type(dtype or "float32").jax_type()
+    return DNDarray.from_dense(jnp.tri(N, M, k, dtype=d), split, device, comm)
+
+
+def vander(x, N: Optional[int] = None, increasing: bool = False):
+    return _wrap(jnp.vander(_d(x), N=N, increasing=increasing), x, split=None)
+
+
+def einsum(subscripts: str, *operands, precision=None):
+    """Einstein summation over DNDarray operands (jnp.einsum under GSPMD —
+    the collective-matmul path the reference hand-writes per case)."""
+    dense_ops = [_d(o) for o in operands]
+    out = jnp.einsum(subscripts, *dense_ops, precision=precision)
+    return _wrap(out, *list(operands), split=None)
+
+
+def array_equal(a1, a2) -> bool:
+    """True when shapes and all elements match."""
+    d1, d2 = _d(a1), _d(a2)
+    if d1.shape != d2.shape:
+        return False
+    return bool(jnp.array_equal(d1, d2))
+
+
+def array_equiv(a1, a2) -> bool:
+    """True when broadcast-compatible and all elements match."""
+    return bool(jnp.array_equiv(_d(a1), _d(a2)))

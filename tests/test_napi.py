@@ -1,0 +1,143 @@
+"""NumPy API extension sweep (heat_tpu/core/napi.py) — every function
+compared against the numpy ground truth on the virtual mesh."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def m():
+    return np.random.default_rng(0).standard_normal((9, 6))
+
+
+@pytest.fixture
+def x(m):
+    return ht.array(m, split=0)
+
+
+def test_sorting_family(m, x):
+    np.testing.assert_array_equal(ht.argsort(x, axis=0).numpy(), np.argsort(m, axis=0))
+    got = ht.partition(x, 3, axis=0).numpy()
+    assert (np.sort(got, axis=0) == np.sort(m, axis=0)).all()
+    # kth element is in sorted position per column
+    for c in range(m.shape[1]):
+        assert got[3, c] == np.sort(m[:, c])[3]
+    ap = ht.argpartition(x, 3, axis=0).numpy()
+    assert ap.shape == m.shape
+    srt = np.sort(m[:, 0])
+    np.testing.assert_array_equal(
+        ht.searchsorted(ht.array(srt), ht.array([0.0, 1.0])).numpy(),
+        np.searchsorted(srt, [0.0, 1.0]),
+    )
+    np.testing.assert_array_equal(
+        ht.lexsort((ht.array([1.0, 2.0, 1.0]), ht.array([3.0, 1.0, 2.0]))).numpy(),
+        np.lexsort((np.array([1.0, 2.0, 1.0]), np.array([3.0, 1.0, 2.0]))),
+    )
+    np.testing.assert_allclose(
+        ht.sort_complex(ht.array([2 + 1j, 1 - 1j, 1 + 0j])).numpy(),
+        np.sort_complex([2 + 1j, 1 - 1j, 1 + 0j]),
+    )
+
+
+def test_nan_family(m, x):
+    mn = m.copy()
+    mn[0, 0] = np.nan
+    xn = ht.array(mn, split=0)
+    np.testing.assert_allclose(float(ht.nanmax(xn)), np.nanmax(mn))
+    np.testing.assert_allclose(float(ht.nanmin(xn)), np.nanmin(mn))
+    np.testing.assert_allclose(ht.nanmean(xn, axis=1).numpy(), np.nanmean(mn, axis=1))
+    np.testing.assert_allclose(float(ht.nanmedian(xn)), np.nanmedian(mn))
+    np.testing.assert_allclose(float(ht.nanstd(xn, ddof=1)), np.nanstd(mn, ddof=1), rtol=1e-12)
+    np.testing.assert_allclose(float(ht.nanvar(xn)), np.nanvar(mn), rtol=1e-12)
+    assert int(ht.nanargmax(xn)) == np.nanargmax(mn)
+    assert int(ht.nanargmin(xn)) == np.nanargmin(mn)
+    np.testing.assert_allclose(float(ht.nanpercentile(xn, 70.0)), np.nanpercentile(mn, 70.0))
+    np.testing.assert_allclose(float(ht.nanquantile(xn, 0.7)), np.nanquantile(mn, 0.7))
+    np.testing.assert_allclose(float(ht.quantile(x, 0.3)), np.quantile(m, 0.3))
+
+
+def test_statistics_extras(m, x):
+    np.testing.assert_allclose(float(ht.ptp(x)), np.ptp(m))
+    np.testing.assert_allclose(ht.corrcoef(x).numpy(), np.corrcoef(m), rtol=1e-10)
+    assert int(ht.count_nonzero(x > 0)) == np.count_nonzero(m > 0)
+    h, xe, ye = ht.histogram2d(ht.array(m[:, 0]), ht.array(m[:, 1]), bins=4)
+    hn, xen, yen = np.histogram2d(m[:, 0], m[:, 1], bins=4)
+    np.testing.assert_allclose(h.numpy(), hn)
+    hd, edges = ht.histogramdd(x, bins=3)
+    hdn, edgesn = np.histogramdd(m, bins=3)
+    np.testing.assert_allclose(hd.numpy(), hdn)
+    np.testing.assert_allclose(
+        ht.histogram_bin_edges(x, bins=5).numpy(), np.histogram_bin_edges(m, bins=5)
+    )
+
+
+def test_manipulation_extras(m, x):
+    np.testing.assert_allclose(ht.append(x, x, axis=0).numpy(), np.append(m, m, axis=0))
+    np.testing.assert_allclose(ht.delete(x, 2, axis=0).numpy(), np.delete(m, 2, axis=0))
+    np.testing.assert_allclose(ht.insert(x, 1, 5.0, axis=1).numpy(), np.insert(m, 1, 5.0, axis=1))
+    np.testing.assert_allclose(ht.resize(x, (4, 4)).numpy(), np.resize(m, (4, 4)))
+    np.testing.assert_allclose(ht.rollaxis(x, 1).numpy(), np.rollaxis(m, 1))
+    np.testing.assert_allclose(ht.dstack([x, x]).numpy(), np.dstack([m, m]))
+    np.testing.assert_allclose(ht.atleast_2d(ht.array([1.0, 2.0])).numpy(), np.atleast_2d([1.0, 2.0]))
+    a1, a3 = ht.atleast_1d(ht.array(1.0)), ht.atleast_3d(x)
+    assert a1.shape == (1,) and a3.ndim == 3
+    np.testing.assert_allclose(
+        ht.trim_zeros(ht.array([0.0, 0.0, 1.0, 2.0, 0.0])).numpy(),
+        np.trim_zeros(np.array([0.0, 0.0, 1.0, 2.0, 0.0])),
+    )
+    parts = ht.array_split(x, 4, axis=0)
+    nparts = np.array_split(m, 4, axis=0)
+    assert len(parts) == len(nparts)
+    for p, q in zip(parts, nparts):
+        np.testing.assert_allclose(p.numpy(), q)
+
+
+def test_copyto(m, x):
+    dst = ht.array(m.copy(), split=0)
+    ht.copyto(dst, 0.0, where=dst > 0)
+    ref = m.copy()
+    np.copyto(ref, 0.0, where=ref > 0)
+    np.testing.assert_allclose(dst.numpy(), ref)
+
+
+def test_indexing_extras(m, x):
+    np.testing.assert_array_equal(ht.argwhere(x > 1).numpy(), np.argwhere(m > 1))
+    np.testing.assert_array_equal(ht.flatnonzero(x > 1).numpy(), np.flatnonzero(m > 1))
+    np.testing.assert_allclose(ht.extract(x > 1, x).numpy(), np.extract(m > 1, m))
+
+
+def test_predicates(x):
+    assert ht.isscalar(3.0) and not ht.isscalar(x)
+    assert ht.iscomplexobj(ht.array([1 + 2j])) and not ht.iscomplexobj(x)
+    assert ht.isrealobj(x)
+    assert ht.array_equal(x, x) and not ht.array_equal(x, x + 1)
+    assert ht.array_equiv(ht.array([1.0, 1.0]), ht.array([[1.0, 1.0], [1.0, 1.0]]))
+
+
+def test_linalg_extras(m, x):
+    np.testing.assert_allclose(
+        ht.inner(ht.array(m[0]), ht.array(m[1])).numpy(), np.inner(m[0], m[1]), rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        ht.tensordot(x, ht.array(m.T), axes=1).numpy(), np.tensordot(m, m.T, axes=1), rtol=1e-10
+    )
+    np.testing.assert_allclose(
+        ht.kron(ht.array([[1.0, 2.0]]), ht.array([[3.0], [4.0]])).numpy(),
+        np.kron([[1.0, 2.0]], [[3.0], [4.0]]),
+    )
+    np.testing.assert_allclose(
+        ht.einsum("ij,kj->ik", x, x).numpy(), np.einsum("ij,kj->ik", m, m), rtol=1e-10
+    )
+    np.testing.assert_allclose(ht.fmax(x, 0.0).numpy(), np.fmax(m, 0.0))
+    np.testing.assert_allclose(ht.fmin(x, 0.0).numpy(), np.fmin(m, 0.0))
+
+
+def test_factory_extras():
+    np.testing.assert_allclose(ht.tri(4, 5, 1).numpy(), np.tri(4, 5, 1))
+    np.testing.assert_allclose(ht.vander(ht.array([1.0, 2.0, 3.0])).numpy(), np.vander([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(
+        ht.vander(ht.array([1.0, 2.0]), 4, increasing=True).numpy(),
+        np.vander([1.0, 2.0], 4, increasing=True),
+    )
