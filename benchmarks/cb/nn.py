@@ -2,11 +2,7 @@
 examples/nn MNIST CNN under data parallelism; the reference measures the
 same workload through perun in its DASO/DataParallel examples)."""
 
-import time
-
-import numpy as np
-
-from monitor import RESULTS, _sync, monitor
+from monitor import RESULTS, monitor
 
 
 def run_nn_benchmarks(scale: float = 1.0) -> None:
@@ -54,7 +50,6 @@ def run_nn_benchmarks(scale: float = 1.0) -> None:
             losses.append(dp.step(loss_fn, xb, yb))
         return losses[-1]
 
-    t0 = time.perf_counter()
     dp_sgd_epoch()
     elapsed = RESULTS[-1]["seconds"]
     steps = n // batch

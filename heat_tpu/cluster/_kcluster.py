@@ -110,12 +110,18 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             centers = dense[idx]
         elif self.init in ("kmeans++", "probability_based", "++"):
             # kmeans++ sampling (_kcluster.py:112-180): greedy D^2 weighting.
-            # The uniforms are pre-drawn from the library RNG (stream
-            # semantics unchanged), then the whole greedy loop compiles as
-            # one program — centers preallocated at (k, f) with unfilled
+            # The uniforms are pre-drawn one call per added center — the
+            # exact draw sequence of the release before the loop was fused,
+            # so seeded results are stable — then the greedy loop compiles
+            # as one program: centers preallocated at (k, f) with unfilled
             # slots masked to +inf so every round has identical shapes.
             key_arr = ht_random.randint(0, n, size=(1,), comm=x.comm)._dense()
-            u_all = ht_random.rand(max(k - 1, 1), comm=x.comm)._dense()
+            if k > 1:
+                u_all = jnp.concatenate(
+                    [ht_random.rand(1, comm=x.comm)._dense() for _ in range(k - 1)]
+                )
+            else:
+                u_all = jnp.zeros((1,), jnp.float32)
             centers = _kmeanspp_init(dense, key_arr[0], u_all, k)
         elif self.init == "batchparallel":
             raise NotImplementedError("batchparallel init: use BatchParallelKMeans")

@@ -130,11 +130,13 @@ def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int):
         lam_fin, v_eig = jnp.linalg.eigh(g_fin)
         lam_fin = jnp.maximum(lam_fin[::-1], 0.0)
         v_eig = v_eig[:, ::-1]
-        # eigenvalues below the f32 Gram noise floor (1e-7 relative, i.e.
-        # sigma < ~3e-4 * sigma_1) are numerical noise whose "singular
+        # eigenvalues below the Gram noise floor (~eps relative, i.e.
+        # sigma < ~sqrt(eps) * sigma_1) are numerical noise whose "singular
         # vectors" live inside the dominant column space — keeping them
-        # double-counts energy; drop value and column together
-        keep = lam_fin > 1e-7 * jnp.maximum(lam_fin[0], 1e-30)
+        # double-counts energy; drop value and column together.  The floor
+        # scales with the working dtype (f32: ~1.2e-7, f64: ~2.2e-16).
+        eps = float(jnp.finfo(us.dtype).eps)
+        keep = lam_fin > eps * jnp.maximum(lam_fin[0], 1e-30)
         s_fin = jnp.where(keep, jnp.sqrt(lam_fin), 0.0)
         inv_s = jnp.where(keep, 1.0 / jnp.maximum(jnp.sqrt(lam_fin), 1e-30), 0.0)
         u_fin = jnp.matmul(us, v_eig, precision=jax.lax.Precision.HIGHEST) * inv_s[None, :]
@@ -211,10 +213,10 @@ def _gram_orthonormalize(y: jnp.ndarray, passes: int = 2) -> jnp.ndarray:
     for _ in range(passes):
         g = jnp.matmul(q.T, q, precision=jax.lax.Precision.HIGHEST)
         lam, v = jnp.linalg.eigh(g)
-        # directions below the f32 Gram noise floor (rank-deficient input)
+        # directions below the Gram noise floor (rank-deficient input)
         # are dropped, not noise-amplified: their columns become zero and a
-        # downstream SVD sorts them to the tail
-        cutoff = 1e-7 * jnp.maximum(jnp.max(lam), 1e-30)
+        # downstream SVD sorts them to the tail (floor scales with dtype)
+        cutoff = float(jnp.finfo(q.dtype).eps) * jnp.maximum(jnp.max(lam), 1e-30)
         inv_sqrt = jnp.where(lam > cutoff, 1.0 / jnp.sqrt(jnp.maximum(lam, 1e-30)), 0.0)
         w = jnp.matmul(v * inv_sqrt[None, :], v.T, precision=jax.lax.Precision.HIGHEST)
         q = jnp.matmul(q, w, precision=jax.lax.Precision.HIGHEST)

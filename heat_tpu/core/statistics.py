@@ -349,7 +349,6 @@ def percentile(
         if size < n:
             idx = ht_random.randint(0, n, size=(size,), comm=x.comm)._dense()
             dense = dense.ravel()[idx] if axis_s is None else jnp.take(dense, idx, axis=axis_s)
-            axis_s = None if axis_s is None else axis_s
     result = jnp.percentile(dense, qa, axis=axis_s, method=interpolation, keepdims=keepdims)
     res = DNDarray.from_dense(result, None, x.device, x.comm)
     return _to_out(res, out)

@@ -116,7 +116,7 @@ class PCA(BaseEstimator, TransformMixin):
             total_var = float(jnp.sum(centered._dense() ** 2)) / max(n - 1, 1)
             ratio = ev / max(total_var, 1e-30)
             self.explained_variance_ratio_ = DNDarray.from_dense(ratio, None, X.device, X.comm)
-            self.total_explained_variance_ratio_ = 1.0 - err**2
+            self.total_explained_variance_ratio_ = 1.0 - float(err) ** 2
             self.n_components_ = int(s.shape[0])
         else:  # randomized
             if k is None:

@@ -213,43 +213,50 @@ def _host_fftn(arr, s, axes, norm, last_kind: str = None):
 
 # TPU runtimes vary in FFT rank support (rank-3 kernels have been observed
 # to return UNIMPLEMENTED on tunneled v5e endpoints).  The first rank>2
-# call probes the ladder native n-D -> chained 1-D -> host with a real
-# synchronization (one-element fetch; block_until_ready can be a no-op
-# through a tunnel) and the working level sticks for the process, so
-# steady state stays fully asynchronous.
-_ND_LEVEL = 0  # 0=native, 1=chain, 2=host
-_ND_PROBED = False
+# call of each capability probes with a real synchronization (one-element
+# fetch; block_until_ready can be a no-op through a tunnel) and the result
+# sticks for the process, so steady state stays fully asynchronous.  The
+# two capabilities are tracked independently: a first hfftn (which has no
+# native n-D kernel) must not demote later fftn calls off the native path.
+_NATIVE_STATE: Optional[bool] = None  # None=unprobed, True=works, False=broken
+_CHAIN_STATE: Optional[bool] = None
+
+
+def _probe(fn):
+    """Run fn and force one element to the host; raises on real failure."""
+    from ..core.dndarray import _np_fetch
+
+    out = fn()
+    _np_fetch(out[(0,) * out.ndim])
+    return out
 
 
 def _nd_dispatch(native, dense, s, axes, norm, last_kind=None):
-    global _ND_LEVEL, _ND_PROBED
+    global _NATIVE_STATE, _CHAIN_STATE
 
     _, eff_axes = _nd_axes(dense, s, axes)
+    chain = lambda: _chain_fftn(dense, s, axes, norm, last_kind=last_kind)
     if jax.default_backend() != "tpu" or (len(eff_axes) <= 2 and native is not None):
-        return native() if native is not None else _chain_fftn(dense, s, axes, norm, last_kind=last_kind)
+        return native() if native is not None else chain()
 
-    levels = [native, lambda: _chain_fftn(dense, s, axes, norm, last_kind=last_kind)]
-    start = _ND_LEVEL if native is not None else max(_ND_LEVEL, 1)
-    if _ND_PROBED:
-        if start < 2 and levels[start] is not None:
-            return levels[start]()
-        return _host_fftn(dense, s, axes, norm, last_kind=last_kind)
-    from ..core.dndarray import _np_fetch
-
-    for lvl in range(start, 2):
-        if levels[lvl] is None:
-            continue
+    if native is not None and _NATIVE_STATE is not False:
+        if _NATIVE_STATE:
+            return native()
         try:
-            out = levels[lvl]()
-            # real synchronization: block_until_ready can be a no-op
-            # through a tunneled runtime, so fetch one element to force
-            # (and observe) execution
-            _np_fetch(out[(0,) * out.ndim])
-            _ND_LEVEL, _ND_PROBED = lvl, True
+            out = _probe(native)
+            _NATIVE_STATE = True
             return out
         except jax.errors.JaxRuntimeError:
-            continue
-    _ND_LEVEL, _ND_PROBED = 2, True
+            _NATIVE_STATE = False
+    if _CHAIN_STATE is not False:
+        if _CHAIN_STATE:
+            return chain()
+        try:
+            out = _probe(chain)
+            _CHAIN_STATE = True
+            return out
+        except jax.errors.JaxRuntimeError:
+            _CHAIN_STATE = False
     return _host_fftn(dense, s, axes, norm, last_kind=last_kind)
 
 

@@ -180,3 +180,18 @@ def test_rsvd_rank_deficient(ht):
     rec = u.numpy() @ np.diag(np.asarray(s._dense())) @ v.numpy().T
     rel = np.linalg.norm(A - rec) / np.linalg.norm(A)
     assert rel < 1e-4, rel
+
+
+def test_hsvd_float64_high_condition(ht):
+    # the Gram noise-floor cutoff must scale with dtype eps: an f64 matrix
+    # with sigma spanning 4 decades keeps every direction f64 resolves
+    rng = np.random.default_rng(3)
+    q1, _ = np.linalg.qr(rng.standard_normal((400, 12)))
+    q2, _ = np.linalg.qr(rng.standard_normal((32, 12)))
+    sv = np.logspace(0, -4, 12)
+    A = (q1 * sv) @ q2.T
+    x = ht.array(A, split=0)  # float64 under the suite's x64 mode
+    u, s, v, err = ht.linalg.hsvd_rank(x, 12, compute_sv=True, safetyshift=0)
+    np.testing.assert_allclose(np.asarray(s._dense()), sv, rtol=1e-8)
+    rec = u.numpy() @ np.diag(np.asarray(s._dense())) @ v.numpy().T
+    assert np.linalg.norm(A - rec) / np.linalg.norm(A) < 1e-8
