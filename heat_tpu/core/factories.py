@@ -30,6 +30,10 @@ __all__ = [
     "eye",
     "from_partitioned",
     "from_partition_dict",
+    "frombuffer",
+    "fromfunction",
+    "fromiter",
+    "fromstring",
     "full",
     "full_like",
     "geomspace",
@@ -383,3 +387,33 @@ def from_partition_dict(parts: dict, comm=None) -> DNDarray:
     else:
         global_np = np.concatenate(pieces, axis=split)
     return array(global_np, split=split, comm=comm)
+
+
+def fromfunction(function, shape, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """Build an array by calling ``function`` over index grids (np parity)."""
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij") if shape else []
+    data = function(*grids)
+    data = jnp.asarray(data)
+    if dtype is not None:
+        data = data.astype(types.canonical_heat_type(dtype).jax_type())
+    return DNDarray.from_dense(jnp.broadcast_to(data, tuple(shape)), sanitize_axis(tuple(shape), split), sanitize_device(device), sanitize_comm(comm))
+
+
+def fromiter(iter, dtype, count: int = -1, split=None, device=None, comm=None) -> DNDarray:
+    """Build a 1-D array from an iterable (np parity)."""
+    arr = np.fromiter(iter, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()), count=count)
+    return array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def frombuffer(buffer, dtype=types.float32, count: int = -1, offset: int = 0, split=None, device=None, comm=None) -> DNDarray:
+    """Interpret a buffer as a 1-D array (np parity)."""
+    arr = np.frombuffer(buffer, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()), count=count, offset=offset)
+    return array(arr.copy(), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def fromstring(string: str, dtype=types.float32, count: int = -1, sep: str = " ", split=None, device=None, comm=None) -> DNDarray:
+    """Parse a 1-D array from a text string (np parity, text mode only)."""
+    if not sep:
+        raise ValueError("binary-mode fromstring is not supported; use frombuffer")
+    arr = np.fromstring(string, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()), count=count, sep=sep)
+    return array(arr, dtype=dtype, split=split, device=device, comm=comm)

@@ -26,13 +26,18 @@ from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
 
 __all__ = [
+    "genfromtxt",
     "load",
     "load_csv",
     "load_hdf5",
     "load_npy_from_path",
+    "loadtxt",
     "save",
     "save_csv",
     "save_hdf5",
+    "savetxt",
+    "savez",
+    "savez_compressed",
     "supports_hdf5",
     "supports_netcdf",
     "supports_pandas",
@@ -304,3 +309,48 @@ def load_npy_from_path(
     return DNDarray.from_dense(
         jax.numpy.asarray(data), sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm)
     )
+
+
+# ----------------------------------------------------------------------
+# NumPy text/archive IO extensions beyond the reference's io surface
+# ----------------------------------------------------------------------
+def loadtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
+            skiprows: int = 0, usecols=None, split: Optional[int] = None,
+            device=None, comm=None) -> DNDarray:
+    """np.loadtxt analog; the parse happens per host, the wrap shards."""
+    arr = np.loadtxt(path, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()),
+                     comments=comments, delimiter=delimiter, skiprows=skiprows, usecols=usecols)
+    from . import factories
+
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def savetxt(path: str, x: DNDarray, fmt: str = "%.18e", delimiter: str = " ",
+            newline: str = "\n", header: str = "", footer: str = "", comments: str = "# ") -> None:
+    """np.savetxt analog (gathers, rank-0-writes)."""
+    np.savetxt(path, x.numpy(), fmt=fmt, delimiter=delimiter, newline=newline,
+               header=header, footer=footer, comments=comments)
+
+
+def genfromtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
+               skip_header: int = 0, filling_values=None, split: Optional[int] = None,
+               device=None, comm=None) -> DNDarray:
+    """np.genfromtxt analog (missing values filled, NaN by default)."""
+    arr = np.genfromtxt(path, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()),
+                        comments=comments, delimiter=delimiter, skip_header=skip_header,
+                        filling_values=filling_values)
+    from . import factories
+
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def savez(path: str, *args, **kwargs) -> None:
+    """np.savez analog over DNDarrays (gathered per array)."""
+    np.savez(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
+             **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
+
+
+def savez_compressed(path: str, *args, **kwargs) -> None:
+    """np.savez_compressed analog over DNDarrays."""
+    np.savez_compressed(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
+                        **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})

@@ -5,3 +5,4 @@ from .qr import *
 from .svd import *
 from .svdtools import *
 from .solver import *
+from .extras import *

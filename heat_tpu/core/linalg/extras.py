@@ -1,0 +1,143 @@
+"""numpy.linalg parity extensions beyond the reference's linalg set.
+
+The reference implements det/inv/qr/svd/solve_triangular and leaves the
+rest of numpy.linalg uncovered; these close the block.  Everything runs
+on the dense global view (GSPMD distributes the batched/matmul parts);
+`eig`/`eigvals` have no TPU kernel in XLA and run on the in-process CPU
+backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dndarray import DNDarray
+
+__all__ = [
+    "cholesky",
+    "cond",
+    "eig",
+    "eigh",
+    "eigvals",
+    "eigvalsh",
+    "lstsq",
+    "matrix_power",
+    "matrix_rank",
+    "multi_dot",
+    "pinv",
+    "slogdet",
+    "solve",
+    "tensorinv",
+    "tensorsolve",
+]
+
+
+def _d(x):
+    if isinstance(x, DNDarray):
+        d = x._dense()
+        if not jnp.issubdtype(d.dtype, jnp.inexact):
+            d = d.astype(jnp.float32)
+        return d
+    return jnp.asarray(x)
+
+
+def _ref(*xs):
+    for x in xs:
+        if isinstance(x, DNDarray):
+            return x
+    return None
+
+
+def _wrap(result, *operands):
+    ref = _ref(*operands)
+    if ref is None:
+        return DNDarray.from_dense(result, None, None, None)
+    return DNDarray.from_dense(result, None, ref.device, ref.comm)
+
+
+def _on_cpu(fn, *arrays):
+    """Run fn on the in-process CPU backend (for factorizations without a
+    TPU kernel: nonsymmetric eig)."""
+    cpu = jax.devices("cpu")[0]
+    moved = [jax.device_put(a, cpu) for a in arrays]
+    return fn(*moved)
+
+
+def cholesky(a):
+    """Lower-triangular Cholesky factor of an SPD matrix."""
+    return _wrap(jnp.linalg.cholesky(_d(a)), a)
+
+
+def cond(x, p=None):
+    """Condition number with respect to norm ``p``."""
+    return _wrap(jnp.linalg.cond(_d(x), p=p), x)
+
+
+def eigh(a, UPLO: str = "L"):
+    """Eigendecomposition of a symmetric/Hermitian matrix."""
+    w, v = jnp.linalg.eigh(_d(a), UPLO=UPLO)
+    return _wrap(w, a), _wrap(v, a)
+
+
+def eigvalsh(a, UPLO: str = "L"):
+    return _wrap(jnp.linalg.eigvalsh(_d(a), UPLO=UPLO), a)
+
+
+def eig(a):
+    """General eigendecomposition (no TPU kernel in XLA: runs on the
+    in-process CPU backend; complex output)."""
+    w, v = _on_cpu(jnp.linalg.eig, _d(a))
+    return _wrap(w, a), _wrap(v, a)
+
+
+def eigvals(a):
+    return _wrap(_on_cpu(jnp.linalg.eigvals, _d(a)), a)
+
+
+def lstsq(a, b, rcond=None):
+    """Least-squares solve; returns (x, residuals, rank, singular values)."""
+    x, resid, rank, sv = jnp.linalg.lstsq(_d(a), _d(b), rcond=rcond)
+    ref = _ref(a, b)
+    return (_wrap(x, ref), _wrap(resid, ref), int(rank), _wrap(sv, ref))
+
+
+def matrix_power(a, n: int):
+    return _wrap(jnp.linalg.matrix_power(_d(a), n), a)
+
+
+def matrix_rank(a, tol=None):
+    return int(jnp.linalg.matrix_rank(_d(a), rtol=None if tol is None else tol))
+
+
+def multi_dot(arrays):
+    """Chained matmul with optimal association order."""
+    dense = [_d(a) for a in arrays]
+    return _wrap(jnp.linalg.multi_dot(dense), *list(arrays))
+
+
+def pinv(a, rcond=None, hermitian: bool = False):
+    """Moore-Penrose pseudo-inverse."""
+    return _wrap(jnp.linalg.pinv(_d(a), rtol=rcond, hermitian=hermitian), a)
+
+
+def slogdet(a):
+    """Sign and log|det|."""
+    sign, logabs = jnp.linalg.slogdet(_d(a))
+    return _wrap(sign, a), _wrap(logabs, a)
+
+
+def solve(a, b):
+    """Solve the linear system a x = b."""
+    return _wrap(jnp.linalg.solve(_d(a), _d(b)), _ref(a, b))
+
+
+def tensorinv(a, ind: int = 2):
+    return _wrap(jnp.linalg.tensorinv(_d(a), ind=ind), a)
+
+
+def tensorsolve(a, b, axes=None):
+    return _wrap(jnp.linalg.tensorsolve(_d(a), _d(b), axes=axes), _ref(a, b))

@@ -20,6 +20,26 @@ from .dndarray import DNDarray
 from . import types
 
 __all__ = [
+    "amax",
+    "amin",
+    "array2string",
+    "array_repr",
+    "array_str",
+    "asanyarray",
+    "asarray_chkfinite",
+    "ascontiguousarray",
+    "asfarray",
+    "asfortranarray",
+    "base_repr",
+    "binary_repr",
+    "block",
+    "correlate",
+    "diagflat",
+    "einsum_path",
+    "format_float_positional",
+    "format_float_scientific",
+    "packbits",
+    "unpackbits",
     "append",
     "argpartition",
     "argsort",
@@ -50,6 +70,7 @@ __all__ = [
     "isscalar",
     "kron",
     "lexsort",
+    "mgrid",
     "nanargmax",
     "nanargmin",
     "nanmax",
@@ -60,6 +81,7 @@ __all__ = [
     "nanquantile",
     "nanstd",
     "nanvar",
+    "ogrid",
     "partition",
     "ptp",
     "quantile",
@@ -414,3 +436,153 @@ def array_equal(a1, a2) -> bool:
 def array_equiv(a1, a2) -> bool:
     """True when broadcast-compatible and all elements match."""
     return bool(jnp.array_equiv(_d(a1), _d(a2)))
+
+
+# -------------------------------------------------- second extension batch
+
+
+def amax(a, axis=None, keepdims=False):
+    """Alias of max (NumPy parity)."""
+    from . import statistics
+
+    return statistics.max(a, axis=axis, keepdims=keepdims)
+
+
+def amin(a, axis=None, keepdims=False):
+    from . import statistics
+
+    return statistics.min(a, axis=axis, keepdims=keepdims)
+
+
+def diagflat(v, k: int = 0):
+    """2-D array with the flattened input on the k-th diagonal."""
+    return _wrap(jnp.diagflat(_d(v), k=k), v, split=None)
+
+
+def correlate(a, v, mode: str = "valid"):
+    """1-D cross-correlation (np.correlate semantics)."""
+    return _wrap(jnp.correlate(_d(a), _d(v), mode=mode), _pick(a, v), split=None)
+
+
+def block(arrays):
+    """Assemble an array from nested lists of blocks."""
+    def conv(obj):
+        if isinstance(obj, list):
+            return [conv(o) for o in obj]
+        return _d(obj)
+
+    def first(obj):
+        if isinstance(obj, list):
+            for o in obj:
+                r = first(o)
+                if r is not None:
+                    return r
+            return None
+        return obj if isinstance(obj, DNDarray) else None
+
+    ref = first(arrays)
+    out = jnp.block(conv(arrays))
+    return _wrap(out, *( [ref] if ref is not None else [] ), split=None)
+
+
+def packbits(a, axis=None, bitorder: str = "big"):
+    return _wrap(jnp.packbits(_d(a), axis=axis, bitorder=bitorder), a, split=None)
+
+
+def unpackbits(a, axis=None, count=None, bitorder: str = "big"):
+    return _wrap(jnp.unpackbits(_d(a), axis=axis, count=count, bitorder=bitorder), a, split=None)
+
+
+def base_repr(number: int, base: int = 2, padding: int = 0) -> str:
+    return np.base_repr(int(number), base=base, padding=padding)
+
+
+def binary_repr(num: int, width=None) -> str:
+    return np.binary_repr(int(num), width=width)
+
+
+def format_float_positional(x, *args, **kwargs) -> str:
+    if isinstance(x, DNDarray):
+        x = x.item()
+    return np.format_float_positional(x, *args, **kwargs)
+
+
+def format_float_scientific(x, *args, **kwargs) -> str:
+    if isinstance(x, DNDarray):
+        x = x.item()
+    return np.format_float_scientific(x, *args, **kwargs)
+
+
+def einsum_path(subscripts, *operands, optimize="greedy"):
+    """Contraction-order plan (host-side np.einsum_path over shapes)."""
+    return np.einsum_path(subscripts, *[np.asarray(_d(o)) for o in operands], optimize=optimize)
+
+
+def array2string(a, *args, **kwargs) -> str:
+    return np.array2string(a.numpy() if isinstance(a, DNDarray) else np.asarray(a), *args, **kwargs)
+
+
+def array_repr(arr, *args, **kwargs) -> str:
+    return np.array_repr(arr.numpy() if isinstance(arr, DNDarray) else np.asarray(arr), *args, **kwargs)
+
+
+def array_str(a, *args, **kwargs) -> str:
+    return np.array_str(a.numpy() if isinstance(a, DNDarray) else np.asarray(a), *args, **kwargs)
+
+
+def asfarray(a, dtype=None):
+    """Convert to a floating-point DNDarray."""
+    from . import factories, types as _t
+
+    out = factories.asarray(a, dtype=dtype)
+    if not _t.heat_type_is_inexact(out.dtype):
+        out = out.astype(_t.float32)
+    return out
+
+
+def ascontiguousarray(a, dtype=None):
+    """C-contiguity is XLA's native layout; an asarray alias here."""
+    from . import factories
+
+    return factories.asarray(a, dtype=dtype)
+
+
+def asfortranarray(a, dtype=None):
+    """Fortran order maps to the memory-layout machinery (memory.py);
+    returns data unchanged logically."""
+    from . import factories
+
+    return factories.asarray(a, dtype=dtype, order="F") if not isinstance(a, DNDarray) else a
+
+
+def asanyarray(a, dtype=None):
+    from . import factories
+
+    return factories.asarray(a, dtype=dtype)
+
+
+def asarray_chkfinite(a, dtype=None):
+    from . import factories
+
+    out = factories.asarray(a, dtype=dtype)
+    if not bool(jnp.all(jnp.isfinite(_d(out)))):
+        raise ValueError("array must not contain infs or NaNs")
+    return out
+
+
+class _GridProxy:
+    """np.mgrid / np.ogrid analogs: index with slices, get DNDarrays."""
+
+    def __init__(self, dense: bool):
+        self._dense_grid = dense
+
+    def __getitem__(self, key):
+        src = jnp.mgrid if self._dense_grid else jnp.ogrid
+        out = src[key]
+        if isinstance(out, (list, tuple)):
+            return [DNDarray.from_dense(o, None, None, None) for o in out]
+        return DNDarray.from_dense(out, None, None, None)
+
+
+mgrid = _GridProxy(True)
+ogrid = _GridProxy(False)

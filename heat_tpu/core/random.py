@@ -16,6 +16,8 @@ the reference's two guarantees, for free.
 from __future__ import annotations
 
 import time
+
+builtins_bytes = bytes
 from typing import Optional, Tuple, Union
 
 import jax
@@ -29,6 +31,8 @@ from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 
 __all__ = [
+    "bytes",
+    "choice",
     "get_state",
     "normal",
     "permutation",
@@ -39,7 +43,9 @@ __all__ = [
     "random_integer",
     "random_sample",
     "randperm",
+    "random_integers",
     "ranf",
+    "shuffle",
     "sample",
     "seed",
     "set_state",
@@ -203,3 +209,48 @@ def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, devic
         _next_key(), size, dtype=dtype.jax_type(), minval=float(low), maxval=float(high)
     )
     return _wrap(data, split, device, comm)
+
+
+def choice(a, size=None, replace: bool = True, p=None, split=None, device=None, comm=None) -> DNDarray:
+    """Random sample from a 1-D array or range(a) (NumPy extension beyond
+    the reference's random exports)."""
+    from .dndarray import DNDarray
+
+    if isinstance(a, DNDarray):
+        pool = a._dense()
+    elif isinstance(a, int):
+        pool = jnp.arange(a)
+    else:
+        pool = jnp.asarray(a)
+    shape = () if size is None else sanitize_shape(size)
+    pd = None
+    if p is not None:
+        pd = p._dense() if isinstance(p, DNDarray) else jnp.asarray(p)
+    data = jax.random.choice(_next_key(), pool, shape=shape, replace=replace, p=pd)
+    if data.ndim == 0:
+        data = data.reshape(1)
+        return _wrap(data, split, device, comm)
+    return _wrap(data, split, device, comm)
+
+
+def shuffle(x) -> None:
+    """Shuffle a DNDarray in place along its first axis (np.random.shuffle)."""
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"x must be a DNDarray, got {type(x)}")
+    perm = jax.random.permutation(_next_key(), x.shape[0])
+    x._replace_local(jnp.take(x._dense(), perm, axis=0))
+
+
+def bytes(length: int) -> builtins_bytes:
+    """``length`` random bytes (np.random.bytes)."""
+    bits = jax.random.randint(_next_key(), (int(length),), 0, 256, dtype=jnp.int32)
+    return builtins_bytes(np.asarray(bits, dtype=np.uint8).tobytes())
+
+
+def random_integers(low, high=None, size=None, split=None, device=None, comm=None) -> DNDarray:
+    """Closed-interval integer samples (legacy np.random.random_integers)."""
+    if high is None:
+        low, high = 1, low
+    return randint(low, int(high) + 1, size=size, split=split, device=device, comm=comm)

@@ -1,0 +1,124 @@
+"""numpy.linalg block, random extras, and text-IO extensions vs numpy."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def spd():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((5, 5))
+    return a @ a.T + 5 * np.eye(5)
+
+
+def test_cholesky_solve_pinv(spd):
+    a = ht.array(spd, split=0)
+    L = ht.linalg.cholesky(a).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-10)
+    b = np.arange(5.0)
+    np.testing.assert_allclose(
+        ht.linalg.solve(a, ht.array(b)).numpy(), np.linalg.solve(spd, b), rtol=1e-8
+    )
+    np.testing.assert_allclose(ht.linalg.pinv(a).numpy(), np.linalg.pinv(spd), rtol=1e-6, atol=1e-8)
+
+
+def test_eigh_eig_family(spd):
+    a = ht.array(spd)
+    w, v = ht.linalg.eigh(a)
+    np.testing.assert_allclose(np.sort(w.numpy()), np.sort(np.linalg.eigvalsh(spd)), rtol=1e-10)
+    np.testing.assert_allclose(
+        np.sort(ht.linalg.eigvalsh(a).numpy()), np.sort(np.linalg.eigvalsh(spd)), rtol=1e-10
+    )
+    g = np.random.default_rng(1).standard_normal((4, 4))
+    wg, vg = ht.linalg.eig(ht.array(g))
+    np.testing.assert_allclose(
+        np.sort_complex(wg.numpy()), np.sort_complex(np.linalg.eigvals(g)), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.sort_complex(ht.linalg.eigvals(ht.array(g)).numpy()),
+        np.sort_complex(np.linalg.eigvals(g)),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_lstsq_rank_cond_slogdet_power(spd):
+    rng = np.random.default_rng(2)
+    A = rng.standard_normal((8, 3))
+    b = rng.standard_normal(8)
+    x, resid, rank, sv = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+    np.testing.assert_allclose(x.numpy(), np.linalg.lstsq(A, b, rcond=None)[0], rtol=1e-8)
+    assert rank == 3
+    assert ht.linalg.matrix_rank(ht.array(spd)) == 5
+    np.testing.assert_allclose(float(ht.linalg.cond(ht.array(spd))), np.linalg.cond(spd), rtol=1e-6)
+    s, ld = ht.linalg.slogdet(ht.array(spd))
+    sn, ldn = np.linalg.slogdet(spd)
+    assert float(s) == sn
+    np.testing.assert_allclose(float(ld), ldn, rtol=1e-10)
+    np.testing.assert_allclose(
+        ht.linalg.matrix_power(ht.array(spd), 3).numpy(), np.linalg.matrix_power(spd, 3), rtol=1e-10
+    )
+
+
+def test_multi_dot_tensor_solve():
+    rng = np.random.default_rng(3)
+    A, B, C = rng.standard_normal((3, 5)), rng.standard_normal((5, 7)), rng.standard_normal((7, 2))
+    np.testing.assert_allclose(
+        ht.linalg.multi_dot([ht.array(A), ht.array(B), ht.array(C)]).numpy(),
+        np.linalg.multi_dot([A, B, C]),
+        rtol=1e-10,
+    )
+    T = rng.standard_normal((2, 3, 6))
+    bb = rng.standard_normal((2, 3))
+    np.testing.assert_allclose(
+        ht.linalg.tensorsolve(ht.array(T), ht.array(bb)).numpy(),
+        np.linalg.tensorsolve(T, bb),
+        rtol=1e-8,
+    )
+    Ti = rng.standard_normal((4, 6, 8, 3))
+    np.testing.assert_allclose(
+        ht.linalg.tensorinv(ht.array(Ti), ind=2).numpy(), np.linalg.tensorinv(Ti, ind=2), rtol=1e-6
+    )
+
+
+def test_random_extras():
+    ht.random.seed(0)
+    c = ht.random.choice(10, size=(20,))
+    assert c.numpy().min() >= 0 and c.numpy().max() < 10
+    c2 = ht.random.choice(ht.array([5.0, 6.0]), size=(8,), replace=True)
+    assert set(np.unique(c2.numpy())).issubset({5.0, 6.0})
+    x = ht.arange(12, split=0)
+    ht.random.shuffle(x)
+    assert sorted(x.numpy().tolist()) == list(range(12))
+    b = ht.random.bytes(16)
+    assert isinstance(b, bytes) and len(b) == 16
+    ri = ht.random.random_integers(1, 6, size=(200,)).numpy()
+    assert ri.min() >= 1 and ri.max() <= 6 and ri.max() == 6  # closed interval
+
+
+def test_text_io_roundtrips(tmp_path):
+    m = np.arange(12.0).reshape(4, 3)
+    p = tmp_path / "t.txt"
+    ht.savetxt(str(p), ht.array(m, split=0))
+    np.testing.assert_allclose(ht.loadtxt(str(p), split=0).numpy(), m)
+    np.testing.assert_allclose(ht.genfromtxt(str(p), split=0).numpy(), m)
+    pz = tmp_path / "t.npz"
+    ht.savez(str(pz), a=ht.array(m), b=ht.arange(5))
+    z = np.load(pz)
+    np.testing.assert_allclose(z["a"], m)
+    ht.savez_compressed(str(tmp_path / "tc.npz"), x=ht.array(m))
+    np.testing.assert_allclose(np.load(tmp_path / "tc.npz")["x"], m)
+
+
+def test_from_family():
+    np.testing.assert_allclose(
+        ht.fromfunction(lambda i, j: i + 10 * j, (3, 4), dtype=ht.float64).numpy(),
+        np.fromfunction(lambda i, j: i + 10 * j, (3, 4)),
+    )
+    assert ht.fromiter(range(6), ht.int32).numpy().tolist() == list(range(6))
+    np.testing.assert_allclose(
+        ht.frombuffer(np.arange(4.0).tobytes(), dtype=ht.float64).numpy(), np.arange(4.0)
+    )
+    np.testing.assert_allclose(ht.fromstring("1 2 3", dtype=ht.float32).numpy(), [1.0, 2.0, 3.0])

@@ -141,3 +141,36 @@ def test_factory_extras():
         ht.vander(ht.array([1.0, 2.0]), 4, increasing=True).numpy(),
         np.vander([1.0, 2.0], 4, increasing=True),
     )
+
+
+def test_second_batch(m, x):
+    np.testing.assert_allclose(float(ht.amax(x)), m.max())
+    np.testing.assert_allclose(float(ht.amin(x)), m.min())
+    np.testing.assert_allclose(ht.diagflat(ht.array([1.0, 2.0]), 1).numpy(), np.diagflat([1.0, 2.0], 1))
+    np.testing.assert_allclose(
+        ht.correlate(ht.array([1.0, 2.0, 3.0]), ht.array([0.0, 1.0, 0.5])).numpy(),
+        np.correlate([1, 2, 3], [0, 1, 0.5]),
+    )
+    np.testing.assert_allclose(ht.block([[x, x], [x, x]]).numpy(), np.block([[m, m], [m, m]]))
+    np.testing.assert_array_equal(
+        ht.packbits(ht.array(np.array([1, 0, 1, 1], np.uint8))).numpy(), np.packbits([1, 0, 1, 1])
+    )
+    np.testing.assert_array_equal(
+        ht.unpackbits(ht.array(np.array([176], np.uint8))).numpy(),
+        np.unpackbits(np.array([176], np.uint8)),
+    )
+    assert ht.base_repr(10, 2) == np.base_repr(10, 2)
+    assert ht.binary_repr(-3, 5) == np.binary_repr(-3, 5)
+    assert ht.format_float_positional(ht.array([1.5]), precision=2) == "1.5"
+    assert ht.einsum_path("ij,jk->ik", x, ht.array(m.T))[0] == np.einsum_path("ij,jk->ik", m, m.T)[0]
+    assert "1." in ht.array2string(x) and "array" in ht.array_repr(x)
+    assert isinstance(ht.array_str(x), str)
+    g = ht.mgrid[0:3, 0:2]
+    np.testing.assert_array_equal(g[0].numpy(), np.mgrid[0:3, 0:2][0])
+    og = ht.ogrid[0:4]
+    np.testing.assert_array_equal(og.numpy(), np.ogrid[0:4])
+    assert ht.asfarray(ht.array([1, 2])).dtype == ht.float32
+    assert ht.ascontiguousarray([1, 2]).shape == (2,)
+    assert ht.asanyarray([1.5]).dtype in (ht.float32, ht.float64)
+    with pytest.raises(ValueError):
+        ht.asarray_chkfinite(ht.array([1.0, np.inf]))
