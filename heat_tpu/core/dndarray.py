@@ -221,6 +221,11 @@ class DNDarray:
         return np.dtype(self.__dtype.jax_type()).itemsize
 
     @property
+    def flat(self):
+        """Flat iterator over the global array (np.ndarray.flat analog)."""
+        return iter(self.numpy().ravel())
+
+    @property
     def larray(self) -> jax.Array:
         """This process's local chunk of the TRUE array (dndarray.py:140).
 
@@ -484,11 +489,18 @@ class DNDarray:
     # printing (printing.py:184)
     # ------------------------------------------------------------------
     def __repr__(self) -> str:
+        override = getattr(type(self), "__repr_override__", None)
+        if override is not None:  # installed via printing.set_string_function
+            return override(self)
         from . import printing
 
         return printing.__str__(self)
 
-    __str__ = __repr__
+    def __str__(self) -> str:
+        override = getattr(type(self), "__str_override__", None)
+        if override is not None:
+            return override(self)
+        return self.__repr__()
 
     # ------------------------------------------------------------------
     # operator overloads — bound to the ops layer via late imports, the

@@ -26,12 +26,17 @@ from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
 
 __all__ = [
+    "DataSource",
+    "fromfile",
+    "fromregex",
     "genfromtxt",
     "load",
     "load_csv",
     "load_hdf5",
     "load_npy_from_path",
     "loadtxt",
+    "memmap",
+    "open_memmap",
     "save",
     "save_csv",
     "save_hdf5",
@@ -39,6 +44,7 @@ __all__ = [
     "savez",
     "savez_compressed",
     "supports_hdf5",
+    "tofile",
     "supports_netcdf",
     "supports_pandas",
 ]
@@ -354,3 +360,67 @@ def savez_compressed(path: str, *args, **kwargs) -> None:
     """np.savez_compressed analog over DNDarrays."""
     np.savez_compressed(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
                         **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
+
+
+def fromfile(path: str, dtype=types.float32, count: int = -1, sep: str = "", offset: int = 0,
+             split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """np.fromfile analog (binary or text mode)."""
+    npdt = np.dtype(types.canonical_heat_type(dtype).jax_type())
+    arr = np.fromfile(path, dtype=npdt, count=count, sep=sep, offset=offset)
+    from . import factories
+
+    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def tofile(x: DNDarray, path: str, sep: str = "", format: str = "%s") -> None:
+    """np.ndarray.tofile analog (gathers, writes raw or text)."""
+    x.numpy().tofile(path, sep=sep, format=format)
+
+
+def fromregex(path: str, regexp, dtype, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """np.fromregex analog (structured text extraction)."""
+    arr = np.fromregex(path, regexp, dtype)
+    from . import factories
+
+    if arr.dtype.names is not None and len(arr.dtype.names) == 1:
+        arr = arr[arr.dtype.names[0]]
+    return factories.array(np.asarray(arr), split=split, device=device, comm=comm)
+
+
+def memmap(path: str, dtype=types.float32, mode: str = "r", offset: int = 0, shape=None,
+           split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """np.memmap-backed ingestion: the file is memory-mapped on the host and
+    each shard's slab is copied to its device (large files never fully
+    materialize in host heap beyond the mapped pages touched)."""
+    npdt = np.dtype(types.canonical_heat_type(dtype).jax_type())
+    mm = np.memmap(path, dtype=npdt, mode=mode, offset=offset, shape=shape)
+    from . import factories
+
+    return factories.array(np.asarray(mm), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def open_memmap(path: str, mode: str = "r", dtype=None, shape=None,
+                split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """np.lib.format.open_memmap analog for .npy files."""
+    mm = np.lib.format.open_memmap(path, mode=mode,
+                                   dtype=None if dtype is None else np.dtype(types.canonical_heat_type(dtype).jax_type()),
+                                   shape=shape)
+    from . import factories
+
+    return factories.array(np.asarray(mm), split=split, device=device, comm=comm)
+
+
+class DataSource:
+    """np.lib.npyio.DataSource passthrough (host-side path/URL resolution)."""
+
+    def __init__(self, destpath="."):
+        self._ds = np.lib.npyio.DataSource(destpath)
+
+    def exists(self, path) -> bool:
+        return self._ds.exists(path)
+
+    def abspath(self, path) -> str:
+        return self._ds.abspath(path)
+
+    def open(self, path, mode="r", encoding=None, newline=None):
+        return self._ds.open(path, mode=mode, encoding=encoding, newline=newline)

@@ -93,6 +93,14 @@ __all__ = [
     "tri",
     "trim_zeros",
     "vander",
+    "asmatrix",
+    "bmat",
+    "broadcast",
+    "from_dlpack",
+    "isfortran",
+    "isnat",
+    "mat",
+    "require",
 ]
 
 
@@ -586,3 +594,69 @@ class _GridProxy:
 
 mgrid = _GridProxy(True)
 ogrid = _GridProxy(False)
+
+
+# ----------------------------------------------- final parity stragglers
+
+
+def from_dlpack(x):
+    """Import an array through the DLPack protocol."""
+    return DNDarray.from_dense(jnp.from_dlpack(x), None, None, None)
+
+
+def isfortran(a) -> bool:
+    """XLA arrays are row-major; Fortran order exists only as a logical
+    layout tag (memory.py), so this is always False."""
+    return False
+
+
+def isnat(x):
+    """NaT detection needs datetime dtypes, which the framework (like the
+    reference) does not provide."""
+    raise TypeError("isnat: datetime64/timedelta64 dtypes are not supported")
+
+
+def require(a, dtype=None, requirements=None):
+    """np.require analog: dtype conversion; layout requirement flags are
+    no-ops on the XLA substrate (always C-contiguous, aligned, writeable
+    copies)."""
+    from . import factories
+
+    out = factories.asarray(a, dtype=dtype)
+    return out
+
+
+class broadcast:
+    """np.broadcast analog: the broadcast shape/metadata of the operands."""
+
+    def __init__(self, *arrays):
+        shapes = [tuple((_d(a)).shape) for a in arrays]
+        self.shape = tuple(np.broadcast_shapes(*shapes))
+        self.ndim = len(self.shape)
+        self.nd = self.ndim
+        self.size = int(np.prod(self.shape)) if self.shape else 1
+        self.numiter = len(arrays)
+
+
+def asmatrix(data, dtype=None):
+    """Legacy matrix API: returns a 2-D DNDarray (no matrix subclass)."""
+    from . import factories
+
+    out = factories.asarray(data, dtype=dtype)
+    d = _d(out)
+    if d.ndim < 2:
+        d = jnp.atleast_2d(d)
+        return DNDarray.from_dense(d, None, out.device, out.comm)
+    if d.ndim > 2:
+        raise ValueError("matrix must be 2-dimensional")
+    return out
+
+
+mat = asmatrix
+
+
+def bmat(obj):
+    """Legacy block-matrix builder: 2-D `block` (string form unsupported)."""
+    if isinstance(obj, str):
+        raise NotImplementedError("string-form bmat is not supported; pass nested lists")
+    return asmatrix(block(obj))

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["get_printoptions", "global_printing", "local_printing", "print0", "set_printoptions"]
+__all__ = ["get_printoptions", "global_printing", "local_printing", "print0", "printoptions", "set_printoptions", "set_string_function"]
 
 _LOCAL_PRINTING = False
 
@@ -81,3 +81,30 @@ def __str__(dndarray) -> str:
         prefix="DNDarray(",
     )
     return f"DNDarray({body}, dtype=ht.{dndarray.dtype.__name__}, device={dndarray.device}, split={dndarray.split})"
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def printoptions(**kwargs):
+    """Context manager temporarily applying print options (np.printoptions)."""
+    saved = dict(get_printoptions())
+    try:
+        set_printoptions(**kwargs)
+        yield get_printoptions()
+    finally:
+        set_printoptions(**saved)
+
+
+def set_string_function(f, repr: bool = True) -> None:
+    """Override DNDarray's __str__/__repr__ rendering (legacy
+    np.set_string_function); pass None to restore the default."""
+    from .dndarray import DNDarray
+
+    attr = "__repr_override__" if repr else "__str_override__"
+    if f is None:
+        if hasattr(DNDarray, attr):
+            delattr(DNDarray, attr)
+    else:
+        setattr(DNDarray, attr, staticmethod(f))

@@ -122,3 +122,52 @@ def test_from_family():
         ht.frombuffer(np.arange(4.0).tobytes(), dtype=ht.float64).numpy(), np.arange(4.0)
     )
     np.testing.assert_allclose(ht.fromstring("1 2 3", dtype=ht.float32).numpy(), [1.0, 2.0, 3.0])
+
+
+def test_io_stragglers(tmp_path):
+    p = tmp_path / "raw.bin"
+    np.arange(6.0).tofile(p)
+    np.testing.assert_allclose(ht.fromfile(str(p), dtype=ht.float64).numpy(), np.arange(6.0))
+    x = ht.arange(4, dtype=ht.float32)
+    ht.tofile(x, str(tmp_path / "o.bin"))
+    np.testing.assert_allclose(np.fromfile(tmp_path / "o.bin", np.float32), np.arange(4.0))
+    (tmp_path / "t.txt").write_text("a=1.5\nb=2.5\n")
+    np.testing.assert_allclose(
+        ht.fromregex(str(tmp_path / "t.txt"), r"\w+=([\d.]+)", [("v", np.float64)]).numpy(),
+        [1.5, 2.5],
+    )
+    mp = tmp_path / "m.dat"
+    np.memmap(mp, dtype=np.float32, mode="w+", shape=(4,))[:] = [1, 2, 3, 4]
+    np.testing.assert_allclose(ht.memmap(str(mp), dtype=ht.float32, shape=(4,)).numpy(), [1, 2, 3, 4])
+    npy = tmp_path / "a.npy"
+    np.save(npy, np.arange(5.0))
+    np.testing.assert_allclose(ht.open_memmap(str(npy)).numpy(), np.arange(5.0))
+    assert ht.DataSource(str(tmp_path)).exists(str(npy))
+
+
+def test_printing_stragglers():
+    a = ht.array([1.23456789])
+    with ht.printoptions(precision=2):
+        assert "1.23]" in str(a)
+    assert "1.2346" in str(a)  # restored
+    ht.set_string_function(lambda arr: f"<custom {arr.shape}>")
+    try:
+        assert repr(a) == "<custom (1,)>"
+    finally:
+        ht.set_string_function(None)
+    assert "DNDarray" in repr(a)
+
+
+def test_napi_stragglers():
+    a = ht.array([1.5])
+    np.testing.assert_allclose(ht.from_dlpack(np.arange(3.0)).numpy(), np.arange(3.0))
+    assert not ht.isfortran(a)
+    with pytest.raises(TypeError):
+        ht.isnat(a)
+    assert ht.require([1, 2], dtype=ht.float32).dtype == ht.float32
+    b = ht.broadcast(ht.ones((3, 1)), ht.ones((1, 4)))
+    assert b.shape == (3, 4) and b.size == 12
+    assert ht.asmatrix([1.0, 2.0]).shape == (1, 2)
+    assert ht.mat([[1.0, 2.0], [3.0, 4.0]]).shape == (2, 2)
+    assert ht.bmat([[ht.ones((2, 2)), ht.zeros((2, 2))]]).shape == (2, 4)
+    assert [int(v) for v in ht.arange(4).flat] == [0, 1, 2, 3]
