@@ -334,8 +334,9 @@ def loadtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
 def savetxt(path: str, x: DNDarray, fmt: str = "%.18e", delimiter: str = " ",
             newline: str = "\n", header: str = "", footer: str = "", comments: str = "# ") -> None:
     """np.savetxt analog (gathers, rank-0-writes)."""
-    np.savetxt(path, x.numpy(), fmt=fmt, delimiter=delimiter, newline=newline,
-               header=header, footer=footer, comments=comments)
+    if jax.process_index() == 0:
+        np.savetxt(path, x.numpy(), fmt=fmt, delimiter=delimiter, newline=newline,
+                   header=header, footer=footer, comments=comments)
 
 
 def genfromtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
@@ -351,15 +352,17 @@ def genfromtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=No
 
 
 def savez(path: str, *args, **kwargs) -> None:
-    """np.savez analog over DNDarrays (gathered per array)."""
-    np.savez(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
-             **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
+    """np.savez analog over DNDarrays (gathered, rank-0-writes)."""
+    if jax.process_index() == 0:
+        np.savez(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
+                 **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
 
 
 def savez_compressed(path: str, *args, **kwargs) -> None:
-    """np.savez_compressed analog over DNDarrays."""
-    np.savez_compressed(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
-                        **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
+    """np.savez_compressed analog over DNDarrays (rank-0-writes)."""
+    if jax.process_index() == 0:
+        np.savez_compressed(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
+                            **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
 
 
 def fromfile(path: str, dtype=types.float32, count: int = -1, sep: str = "", offset: int = 0,
@@ -373,8 +376,9 @@ def fromfile(path: str, dtype=types.float32, count: int = -1, sep: str = "", off
 
 
 def tofile(x: DNDarray, path: str, sep: str = "", format: str = "%s") -> None:
-    """np.ndarray.tofile analog (gathers, writes raw or text)."""
-    x.numpy().tofile(path, sep=sep, format=format)
+    """np.ndarray.tofile analog (gathers, rank-0-writes raw or text)."""
+    if jax.process_index() == 0:
+        x.numpy().tofile(path, sep=sep, format=format)
 
 
 def fromregex(path: str, regexp, dtype, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
@@ -382,21 +386,26 @@ def fromregex(path: str, regexp, dtype, split: Optional[int] = None, device=None
     arr = np.fromregex(path, regexp, dtype)
     from . import factories
 
-    if arr.dtype.names is not None and len(arr.dtype.names) == 1:
-        arr = arr[arr.dtype.names[0]]
+    if arr.dtype.names is not None:
+        if len(arr.dtype.names) == 1:
+            arr = arr[arr.dtype.names[0]]
+        else:
+            from numpy.lib import recfunctions
+
+            arr = recfunctions.structured_to_unstructured(arr)
     return factories.array(np.asarray(arr), split=split, device=device, comm=comm)
 
 
 def memmap(path: str, dtype=types.float32, mode: str = "r", offset: int = 0, shape=None,
            split: Optional[int] = None, device=None, comm=None) -> DNDarray:
     """np.memmap-backed ingestion: the file is memory-mapped on the host and
-    each shard's slab is copied to its device (large files never fully
-    materialize in host heap beyond the mapped pages touched)."""
+    transferred to device in one pass (pages stream through the map; one
+    host-side densification happens during the device copy)."""
     npdt = np.dtype(types.canonical_heat_type(dtype).jax_type())
     mm = np.memmap(path, dtype=npdt, mode=mode, offset=offset, shape=shape)
     from . import factories
 
-    return factories.array(np.asarray(mm), dtype=dtype, split=split, device=device, comm=comm)
+    return factories.array(mm, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def open_memmap(path: str, mode: str = "r", dtype=None, shape=None,

@@ -522,8 +522,10 @@ def format_float_scientific(x, *args, **kwargs) -> str:
 
 
 def einsum_path(subscripts, *operands, optimize="greedy"):
-    """Contraction-order plan (host-side np.einsum_path over shapes)."""
-    return np.einsum_path(subscripts, *[np.asarray(_d(o)) for o in operands], optimize=optimize)
+    """Contraction-order plan (host-side np.einsum_path over shape dummies —
+    no device data is transferred)."""
+    dummies = [np.empty(_d(o).shape, dtype=np.dtype(_d(o).dtype)) for o in operands]
+    return np.einsum_path(subscripts, *dummies, optimize=optimize)
 
 
 def array2string(a, *args, **kwargs) -> str:
@@ -557,10 +559,12 @@ def ascontiguousarray(a, dtype=None):
 
 def asfortranarray(a, dtype=None):
     """Fortran order maps to the memory-layout machinery (memory.py);
-    returns data unchanged logically."""
+    logically a dtype-honoring asarray."""
     from . import factories
 
-    return factories.asarray(a, dtype=dtype, order="F") if not isinstance(a, DNDarray) else a
+    if isinstance(a, DNDarray):
+        return a if dtype is None else a.astype(dtype)
+    return factories.asarray(a, dtype=dtype, order="F")
 
 
 def asanyarray(a, dtype=None):

@@ -89,12 +89,15 @@ import contextlib
 @contextlib.contextmanager
 def printoptions(**kwargs):
     """Context manager temporarily applying print options (np.printoptions)."""
-    saved = dict(get_printoptions())
+    saved = dict(__PRINT_OPTIONS)
     try:
         set_printoptions(**kwargs)
         yield get_printoptions()
     finally:
-        set_printoptions(**saved)
+        # restore the raw dict: set_printoptions skips None values, which
+        # would leak options whose saved value was None (e.g. sci_mode)
+        __PRINT_OPTIONS.clear()
+        __PRINT_OPTIONS.update(saved)
 
 
 def set_string_function(f, repr: bool = True) -> None:

@@ -227,9 +227,8 @@ def choice(a, size=None, replace: bool = True, p=None, split=None, device=None, 
     if p is not None:
         pd = p._dense() if isinstance(p, DNDarray) else jnp.asarray(p)
     data = jax.random.choice(_next_key(), pool, shape=shape, replace=replace, p=pd)
-    if data.ndim == 0:
-        data = data.reshape(1)
-        return _wrap(data, split, device, comm)
+    # size=None returns a 0-d array (np.random.choice returns a scalar;
+    # the 0-d DNDarray is the library's scalar form, item()-able)
     return _wrap(data, split, device, comm)
 
 
