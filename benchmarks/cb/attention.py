@@ -1,0 +1,35 @@
+"""Sequence-parallel attention benchmark (long-context capability: ring
+attention over the mesh's split sequence axis — a TPU-native extension
+beyond the reference, which has no attention at all)."""
+
+from monitor import RESULTS, monitor
+
+
+def run_attention_benchmarks(scale: float = 1.0) -> None:
+    import heat_tpu as ht
+
+    seq = max(int(16384 * scale), 512)
+    heads, hd = 8, 64
+
+    ht.random.seed(7)
+    q = ht.random.randn(seq, heads, hd, split=0)
+    k = ht.random.randn(seq, heads, hd, split=0)
+    v = ht.random.randn(seq, heads, hd, split=0)
+
+    # warmup/compile both strategies
+    ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ring")
+    ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ulysses")
+
+    @monitor()
+    def ring_attention_causal():
+        return ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ring")
+
+    @monitor()
+    def ulysses_attention_causal():
+        return ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ulysses")
+
+    ring_attention_causal()
+    flops = 4.0 * seq * seq * heads * hd  # 2 matmuls, causal ~half but count full
+    RESULTS[-1]["tflops"] = round(flops / max(RESULTS[-1]["seconds"], 1e-9) / 1e12, 3)
+    ulysses_attention_causal()
+    RESULTS[-1]["tflops"] = round(flops / max(RESULTS[-1]["seconds"], 1e-9) / 1e12, 3)

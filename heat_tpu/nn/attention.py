@@ -26,6 +26,7 @@ Two strategies, both exact (not approximations):
 
 from __future__ import annotations
 
+import functools
 import math
 from functools import partial
 from typing import Optional
@@ -127,16 +128,24 @@ def ring_attention(
     scale = 1.0 / math.sqrt(d) if scale is None else scale
     n_true = seq if n_true is None else n_true
     block = seq // comm.size
+    return _ring_fn(comm, float(scale), bool(causal), int(n_true), block)(q, k, v)
+
+
+@functools.lru_cache(maxsize=128)
+def _ring_fn(comm, scale, causal, n_true, block):
+    """Jitted, cached ring-attention executable — rebuilding the shard_map
+    per call would retrace and recompile every time."""
     body = partial(
         _ring_body, comm=comm, scale=scale, causal=causal, n_true=n_true, block=block
     )
-    f = jax.shard_map(
-        body,
-        mesh=comm.mesh,
-        in_specs=(P(comm.axis_name), P(comm.axis_name), P(comm.axis_name)),
-        out_specs=P(comm.axis_name),
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=(P(comm.axis_name), P(comm.axis_name), P(comm.axis_name)),
+            out_specs=P(comm.axis_name),
+        )
     )
-    return f(q, k, v)
 
 
 def _ulysses_body(q, k, v, *, comm, scale, causal, n_true):
@@ -185,14 +194,21 @@ def ulysses_attention(
         raise ValueError(f"ulysses needs heads ({h}) divisible by the mesh size ({comm.size})")
     scale = 1.0 / math.sqrt(d) if scale is None else scale
     n_true = seq if n_true is None else n_true
+    return _ulysses_fn(comm, float(scale), bool(causal), int(n_true))(q, k, v)
+
+
+@functools.lru_cache(maxsize=128)
+def _ulysses_fn(comm, scale, causal, n_true):
+    """Jitted, cached Ulysses executable (see _ring_fn)."""
     body = partial(_ulysses_body, comm=comm, scale=scale, causal=causal, n_true=n_true)
-    f = jax.shard_map(
-        body,
-        mesh=comm.mesh,
-        in_specs=(P(comm.axis_name), P(comm.axis_name), P(comm.axis_name)),
-        out_specs=P(comm.axis_name),
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=(P(comm.axis_name), P(comm.axis_name), P(comm.axis_name)),
+            out_specs=P(comm.axis_name),
+        )
     )
-    return f(q, k, v)
 
 
 def scaled_dot_product_attention(
@@ -242,7 +258,7 @@ def scaled_dot_product_attention(
     if q.split != 0:
         raise ValueError(f"attention is sequence-parallel over split=0, got split={q.split}")
 
-    fn = {"ring": ring_attention, "ulysses": ulysses_attention}.get(method)
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention, "alltoall": ulysses_attention}.get(method)
     if fn is None:
         raise ValueError(f'method must be "ring" or "ulysses", got {method!r}')
     out_padded = fn(
