@@ -18,6 +18,8 @@ from __future__ import annotations
 import collections
 from typing import Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -97,13 +99,22 @@ def _tsqr_shard_map(A: DNDarray, compute_q: bool = True):
     Requires m divisible by p and m/p >= n (caller checks).
     """
     comm = A.comm
+    q, r = _tsqr_fn(comm, compute_q)(A.larray_padded)
+    # r is replicated identically on all shards; take it as the global R
+    return q, r
+
+
+@functools.lru_cache(maxsize=64)
+def _tsqr_fn(comm, compute_q: bool):
+    """Jitted, cached TS-QR executable — rebuilding the shard_map per call
+    would retrace (and through a remote compile service, recompile) on
+    every invocation."""
     mesh = comm.mesh
     axis = comm.axis_name
-    n = A.shape[1]
-    p = comm.size
 
     def body(a_loc):
         # a_loc: (m/p, n) local block
+        n = a_loc.shape[1]
         q1, r1 = jnp.linalg.qr(a_loc, mode="reduced")  # (m/p, n), (n, n)
         r_all = jax.lax.all_gather(r1, axis, axis=0, tiled=True)  # (p*n, n)
         q2, r2 = jnp.linalg.qr(r_all, mode="reduced")  # (p*n, n), (n, n)
@@ -112,16 +123,15 @@ def _tsqr_shard_map(A: DNDarray, compute_q: bool = True):
         q_loc = jnp.matmul(q1, q2_block, precision=jax.lax.Precision.HIGHEST) if compute_q else q1
         return q_loc, r2
 
-    f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=P(axis, None),
-        out_specs=(P(axis, None), P(None, None)),
-        # r2 is computed redundantly from the all-gathered R stack, so it is
-        # replicated by construction; the static analyzer cannot see through
-        # the QR call to prove it
-        check_vma=False,
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=(P(axis, None), P(None, None)),
+            # r2 is computed redundantly from the all-gathered R stack, so it
+            # is replicated by construction; the static analyzer cannot see
+            # through the QR call to prove it
+            check_vma=False,
+        )
     )
-    q, r = f(A.larray_padded)
-    # r is replicated identically on all shards; take it as the global R
-    return q, r

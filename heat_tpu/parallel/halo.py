@@ -10,6 +10,8 @@ exactly what ring-attention/context-parallel kernels need).
 
 from __future__ import annotations
 
+import functools
+
 from functools import partial
 from typing import Optional
 
@@ -53,17 +55,26 @@ def with_halos(comm: Communication, padded: jnp.ndarray, halo_size: int, split: 
     if split != 0:
         padded = jnp.moveaxis(padded, split, 0)
 
+    out = _with_halos_fn(comm, halo_size)(padded)  # (n_shards, chunk + 2*halo, ...)
+    if split != 0:
+        out = jnp.moveaxis(out, 1, split + 1)
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def _with_halos_fn(comm: Communication, halo_size: int):
+    """Jitted, cached halo-concat executable (rebuilding the shard_map per
+    call would retrace/recompile each time)."""
+
     def body(local):
         prev, nxt = halo_exchange(comm, local, halo_size, axis=0)
         return jnp.concatenate([prev, local, nxt], axis=0)[None]
 
-    f = jax.shard_map(
-        body,
-        mesh=comm.mesh,
-        in_specs=P(comm.axis_name),
-        out_specs=P(comm.axis_name),
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=P(comm.axis_name),
+            out_specs=P(comm.axis_name),
+        )
     )
-    out = f(padded)  # (n_shards, chunk + 2*halo, ...)
-    if split != 0:
-        out = jnp.moveaxis(out, 1, split + 1)
-    return out
