@@ -105,6 +105,8 @@ def load(path: str, *args, **kwargs) -> DNDarray:
         return load_csv(path, *args, **kwargs)
     if ext == ".npy":
         return load_npy_from_path(path, *args, **kwargs) if os.path.isdir(path) else _load_npy_file(path, *args, **kwargs)
+    if ext in (".txt", ".dat"):
+        return loadtxt(path, *args, **kwargs)
     raise ValueError(f"Unsupported file extension {ext}")
 
 
@@ -121,6 +123,14 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
         return save_netcdf(data, path, *args, **kwargs)
     if ext == ".csv":
         return save_csv(data, path, *args, **kwargs)
+    if ext == ".npy":
+        if jax.process_index() == 0:
+            np.save(path, data.numpy())
+        return None
+    if ext == ".npz":
+        return savez(path, data, *args, **kwargs)
+    if ext in (".txt", ".dat"):
+        return savetxt(path, data, *args, **kwargs)
     raise ValueError(f"Unsupported file extension {ext}")
 
 

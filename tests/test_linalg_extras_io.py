@@ -171,3 +171,17 @@ def test_napi_stragglers():
     assert ht.mat([[1.0, 2.0], [3.0, 4.0]]).shape == (2, 2)
     assert ht.bmat([[ht.ones((2, 2)), ht.zeros((2, 2))]]).shape == (2, 4)
     assert [int(v) for v in ht.arange(4).flat] == [0, 1, 2, 3]
+
+
+def test_save_load_extension_dispatch(tmp_path):
+    m = np.arange(12.0).reshape(4, 3)
+    x = ht.array(m, split=0)
+    for name in ("a.npy", "a.txt"):
+        p = str(tmp_path / name)
+        ht.save(x, p)
+        np.testing.assert_allclose(ht.load(p, split=0).numpy(), m)
+    ht.save(x, str(tmp_path / "a.npz"))
+    z = np.load(tmp_path / "a.npz")
+    np.testing.assert_allclose(z[z.files[0]], m)
+    with pytest.raises(ValueError):
+        ht.save(x, str(tmp_path / "a.unknown"))
