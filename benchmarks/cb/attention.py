@@ -8,17 +8,26 @@ from monitor import RESULTS, monitor
 def run_attention_benchmarks(scale: float = 1.0) -> None:
     import heat_tpu as ht
 
+    import heat_tpu.parallel.comm as comm_mod
+
     seq = max(int(16384 * scale), 512)
-    heads, hd = 8, 64
+    p = comm_mod.get_comm().size
+    heads = max(8, p)  # ulysses needs heads % mesh size == 0
+    heads += (-heads) % p
+    hd = 64
 
     ht.random.seed(7)
     q = ht.random.randn(seq, heads, hd, split=0)
     k = ht.random.randn(seq, heads, hd, split=0)
     v = ht.random.randn(seq, heads, hd, split=0)
 
-    # warmup/compile both strategies
-    ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ring")
-    ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ulysses")
+    # warmup/compile both strategies — and SYNC the warmups: the device
+    # executes in order, so un-fetched warmup programs would drain inside
+    # the first timed region
+    from monitor import _sync
+
+    _sync(ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ring"))
+    _sync(ht.nn.scaled_dot_product_attention(q, k, v, causal=True, method="ulysses"))
 
     @monitor()
     def ring_attention_causal():

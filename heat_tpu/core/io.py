@@ -105,6 +105,8 @@ def load(path: str, *args, **kwargs) -> DNDarray:
         return load_csv(path, *args, **kwargs)
     if ext == ".npy":
         return load_npy_from_path(path, *args, **kwargs) if os.path.isdir(path) else _load_npy_file(path, *args, **kwargs)
+    if ext == ".npz":
+        return _load_npz_file(path, *args, **kwargs)
     if ext in (".txt", ".dat"):
         return loadtxt(path, *args, **kwargs)
     raise ValueError(f"Unsupported file extension {ext}")
@@ -443,3 +445,14 @@ class DataSource:
 
     def open(self, path, mode="r", encoding=None, newline=None):
         return self._ds.open(path, mode=mode, encoding=encoding, newline=newline)
+
+
+def _load_npz_file(path: str, name: Optional[str] = None, split: Optional[int] = None,
+                   device=None, comm=None) -> DNDarray:
+    """Load one array from a .npz archive (first entry unless ``name``)."""
+    from . import factories
+
+    with np.load(path) as z:
+        key = name if name is not None else z.files[0]
+        arr = z[key]
+    return factories.array(arr, split=split, device=device, comm=comm)

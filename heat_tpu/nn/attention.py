@@ -223,7 +223,8 @@ def scaled_dot_product_attention(
 
     Inputs are (seq, heads, head_dim) DNDarrays, all with the same split:
     ``split=0`` runs the distributed strategy chosen by ``method``
-    ("ring" or "ulysses"); ``split=None`` computes locally.
+    ("ring", "ulysses", or its alias "alltoall"); ``split=None`` computes
+    locally.
     """
     for name, t in (("q", q), ("k", k), ("v", v)):
         if not isinstance(t, DNDarray):
@@ -260,7 +261,7 @@ def scaled_dot_product_attention(
 
     fn = {"ring": ring_attention, "ulysses": ulysses_attention, "alltoall": ulysses_attention}.get(method)
     if fn is None:
-        raise ValueError(f'method must be "ring" or "ulysses", got {method!r}')
+        raise ValueError(f'method must be "ring", "ulysses" or "alltoall", got {method!r}')
     out_padded = fn(
         q.larray_padded, k.larray_padded, v.larray_padded,
         comm=q.comm, causal=causal, scale=scale, n_true=seq,
