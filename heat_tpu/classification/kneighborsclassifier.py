@@ -53,7 +53,10 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         (kneighborsclassifier.py:114-132)."""
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
-        d = distance.cdist(x, self.x)._dense()
+        # expanded form keeps the n_test x n_train distance on the MXU; the
+        # ranking only needs relative order, so the cancellation loss of the
+        # expanded form cannot change non-tied neighbor sets
+        d = distance.cdist(x, self.x, quadratic_expansion=True)._dense()
         # k smallest distances -> neighbor indices
         _, idx = jax.lax.top_k(-d, self.n_neighbors)
         labels_oh = self.y._dense()

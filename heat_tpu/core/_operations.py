@@ -56,7 +56,7 @@ def _out_split_binary(t1: DNDarray, t2: DNDarray, out_shape) -> Optional[int]:
         if t.split is not None:
             cand = t.split + (nd_out - t.ndim)
             # a broadcast (size-1) split dim cannot carry the distribution
-            if t.shape[t.split] == out_shape[cand] and out_shape[cand] != 1 or out_shape[cand] == t.shape[t.split]:
+            if t.shape[t.split] == out_shape[cand] and out_shape[cand] != 1:
                 return cand
     return None
 

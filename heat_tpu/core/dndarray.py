@@ -473,8 +473,65 @@ class DNDarray:
         if isinstance(value, DNDarray):
             value = value._dense()
         value = jnp.asarray(value, dtype=self.__dtype.jax_type())
+        key_p = self._padded_safe_key(key)
+        if key_p is not None:
+            # fast path: write straight into the padded buffer — no dense
+            # slice + re-pad device round trip (one fused scatter on device)
+            out = self.__array.at[key_p].set(value)
+            want = self.__comm.sharding(self.__split, self.ndim)
+            if not out.sharding.is_equivalent_to(want, out.ndim):
+                # scatter output sharding followed the value operand; restore
+                # the canonical placement downstream shard_maps rely on
+                out = jax.device_put(out, want)
+            self.__array = out
+            return
         new_dense = self._dense().at[key].set(value)
         self.__array = _pad_to_canonical(new_dense, self.__gshape, self.__split, self.__comm)
+
+    def _padded_safe_key(self, key):
+        """Return a key usable directly on the padded buffer, or None.
+
+        Safe when there is no padding (dense view == padded buffer), or when
+        the key component addressing the split axis is an integer / bounded
+        slice that provably stays inside the true extent (negative indices
+        are resolved against the TRUE extent, which differs from the padded
+        one, so they are normalized here).
+        """
+        keys = list(key) if isinstance(key, tuple) else [key]
+        # bool scalars are advanced indexing (numpy adds an axis), not ints —
+        # and bool is an int subclass, so screen them out before any int check
+        if any(isinstance(k, (bool, np.bool_)) for k in keys):
+            return None
+        if self._pad == 0:
+            return key
+        split = self.__split
+        extent = self.__gshape[split]
+        # map each key component to the dimension it addresses
+        dim = 0
+        n_explicit = sum(1 for k in keys if k is not None and k is not Ellipsis)
+        for i, k in enumerate(keys):
+            if k is None:
+                continue
+            if k is Ellipsis:
+                dim += self.ndim - n_explicit
+                continue
+            if dim == split:
+                if isinstance(k, (int, np.integer)):
+                    j = int(k) + (extent if k < 0 else 0)
+                    if 0 <= j < extent:
+                        keys[i] = j
+                        return tuple(keys)
+                    return None
+                if isinstance(k, slice) and k.step in (None, 1):
+                    start, stop, _ = k.indices(extent)
+                    if 0 <= start <= stop <= extent:
+                        keys[i] = slice(start, stop)
+                        return tuple(keys)
+                return None
+            if not isinstance(k, (int, np.integer, slice)):
+                return None  # advanced indexing may interact with the split axis
+            dim += 1
+        return None  # split axis addressed implicitly (full slice over padding)
 
     def __len__(self) -> int:
         if self.ndim == 0:

@@ -32,6 +32,14 @@ def _pairwise_sqeuclidean(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(d, 0.0)
 
 
+def _pairwise_direct(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Exact broadcast-subtract form (distance.py:17-40).  More accurate than
+    the expanded form for near-duplicate points (no catastrophic cancellation)
+    at the cost of an O(n*m*f) intermediate that XLA fuses into the reduce."""
+    diff = x[:, None, :] - y[None, :, :]
+    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+
+
 def _prep(X: DNDarray, Y: Optional[DNDarray]):
     sanitize_in(X)
     if X.ndim != 2:
@@ -60,7 +68,7 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
     if quadratic_expansion:
         d = jnp.sqrt(_pairwise_sqeuclidean(xd, yd))
     else:
-        d = jnp.sqrt(_pairwise_sqeuclidean(xd, yd))
+        d = _pairwise_direct(xd, yd)
     split = 0 if X.split is not None else None
     return DNDarray.from_dense(d, split, X.device, X.comm)
 

@@ -205,3 +205,24 @@ def test_vmap():
     a = ht.array(x, split=0)
     f = ht.vmap(lambda row: row * 2.0)
     np.testing.assert_allclose(f(a).numpy(), x * 2)
+
+
+def test_cdist_direct_vs_expanded():
+    """quadratic_expansion=False is the exact broadcast-subtract path
+    (reference distance.py:17-40); it must beat the expanded form on
+    near-duplicate points where cancellation hurts."""
+    import heat_tpu as ht
+    import numpy as np
+    from scipy.spatial.distance import cdist as sp_cdist
+
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal((9, 5)) * 100.0
+    x = base
+    y = base + 1e-7  # near-duplicates: expanded form loses precision here
+    direct = ht.spatial.cdist(ht.array(x, split=0), ht.array(y)).numpy()
+    truth = sp_cdist(x, y)
+    np.testing.assert_allclose(direct, truth, rtol=1e-5, atol=1e-9)
+    exp = ht.spatial.cdist(
+        ht.array(x, split=0), ht.array(y), quadratic_expansion=True
+    ).numpy()
+    assert np.abs(direct - truth).max() <= np.abs(exp - truth).max()

@@ -163,3 +163,20 @@ def test_where_param(data):
     res = ht.add(a, 1.0, where=cond)
     expected = np.where(data > 0, data + 1.0, 0.0)
     np.testing.assert_allclose(res.numpy(), expected, rtol=1e-6)
+
+
+def test_size1_split_dim_does_not_carry_distribution(ht):
+    """A size-1 split dim broadcasts; it must not impose its split on the
+    output (the `!= 1` guard in _out_split_binary)."""
+    import numpy as np
+
+    a = ht.ones((1, 6), split=0)      # split axis has global size 1
+    b = ht.ones((5, 6), split=None)
+    out = a + b
+    assert out.shape == (5, 6)
+    assert out.split is None          # size-1 split must not carry
+    np.testing.assert_allclose(out.numpy(), np.full((5, 6), 2.0))
+
+    c = ht.ones((5, 6), split=0)      # real split still carries
+    out2 = a + c
+    assert out2.split == 0
