@@ -35,3 +35,12 @@ from . import utils
 from . import datasets
 
 communication = parallel  # API-parity alias for heat.core.communication
+
+
+def __getattr__(name):
+    # lazy accelerator device globals (``ht.tpu`` / ``ht.gpu``): resolving
+    # them queries the backend, which must not happen at import time (the
+    # multi-process bootstrap ``parallel.init`` has to be able to run first)
+    if name in ("tpu", "gpu"):
+        return getattr(devices, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

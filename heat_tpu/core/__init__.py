@@ -33,3 +33,12 @@ from . import tiling
 from . import linalg
 from .linalg import *
 from ..version import __version__  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy accelerator device globals, forwarded to devices.__getattr__
+    if name in ("tpu", "gpu"):
+        from . import devices as _devices
+
+        return getattr(_devices, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

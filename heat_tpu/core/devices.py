@@ -57,30 +57,48 @@ class Device:
 cpu = Device("cpu")
 """The host CPU device (devices.py:107)."""
 
-# Register an accelerator device if the default backend is one, mirroring the
-# dynamic gpu registration in devices.py:110-134.
+# Accelerator registration mirrors the dynamic gpu registration in
+# devices.py:110-134, but is LAZY: querying ``jax.default_backend()``
+# initializes the XLA backend, which must not happen at import time or the
+# multi-process bootstrap (``heat_tpu.parallel.init``) could no longer run
+# first.  The registry resolves on first device lookup instead.
 __registry = {"cpu": cpu}
-try:  # pragma: no cover - depends on runtime platform
-    _default_platform = jax.default_backend()
-except Exception:  # pragma: no cover
-    _default_platform = "cpu"
+__default_device: Optional[Device] = None
 
-if _default_platform not in __registry:
-    _accel = Device(_default_platform)
-    __registry[_default_platform] = _accel
-    if _default_platform in ("tpu", "axon"):
-        tpu = _accel
-        __all__.append("tpu")
-    elif _default_platform in ("gpu", "cuda", "rocm"):
-        gpu = _accel
-        __all__.append("gpu")
 
-__default_device = __registry.get(_default_platform, cpu)
+def _ensure_registry() -> Device:
+    global __default_device
+    if __default_device is None:
+        try:  # pragma: no cover - depends on runtime platform
+            platform = jax.default_backend()
+        except Exception:  # pragma: no cover
+            platform = "cpu"
+        if platform not in __registry:
+            accel = Device(platform)
+            __registry[platform] = accel
+            if platform in ("tpu", "axon"):
+                __registry.setdefault("tpu", accel)
+            elif platform in ("cuda", "rocm"):
+                __registry.setdefault("gpu", accel)
+        __default_device = __registry[platform]
+    return __default_device
+
+
+def __getattr__(name: str):
+    # PEP 562 lazy module attributes: ``devices.tpu`` / ``devices.gpu``
+    # resolve after the registry exists (mirroring the conditional globals
+    # in the reference's devices.py:110-134)
+    if name in ("tpu", "gpu"):
+        _ensure_registry()
+        if name in __registry:
+            return __registry[name]
+        raise AttributeError(f"no {name!r} device on this platform")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def get_device() -> Device:
     """Current default device (devices.py:137)."""
-    return __default_device
+    return _ensure_registry()
 
 
 def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
@@ -89,6 +107,7 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
         return get_device()
     if isinstance(device, Device):
         return device
+    _ensure_registry()
     name = str(device).split(":")[0].strip().lower()
     if name in __registry:
         return __registry[name]

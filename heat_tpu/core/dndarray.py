@@ -122,12 +122,47 @@ class DNDarray:
         self.__array = padded
 
     def _replace_local(self, local: jax.Array) -> None:
-        """Replace this process's local chunk (single-process: everything)."""
+        """Replace this process's local chunk (single-process: everything).
+
+        Multi-host: every process calls this collectively with its own block
+        (the true rows of its devices' canonical shards); the global array is
+        reassembled host-locally via
+        ``jax.make_array_from_process_local_data`` — no communication, the
+        analog of the reference's in-place ``_DNDarray__array`` swap.
+        """
         if jax.process_count() == 1:
             new = DNDarray.from_dense(local, self.__split, self.__device, self.__comm)
             self.__array = new.larray_padded
-        else:  # pragma: no cover - multi-host
-            raise NotImplementedError("local assignment across hosts: use global __setitem__")
+            return
+        comm = self.__comm
+        split = self.__split
+        if not comm.process_blocks_contiguous:
+            raise NotImplementedError(
+                "local replacement on an interleaved sub-mesh: use global __setitem__"
+            )
+        sharding = comm.sharding(split)
+        if split is None:
+            # replicated: each process supplies the full array
+            self.__array = jax.make_array_from_process_local_data(
+                sharding, np.asarray(local), self.__gshape
+            )
+            return
+        _, lshape, _ = comm.process_chunk(self.__gshape, split)
+        if tuple(int(s) for s in local.shape) != tuple(lshape):
+            raise ValueError(
+                f"local block must have shape {tuple(lshape)} on process "
+                f"{comm.rank}, got {tuple(local.shape)}"
+            )
+        padded_gshape = tuple(self.__array.shape)
+        per = padded_gshape[split] // comm.size
+        want = per * len(comm.local_participants)
+        pad = want - lshape[split]
+        if pad:
+            widths = [(0, pad) if d == split else (0, 0) for d in range(self.ndim)]
+            local = np.pad(np.asarray(local), widths)
+        self.__array = jax.make_array_from_process_local_data(
+            sharding, np.asarray(local), padded_gshape
+        )
 
     # ------------------------------------------------------------------
     # padded / dense / masked views
@@ -234,21 +269,28 @@ class DNDarray:
         """
         if jax.process_count() == 1:
             return self._dense()
-        # multi-host: rows owned by this process's devices  # pragma: no cover
-        if self.__split is None:
-            return self._dense()
-        nlocal = self.__comm.size // jax.process_count()
-        first = self.__comm.rank * nlocal
-        per = self.__array.shape[self.__split] // self.__comm.size
-        start = min(first * per, self.__gshape[self.__split])
-        stop = min((first + nlocal) * per, self.__gshape[self.__split])
+        # multi-host: assemble this process's block from its ADDRESSABLE
+        # device shards — purely host-local, no collective (the analog of the
+        # reference's per-rank torch tensor, dndarray.py:140)
+        split = self.__split
+        shards = self.__array.addressable_shards
+        if split is None:
+            return jnp.asarray(shards[0].data)
+        shards = sorted(shards, key=lambda s: s.index[split].start or 0)
+        # shards sit on different local devices; assemble via host (numpy)
+        blocks = [np.asarray(s.data) for s in shards]
+        local_padded = blocks[0] if len(blocks) == 1 else np.concatenate(blocks, axis=split)
+        _, lshape, _ = self.__comm.process_chunk(self.__gshape, split)
         sl = tuple(
-            slice(start, stop) if d == self.__split else slice(None) for d in range(self.ndim)
+            slice(0, lshape[split]) if d == split else slice(None) for d in range(self.ndim)
         )
-        return self.__array[sl]
+        return jnp.asarray(local_padded[sl])
 
     @property
     def lshape(self) -> Tuple[int, ...]:
+        if jax.process_count() > 1:
+            # pure metadata — larray would materialize the local block
+            return tuple(int(s) for s in self.__comm.process_chunk(self.__gshape, self.__split)[1])
         return tuple(int(s) for s in self.larray.shape)
 
     @property
@@ -334,8 +376,17 @@ class DNDarray:
         return out
 
     def numpy(self) -> np.ndarray:
-        """Gather the full array to host numpy (dndarray.py:1177)."""
-        return _np_fetch(self._dense())
+        """Gather the full array to host numpy (dndarray.py:1177).
+
+        Multi-host: collective — every process receives the full value (the
+        reference's resplit-to-None + local numpy, dndarray.py:1177-1192).
+        """
+        dense = self._dense()
+        if jax.process_count() > 1 and not dense.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(dense, tiled=True))
+        return _np_fetch(dense)
 
     def __array__(self, dtype=None) -> np.ndarray:
         a = self.numpy()
@@ -348,6 +399,8 @@ class DNDarray:
         """Scalar value of a single-element array (dndarray.py:1152)."""
         if self.size != 1:
             raise ValueError(f"only one-element arrays can be converted to Python scalars, got shape {self.__gshape}")
+        if jax.process_count() > 1:  # collective fetch
+            return self.numpy().reshape(()).item()
         return _np_fetch(self._dense().reshape(())).item()
 
     def cpu(self) -> "DNDarray":

@@ -143,21 +143,84 @@ def array(
         is_split = sanitize_axis(data.shape, is_split)
         if jax.process_count() == 1:
             return DNDarray.from_dense(data, is_split, device, comm)
-        # multi-host: assemble the global array from per-process chunks
-        # (the reference infers gshape via allgather, factories.py:382-428)
-        sharding = comm.sharding(is_split)  # pragma: no cover - multi-host
-        global_arr = jax.make_array_from_process_local_data(sharding, np.asarray(data))
-        return DNDarray(
-            global_arr,
-            tuple(global_arr.shape),
-            dtype,
-            is_split,
-            device,
-            comm,
-        )
+        return _ingest_process_chunks(data, is_split, dtype, device, comm)
 
     split = sanitize_axis(data.shape, split)
     return DNDarray.from_dense(jnp.asarray(data), split, device, comm)
+
+
+def _ingest_process_chunks(data, axis: int, dtype, device, comm) -> DNDarray:
+    """Assemble a global DNDarray from each process's pre-distributed chunk.
+
+    Multi-host ``is_split`` ingestion, the analog of the reference's
+    allgather-based gshape inference (factories.py:382-428).  Two paths:
+
+    1. aligned fast path — every process's chunk already coincides with its
+       canonical block (e.g. it came from ``Communication.process_chunk``
+       slab reads): host-local placement, zero communication;
+    2. ragged general path — chunks of arbitrary extents: one host-level
+       allgather rebuilds the global value on every process (the reference's
+       ragged chunks are likewise host tensors before wrapping), then each
+       local device shard is carved out of it.  Scales with the global array
+       size on the host; large arrays should ingest via aligned slab reads.
+    """
+    from jax.experimental import multihost_utils
+
+    nproc = jax.process_count()
+    local = np.asarray(data)
+    if not comm.local_participants:
+        raise RuntimeError(
+            "calling process owns no devices in this communication; "
+            "is_split ingestion requires every process to be a member"
+        )
+    # exchange chunk shapes; validate non-split dims agree (factories.py:406)
+    shapes = multihost_utils.process_allgather(np.asarray(local.shape, dtype=np.int64))
+    shapes = np.asarray(shapes).reshape(nproc, local.ndim)
+    other = np.delete(shapes, axis, axis=1)
+    if not (other == other[0]).all():
+        raise ValueError(f"non-split dimensions must match across processes, got {shapes.tolist()}")
+    exts = shapes[:, axis]
+    offs = np.concatenate([[0], np.cumsum(exts)])
+    total = int(offs[-1])
+    gshape = local.shape[:axis] + (total,) + local.shape[axis + 1 :]
+    sharding = comm.sharding(axis)
+    padded_total = comm.padded_extent(total)
+    padded_gshape = gshape[:axis] + (padded_total,) + gshape[axis + 1 :]
+    per = padded_total // comm.size
+
+    def _pad_rows(arr, rows):
+        pad = rows - arr.shape[axis]
+        if pad <= 0:
+            return arr
+        widths = [(0, pad) if d == axis else (0, 0) for d in range(arr.ndim)]
+        return np.pad(arr, widths)
+
+    # fast path: chunk == canonical process block everywhere, and each
+    # process's devices cover one contiguous index range (so host-local data
+    # tiles its shards exactly)
+    aligned = comm.process_blocks_contiguous
+    for q in range(nproc):
+        if not aligned:
+            break
+        lo, lsh, _ = comm.process_chunk(gshape, axis, process=q)
+        aligned = lo == int(offs[q]) and lsh[axis] == int(exts[q])
+    if aligned:
+        want = per * len(comm.local_participants)
+        arr = jax.make_array_from_process_local_data(
+            sharding, _pad_rows(local, want), padded_gshape
+        )
+        return DNDarray(arr, gshape, dtype, axis, device, comm)
+
+    # general (ragged) path: rebuild the global value on every host, then
+    # place local shards from it (works for any device/process interleaving)
+    m_max = int(exts.max())
+    stacked = np.asarray(multihost_utils.process_allgather(_pad_rows(local, m_max)))
+    blocks = [np.take(stacked[q], np.arange(int(exts[q])), axis=axis) for q in range(nproc)]
+    full = np.concatenate(blocks, axis=axis)
+    widths = [(0, padded_total - total) if d == axis else (0, 0) for d in range(full.ndim)]
+    padded = np.pad(full, widths)
+    arr = jax.make_array_from_callback(padded.shape, sharding, lambda idx: padded[idx])
+    return DNDarray(arr, gshape, dtype, axis, device, comm)
 
 
 def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -> DNDarray:

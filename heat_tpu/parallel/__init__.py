@@ -7,6 +7,9 @@ from .comm import (
     get_comm,
     sanitize_comm,
     use_comm,
+    init,
+    is_initialized,
+    finalize,
 )
 
 __all__ = [
@@ -16,4 +19,7 @@ __all__ = [
     "get_comm",
     "sanitize_comm",
     "use_comm",
+    "init",
+    "is_initialized",
+    "finalize",
 ]

@@ -54,6 +54,9 @@ __all__ = [
     "get_comm",
     "sanitize_comm",
     "use_comm",
+    "init",
+    "is_initialized",
+    "finalize",
 ]
 
 #: Name of the mesh axis used for the (single) split dimension, mirroring the
@@ -77,14 +80,42 @@ class Communication:
         devices: Optional[Sequence] = None,
         axis_name: str = SPLIT_AXIS_NAME,
     ):
-        if devices is None:
-            devices = jax.devices()
-        self._devices: List = list(devices)
+        # ``devices`` may be None (all devices), a sequence, or a zero-arg
+        # callable.  Resolution is LAZY so that constructing the module-level
+        # WORLD/SELF does not initialize the XLA backend — ``init()`` (the
+        # multi-process bootstrap) must run before the first backend touch.
+        self._devices_spec = devices
         self.axis_name = axis_name
-        self._mesh = Mesh(np.asarray(self._devices, dtype=object), (axis_name,))
+        self._resolved: Optional[Tuple[List, Mesh]] = None
+
+    def _ensure(self) -> Tuple[List, Mesh]:
+        if self._resolved is None:
+            spec = self._devices_spec
+            if spec is None:
+                devs = list(jax.devices())
+            elif callable(spec):
+                devs = list(spec())
+            else:
+                devs = list(spec)
+            mesh = Mesh(np.asarray(devs, dtype=object), (self.axis_name,))
+            self._resolved = (devs, mesh)
+        return self._resolved
+
+    @property
+    def _devices(self) -> List:
+        return self._ensure()[0]
+
+    @property
+    def _mesh(self) -> Mesh:
+        return self._ensure()[1]
 
     # ------------------------------------------------------------------
-    # topology
+    # topology.  Terminology (coherent multi-host semantics):
+    #   * participant = one DEVICE in the mesh; ``size``/``chunk(rank=...)``
+    #     are in participant units (the analog of an MPI rank's chunk).
+    #   * process = one HOST controller (``jax.process_index``); each process
+    #     owns a contiguous block of participants.  Single-controller mode is
+    #     the special case process_count == 1 owning all participants.
     # ------------------------------------------------------------------
     @property
     def mesh(self) -> Mesh:
@@ -102,14 +133,41 @@ class Communication:
 
     @property
     def rank(self) -> int:
-        """Index of the calling process (``jax.process_index``).
-
-        In the reference every MPI rank runs its own Python interpreter; in
-        single-controller JAX one process sees all devices, so ``rank`` is 0
-        and per-device data is accessed positionally (see
-        ``DNDarray.lshape_map``).
+        """Index of the calling *process* (``jax.process_index``), the analog
+        of the reference's ``comm.rank`` when one interpreter == one MPI rank
+        (communication.py:116).  For the participant (device) view use
+        ``chunk(rank=...)`` / ``local_participants``.
         """
         return jax.process_index()
+
+    process_rank = rank
+
+    @property
+    def process_count(self) -> int:
+        """Number of host controllers driving this mesh."""
+        return jax.process_count()
+
+    @property
+    def local_participants(self) -> List[int]:
+        """Participant (device) indices owned by the calling process."""
+        pid = jax.process_index()
+        return [i for i, d in enumerate(self._devices) if d.process_index == pid]
+
+    @property
+    def local_devices(self) -> List:
+        """The calling process's addressable devices within this mesh."""
+        return [d for d in self._devices if d.process_index == jax.process_index()]
+
+    @property
+    def process_blocks_contiguous(self) -> bool:
+        """True when every process's devices occupy one contiguous run of
+        participant indices (the canonical WORLD layout).  Host-local data
+        placement (``make_array_from_process_local_data``) requires this;
+        interleaved sub-meshes fall back to callback-based placement."""
+        owners = {}
+        for i, d in enumerate(self._devices):
+            owners.setdefault(d.process_index, []).append(i)
+        return all(v == list(range(v[0], v[-1] + 1)) for v in owners.values())
 
     @property
     def is_distributed(self) -> bool:
@@ -168,6 +226,35 @@ class Communication:
         per = self.padded_extent(extent) // self.size
         start = min(rank * per, extent)
         stop = min(start + per, extent)
+        lshape = shape[:split] + (stop - start,) + shape[split + 1 :]
+        slices = tuple(
+            slice(start, stop) if dim == split else slice(0, s)
+            for dim, s in enumerate(shape)
+        )
+        return start, lshape, slices
+
+    def process_chunk(
+        self, shape: Sequence[int], split: Optional[int], process: Optional[int] = None
+    ) -> Tuple[int, Tuple[int, ...], Tuple[slice, ...]]:
+        """One *process's* block: the union of its participants' chunks.
+
+        The multi-host analog of the reference's ``chunk`` (one MPI rank ==
+        one interpreter, communication.py:157): a process owns the contiguous
+        row range covered by its devices' canonical shards.
+        """
+        shape = tuple(int(s) for s in shape)
+        if split is None:
+            return 0, shape, tuple(slice(0, s) for s in shape)
+        process = jax.process_index() if process is None else process
+        parts = [i for i, d in enumerate(self._devices) if d.process_index == process]
+        if not parts:
+            lshape = shape[:split] + (0,) + shape[split + 1 :]
+            return 0, lshape, tuple(
+                slice(0, 0) if d == split else slice(0, s) for d, s in enumerate(shape)
+            )
+        per = self.padded_extent(shape[split]) // self.size
+        start = min(min(parts) * per, shape[split])
+        stop = min((max(parts) + 1) * per, shape[split])
         lshape = shape[:split] + (stop - start,) + shape[split + 1 :]
         slices = tuple(
             slice(start, stop) if dim == split else slice(0, s)
@@ -257,10 +344,84 @@ class Communication:
 
 
 # ----------------------------------------------------------------------
+# multi-process bootstrap, the analog of the reference's implicit MPI_Init
+# (importing heat initializes MPI via mpi4py; here the runtime is explicit:
+# call ``heat_tpu.parallel.init(...)`` before any array work, mirroring
+# ``jax.distributed.initialize``'s own contract)
+# ----------------------------------------------------------------------
+_initialized = False
+
+
+def init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+    **kwargs,
+) -> None:
+    """Bootstrap multi-host SPMD execution.
+
+    Wraps :func:`jax.distributed.initialize` (the moral equivalent of the
+    reference's MPI world bootstrap, communication.py:116 + quick_start's
+    ``mpirun -n N python prog.py``): every host runs the same program, and
+    after ``init`` the default WORLD communication spans the global device
+    set.  Must be called before the first array operation (JAX requires the
+    distributed runtime to exist before the backend is initialized).  On a
+    single host with no coordinator this is a no-op, so programs written for
+    multi-host run unchanged in single-controller mode.
+    """
+    global _initialized
+    if coordinator_address is None and num_processes is None and process_id is None and not kwargs:
+        # Zero-arg bootstrap: let jax auto-detect a cluster environment
+        # (SLURM, Open MPI, Cloud TPU pod).  On a plain single host there is
+        # nothing to detect — initialize() raises and this becomes a no-op,
+        # so single-host programs need no special-casing.
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            _initialized = True
+            return
+        _initialized = True
+        _reset_defaults()
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+        **kwargs,
+    )
+    _initialized = True
+    _reset_defaults()
+
+
+def is_initialized() -> bool:
+    """Whether :func:`init` has run (``MPI.Is_initialized`` analog)."""
+    return _initialized
+
+
+def finalize() -> None:
+    """Tear down the distributed runtime (``MPI_Finalize`` analog)."""
+    global _initialized
+    if jax.process_count() > 1:  # pragma: no cover - multi-host only
+        jax.distributed.shutdown()
+    _initialized = False
+
+
+def _reset_defaults() -> None:
+    """Re-resolve WORLD/SELF after the device set changes (post-``init``)."""
+    global __default_comm
+    WORLD._resolved = None
+    SELF._resolved = None
+    __default_comm = WORLD
+
+
+# ----------------------------------------------------------------------
 # module-level default communications, mirroring communication.py:2204-2251
+# (device resolution is lazy — see Communication.__init__)
 # ----------------------------------------------------------------------
 WORLD = Communication()
-SELF = Communication(jax.devices()[:1])
+SELF = Communication(lambda: jax.devices()[:1])
 
 __default_comm = WORLD
 

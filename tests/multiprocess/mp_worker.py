@@ -1,0 +1,130 @@
+"""Multi-process worker battery: one host controller of an N-process SPMD run.
+
+The analog of the reference's ``mpirun -n 3 pytest`` CI lane
+(/root/reference/.github/workflows/ci.yaml:58-61): every process runs this
+same program in lockstep; collective results must agree with numpy ground
+truth on every process.  Launched by tests/test_multiprocess.py with
+2 processes x 4 virtual CPU devices each.
+
+Usage: python mp_worker.py <process_id> <num_processes> <port> [devices_per_proc]
+"""
+
+import os
+import sys
+
+PID = int(sys.argv[1])
+NPROC = int(sys.argv[2])
+PORT = int(sys.argv[3])
+DEV_PER_PROC = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={DEV_PER_PROC}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+import heat_tpu as ht
+
+ht.parallel.init(
+    coordinator_address=f"localhost:{PORT}", num_processes=NPROC, process_id=PID
+)
+
+import jax.numpy as jnp  # noqa: E402  (after init: backend is now live)
+
+
+def check(name, cond):
+    if not cond:
+        print(f"[{PID}] FAIL: {name}", flush=True)
+        sys.exit(1)
+    print(f"[{PID}] ok: {name}", flush=True)
+
+
+NDEV = NPROC * DEV_PER_PROC
+
+# ---------------------------------------------------------------- topology
+comm = ht.get_comm()
+check("global device count", comm.size == NDEV)
+check("process count", comm.process_count == NPROC)
+check("process rank", comm.rank == PID)
+check(
+    "local participants",
+    comm.local_participants == list(range(PID * DEV_PER_PROC, (PID + 1) * DEV_PER_PROC)),
+)
+
+# ---------------------------------------------------------------- factories
+a = ht.arange(2 * NDEV + 3, split=0)  # uneven extent exercises pad-and-mask
+truth = np.arange(2 * NDEV + 3)
+check("arange sum (collective reduce)", float(a.sum()) == truth.sum())
+check("arange numpy allgather", np.array_equal(a.numpy(), truth))
+
+# larray: this process's true block of the canonical distribution
+off, lshape, _ = comm.process_chunk(a.shape, 0)
+check("larray shape", a.larray.shape == lshape)
+check("larray content", np.array_equal(np.asarray(a.larray), truth[off : off + lshape[0]]))
+
+# ---------------------------------------------------------------- is_split
+# ragged ingestion: process p contributes 5-p rows (not canonically aligned)
+rows = 5 - PID
+local = np.full((rows, 3), float(PID)) + np.arange(rows)[:, None]
+g = ht.array(local, is_split=0)
+total = sum(5 - q for q in range(NPROC))
+check("is_split gshape", g.shape == (total, 3))
+expected = np.concatenate(
+    [np.full((5 - q, 3), float(q)) + np.arange(5 - q)[:, None] for q in range(NPROC)]
+)
+check("is_split content (ragged permute)", np.allclose(g.numpy(), expected))
+
+# aligned ingestion fast path: chunk shapes straight from process_chunk
+gshape = (3 * NDEV, 2)
+off2, lsh2, _ = comm.process_chunk(gshape, 0)
+mine = np.arange(off2, off2 + lsh2[0], dtype=np.float64)[:, None] * np.ones((1, 2))
+g2 = ht.array(mine, is_split=0)
+check("is_split aligned gshape", g2.shape == gshape)
+check(
+    "is_split aligned content",
+    np.allclose(g2.numpy(), np.arange(gshape[0], dtype=np.float64)[:, None] * np.ones((1, 2))),
+)
+
+# ---------------------------------------------------------------- ops
+x_np = np.linspace(0.0, 1.0, 7 * NDEV - 5).reshape(-1, 1) * np.ones((1, 4))
+x = ht.array(x_np, split=0)
+y = x * 2.0 + 1.0
+check("elementwise", np.allclose(y.numpy(), x_np * 2.0 + 1.0))
+check("reduction mean", abs(float(y.mean()) - (x_np * 2 + 1).mean()) < 1e-12)
+check("axis reduction", np.allclose(x.sum(axis=0).numpy(), x_np.sum(0)))
+
+# global setitem is collective (same scatter on every process)
+x[3] = 9.0
+x_np[3] = 9.0
+check("setitem", np.allclose(x.numpy(), x_np))
+
+# ---------------------------------------------------------------- resplit
+r = x.resplit(1)
+check("resplit 0->1", r.split == 1 and np.allclose(r.numpy(), x_np))
+rn = x.resplit(None)
+check("resplit 0->None", rn.split is None and np.allclose(rn.numpy(), x_np))
+
+# ---------------------------------------------------------------- lloc write
+b = ht.zeros((NDEV * 2, 2), split=0)
+_, lsh3, _ = comm.process_chunk(b.shape, 0)
+b._replace_local(jnp.full(lsh3, float(PID + 1)))
+bn = b.numpy()
+for q in range(NPROC):
+    o, ls, _ = comm.process_chunk(b.shape, 0, process=q)
+    if not np.allclose(bn[o : o + ls[0]], float(q + 1)):
+        check(f"replace_local block of process {q}", False)
+check("replace_local collective view", True)
+
+# ---------------------------------------------------------------- linalg
+m = ht.random.randn(8 * NDEV, 5, split=0, dtype=ht.float64)
+q_, r_ = ht.qr(m)
+check(
+    "qr factorization",
+    np.allclose(q_.numpy() @ r_.numpy(), m.numpy(), atol=1e-10),
+)
+
+print(f"[{PID}] MP-OK", flush=True)

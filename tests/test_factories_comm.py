@@ -206,3 +206,51 @@ def test_comm_equality_and_repr(ht):
     assert comm == comm
     assert "Communication" in repr(comm) or "devices" in repr(comm)
     assert comm.is_distributed == (comm.size > 1)
+
+
+# ----------------------------------------------------- multi-host comm API
+
+
+def test_process_topology_single_controller(ht):
+    comm = ht.get_comm()
+    assert comm.process_count == 1
+    assert comm.process_rank == 0
+    assert comm.local_participants == list(range(comm.size))
+    assert len(comm.local_devices) == comm.size
+
+
+def test_process_chunk_covers_participants(ht):
+    comm = ht.get_comm()
+    # single process owns every participant: the process block is everything
+    off, lshape, slices = comm.process_chunk((13, 4), 0)
+    assert off == 0 and lshape == (13, 4)
+    off, lshape, _ = comm.process_chunk((13, 4), None)
+    assert off == 0 and lshape == (13, 4)
+    # a process that owns no participants gets an empty block
+    off, lshape, _ = comm.process_chunk((13, 4), 0, process=comm.process_count + 7)
+    assert lshape[0] == 0
+
+
+def test_parallel_init_single_host_noop(ht):
+    import heat_tpu
+
+    heat_tpu.parallel.init()  # no coordinator: single-controller no-op
+    assert heat_tpu.parallel.is_initialized()
+    a = heat_tpu.arange(5, split=0)
+    assert float(a.sum()) == 10.0
+
+
+def test_lazy_import_does_not_touch_backend():
+    # regression: importing heat_tpu must not initialize the XLA backend
+    # (jax.distributed.initialize would otherwise be impossible after import)
+    import subprocess, sys
+
+    code = (
+        "import heat_tpu\n"
+        "from jax._src import xla_bridge\n"
+        "raise SystemExit(1 if xla_bridge._backends else 0)\n"
+    )
+    import os
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS",)}
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0
