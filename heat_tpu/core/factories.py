@@ -168,10 +168,16 @@ def _ingest_process_chunks(data, axis: int, dtype, device, comm) -> DNDarray:
 
     nproc = jax.process_count()
     local = np.asarray(data)
-    if not comm.local_participants:
+    # Membership is globally known (the device list is the same on every
+    # process), so a partial comm is detected on ALL processes before the
+    # first collective — an asymmetric raise would leave the member
+    # processes hanging in the allgather below.
+    member_procs = {d.process_index for d in comm.devices}
+    if member_procs != set(range(nproc)):
         raise RuntimeError(
-            "calling process owns no devices in this communication; "
-            "is_split ingestion requires every process to be a member"
+            f"is_split ingestion requires every process to own devices in "
+            f"the communication; members are processes {sorted(member_procs)} "
+            f"of {nproc}"
         )
     # exchange chunk shapes; validate non-split dims agree (factories.py:406)
     shapes = multihost_utils.process_allgather(np.asarray(local.shape, dtype=np.int64))

@@ -83,13 +83,17 @@ class DataParallel:
         self._train_step = None
 
     # ------------------------------------------------------------------
+    def _forward_params(self):
+        """Parameter pytree used for inference (hook for subclasses)."""
+        return self.params
+
     def __call__(self, x):
         """Forward pass on a (batch-sharded) input (data_parallel.py:150)."""
         if self.params is None:
             raise RuntimeError("call init() or set_params() first")
         wrap = isinstance(x, DNDarray)
         xd = x._dense() if wrap else x
-        out = self._apply(self.params, xd)
+        out = self._apply(self._forward_params(), xd)
         if wrap:
             return DNDarray.from_dense(out, x.split, x.device, x.comm)
         return out
@@ -151,10 +155,109 @@ class DataParallel:
 
 class DataParallelMultiGPU(DataParallel):
     """Hierarchical DP (data_parallel.py:313): torch-DDP-intra-node + DASO
-    inter-node in the reference.  On TPU the hierarchy is a property of the
-    mesh (ICI within a slice, DCN across slices); this subclass exists for
-    API parity and to pair with :class:`heat_tpu.optim.DASO`, which manages
-    the skipped/delayed global synchronization."""
+    inter-node in the reference.
 
-    def __init__(self, module, comm: Optional[Communication] = None, optimizer: Any = None):
+    TPU-native topology: the batch is sharded over BOTH axes of a
+    :class:`~heat_tpu.parallel.HierarchicalCommunication` mesh — each node
+    gets a contiguous batch slab (axis 'global'), further sharded within the
+    node (axis 'node').  Parameters are per-node replicas (a stacked pytree,
+    leading node dim sharded over 'global', managed by
+    :class:`heat_tpu.optim.DASO`): the per-node gradient is a ``vmap`` over
+    the node dimension, inside which the mean-loss gradient psums over
+    'node' — the reference's intra-node DDP allreduce (:220).  Cross-node
+    averaging happens only when DASO decides to sync, as a bf16 all-reduce
+    over 'global' (the reference's ``_global_sync``, dp_optimizer.py:450).
+    """
+
+    def __init__(
+        self,
+        module,
+        comm: Optional[Communication] = None,
+        optimizer: Any = None,
+        daso: Optional["Any"] = None,
+    ):
+        from ..parallel.comm import HierarchicalCommunication
+        from ..optim.dp_optimizer import DASO
+
+        if daso is not None:
+            # DASO owns the hierarchy; a conflicting explicit comm would
+            # shard the batch on one mesh and sync params on another
+            if comm is not None and comm != daso.comm:
+                raise ValueError(
+                    "pass either comm or daso, not both: the DASO instance's "
+                    "communication defines the (node x local) grid"
+                )
+            if not daso.hierarchical:
+                raise ValueError(
+                    "DataParallelMultiGPU requires a DASO built on a "
+                    "HierarchicalCommunication (e.g. DASO(..., comm="
+                    "HierarchicalCommunication(grid=(n_node, per_node)))); "
+                    "a plain-comm DASO has no node axis to sync across"
+                )
+            comm = daso.comm
+        if not isinstance(comm, HierarchicalCommunication):
+            comm = HierarchicalCommunication(devices=comm.devices if comm else None)
         super().__init__(module, comm=comm, optimizer=optimizer)
+        if daso is None and optimizer is not None:
+            daso = DASO(local_optimizer=optimizer, total_epochs=1, comm=comm,
+                        warmup_epochs=0, cooldown_epochs=0)
+        self.daso = daso
+
+    # -- per-node replica parameter state ------------------------------
+    def set_params(self, params) -> None:
+        if self.daso is None or not self.daso.hierarchical:
+            super().set_params(params)
+            return
+        self.params = self.daso.replicate(params)
+        self._train_step = None
+
+    def _forward_params(self):
+        # inference runs on the node-0 replica (identical everywhere after
+        # a sync; representative between syncs)
+        if self.daso is not None and self.daso.hierarchical:
+            return jax.tree_util.tree_map(lambda p: p[0], self.params)
+        return self.params
+
+    def step(self, loss_fn: Callable, x, y) -> float:
+        """One hierarchical step: per-node grads (vmap over node replicas,
+        psum over 'node' inside) + DASO's skipped/delayed global sync."""
+        if self.daso is None or not self.daso.hierarchical:
+            return super().step(loss_fn, x, y)
+        comm = self.comm
+        n_node = comm.num_nodes
+        if self._train_step is None:
+            apply = self._apply
+
+            @jax.jit
+            def grad_step(stacked, xn, yn):
+                def node_loss(p, xi, yi):
+                    return loss_fn(apply(p, xi), yi)
+
+                losses, grads = jax.vmap(jax.value_and_grad(node_loss))(stacked, xn, yn)
+                return losses.mean(), grads
+
+            self._train_step = grad_step
+            self._batch_sharding = NamedSharding(
+                comm.mesh, P(comm.global_axis, comm.node_axis)
+            )
+
+        xd = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
+        yd = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)
+        b = xd.shape[0]
+        if b % n_node != 0:
+            raise ValueError(f"batch {b} not divisible by {n_node} nodes")
+        xn = xd.reshape((n_node, b // n_node) + xd.shape[1:])
+        yn = yd.reshape((n_node, b // n_node) + yd.shape[1:])
+        if (b // n_node) % comm.node_size == 0:
+            xn = jax.device_put(xn, self._batch_sharding)
+            yn = jax.device_put(yn, self._batch_sharding)
+        loss, grads = self._train_step(self.params, xn, yn)
+        self.params = self.daso.step(self.params, grads)
+        return float(loss)
+
+    def collect_params(self):
+        """One coherent (node-0) parameter pytree (after :meth:`DASO.last_batch`
+        the replicas are identical up to bf16 transport)."""
+        if self.daso is not None and self.daso.hierarchical:
+            return self.daso.collect(self.params)
+        return self.params

@@ -11,13 +11,20 @@
   with a custom MPI sum op on raw int16 buffers (:40); warmup / cycling /
   cooldown phases driven by loss-plateau detection (:354).
 
-TPU-native DASO: the hierarchy is a 2-axis mesh ('node' = ICI slice,
-'global' = DCN).  Node-local averaging is free (gradients of a mean loss
-over the node-sharded batch psum automatically).  The skipped global sync
-is an explicit bf16 parameter average jitted over the mesh; because JAX
-dispatch is asynchronous, the delayed application (``batches_to_wait``)
-falls out of simply not blocking on the result until k steps later — the
-same overlap the reference implements with Iallreduce + Wait bookkeeping.
+TPU-native DASO: the hierarchy is a 2-axis
+:class:`~heat_tpu.parallel.HierarchicalCommunication` mesh
+(axis 'node' = devices within a node, ICI; axis 'global' = across nodes,
+DCN).  Parameters are kept as a *stacked* pytree with a leading node
+dimension sharded over the 'global' axis — one live copy per node, exactly
+the reference's "each node's DDP group holds its own replica" state.
+Node-local averaging is free (gradients of a mean loss over the
+node-sharded batch psum over 'node' automatically).  The skipped global
+sync is a jitted bf16 mean over the node dimension — because that
+dimension is sharded over 'global', XLA lowers it to a genuine cross-node
+all-reduce riding DCN.  Because JAX dispatch is asynchronous, the delayed
+application (``batches_to_wait``) falls out of simply not blocking on the
+result until k steps later — the same overlap the reference implements
+with Iallreduce + Wait bookkeeping.
 """
 
 from __future__ import annotations
@@ -27,7 +34,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from ..parallel.comm import Communication, sanitize_comm
+from ..parallel.comm import Communication, HierarchicalCommunication, sanitize_comm
 from .utils import DetectMetricPlateau
 
 __all__ = ["DataParallelOptimizer", "DASO"]
@@ -111,15 +118,70 @@ class DASO:
         self.stability = DetectMetricPlateau(patience=2, threshold=stability_level)
         self.split_inds = None
 
-        # bf16 global parameter average, jitted once; jnp.mean over the
-        # replicated copies is the psum/size of the reference's
-        # mpi_sum_bfloat custom op (:40)
-        def _bf16_avg(params):
-            return jax.tree_util.tree_map(
-                lambda p: p.astype(self.downcast_type).astype(p.dtype), params
-            )
+        #: True when driving per-node parameter replicas on a 2-axis mesh —
+        #: the reference's real topology (dp_optimizer.py:64).  Plain comms
+        #: keep the flat single-group semantics (one replica, the bf16 cast
+        #: is the only observable transport effect).
+        self.hierarchical = isinstance(self.comm, HierarchicalCommunication)
 
-        self._bf16_roundtrip = jax.jit(_bf16_avg)
+        if self.hierarchical:
+            gshard = self.comm.node_sharding()
+            self._node_sharding = gshard
+            down = self.downcast_type
+
+            # Cross-node parameter average with bf16 transport: each leaf is
+            # stacked (n_node, ...) and sharded over 'global', so the mean
+            # over axis 0 lowers to an all-reduce over the 'global' mesh
+            # axis — DCN on a multi-slice pod.  This is the reference's
+            # mpi_sum_bfloat Allreduce + /= n (dp_optimizer.py:40,450).
+            def _global_avg(params):
+                def one(p):
+                    avg = jnp.mean(p.astype(down), axis=0).astype(p.dtype)
+                    out = jnp.broadcast_to(avg[None], p.shape)
+                    return jax.lax.with_sharding_constraint(out, gshard)
+
+                return jax.tree_util.tree_map(one, params)
+
+            self._bf16_roundtrip = jax.jit(_global_avg)
+        else:
+            # bf16 global parameter average, jitted once; jnp.mean over the
+            # replicated copies is the psum/size of the reference's
+            # mpi_sum_bfloat custom op (:40)
+            def _bf16_avg(params):
+                return jax.tree_util.tree_map(
+                    lambda p: p.astype(self.downcast_type).astype(p.dtype), params
+                )
+
+            self._bf16_roundtrip = jax.jit(_bf16_avg)
+
+    # ------------------------------------------------------------------
+    # per-node replica management (hierarchical mode only)
+    # ------------------------------------------------------------------
+    def replicate(self, params):
+        """Stack one parameter pytree into per-node replicas.
+
+        Each leaf gains a leading dimension of size ``num_nodes`` sharded
+        over the 'global' mesh axis: node i's replica lives on node i's
+        devices, the analog of the reference's per-DDP-group copies
+        (dp_optimizer.py:64).  All replicas start identical (the reference's
+        shared-seed init, nn/data_parallel.py:299)."""
+        if not self.hierarchical:
+            return params
+        n = self.comm.num_nodes
+        sh = self._node_sharding
+
+        def one(p):
+            p = jnp.asarray(p)
+            return jax.device_put(jnp.broadcast_to(p[None], (n,) + p.shape), sh)
+
+        return jax.tree_util.tree_map(one, params)
+
+    def collect(self, params):
+        """Extract one coherent parameter pytree from per-node replicas
+        (use after :meth:`last_batch`; replicas are then identical)."""
+        if not self.hierarchical:
+            return params
+        return jax.tree_util.tree_map(lambda p: p[0], params)
 
     # ------------------------------------------------------------------
     # phase control (dp_optimizer.py:354 epoch_loss_logic, :300 _prev_params)
@@ -165,9 +227,9 @@ class DASO:
 
         sync_now = self.global_skip == 0 or (self.batch % max(self.global_skip, 1) == 0)
         if sync_now:
-            # on a multi-slice mesh this is a DCN psum of bf16 parameter
-            # chunks; single-slice it reduces to the bf16 round-trip (the
-            # transport quantization is the observable semantic)
+            # hierarchical: a cross-node all-reduce of bf16 replicas over
+            # the 'global' mesh axis (DCN); plain comm: the bf16 round-trip
+            # (the transport quantization is the observable semantic)
             avg = self._bf16_roundtrip(params)
             if self.batches_to_wait == 0:
                 params = avg

@@ -2,6 +2,7 @@
 
 from .comm import (
     Communication,
+    HierarchicalCommunication,
     WORLD,
     SELF,
     get_comm,
@@ -14,6 +15,7 @@ from .comm import (
 
 __all__ = [
     "Communication",
+    "HierarchicalCommunication",
     "WORLD",
     "SELF",
     "get_comm",

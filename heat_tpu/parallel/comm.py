@@ -49,6 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "Communication",
+    "HierarchicalCommunication",
     "WORLD",
     "SELF",
     "get_comm",
@@ -62,6 +63,11 @@ __all__ = [
 #: Name of the mesh axis used for the (single) split dimension, mirroring the
 #: reference's one-split-axis model (SURVEY.md L2).
 SPLIT_AXIS_NAME = "split"
+
+#: Axis names of the hierarchical (node x local) mesh: 'global' spans nodes
+#: (DCN in a multi-slice pod), 'node' spans the devices within one node (ICI).
+GLOBAL_AXIS_NAME = "global"
+NODE_AXIS_NAME = "node"
 
 
 class Communication:
@@ -88,15 +94,17 @@ class Communication:
         self.axis_name = axis_name
         self._resolved: Optional[Tuple[List, Mesh]] = None
 
+    def _resolve_devices(self) -> List:
+        spec = self._devices_spec
+        if spec is None:
+            return list(jax.devices())
+        if callable(spec):
+            return list(spec())
+        return list(spec)
+
     def _ensure(self) -> Tuple[List, Mesh]:
         if self._resolved is None:
-            spec = self._devices_spec
-            if spec is None:
-                devs = list(jax.devices())
-            elif callable(spec):
-                devs = list(spec())
-            else:
-                devs = list(spec)
+            devs = self._resolve_devices()
             mesh = Mesh(np.asarray(devs, dtype=object), (self.axis_name,))
             self._resolved = (devs, mesh)
         return self._resolved
@@ -247,6 +255,13 @@ class Communication:
             return 0, shape, tuple(slice(0, s) for s in shape)
         process = jax.process_index() if process is None else process
         parts = [i for i, d in enumerate(self._devices) if d.process_index == process]
+        if parts and parts != list(range(parts[0], parts[-1] + 1)):
+            raise NotImplementedError(
+                "process_chunk requires each process's devices to occupy a "
+                "contiguous run of participant indices (see "
+                "process_blocks_contiguous); interleaved sub-meshes are not "
+                "supported"
+            )
         if not parts:
             lshape = shape[:split] + (0,) + shape[split + 1 :]
             return 0, lshape, tuple(
@@ -343,6 +358,110 @@ class Communication:
         return jax.lax.axis_index(axis_name or self.axis_name)
 
 
+class HierarchicalCommunication(Communication):
+    """A 2-axis (n_node, per_node) device grid for hierarchical parallelism.
+
+    The analog of the reference DASO's two-level communicator pair
+    (``heat/optim/dp_optimizer.py:64``: torch-DDP process groups within a
+    node + an MPI world across nodes, ``:450`` ``_global_sync``).  Here the
+    hierarchy is a property of the mesh: axis ``'global'`` (size
+    ``n_node``) spans nodes and rides DCN on a multi-slice pod; axis
+    ``'node'`` (size ``per_node``) spans the devices within one node and
+    rides ICI.  A collective over ``'node'`` is the reference's node-local
+    DDP allreduce; a collective over ``'global'`` is the reference's
+    cross-node MPI averaging.
+
+    Used as a drop-in :class:`Communication` for ordinary split arrays, the
+    split dimension shards over BOTH axes (the flattened participant
+    order), so every factory/op works unchanged on a hierarchical comm.
+    """
+
+    def __init__(
+        self,
+        grid: Optional[Tuple[int, int]] = None,
+        devices: Optional[Sequence] = None,
+        axis_names: Tuple[str, str] = (GLOBAL_AXIS_NAME, NODE_AXIS_NAME),
+    ):
+        self._grid_spec = grid
+        self._axis_names = tuple(axis_names)
+        # axis_name is the tuple of both axes: PartitionSpec and
+        # psum/all_gather accept axis-name tuples, so the base class's
+        # sharding()/collectives shard/reduce over the flattened grid.
+        super().__init__(devices=devices, axis_name=self._axis_names)
+
+    def _ensure(self) -> Tuple[List, Mesh]:
+        if self._resolved is None:
+            devs = self._resolve_devices()
+            grid = self._grid_spec
+            if grid is None:
+                # infer one 'node' per host process (the reference's
+                # node==host assumption); single host degenerates to (1, n)
+                nproc = len({d.process_index for d in devs})
+                if nproc > 1 and len(devs) % nproc == 0:
+                    grid = (nproc, len(devs) // nproc)
+                else:
+                    grid = (1, len(devs))
+            n_node, per_node = int(grid[0]), int(grid[1])
+            if n_node * per_node != len(devs):
+                raise ValueError(
+                    f"grid {grid} does not tile {len(devs)} devices"
+                )
+            arr = np.asarray(devs, dtype=object).reshape(n_node, per_node)
+            mesh = Mesh(arr, self._axis_names)
+            self._resolved = (devs, mesh)
+        return self._resolved
+
+    # -- hierarchy topology --------------------------------------------
+    @property
+    def global_axis(self) -> str:
+        """Mesh axis spanning nodes (DCN)."""
+        return self._axis_names[0]
+
+    @property
+    def node_axis(self) -> str:
+        """Mesh axis spanning a node's devices (ICI)."""
+        return self._axis_names[1]
+
+    @property
+    def num_nodes(self) -> int:
+        return self._mesh.shape[self._axis_names[0]]
+
+    @property
+    def node_size(self) -> int:
+        return self._mesh.shape[self._axis_names[1]]
+
+    def node_sharding(self) -> NamedSharding:
+        """Sharding for per-node stacked pytrees: leading dim = node index,
+        sharded over 'global'; everything else replicated."""
+        return NamedSharding(self._mesh, PartitionSpec(self.global_axis))
+
+    def split(self, color_ranks: Sequence[int], axis_name: Optional[str] = None) -> Communication:
+        """Sub-communication over a device subset.  A subset of a grid is
+        not itself a grid, so the result is a flat 1-D Communication (the
+        reference's Split likewise returns a plain communicator)."""
+        devs = [self._devices[i] for i in color_ranks]
+        return Communication(devs, axis_name or SPLIT_AXIS_NAME)
+
+    def __eq__(self, other) -> bool:
+        # same devices in a different (n_node, per_node) layout is a
+        # DIFFERENT topology: collectives over 'node'/'global' change
+        return (
+            isinstance(other, HierarchicalCommunication)
+            and super().__eq__(other)
+            and (self.num_nodes, self.node_size) == (other.num_nodes, other.node_size)
+        )
+
+    def __hash__(self) -> int:
+        return super().__hash__()
+
+    def __repr__(self) -> str:
+        plat = self._devices[0].platform if self._devices else "?"
+        return (
+            f"HierarchicalCommunication(nodes={self.num_nodes}, "
+            f"per_node={self.node_size}, platform={plat!r})"
+        )
+
+
 # ----------------------------------------------------------------------
 # multi-process bootstrap, the analog of the reference's implicit MPI_Init
 # (importing heat initializes MPI via mpi4py; here the runtime is explicit:
@@ -371,16 +490,38 @@ def init(
     multi-host run unchanged in single-controller mode.
     """
     global _initialized
-    if coordinator_address is None and num_processes is None and process_id is None and not kwargs:
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+        and local_device_ids is None
+        and not kwargs
+    ):
         # Zero-arg bootstrap: let jax auto-detect a cluster environment
         # (SLURM, Open MPI, Cloud TPU pod).  On a plain single host there is
-        # nothing to detect — initialize() raises and this becomes a no-op,
-        # so single-host programs need no special-casing.
+        # nothing to detect — initialize() raises the "could not detect"
+        # error and this becomes a no-op, so single-host programs need no
+        # special-casing.  A detected-but-unreachable cluster (bad
+        # coordinator port, network failure) must fail LOUDLY — silently
+        # degrading to independent single-process worlds would make every
+        # collective return per-host partial results.
         try:
             jax.distributed.initialize()
-        except Exception:
-            _initialized = True
-            return
+        except (ValueError, RuntimeError) as e:
+            msg = str(e).lower()
+            # no cluster detected (plain single host): harmless no-op
+            no_cluster = "coordinator" in msg and (
+                "defined" in msg or "detect" in msg or "none" in msg or "specif" in msg
+            )
+            # backend already up on a lone host: a defensive init() call
+            # after array work — also harmless.  On a real multi-process
+            # run either failure must propagate: silently degrading to
+            # independent single-process worlds corrupts every collective.
+            late_single_host = "before any jax" in msg and jax.process_count() == 1
+            if no_cluster or late_single_host:
+                _initialized = True
+                return
+            raise
         _initialized = True
         _reset_defaults()
         return
