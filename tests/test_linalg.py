@@ -62,6 +62,44 @@ def test_tsqr_uses_shard_map():
     np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("p_dev", [3, 8])
+def test_qr_ragged_sweep(p_dev):
+    """Uneven extents on 3- and 8-device meshes never fall back to the
+    gathering global path (reference qr.py:64 TS-QR + :220 block-GS)."""
+    import importlib
+
+    import jax
+    from heat_tpu.parallel import Communication
+
+    qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
+
+    comm = Communication(jax.devices()[:p_dev])
+    rng = np.random.default_rng(21)
+    tsqr_before = qr_mod._tsqr_fn.cache_info().misses
+    bgs_before = qr_mod._bgs_fn.cache_info().misses
+    for (m, n) in [(37, 5), (13, 4), (23, 23), (50, 13)]:
+        for split in (0, 1):
+            x = rng.standard_normal((m, n))
+            A = ht.array(x, split=split, comm=comm)
+            q, r = ht.qr(A)
+            assert q.split == split and r.split == (None if split == 0 else 1)
+            np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-10)
+            np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(n), atol=1e-10)
+            np.testing.assert_allclose(np.tril(r.numpy(), -1), 0.0, atol=1e-10)
+    # both distributed kernels were exercised (no silent global fallback)
+    assert qr_mod._tsqr_fn.cache_info().misses > tsqr_before
+    assert qr_mod._bgs_fn.cache_info().misses > bgs_before
+
+
+def test_qr_split1_wide_falls_back():
+    # wide (m < n) split=1 goes through the dense path but stays correct
+    rng = np.random.default_rng(22)
+    x = rng.standard_normal((6, 20))
+    A = ht.array(x, split=1)
+    q, r = ht.qr(A)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-10)
+
+
 @pytest.mark.parametrize("split", SPLITS)
 def test_svd(split):
     rng = np.random.default_rng(15)

@@ -196,8 +196,13 @@ def test_printing_modes(capsys):
     ht.print0("hello")
     out = capsys.readouterr().out
     assert "hello" in out
-    ht.set_printoptions(precision=2)
-    b = ht.array([1.23456789])
-    s = str(b)
-    assert "1.23456789" not in s
-    ht.set_printoptions(precision=8)
+    orig = ht.get_printoptions()["precision"]
+    try:
+        ht.set_printoptions(precision=2)
+        b = ht.array([1.23456789])
+        s = str(b)
+        assert "1.23456789" not in s
+        ht.set_printoptions(precision=8)
+        assert "1.2345679" in str(b)
+    finally:
+        ht.set_printoptions(precision=orig)
