@@ -53,10 +53,12 @@ def _ref(*xs):
 
 
 def _wrap(result, *operands):
+    from ..napi import _auto_split
+
     ref = _ref(*operands)
     if ref is None:
         return DNDarray.from_dense(result, None, None, None)
-    return DNDarray.from_dense(result, None, ref.device, ref.comm)
+    return DNDarray.from_dense(result, _auto_split(result, ref), ref.device, ref.comm)
 
 
 def _on_cpu(fn, *arrays):
@@ -99,10 +101,14 @@ def eigvals(a):
 
 
 def lstsq(a, b, rcond=None):
-    """Least-squares solve; returns (x, residuals, rank, singular values)."""
+    """Least-squares solve; returns (x, residuals, rank, singular values).
+
+    ``rank`` is a lazy 0-d array — no host sync is forced inside the call
+    (one full link round-trip on a tunneled chip); use ``int(rank)`` to
+    materialize it."""
     x, resid, rank, sv = jnp.linalg.lstsq(_d(a), _d(b), rcond=rcond)
     ref = _ref(a, b)
-    return (_wrap(x, ref), _wrap(resid, ref), int(rank), _wrap(sv, ref))
+    return (_wrap(x, ref), _wrap(resid, ref), _wrap(rank, ref), _wrap(sv, ref))
 
 
 def matrix_power(a, n: int):
@@ -110,7 +116,9 @@ def matrix_power(a, n: int):
 
 
 def matrix_rank(a, tol=None):
-    return int(jnp.linalg.matrix_rank(_d(a), rtol=None if tol is None else tol))
+    """Matrix rank as a lazy 0-d array (no forced host sync; ``int()`` it
+    to materialize)."""
+    return _wrap(jnp.linalg.matrix_rank(_d(a), rtol=None if tol is None else tol), a)
 
 
 def multi_dot(arrays):

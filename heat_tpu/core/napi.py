@@ -3,8 +3,12 @@
 The reference's coverage_tables.md stops at 185 NumPy functions; everything
 here widens the surface further so a NumPy user finds what they expect.
 All functions follow the library's standard recipe: operate on the dense
-global view (XLA/GSPMD distributes), wrap results with a conservative
-split (preserved when the shape survives, replicated otherwise).
+global view (XLA/GSPMD distributes), wrap results with a
+distribution-preserving split: preserved when the shape (or the split
+axis's extent) survives, re-split along the largest axis for large
+grown/stacked outputs (kron, tensordot, histogram2d), replicated only for
+small results.  Mirrors the reference ops layer's keep-it-distributed
+behavior (heat/core/_operations.py:22-229).
 """
 
 from __future__ import annotations
@@ -125,14 +129,43 @@ def _pick(*xs):
     return r if r is not None else xs[0]
 
 
+def _auto_split(result, ref) -> Optional[int]:
+    """Distribution-preserving output split for a dense result derived from
+    a split operand.
+
+    Policy (the ops-layer behavior of the reference,
+    heat/core/_operations.py:22-229, expressed as a placement rule):
+
+    1. same shape -> same split (elementwise / shape-preserving);
+    2. the split axis's extent survived at the same position -> same split
+       (batch-style ops that reshape other dims);
+    3. large grown/stacked outputs (kron, tensordot, histogram2d, outer)
+       -> split along the largest axis, provided that axis has at least one
+       row per device — the result stays distributed instead of being
+       silently replicated on every device;
+    4. small results -> replicated.
+    """
+    if ref.split is None:
+        return None
+    if result.shape == ref.shape:
+        return ref.split
+    if ref.split < result.ndim and result.shape[ref.split] == ref.shape[ref.split]:
+        return ref.split
+    if result.ndim:
+        axis = int(np.argmax(result.shape))
+        if result.shape[axis] >= ref.comm.size:
+            return axis
+    return None
+
+
 def _wrap(result, *operands, split="auto"):
-    """Wrap a dense result; split is preserved when any DNDarray operand
-    has the same shape, else replicated."""
+    """Wrap a dense result with a distribution-preserving split (see
+    :func:`_auto_split`); pass ``split=`` explicitly to override."""
     ref = _ref(*operands)
     if ref is None:
         return DNDarray.from_dense(result, None, None, None)
     if split == "auto":
-        split = ref.split if (ref.split is not None and result.shape == ref.shape) else None
+        split = _auto_split(result, ref)
     return DNDarray.from_dense(result, split, ref.device, ref.comm)
 
 
@@ -165,7 +198,7 @@ def searchsorted(a, v, side: str = "left", sorter=None):
     ad = _d(a)
     if sorter is not None:
         ad = jnp.take(ad, _d(sorter))
-    return _wrap(jnp.searchsorted(ad, _d(v), side=side), _pick(v, a), split=None)
+    return _wrap(jnp.searchsorted(ad, _d(v), side=side), _pick(v, a))
 
 
 def sort_complex(a):
@@ -174,7 +207,7 @@ def sort_complex(a):
     if not jnp.issubdtype(ad.dtype, jnp.complexfloating):
         ad = ad.astype(jnp.complex64)
     order = jnp.lexsort((jnp.imag(ad), jnp.real(ad)))
-    return _wrap(jnp.take(ad, order), a, split=None)
+    return _wrap(jnp.take(ad, order), a)
 
 
 # ------------------------------------------------------------- nan family
@@ -187,7 +220,7 @@ def _nan_reduce(fn, a, axis=None, keepdims=False, ddof=None):
     d = _d(a)
     if not types.heat_type_is_inexact(a.dtype) if isinstance(a, DNDarray) else not jnp.issubdtype(d.dtype, jnp.inexact):
         d = d.astype(jnp.float32)
-    return _wrap(fn(d, **kwargs), a, split=None)
+    return _wrap(fn(d, **kwargs), a)
 
 
 def nanmax(a, axis=None, keepdims=False):
@@ -215,25 +248,25 @@ def nanvar(a, axis=None, ddof: int = 0, keepdims=False):
 
 
 def nanargmax(a, axis=None):
-    return _wrap(jnp.nanargmax(_d(a), axis=axis), a, split=None)
+    return _wrap(jnp.nanargmax(_d(a), axis=axis), a)
 
 
 def nanargmin(a, axis=None):
-    return _wrap(jnp.nanargmin(_d(a), axis=axis), a, split=None)
+    return _wrap(jnp.nanargmin(_d(a), axis=axis), a)
 
 
 def quantile(a, q, axis=None, interpolation: str = "linear", keepdims=False):
     d = _d(a)
     if not jnp.issubdtype(d.dtype, jnp.inexact):
         d = d.astype(jnp.float32)
-    return _wrap(jnp.quantile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims), a, split=None)
+    return _wrap(jnp.quantile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims), a)
 
 
 def nanquantile(a, q, axis=None, interpolation: str = "linear", keepdims=False):
     d = _d(a)
     if not jnp.issubdtype(d.dtype, jnp.inexact):
         d = d.astype(jnp.float32)
-    return _wrap(jnp.nanquantile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims), a, split=None)
+    return _wrap(jnp.nanquantile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims), a)
 
 
 def nanpercentile(a, q, axis=None, interpolation: str = "linear", keepdims=False):
@@ -243,7 +276,6 @@ def nanpercentile(a, q, axis=None, interpolation: str = "linear", keepdims=False
     return _wrap(
         jnp.nanpercentile(d, jnp.asarray(q, d.dtype), axis=axis, method=interpolation, keepdims=keepdims),
         a,
-        split=None,
     )
 
 
@@ -252,7 +284,7 @@ def nanpercentile(a, q, axis=None, interpolation: str = "linear", keepdims=False
 
 def ptp(a, axis=None, keepdims=False):
     """Peak-to-peak (max - min)."""
-    return _wrap(jnp.ptp(_d(a), axis=axis, keepdims=keepdims), a, split=None)
+    return _wrap(jnp.ptp(_d(a), axis=axis, keepdims=keepdims), a)
 
 
 def corrcoef(x, y=None, rowvar: bool = True):
@@ -262,83 +294,82 @@ def corrcoef(x, y=None, rowvar: bool = True):
     yd = None if y is None else _d(y)
     if yd is not None and not jnp.issubdtype(yd.dtype, jnp.inexact):
         yd = yd.astype(jnp.float32)
-    return _wrap(jnp.corrcoef(xd, yd, rowvar=rowvar), x, split=None)
+    return _wrap(jnp.corrcoef(xd, yd, rowvar=rowvar), x)
 
 
 def histogram2d(x, y, bins=10, range=None, density=None, weights=None):
     h, xe, ye = jnp.histogram2d(_d(x), _d(y), bins=bins, range=range, density=density, weights=None if weights is None else _d(weights))
-    return _wrap(h, x, split=None), _wrap(xe, x, split=None), _wrap(ye, x, split=None)
+    return _wrap(h, x), _wrap(xe, x), _wrap(ye, x)
 
 
 def histogramdd(sample, bins=10, range=None, density=None, weights=None):
     h, edges = jnp.histogramdd(_d(sample), bins=bins, range=range, density=density, weights=None if weights is None else _d(weights))
-    return _wrap(h, sample, split=None), [_wrap(e, sample, split=None) for e in edges]
+    return _wrap(h, sample), [_wrap(e, sample) for e in edges]
 
 
 def histogram_bin_edges(a, bins=10, range=None, weights=None):
-    return _wrap(jnp.histogram_bin_edges(_d(a), bins=bins, range=range, weights=weights), a, split=None)
+    return _wrap(jnp.histogram_bin_edges(_d(a), bins=bins, range=range, weights=weights), a)
 
 
 def count_nonzero(a, axis=None, keepdims=False):
-    return _wrap(jnp.count_nonzero(_d(a), axis=axis, keepdims=keepdims), a, split=None)
+    return _wrap(jnp.count_nonzero(_d(a), axis=axis, keepdims=keepdims), a)
 
 
 # ------------------------------------------------------------ manipulations
 
 
 def append(arr, values, axis=None):
-    return _wrap(jnp.append(_d(arr), _d(values), axis=axis), _pick(arr, values), split=None)
+    return _wrap(jnp.append(_d(arr), _d(values), axis=axis), _pick(arr, values))
 
 
 def delete(arr, obj, axis=None):
-    return _wrap(jnp.delete(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), axis=axis), arr, split=None)
+    return _wrap(jnp.delete(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), axis=axis), arr)
 
 
 def insert(arr, obj, values, axis=None):
     return _wrap(
         jnp.insert(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), _d(values), axis=axis),
         arr,
-        split=None,
     )
 
 
 def resize(a, new_shape):
-    return _wrap(jnp.resize(_d(a), new_shape), a, split=None)
+    return _wrap(jnp.resize(_d(a), new_shape), a)
 
 
 def rollaxis(a, axis: int, start: int = 0):
-    return _wrap(jnp.rollaxis(_d(a), axis, start), a, split=None)
+    return _wrap(jnp.rollaxis(_d(a), axis, start), a)
 
 
 def trim_zeros(filt, trim: str = "fb"):
     # data-dependent output shape: host-side trim (eager semantics)
     arr = np.asarray(filt.numpy() if isinstance(filt, DNDarray) else filt)
     trimmed = np.trim_zeros(arr, trim)
-    return _wrap(jnp.asarray(trimmed), filt, split=None)
+    return _wrap(jnp.asarray(trimmed), filt)
 
 
 def array_split(ary, indices_or_sections, axis: int = 0):
     parts = jnp.array_split(_d(ary), indices_or_sections, axis=axis)
     ref = _ref(ary)
-    return [_wrap(p, ary, split=None) for p in parts]
+    return [_wrap(p, ary) for p in parts]
 
 
 def dstack(tup):
-    return _wrap(jnp.dstack([_d(t) for t in tup]), *list(tup), split=None)
+    return _wrap(jnp.dstack([_d(t) for t in tup]), *list(tup))
 
 
 def atleast_1d(*arys):
-    out = [_wrap(jnp.atleast_1d(_d(a)), a, split=None) for a in arys]
+    out = [_wrap(jnp.atleast_1d(_d(a)), a) for a in arys]
     return out[0] if len(out) == 1 else out
 
 
 def atleast_2d(*arys):
-    out = [_wrap(jnp.atleast_2d(_d(a)), a, split=None) for a in arys]
+    out = [_wrap(jnp.atleast_2d(_d(a)), a) for a in arys]
     return out[0] if len(out) == 1 else out
 
 
 def atleast_3d(*arys):
-    out = [_wrap(jnp.atleast_3d(_d(a)), a, split=None) for a in arys]
+    out = [_wrap(jnp.atleast_3d(_d(a)), a) for a in arys]
     return out[0] if len(out) == 1 else out
 
 
@@ -356,15 +387,15 @@ def copyto(dst, src, where=True):
 
 
 def argwhere(a):
-    return _wrap(jnp.argwhere(_d(a)), a, split=None)
+    return _wrap(jnp.argwhere(_d(a)), a)
 
 
 def flatnonzero(a):
-    return _wrap(jnp.flatnonzero(_d(a)), a, split=None)
+    return _wrap(jnp.flatnonzero(_d(a)), a)
 
 
 def extract(condition, arr):
-    return _wrap(jnp.extract(_d(condition), _d(arr)), _pick(arr, condition), split=None)
+    return _wrap(jnp.extract(_d(condition), _d(arr)), _pick(arr, condition))
 
 
 # --------------------------------------------------------------- predicates
@@ -402,15 +433,15 @@ def fmin(x1, x2):
 
 
 def inner(a, b):
-    return _wrap(jnp.inner(_d(a), _d(b)), _pick(a, b), split=None)
+    return _wrap(jnp.inner(_d(a), _d(b)), _pick(a, b))
 
 
 def tensordot(a, b, axes=2):
-    return _wrap(jnp.tensordot(_d(a), _d(b), axes=axes), _pick(a, b), split=None)
+    return _wrap(jnp.tensordot(_d(a), _d(b), axes=axes), _pick(a, b))
 
 
 def kron(a, b):
-    return _wrap(jnp.kron(_d(a), _d(b)), _pick(a, b), split=None)
+    return _wrap(jnp.kron(_d(a), _d(b)), _pick(a, b))
 
 
 # ---------------------------------------------------------------- factories
@@ -422,7 +453,7 @@ def tri(N: int, M: Optional[int] = None, k: int = 0, dtype=None, split=None, dev
 
 
 def vander(x, N: Optional[int] = None, increasing: bool = False):
-    return _wrap(jnp.vander(_d(x), N=N, increasing=increasing), x, split=None)
+    return _wrap(jnp.vander(_d(x), N=N, increasing=increasing), x)
 
 
 def einsum(subscripts: str, *operands, precision=None):
@@ -430,7 +461,7 @@ def einsum(subscripts: str, *operands, precision=None):
     the collective-matmul path the reference hand-writes per case)."""
     dense_ops = [_d(o) for o in operands]
     out = jnp.einsum(subscripts, *dense_ops, precision=precision)
-    return _wrap(out, *list(operands), split=None)
+    return _wrap(out, *list(operands))
 
 
 def array_equal(a1, a2) -> bool:
@@ -464,12 +495,12 @@ def amin(a, axis=None, keepdims=False):
 
 def diagflat(v, k: int = 0):
     """2-D array with the flattened input on the k-th diagonal."""
-    return _wrap(jnp.diagflat(_d(v), k=k), v, split=None)
+    return _wrap(jnp.diagflat(_d(v), k=k), v)
 
 
 def correlate(a, v, mode: str = "valid"):
     """1-D cross-correlation (np.correlate semantics)."""
-    return _wrap(jnp.correlate(_d(a), _d(v), mode=mode), _pick(a, v), split=None)
+    return _wrap(jnp.correlate(_d(a), _d(v), mode=mode), _pick(a, v))
 
 
 def block(arrays):
@@ -490,15 +521,15 @@ def block(arrays):
 
     ref = first(arrays)
     out = jnp.block(conv(arrays))
-    return _wrap(out, *( [ref] if ref is not None else [] ), split=None)
+    return _wrap(out, *( [ref] if ref is not None else [] ))
 
 
 def packbits(a, axis=None, bitorder: str = "big"):
-    return _wrap(jnp.packbits(_d(a), axis=axis, bitorder=bitorder), a, split=None)
+    return _wrap(jnp.packbits(_d(a), axis=axis, bitorder=bitorder), a)
 
 
 def unpackbits(a, axis=None, count=None, bitorder: str = "big"):
-    return _wrap(jnp.unpackbits(_d(a), axis=axis, count=count, bitorder=bitorder), a, split=None)
+    return _wrap(jnp.unpackbits(_d(a), axis=axis, count=count, bitorder=bitorder), a)
 
 
 def base_repr(number: int, base: int = 2, padding: int = 0) -> str:
