@@ -40,6 +40,7 @@ __all__ = [
     "save",
     "save_csv",
     "save_hdf5",
+    "save_npy_from_path",
     "savetxt",
     "savez",
     "savez_compressed",
@@ -183,17 +184,88 @@ def load_hdf5(
         return DNDarray(global_arr, gshape, dtype, split, device, comm)
 
 
+def _iter_shard_slabs(data: DNDarray):
+    """Yield ``(offset, block)`` pairs of this process's true (unpadded)
+    device-shard slabs along the split axis, in offset order.
+
+    The streaming primitive behind the sharded writers: each block is one
+    device shard pulled to the host on its own, so the full global array is
+    never materialized — for a 200 GB array the peak host footprint is one
+    shard.  The analog of the reference's per-rank slab writes
+    (io.py:597-680 serialized rank writes / mpio slabs)."""
+    split = data.split
+    arr = data.larray_padded
+    if split is None:
+        yield 0, np.asarray(arr)
+        return
+    extent = data.shape[split]
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: s.index[split].start or 0
+    )
+    for shard in shards:
+        sl = shard.index[split]
+        start = sl.start or 0
+        if start >= extent:
+            continue  # shard is pure canonical padding
+        block = np.asarray(shard.data)
+        true_rows = min(start + block.shape[split], extent) - start
+        if true_rows < block.shape[split]:
+            cut = tuple(
+                slice(0, true_rows) if d == split else slice(None)
+                for d in range(block.ndim)
+            )
+            block = block[cut]
+        yield start, block
+
+
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-    """Write a DNDarray to HDF5 (io.py:597).  The gathered global array is
-    written once (rank-0-write analog; parallel-HDF5 is not available
-    without MPI-IO)."""
+    """Write a DNDarray to HDF5, streaming shard-by-shard (io.py:597).
+
+    The dataset is created at the global shape and each device shard's true
+    rows are written as a hyperslab — the global array is never gathered
+    (the TPU-native analog of the reference's mpio / serialized rank
+    writes).  Multi-host: processes take turns appending their slabs (HDF5
+    without MPI-IO cannot write one file concurrently), synchronized via a
+    global device barrier."""
     if not __HDF5:
         raise RuntimeError("h5py is not available")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
-    if jax.process_index() == 0:
+    np_dtype = np.dtype(data.dtype.jax_type())
+
+    def write_slabs(handle, create: bool):
+        if create:
+            dset = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
+        else:  # pragma: no cover - multi-host only
+            dset = handle[dataset]
+        split = data.split
+        for start, block in _iter_shard_slabs(data):
+            if split is None:
+                dset[...] = block
+            else:
+                key = tuple(
+                    slice(start, start + block.shape[d]) if d == split else slice(None)
+                    for d in range(block.ndim)
+                )
+                dset[key] = block
+
+    nproc = jax.process_count()
+    if nproc == 1:
         with h5py.File(path, mode) as handle:
-            handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+            write_slabs(handle, create=True)
+        return
+    # multi-host: serialized turns (reference io.py:648 rank-serialized path)
+    from jax.experimental import multihost_utils  # pragma: no cover - multi-host only
+
+    for turn in range(nproc):  # pragma: no cover - multi-host only
+        if jax.process_index() == turn:
+            if turn == 0:
+                with h5py.File(path, mode) as handle:
+                    write_slabs(handle, create=True)
+            elif data.split is not None:
+                with h5py.File(path, "a") as handle:
+                    write_slabs(handle, create=False)
+        multihost_utils.sync_global_devices(f"save_hdf5:{path}:{turn}")
 
 
 # ----------------------------------------------------------------------
@@ -327,6 +399,28 @@ def load_npy_from_path(
     return DNDarray.from_dense(
         jax.numpy.asarray(data), sanitize_axis(data.shape, split), sanitize_device(device), sanitize_comm(comm)
     )
+
+
+def save_npy_from_path(data: DNDarray, path: str) -> None:
+    """Write a DNDarray as a directory of per-shard ``.npy`` slab files.
+
+    The sharded counterpart of ``np.save`` and the round-trip partner of
+    :func:`load_npy_from_path` (reference io.py:1145): each device shard's
+    true rows stream to ``path/part_<offset>.npy`` one at a time, so the
+    global array is never materialized on any host.  Offsets are
+    zero-padded so a lexicographic listing is offset order.  Multi-host:
+    every process writes only its own shards — fully parallel, no
+    coordination needed (distinct files).
+    """
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    os.makedirs(path, exist_ok=True)
+    if data.split is None:
+        if jax.process_index() == 0:
+            np.save(os.path.join(path, "part_000000000000.npy"), np.asarray(data.larray_padded))
+        return
+    for start, block in _iter_shard_slabs(data):
+        np.save(os.path.join(path, f"part_{start:012d}.npy"), block)
 
 
 # ----------------------------------------------------------------------
