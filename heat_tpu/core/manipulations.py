@@ -344,6 +344,19 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     reference.
     """
     axis = sanitize_axis(a.shape, axis)
+
+    from .sample_sort import sample_sort_1d, supports_sample_sort
+
+    if supports_sample_sort(a, axis, descending):
+        res_v, res_i = sample_sort_1d(a)
+        if out is not None:
+            from .sanitation import sanitize_out
+
+            sanitize_out(out, res_v.shape, res_v.split, res_v.device)
+            out._replace(res_v.astype(out.dtype).larray_padded)
+            return out, res_i
+        return res_v, res_i
+
     dense = a._dense()
     idx = jnp.argsort(dense, axis=axis, descending=descending, stable=True)
     values = jnp.take_along_axis(dense, idx, axis=axis)

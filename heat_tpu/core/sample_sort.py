@@ -1,0 +1,180 @@
+"""Distributed sample-sort (PSRS) as a shard_map collective program.
+
+The analog of the reference's parallel sample-sort behind ``ht.sort``
+(heat/core/manipulations.py:2497-2750: local sort -> gathered pivots ->
+Alltoallv exchange -> local merge).  The TPU-native formulation keeps every
+buffer statically shaped:
+
+1.  **Pack**: each element becomes one uint64 key
+    ``(order_bits(value) << 32) | global_index``.  ``order_bits`` maps the
+    value to a uint32 whose unsigned order equals the value order
+    (sign-flip trick for floats, offset for ints), and the global index
+    makes every key DISTINCT — ties are broken exactly like a stable sort,
+    and the classic PSRS bucket bound (no bucket exceeds 2·B for distinct
+    keys, Shi & Schaeffer 1992) holds unconditionally, even for
+    all-equal inputs.  Canonical padding positions get the max-uint64
+    sentinel, which sorts strictly after every real key.
+2.  **Local sort** of the packed keys (one radix/comparison sort of B).
+3.  **Pivots**: p regular samples per shard, one all_gather of p*p keys,
+    replicated sort, p-1 regular pivots.
+4.  **Bucket exchange**: each element's bucket is found by searchsorted
+    against the pivots; elements scatter into a (p, B) send buffer (bucket
+    b's run goes to row b) and one ``all_to_all`` routes row b to shard b.
+5.  **Local merge**: the 2·B bound lets ``top_k`` on the order-reversed
+    keys (bitwise NOT) extract *all* real keys of the bucket, already
+    sorted — no full p·B re-sort.
+6.  **Rebalance**: bucket sizes are exchanged (all_gather of p counts),
+    every key's exact global rank is its bucket offset + local position,
+    and a second ``all_to_all`` routes each key to the canonical owner of
+    its rank (device rank//B, column rank%B).  A column-wise min folds the
+    received (p, B) buffer to the final (B,) block — exactly one source
+    holds a real key per column.
+7.  **Unpack** values and original indices from the final keys.
+
+Total traffic: two all_to_alls of p·B keys + two small all_gathers,
+against the gather path's full replication of the array on every device;
+every local sort is B or 2B elements instead of the global N.
+
+Caveats (documented, the gather path remains the fallback): 1-D along the
+split axis, ascending, float32/int32/int64-packable dtypes, global size
+< 2^32.  NaNs follow the total order of their bit pattern rather than
+numpy's NaN-last convention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["sample_sort_1d", "supports_sample_sort", "SAMPLE_SORT_THRESHOLD"]
+
+#: Global element count above which ``ht.sort`` prefers the sample-sort
+#: collective over the gather path (tests lower it to force the path).
+SAMPLE_SORT_THRESHOLD = 1 << 22
+
+_SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def supports_sample_sort(a, axis: int, descending: bool) -> bool:
+    """Whether the PSRS fast path applies to this sort call."""
+    import numpy as np
+
+    return (
+        a.ndim == 1
+        and a.split == 0
+        and axis == 0
+        and not descending
+        and a.comm.size > 1
+        and a.shape[0] >= SAMPLE_SORT_THRESHOLD
+        and a.shape[0] < (1 << 32)
+        and np.dtype(a.dtype.jax_type()) in (np.dtype("float32"), np.dtype("int32"))
+        and jax.config.read("jax_enable_x64")
+    )
+
+
+def _order_bits(vals):
+    """uint32 whose unsigned order equals the value order."""
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        u = jax.lax.bitcast_convert_type(vals.astype(jnp.float32), jnp.uint32)
+        # negative floats: flip all bits; non-negative: flip the sign bit
+        mask = jnp.where(u >> 31 == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
+        return u ^ mask
+    # int32/int64 in-range: offset shifts the order onto uint32
+    return (vals.astype(jnp.int64) + jnp.int64(0x80000000)).astype(jnp.uint32)
+
+
+def _unorder_bits(u, dtype):
+    """Inverse of :func:`_order_bits`."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        mask = jnp.where(u >> 31 == 1, jnp.uint32(0x80000000), jnp.uint32(0xFFFFFFFF))
+        return jax.lax.bitcast_convert_type(u ^ mask, jnp.float32).astype(dtype)
+    return (u.astype(jnp.int64) - jnp.int64(0x80000000)).astype(dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _psrs_fn(comm, m: int, b: int, dtype_name: str):
+    """Jitted, cached PSRS executable for (mesh, global extent m, block b)."""
+    mesh = comm.mesh
+    axis = comm.axis_name
+    p = comm.size
+    dtype = jnp.dtype(dtype_name)
+
+    def body(a_loc):
+        # ---- 1. pack (value order bits, global index) into uint64 keys
+        idx = jax.lax.axis_index(axis)
+        gid = (idx * b + jnp.arange(b)).astype(jnp.uint64)
+        keys = (_order_bits(a_loc).astype(jnp.uint64) << 32) | gid
+        keys = jnp.where(gid < m, keys, _SENT)  # canonical padding -> sentinel
+
+        # ---- 2. local sort
+        keys = jnp.sort(keys)
+
+        # ---- 3. regular samples -> gathered, replicated pivot selection
+        sample_pos = ((jnp.arange(p) + 1) * b) // (p + 1)
+        samples = keys[sample_pos]  # (p,)
+        all_samples = jnp.sort(jax.lax.all_gather(samples, axis, axis=0, tiled=True))
+        pivots = all_samples[(jnp.arange(p - 1) + 1) * p]  # (p-1,)
+
+        # ---- 4. bucket exchange (reference's Alltoallv, manipulations.py:2600)
+        bkt = jnp.searchsorted(pivots, keys, side="left").astype(jnp.int32)  # (b,)
+        run_start = jnp.searchsorted(bkt, jnp.arange(p), side="left")  # (p,)
+        col = jnp.arange(b, dtype=jnp.int32) - run_start[bkt].astype(jnp.int32)
+        send = jnp.full((p, b), _SENT, jnp.uint64).at[bkt, col].set(keys, mode="drop")
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
+
+        # ---- 5. local merge via order-reversed top_k (2B bound, distinct keys)
+        cap = min(2 * b, p * b)
+        inv = ~recv.reshape(-1)  # order-reversing bijection on uint64
+        top, _ = jax.lax.top_k(inv, cap)
+        bucket = ~top  # ascending, all real keys first, sentinels last
+        k_real = jnp.sum(bucket != _SENT).astype(jnp.int32)
+
+        # ---- 6. rebalance to the canonical distribution by exact rank
+        counts = jax.lax.all_gather(k_real[None], axis, axis=0, tiled=True)  # (p,)
+        offset = jnp.cumsum(counts) - counts
+        rank = offset[idx] + jnp.arange(cap, dtype=jnp.int32)
+        valid = jnp.arange(cap, dtype=jnp.int32) < k_real
+        dest = jnp.where(valid, rank // b, p).astype(jnp.int32)  # p -> dropped
+        dcol = jnp.where(valid, rank % b, 0).astype(jnp.int32)
+        send2 = jnp.full((p, b), _SENT, jnp.uint64).at[dest, dcol].set(bucket, mode="drop")
+        recv2 = jax.lax.all_to_all(send2, axis, split_axis=0, concat_axis=0, tiled=True)
+        final_keys = jnp.min(recv2, axis=0)  # one real key per column
+
+        # ---- 7. unpack
+        vals = _unorder_bits((final_keys >> 32).astype(jnp.uint32), dtype)
+        gids = (final_keys & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
+        return vals, gids
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+    )
+
+
+def sample_sort_1d(a):
+    """Sort a 1-D split-0 DNDarray ascending via the PSRS collective.
+
+    Returns ``(values, indices)`` as DNDarrays with the input's split —
+    the backing arrays come straight out of the shard_map in canonical
+    layout; nothing is gathered.
+    """
+    from .dndarray import DNDarray
+
+    comm = a.comm
+    m = a.shape[0]
+    b = a.larray_padded.shape[0] // comm.size
+    fn = _psrs_fn(comm, m, b, str(jnp.dtype(a.dtype.jax_type())))
+    vals, gids = fn(a.larray_padded)
+    values = DNDarray(vals, (m,), a.dtype, 0, a.device, a.comm)
+    from . import types
+
+    indices = DNDarray(gids, (m,), types.int64, 0, a.device, a.comm)
+    return values, indices
