@@ -683,6 +683,13 @@ class DNDarray:
     def __matmul__(self, other):
         from .linalg import basics
 
+        type_name = type(other).__name__
+        if type_name in ("DCSR_matrix", "DCSC_matrix", "DCSX_matrix"):
+            # dense @ sparse routes through the sparse layer (Python will
+            # not try __rmatmul__ once this raises, so dispatch here)
+            from ..sparse import arithmetics as sparse_arithmetics
+
+            return sparse_arithmetics.matmul(self, other)
         return basics.matmul(self, other)
 
     def __neg__(self):

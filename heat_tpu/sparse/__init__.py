@@ -1,6 +1,6 @@
 """Distributed sparse matrices (analog of heat/sparse)."""
 
-from .arithmetics import add, mul
+from .arithmetics import add, matmul, mul, sum
 from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
 from .factories import sparse_csc_matrix, sparse_csr_matrix
 from .manipulations import to_dense, to_sparse, to_sparse_csc, to_sparse_csr
@@ -9,7 +9,9 @@ __all__ = [
     "DCSC_matrix",
     "DCSR_matrix",
     "add",
+    "matmul",
     "mul",
+    "sum",
     "sparse_csc_matrix",
     "sparse_csr_matrix",
     "to_dense",

@@ -13,7 +13,7 @@ from jax.experimental import sparse as jsparse
 from ..core.dndarray import DNDarray
 from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
 
-__all__ = ["add", "mul"]
+__all__ = ["add", "mul", "sum", "matmul"]
 
 
 def _binary_op_csx(op_name, t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
@@ -51,3 +51,60 @@ def add(t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
 def mul(t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
     """Element-wise sparse multiplication (sparse/arithmetics.py:58)."""
     return _binary_op_csx("mul", t1, t2)
+
+
+def sum(t: DCSX_matrix, axis=None) -> "DNDarray":
+    """Sparse sum reduction to a dense DNDarray.
+
+    Beyond the reference's sparse surface (its DCSX has no reductions);
+    axis=None gives the 0-d total, axis 0/1 a dense vector.  BCOO's
+    segment-sum reduction runs on-device; nothing is densified before the
+    reduction."""
+    import jax.numpy as jnp
+
+    if not isinstance(t, DCSX_matrix):
+        raise TypeError(f"expected a sparse matrix, got {type(t)}")
+    mat = t.larray
+    if axis is None:
+        res = jsparse.bcoo_reduce_sum(mat, axes=(0, 1)).todense()
+        return DNDarray.from_dense(jnp.asarray(res), None, t.device, t.comm)
+    axis = axis if axis >= 0 else axis + 2
+    if axis not in (0, 1):
+        raise ValueError(f"axis must be 0, 1 or None, got {axis}")
+    res = jsparse.bcoo_reduce_sum(mat, axes=(axis,)).todense()
+    split = 0 if t.split is not None else None
+    return DNDarray.from_dense(res, split, t.device, t.comm)
+
+
+def matmul(a, b):
+    """Sparse matrix product: sparse@sparse -> sparse, sparse@dense and
+    dense@sparse -> dense DNDarray.
+
+    Beyond the reference's sparse surface; the products lower to XLA's
+    sparse dot (``bcoo_dot_general``), which on TPU feeds the MXU with the
+    gathered rows instead of densifying the operand."""
+    import jax.numpy as jnp
+
+    a_sp = isinstance(a, DCSX_matrix)
+    b_sp = isinstance(b, DCSX_matrix)
+    if not a_sp and not b_sp:
+        raise TypeError("at least one operand must be a sparse matrix")
+    ref = a if a_sp else b
+    if a_sp and b_sp:
+        res = jsparse.bcoo_sum_duplicates(
+            jsparse.bcoo_sort_indices(a.larray @ b.larray)
+        )
+        from ..core import types
+
+        dtype = types.canonical_heat_type(res.data.dtype)
+        out_shape = (a.shape[0], b.shape[1])
+        return type(a)(res, int(res.nse), out_shape, dtype, a.split, a.device, a.comm)
+    if a_sp:
+        dense = b._dense() if isinstance(b, DNDarray) else jnp.asarray(b)
+        out = a.larray @ dense
+        split = a.split if a.split == 0 else (b.split if isinstance(b, DNDarray) else None)
+        return DNDarray.from_dense(out, split if split in (0, 1) else None, a.device, a.comm)
+    dense = a._dense() if isinstance(a, DNDarray) else jnp.asarray(a)
+    out = dense @ b.larray
+    split = a.split if isinstance(a, DNDarray) and a.split == 0 else None
+    return DNDarray.from_dense(out, split, b.device, b.comm)

@@ -143,9 +143,19 @@ class DCSX_matrix:
         return jnp.asarray(indptr[start : stop + 1] - indptr[start])
 
     @property
+    def gindptr(self) -> jnp.ndarray:
+        """Alias of :attr:`indptr` (reference's ``gindptr``, dcsx_matrix.py:167)."""
+        return self.indptr
+
+    @property
     def indices(self) -> jnp.ndarray:
         """Global uncompressed indices (dcsx_matrix.py:110)."""
         return jnp.asarray(self._csr_triple()[1])
+
+    @property
+    def gindices(self) -> jnp.ndarray:
+        """Alias of :attr:`indices` (dcsx_matrix.py:196)."""
+        return self.indices
 
     @property
     def lindices(self) -> jnp.ndarray:
@@ -159,10 +169,33 @@ class DCSX_matrix:
         return jnp.asarray(self._csr_triple()[2])
 
     @property
+    def gdata(self) -> jnp.ndarray:
+        """Alias of :attr:`data` (dcsx_matrix.py:143)."""
+        return self.data
+
+    @property
     def ldata(self) -> jnp.ndarray:
         indptr, _, data = self._csr_triple()
         start, stop = self._local_compressed_range()
         return jnp.asarray(data[indptr[start] : indptr[stop]])
+
+    def is_distributed(self) -> bool:
+        """Whether the data is split across participants (dcsx_matrix.py:272)."""
+        return self.__split is not None and self.__comm.is_distributed
+
+    def counts_displs_nnz(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """Per-participant (nnz counts, nnz displacements) along the
+        compressed axis (dcsx_matrix.py:278) — computed from the global
+        indptr at the canonical chunk boundaries, the Exscan the reference
+        performs over local nnz."""
+        indptr = self._csr_triple()[0]
+        counts, displs = [], []
+        ax = self._compressed_axis
+        for r in range(self.__comm.size):
+            off, lshape, _ = self.__comm.chunk(self.__gshape, ax, rank=r)
+            displs.append(int(indptr[off]))
+            counts.append(int(indptr[off + lshape[ax]] - indptr[off]))
+        return tuple(counts), tuple(displs)
 
     # ------------------------------------------------------------------
     def todense(self):
@@ -218,6 +251,26 @@ class DCSX_matrix:
         return arithmetics.mul(self, other)
 
     __rmul__ = __mul__
+
+    def __matmul__(self, other):
+        from . import arithmetics
+
+        return arithmetics.matmul(self, other)
+
+    def __rmatmul__(self, other):
+        from . import arithmetics
+
+        return arithmetics.matmul(other, self)
+
+    def sum(self, axis=None):
+        from . import arithmetics
+
+        return arithmetics.sum(self, axis=axis)
+
+    def matmul(self, other):
+        from . import arithmetics
+
+        return arithmetics.matmul(self, other)
 
 
 class DCSR_matrix(DCSX_matrix):

@@ -1,0 +1,164 @@
+"""Sparse depth tests mirroring the reference's split sweeps
+(heat/sparse/tests/test_dcsrmatrix.py, test_dcscmatrix.py,
+test_arithmetics_csr.py, test_manipulations.py idiom: every property and
+op checked against the scipy/numpy ground truth for split in (None, 0/1)).
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def mats():
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((9, 7))
+    b = rng.standard_normal((9, 7))
+    a[rng.random(a.shape) < 0.6] = 0.0
+    b[rng.random(b.shape) < 0.6] = 0.0
+    return a, b
+
+
+def _csr_truth(m):
+    import scipy.sparse as sp
+
+    return sp.csr_matrix(m)
+
+
+try:
+    import scipy.sparse  # noqa: F401
+
+    HAVE_SCIPY = True
+except ImportError:  # pragma: no cover
+    HAVE_SCIPY = False
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_dcsr_triple_matches_scipy(mats, split):
+    if not HAVE_SCIPY:
+        pytest.skip("scipy missing")
+    a, _ = mats
+    s = ht.sparse.sparse_csr_matrix(a, split=split)
+    truth = _csr_truth(a)
+    assert s.gnnz == truth.nnz
+    np.testing.assert_array_equal(np.asarray(s.indptr), truth.indptr)
+    np.testing.assert_array_equal(np.asarray(s.indices), truth.indices)
+    np.testing.assert_allclose(np.asarray(s.data), truth.data)
+    # g-aliases (reference dcsx_matrix.py:143,167,196)
+    np.testing.assert_array_equal(np.asarray(s.gindptr), truth.indptr)
+    np.testing.assert_array_equal(np.asarray(s.gindices), truth.indices)
+    np.testing.assert_allclose(np.asarray(s.gdata), truth.data)
+
+
+def test_dcsc_triple_matches_scipy(mats):
+    if not HAVE_SCIPY:
+        pytest.skip("scipy missing")
+    import scipy.sparse as sp
+
+    a, _ = mats
+    s = ht.sparse.sparse_csc_matrix(a, split=1)
+    truth = sp.csc_matrix(a)
+    assert s.gnnz == truth.nnz
+    np.testing.assert_array_equal(np.asarray(s.indptr), truth.indptr)
+    np.testing.assert_array_equal(np.asarray(s.indices), truth.indices)
+    np.testing.assert_allclose(np.asarray(s.data), truth.data)
+
+
+def test_counts_displs_nnz(mats):
+    if not HAVE_SCIPY:
+        pytest.skip("scipy missing")
+    a, _ = mats
+    s = ht.sparse.sparse_csr_matrix(a, split=0)
+    counts, displs = s.counts_displs_nnz()
+    truth = _csr_truth(a)
+    assert sum(counts) == truth.nnz
+    assert displs[0] == 0
+    # displacements are the Exscan of counts (reference dcsx_matrix.py:278)
+    np.testing.assert_array_equal(np.cumsum((0,) + counts[:-1]), displs)
+    assert len(counts) == s.comm.size
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1, -1])
+def test_sparse_sum(mats, axis):
+    a, _ = mats
+    s = ht.sparse.sparse_csr_matrix(a, split=0)
+    res = ht.sparse.sum(s, axis=axis)
+    np.testing.assert_allclose(np.asarray(res.numpy()), a.sum(axis=axis), rtol=1e-12)
+    # method form
+    res2 = s.sum(axis=axis)
+    np.testing.assert_allclose(np.asarray(res2.numpy()), a.sum(axis=axis), rtol=1e-12)
+
+
+def test_sparse_dense_matmul(mats):
+    a, _ = mats
+    rng = np.random.default_rng(12)
+    d = rng.standard_normal((7, 5))
+    s = ht.sparse.sparse_csr_matrix(a, split=0)
+
+    out = s @ ht.array(d, split=0)
+    np.testing.assert_allclose(out.numpy(), a @ d, rtol=1e-12)
+    out = s @ d
+    np.testing.assert_allclose(out.numpy(), a @ d, rtol=1e-12)
+
+    # dense @ sparse
+    e = rng.standard_normal((4, 9))
+    out = ht.array(e, split=0) @ s
+    np.testing.assert_allclose(out.numpy(), e @ a, rtol=1e-12)
+    out = ht.sparse.matmul(e, s)
+    np.testing.assert_allclose(out.numpy(), e @ a, rtol=1e-12)
+
+
+def test_sparse_sparse_matmul(mats):
+    a, b = mats
+    s1 = ht.sparse.sparse_csr_matrix(a, split=0)
+    s2 = ht.sparse.sparse_csr_matrix(b.T.copy(), split=0)
+    out = s1 @ s2
+    assert isinstance(out, ht.sparse.DCSR_matrix)
+    np.testing.assert_allclose(out.todense().numpy(), a @ b.T, rtol=1e-12)
+    assert out.shape == (9, 9)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_roundtrip_csr(mats, split):
+    a, _ = mats
+    x = ht.array(a, split=split)
+    s = ht.sparse.to_sparse_csr(x)
+    back = ht.sparse.to_dense(s)
+    np.testing.assert_allclose(back.numpy(), a, rtol=1e-12)
+    assert back.split == s.split
+
+
+def test_roundtrip_csc(mats):
+    a, _ = mats
+    x = ht.array(a, split=1)
+    s = ht.sparse.to_sparse_csc(x)
+    assert s.split == 1
+    back = ht.sparse.to_dense(s)
+    np.testing.assert_allclose(back.numpy(), a, rtol=1e-12)
+
+
+def test_sparse_add_mul_sweep(mats):
+    a, b = mats
+    for split in (None, 0):
+        s1 = ht.sparse.sparse_csr_matrix(a, split=split)
+        s2 = ht.sparse.sparse_csr_matrix(b, split=split)
+        np.testing.assert_allclose((s1 + s2).todense().numpy(), a + b, rtol=1e-12)
+        np.testing.assert_allclose((s1 * s2).todense().numpy(), a * b, rtol=1e-12)
+
+
+def test_is_distributed(mats):
+    a, _ = mats
+    assert ht.sparse.sparse_csr_matrix(a, split=0).is_distributed()
+    assert not ht.sparse.sparse_csr_matrix(a).is_distributed()
+
+
+def test_astype_and_transpose(mats):
+    a, _ = mats
+    s = ht.sparse.sparse_csr_matrix(a, split=0)
+    s32 = s.astype(ht.float32)
+    assert s32.dtype == ht.float32
+    t = s.T
+    assert isinstance(t, ht.sparse.DCSC_matrix)
+    assert t.split == 1
+    np.testing.assert_allclose(t.todense().numpy(), a.T, rtol=1e-6)
