@@ -1,20 +1,22 @@
-"""Benchmark driver: hierarchical SVD GFLOP/s per chip (the north star).
+"""Benchmark driver: the full BASELINE grid on the attached chip.
 
-BASELINE config 3: "heat.decomposition hierarchical SVD on 200GB
-tall-skinny matrix".  One chip factorizes a 2^22 x 128 f32 split-0 matrix
-(2 GiB) to rank 10 via ``ht.linalg.hsvd_rank`` — on a pod the same call
-scales the sample axis over the mesh, so per-chip GFLOP/s is the number
-that multiplies out to the 200 GB configuration.
+Emits one JSON line per BASELINE config (smoke, KMeans, hSVD north star,
+DP-SGD, 3-D FFT), then a final summary line whose top-level fields are the
+hSVD north star (so single-metric consumers keep working) with the whole
+grid attached under ``"all"`` — BENCH_r{N}.json then records every config
+each round and rounds stay comparable (BASELINE.md targets table).
 
-FLOP accounting is the standard 2*n*f^2 for a tall-skinny factorization;
-``vs_baseline`` divides by the reference's per-process compute path (the
-same truncated factorization in torch on CPU, measured on a subset), so
->1 means one chip beats one reference process on this host.
+Timing methodology (tunneled-chip aware): every measurement enqueues
+``n_iter`` programs and fetches one scalar at the end — the device
+executes in order, so one fetch bounds all iterations and the link
+round-trip floor is amortized instead of being subtracted per call
+(block_until_ready does not synchronize through the tunnel; RTT variance
+can exceed an iteration's compute).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Synchronization is a device->host scalar fetch minus the measured
-round-trip floor — block_until_ready does not synchronize through a
-tunneled remote chip.
+``vs_baseline`` for each config divides by the reference's per-process
+compute path measured in-process: torch CPU doing the equivalent local
+computation (the reference's per-rank torch kernels), on a subset where
+the full size would be unreasonable on one CPU.
 """
 
 from __future__ import annotations
@@ -24,9 +26,10 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-def _measure_sync_floor() -> float:
+def _sync_floor() -> float:
     f = jax.jit(lambda x: x + 1.0)
     z = jnp.zeros(())
     float(f(z))
@@ -38,71 +41,272 @@ def _measure_sync_floor() -> float:
     return best
 
 
-def _measure_reference_baseline(f: int, rank: int) -> float:
-    """GFLOP/s of the reference's per-process compute path: torch CPU
-    doing the same truncated factorization (its hsvd leaves are
-    torch.linalg.svd of the local block, svdtools.py:474), measured on a
-    2^18-row subset."""
+def _time_amortized(
+    run_once, fetch_scalar, n_iter: int, sync_floor: float, windows: int = 3
+) -> float:
+    """Seconds per iteration: enqueue n_iter runs, one trailing fetch.
+
+    Repeats the whole window ``windows`` times and keeps the best — the
+    tunnel link's RTT variance between runs can exceed an iteration's
+    compute, and the minimum is the standard noise-robust estimator."""
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_iter):
+            out = run_once()
+        fetch_scalar(out)
+        per = max((time.perf_counter() - t0 - sync_floor) / n_iter, 1e-9)
+        best = min(best, per)
+    return best
+
+
+# ---------------------------------------------------------------- configs
+
+
+def bench_smoke(ht, sync_floor):
+    """Config 1: factory smoke — ht.arange on the mesh, ms per call."""
+    n_iter = 20
+    per = _time_amortized(
+        lambda: ht.arange(10, split=0),
+        lambda a: float(a.sum()),
+        n_iter,
+        sync_floor,
+    )
+    return {
+        "metric": "smoke_arange10_ms",
+        "value": round(per * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+    }
+
+
+def bench_kmeans(ht, sync_floor):
+    """Config 2: KMeans throughput, points/s through the Lloyd loop."""
+    n, f, k, iters = 1 << 22, 16, 8, 10
+    ht.random.seed(1)
+    x = ht.random.randn(n, f, split=0)
+    x = x.astype(ht.float32)
+    float(x.sum())
+
+    def fit():
+        km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=iters, tol=-1.0, random_state=0)
+        km.fit(x)
+        return km
+
+    fit()  # compile
+    per = _time_amortized(fit, lambda km: float(km.cluster_centers_.sum()), 2, sync_floor)
+    pts_per_s = n * iters / per
+
+    # reference per-process path: torch CPU one Lloyd iteration (cdist+argmin
+    # +scatter mean, cluster/kmeans.py torch kernels) on a subset
     import torch
 
-    n_b = 1 << 18
-    xb = torch.randn(n_b, f)
+    nb = 1 << 18
+    xb = torch.randn(nb, f)
+    cb = torch.randn(k, f)
 
-    def factorize():
-        u, s, v = torch.linalg.svd(xb, full_matrices=False)
-        return u[:, :rank] * s[:rank]
+    def lloyd_once():
+        d = torch.cdist(xb, cb)
+        lab = d.argmin(1)
+        return torch.stack([xb[lab == i].mean(0) for i in range(k)])
 
-    factorize()
+    lloyd_once()
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        us = factorize()
-        _ = us.sum().item()
+        c = lloyd_once()
+        _ = c.sum().item()
         best = min(best, time.perf_counter() - t0)
-    return 2.0 * n_b * f * f / best / 1e9
+    base_pts = nb / best
+    return {
+        "metric": "kmeans_2^22x16_k8_pts_per_s",
+        "value": round(pts_per_s / 1e9, 3),
+        "unit": "Gpts/s",
+        "vs_baseline": round(pts_per_s / base_pts, 2),
+    }
 
 
-def main() -> None:
-    import heat_tpu as ht
-
-    n, f, rank = 1 << 22, 128, 10  # 2 GiB f32 tall-skinny
+def bench_hsvd(ht, sync_floor):
+    """Config 3 (north star): hierarchical SVD GFLOP/s per chip."""
+    n, f, rank = 1 << 22, 128, 10
     n_iter = 5
-
     ht.random.seed(0)
     x = ht.random.randn(n, f, split=0)
-    float(x.sum())  # materialize
+    float(x.sum())
 
     def factorize():
         u, s, v, err = ht.linalg.hsvd_rank(x, rank, compute_sv=True, safetyshift=5)
         return s
 
-    float(factorize().sum())  # warmup/compile
-    sync_floor = _measure_sync_floor()
-
-    # enqueue all iterations and fetch once: the device executes programs
-    # in order, so one final fetch bounds all of them, and the link
-    # round-trip floor is amortized across n_iter instead of being
-    # subtracted per call (tunnel RTT variance can exceed one iteration's
-    # compute, which would drive a per-call measurement negative)
-    t0 = time.perf_counter()
-    for _ in range(n_iter):
-        s = factorize()
-    float(s.sum())
-    per = max((time.perf_counter() - t0 - sync_floor) / n_iter, 1e-9)
-
+    float(factorize().sum())
+    per = _time_amortized(factorize, lambda s: float(s.sum()), n_iter, sync_floor)
     gflops = 2.0 * n * f * f / per / 1e9
-    baseline = _measure_reference_baseline(f, rank)
 
-    print(
-        json.dumps(
-            {
-                "metric": "hsvd_rank10_gflops_per_chip_2^22x128",
-                "value": round(gflops, 1),
-                "unit": "GFLOP/s",
-                "vs_baseline": round(gflops / baseline, 2),
-            }
-        )
+    import torch
+
+    n_b = 1 << 18
+    xb = torch.randn(n_b, f)
+
+    def tfact():
+        u, s, v = torch.linalg.svd(xb, full_matrices=False)
+        return u[:, :rank] * s[:rank]
+
+    tfact()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        us = tfact()
+        _ = us.sum().item()
+        best = min(best, time.perf_counter() - t0)
+    base = 2.0 * n_b * f * f / best / 1e9
+    return {
+        "metric": "hsvd_rank10_gflops_per_chip_2^22x128",
+        "value": round(gflops, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / base, 2),
+    }
+
+
+def bench_dpsgd(ht, sync_floor):
+    """Config 4: data-parallel CNN training steps/s (examples/nn analog)."""
+    import optax
+    import flax.linen as lnn
+
+    class CNN(lnn.Module):
+        @lnn.compact
+        def __call__(self, x):
+            x = lnn.relu(lnn.Conv(16, (3, 3))(x))
+            x = lnn.avg_pool(x, (2, 2), strides=(2, 2))
+            x = lnn.relu(lnn.Conv(32, (3, 3))(x))
+            x = lnn.avg_pool(x, (2, 2), strides=(2, 2))
+            x = x.reshape((x.shape[0], -1))
+            return lnn.Dense(10)(lnn.relu(lnn.Dense(64)(x)))
+
+    batch = 256
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.normal(size=(batch, 28, 28, 1)), jnp.float32)
+    yb = jnp.asarray(rng.integers(0, 10, size=(batch,)), jnp.int32)
+
+    dp = ht.nn.DataParallel(CNN(), optimizer=optax.adam(1e-3))
+    dp.init(jax.random.PRNGKey(0), xb)
+
+    def loss_fn(pred, target):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
+
+    dp.step(loss_fn, xb, yb)  # compile + cache the fused step
+    # steady-state training never fetches the loss per step: drive the
+    # compiled step with device-resident state and fetch once per window
+    step = dp._train_step
+    params, opt_state = dp.params, dp._opt_state
+    n_iter = 30
+
+    def run_once():
+        nonlocal params, opt_state
+        loss, params, opt_state = step(params, opt_state, xb, yb)
+        return loss
+
+    per = _time_amortized(run_once, lambda l: float(l), n_iter, sync_floor)
+    steps_per_s = 1.0 / per
+
+    # reference per-process path: the same CNN step in torch on CPU
+    import torch
+    import torch.nn as tnn
+
+    tmodel = tnn.Sequential(
+        tnn.Conv2d(1, 16, 3, padding=1), tnn.ReLU(), tnn.AvgPool2d(2),
+        tnn.Conv2d(16, 32, 3, padding=1), tnn.ReLU(), tnn.AvgPool2d(2),
+        tnn.Flatten(), tnn.Linear(32 * 49, 64), tnn.ReLU(), tnn.Linear(64, 10),
     )
+    topt = torch.optim.Adam(tmodel.parameters(), lr=1e-3)
+    txb = torch.randn(batch, 1, 28, 28)
+    tyb = torch.randint(0, 10, (batch,))
+
+    def tstep():
+        topt.zero_grad()
+        loss = tnn.functional.cross_entropy(tmodel(txb), tyb)
+        loss.backward()
+        topt.step()
+        return loss
+
+    tstep()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _ = tstep().item()
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "metric": "dpsgd_cnn_batch256_steps_per_s",
+        "value": round(steps_per_s, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(steps_per_s * best, 2),
+    }
+
+
+def bench_fft3d(ht, sync_floor):
+    """Config 5: 3-D FFT throughput (pencil resplit on a pod; one chip
+    measures the per-chip kernel), standard 5 N log2 N flop count.  On a
+    complex-less TPU runtime the framework's documented fallback runs the
+    transform on the host CPU backend — the number then reports that
+    fallback, not the chip."""
+    s = 128
+    n = s**3
+    ht.random.seed(2)
+    x = ht.random.randn(s, s, s, split=0).astype(ht.float32)
+    float(x.sum())
+
+    def fft():
+        return ht.fft.fftn(x)
+
+    fft()
+    per = _time_amortized(
+        fft, lambda r: float(jnp.abs(r.larray_padded[0, 0, 0])), 5, sync_floor
+    )
+    gflops = 5.0 * n * np.log2(n) / per / 1e9
+
+    import torch
+
+    sb = 128
+    xb = torch.randn(sb, sb, sb)
+    torch.fft.fftn(xb)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = torch.fft.fftn(xb)
+        _ = r.real.sum().item()
+        best = min(best, time.perf_counter() - t0)
+    base = 5.0 * sb**3 * np.log2(sb**3) / best / 1e9
+    return {
+        "metric": "fft3d_128^3_gflops",
+        "value": round(gflops, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / base, 2),
+    }
+
+
+def main() -> None:
+    import heat_tpu as ht
+
+    sync_floor = _sync_floor()
+    results = []
+    for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d):
+        try:
+            r = bench(ht, sync_floor)
+        except Exception as e:  # record the failure, keep the grid going
+            r = {
+                "metric": bench.__name__,
+                "value": -1,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    headline = next(r for r in results if r["metric"].startswith("hsvd"))
+    summary = dict(headline)
+    summary["all"] = results
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

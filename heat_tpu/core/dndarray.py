@@ -1099,17 +1099,57 @@ def _tpu_complex_ok() -> bool:
     """Whether the TPU runtime supports complex64 compute + transfer.
 
     Tunneled TPU runtimes vary: some reject every complex op/transfer with
-    UNIMPLEMENTED.  Probed once per process with a tiny multiply+fetch;
-    when unsupported, complex arrays stay on the in-process CPU backend
+    UNIMPLEMENTED — and on those, the FAILED op permanently poisons the
+    process's device stream (every later host fetch returns the same
+    error).  The probe therefore runs in a throwaway subprocess whose
+    poisoned stream dies with it; the verdict is cached on disk per device
+    kind so only the first process on a machine pays the probe's backend
+    init.  ``HEAT_TPU_COMPLEX=0/1`` overrides both.  Compile-only probing
+    cannot replace this: on the poisoning runtimes complex programs
+    compile fine and only execution/transfer fails.
+
+    When unsupported, complex arrays stay on the in-process CPU backend
     (jax ops follow operand placement, so complex math still works — at
     host speed — instead of crashing)."""
     global _TPU_COMPLEX_OK
-    if _TPU_COMPLEX_OK is None:
-        try:
-            probe = jax.device_put(np.ones((2,), np.complex64), jax.devices()[0])
-            _TPU_COMPLEX_OK = bool(np.asarray(probe * probe)[0] == 1.0)
-        except Exception:
-            _TPU_COMPLEX_OK = False
+    if _TPU_COMPLEX_OK is not None:
+        return _TPU_COMPLEX_OK
+
+    import os
+
+    env = os.environ.get("HEAT_TPU_COMPLEX")
+    if env is not None:
+        _TPU_COMPLEX_OK = env.strip().lower() not in ("0", "false", "no")
+        return _TPU_COMPLEX_OK
+
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+
+    kind = jax.devices()[0].device_kind.replace(" ", "_").replace("/", "_")
+    cache = pathlib.Path(tempfile.gettempdir()) / f"heat_tpu_complex_{kind}.flag"
+    if cache.exists():
+        _TPU_COMPLEX_OK = cache.read_text().strip() == "1"
+        return _TPU_COMPLEX_OK
+
+    code = (
+        "import jax, numpy as np\n"
+        "p = jax.device_put(np.ones((2,), np.complex64), jax.devices()[0])\n"
+        "print('OK' if np.asarray(p * p)[0].real == 1.0 else 'NO')\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=180
+        )
+        ok = out.returncode == 0 and b"OK" in out.stdout
+    except Exception:
+        ok = False
+    _TPU_COMPLEX_OK = ok
+    try:
+        cache.write_text("1" if ok else "0")
+    except OSError:  # pragma: no cover - read-only tempdir
+        pass
     return _TPU_COMPLEX_OK
 
 
