@@ -1,0 +1,259 @@
+"""High-value reference test families ported to the split-sweep +
+numpy-ground-truth idiom (VERDICT #9).
+
+Sources: heat/core/tests/test_dndarray.py (indexing matrix),
+test_manipulations.py (concatenate/pad/unique sweeps),
+test_statistics.py (moments: mean/var/std/skew/kurtosis/average/cov),
+test_suites/basic_test.py:77+ (assert-vs-numpy-across-splits idiom).
+Extents are non-divisible by the 8-device mesh on purpose (the analog of
+the reference's mpirun -n 3 remainder chunks).
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS_2D = [None, 0, 1]
+
+
+@pytest.fixture(scope="module")
+def base():
+    rng = np.random.default_rng(42)
+    return rng.standard_normal((11, 7))
+
+
+# ---------------------------------------------------------------- indexing
+
+
+class TestIndexingMatrix:
+    """The reference's getitem/setitem key matrix (test_dndarray.py:600+),
+    swept over splits."""
+
+    KEYS = [
+        3,
+        -2,
+        slice(2, 9),
+        slice(None, None, 2),
+        slice(8, 2, -2),
+        (slice(1, 6), 2),
+        (slice(None), slice(1, 4)),
+        (4, slice(None)),
+        (slice(2, 10, 3), slice(0, 6, 2)),
+        ...,
+        (Ellipsis, 1),
+        None,
+    ]
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_getitem_matrix(self, base, split):
+        a = ht.array(base, split=split)
+        for key in self.KEYS:
+            got = a[key]
+            want = base[key]
+            np.testing.assert_allclose(
+                np.asarray(got.numpy()), want, rtol=1e-12, err_msg=f"key={key}"
+            )
+            assert got.shape == want.shape
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_getitem_bool_and_array_keys(self, base, split):
+        a = ht.array(base, split=split)
+        mask = base[:, 0] > 0
+        np.testing.assert_allclose(a[ht.array(mask)].numpy(), base[mask], rtol=1e-12)
+        idx = np.array([0, 4, 2, 10])
+        np.testing.assert_allclose(a[ht.array(idx)].numpy(), base[idx], rtol=1e-12)
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_setitem_matrix(self, base, split):
+        for key, value in [
+            (2, 5.0),
+            (slice(1, 4), -1.0),
+            ((slice(None), 3), 0.5),
+            ((slice(2, 8, 2), slice(1, 5)), 9.0),
+            (-1, 7.0),
+        ]:
+            a = ht.array(base.copy(), split=split)
+            want = base.copy()
+            a[key] = value
+            want[key] = value
+            np.testing.assert_allclose(a.numpy(), want, rtol=1e-12, err_msg=f"key={key}")
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_setitem_array_value(self, base, split):
+        a = ht.array(base.copy(), split=split)
+        want = base.copy()
+        val = np.arange(7, dtype=base.dtype)
+        a[5] = ht.array(val)
+        want[5] = val
+        np.testing.assert_allclose(a.numpy(), want, rtol=1e-12)
+
+
+# ------------------------------------------------------------ manipulations
+
+
+class TestManipulationSweeps:
+    """concatenate/pad/unique and friends (test_manipulations.py idiom)."""
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    @pytest.mark.parametrize("axis", [0, 1])
+    def test_concatenate(self, base, split, axis):
+        other = np.linspace(0, 1, base.size).reshape(base.shape)
+        got = ht.concatenate(
+            [ht.array(base, split=split), ht.array(other, split=split)], axis=axis
+        )
+        np.testing.assert_allclose(got.numpy(), np.concatenate([base, other], axis), rtol=1e-12)
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_pad_modes(self, base, split):
+        a = ht.array(base, split=split)
+        for width in [1, (2, 3), ((1, 2), (3, 0))]:
+            np.testing.assert_allclose(
+                ht.pad(a, width).numpy(), np.pad(base, width), rtol=1e-12, err_msg=str(width)
+            )
+        np.testing.assert_allclose(
+            ht.pad(a, 2, mode="constant", constant_values=5).numpy(),
+            np.pad(base, 2, constant_values=5),
+            rtol=1e-12,
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_unique_sweep(self, split):
+        data = np.array([3, 1, 3, 2, 1, 7, 7, 7, 0, 2, 5], dtype=np.float64)
+        a = ht.array(data, split=split)
+        got = ht.unique(a, sorted=True)
+        np.testing.assert_array_equal(np.sort(np.asarray(got.numpy())), np.unique(data))
+        got_v, inv = ht.unique(a, sorted=True, return_inverse=True)
+        vals = np.asarray(got_v.numpy())
+        np.testing.assert_array_equal(vals[np.asarray(inv.numpy())], data)
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_flip_roll_rot90(self, base, split):
+        a = ht.array(base, split=split)
+        np.testing.assert_allclose(ht.flip(a, 0).numpy(), np.flip(base, 0), rtol=1e-12)
+        np.testing.assert_allclose(ht.roll(a, 3, axis=0).numpy(), np.roll(base, 3, 0), rtol=1e-12)
+        np.testing.assert_allclose(ht.rot90(a).numpy(), np.rot90(base), rtol=1e-12)
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_stack_family(self, base, split):
+        a = ht.array(base, split=split)
+        b = ht.array(base * 2, split=split)
+        np.testing.assert_allclose(ht.stack([a, b]).numpy(), np.stack([base, base * 2]), rtol=1e-12)
+        np.testing.assert_allclose(ht.vstack([a, b]).numpy(), np.vstack([base, base * 2]), rtol=1e-12)
+        np.testing.assert_allclose(ht.hstack([a, b]).numpy(), np.hstack([base, base * 2]), rtol=1e-12)
+        np.testing.assert_allclose(
+            ht.column_stack([a, b]).numpy(), np.column_stack([base, base * 2]), rtol=1e-12
+        )
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_reshape_ravel_transpose(self, base, split):
+        a = ht.array(base, split=split)
+        np.testing.assert_allclose(a.reshape((7, 11)).numpy(), base.reshape(7, 11), rtol=1e-12)
+        np.testing.assert_allclose(a.ravel().numpy(), base.ravel(), rtol=1e-12)
+        np.testing.assert_allclose(a.T.numpy(), base.T, rtol=1e-12)
+        np.testing.assert_allclose(
+            ht.moveaxis(a, 0, 1).numpy(), np.moveaxis(base, 0, 1), rtol=1e-12
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_repeat_tile(self, split):
+        data = np.arange(10, dtype=np.float64)
+        a = ht.array(data, split=split)
+        np.testing.assert_array_equal(ht.repeat(a, 3).numpy(), np.repeat(data, 3))
+        np.testing.assert_array_equal(ht.tile(a, 2).numpy(), np.tile(data, 2))
+
+
+# --------------------------------------------------------------- statistics
+
+
+class TestMoments:
+    """mean/var/std/skew/kurtosis/average/cov (test_statistics.py:192-1397)."""
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    @pytest.mark.parametrize("axis", [None, 0, 1])
+    def test_mean_var_std(self, base, split, axis):
+        a = ht.array(base, split=split)
+        np.testing.assert_allclose(
+            np.asarray(ht.mean(a, axis=axis).numpy()), base.mean(axis=axis), rtol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(ht.var(a, axis=axis).numpy()), base.var(axis=axis), rtol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(ht.std(a, axis=axis).numpy()), base.std(axis=axis), rtol=1e-10
+        )
+        np.testing.assert_allclose(
+            np.asarray(ht.var(a, axis=axis, ddof=1).numpy()),
+            base.var(axis=axis, ddof=1),
+            rtol=1e-10,
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_skew_kurtosis(self, split):
+        rng = np.random.default_rng(3)
+        data = rng.gamma(2.0, size=37)
+        a = ht.array(data, split=split)
+        m = data.mean()
+        c = data - m
+        skew_np = (c**3).mean() / (c**2).mean() ** 1.5
+        kurt_np = (c**4).mean() / (c**2).mean() ** 2 - 3.0
+        # biased (population) moments match the plain numpy formulas
+        np.testing.assert_allclose(float(ht.skew(a, unbiased=False)), skew_np, rtol=1e-6)
+        np.testing.assert_allclose(float(ht.kurtosis(a, unbiased=False)), kurt_np, rtol=1e-6)
+        # default unbiased estimators apply the standard corrections
+        n = data.size
+        skew_unb = skew_np * np.sqrt(n * (n - 1)) / (n - 2)
+        np.testing.assert_allclose(float(ht.skew(a)), skew_unb, rtol=1e-6)
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    def test_average_weighted(self, base, split):
+        a = ht.array(base, split=split)
+        w = np.abs(np.random.default_rng(4).standard_normal(7)) + 0.1
+        got = ht.average(a, axis=1, weights=ht.array(w))
+        np.testing.assert_allclose(got.numpy(), np.average(base, axis=1, weights=w), rtol=1e-10)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_cov(self, split):
+        rng = np.random.default_rng(5)
+        data = rng.standard_normal((5, 40))
+        a = ht.array(data, split=None if split is None else 1)
+        np.testing.assert_allclose(ht.cov(a).numpy(), np.cov(data), rtol=1e-8)
+
+    @pytest.mark.parametrize("split", SPLITS_2D)
+    @pytest.mark.parametrize("axis", [None, 0])
+    def test_minmax_arg(self, base, split, axis):
+        a = ht.array(base, split=split)
+        np.testing.assert_allclose(
+            np.asarray(ht.max(a, axis=axis).numpy()), base.max(axis=axis), rtol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(ht.min(a, axis=axis).numpy()), base.min(axis=axis), rtol=1e-12
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ht.argmax(a, axis=axis).numpy()), base.argmax(axis=axis)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ht.argmin(a, axis=axis).numpy()), base.argmin(axis=axis)
+        )
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_percentile_median(self, split):
+        rng = np.random.default_rng(6)
+        data = rng.standard_normal(53)
+        a = ht.array(data, split=split)
+        for q in (10, 50, 92.5):
+            np.testing.assert_allclose(
+                float(ht.percentile(a, q)), np.percentile(data, q), rtol=1e-8
+            )
+        np.testing.assert_allclose(float(ht.median(a)), np.median(data), rtol=1e-10)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_bincount_digitize(self, split):
+        data = np.array([0, 1, 1, 3, 2, 1, 7, 3], dtype=np.int64)
+        a = ht.array(data, split=split)
+        np.testing.assert_array_equal(ht.bincount(a).numpy(), np.bincount(data))
+        bins = np.array([0.0, 2.0, 4.0, 6.0])
+        np.testing.assert_array_equal(
+            ht.digitize(ht.array(data.astype(np.float64), split=split), ht.array(bins)).numpy(),
+            np.digitize(data, bins),
+        )
