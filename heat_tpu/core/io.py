@@ -176,11 +176,23 @@ def load_hdf5(
         if jax.process_count() == 1:
             arr = np.asarray(data[tuple(slice(0, s) for s in gshape)], dtype=np.dtype(dtype.jax_type()))
             return DNDarray.from_dense(jax.numpy.asarray(arr), split, device, comm)
-        # multi-host slab read  # pragma: no cover - multi-host
-        _, _, slices = comm.chunk(gshape, split, rank=comm.rank)
+        # multi-host slab read: each process reads only its devices' true
+        # rows, pads to its canonical (padded) block and places host-locally
+        _, _, slices = comm.process_chunk(gshape, split)
         local = np.asarray(data[slices], dtype=np.dtype(dtype.jax_type()))
-        sharding = comm.sharding(split)
-        global_arr = jax.make_array_from_process_local_data(sharding, local)
+        padded_total = comm.padded_extent(gshape[split])
+        per = padded_total // comm.size
+        want = per * len(comm.local_participants)
+        pad = want - local.shape[split]
+        if pad:
+            widths = [(0, pad) if d == split else (0, 0) for d in range(local.ndim)]
+            local = np.pad(local, widths)
+        padded_gshape = tuple(
+            padded_total if d == split else s for d, s in enumerate(gshape)
+        )
+        global_arr = jax.make_array_from_process_local_data(
+            comm.sharding(split), local, padded_gshape
+        )
         return DNDarray(global_arr, gshape, dtype, split, device, comm)
 
 

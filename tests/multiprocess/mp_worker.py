@@ -127,4 +127,79 @@ check(
     np.allclose(q_.numpy() @ r_.numpy(), m.numpy(), atol=1e-10),
 )
 
+# split=1 QR: the block-MGS shard_map collective over the cross-process mesh
+m2 = ht.random.randn(3 * NDEV + 7, NDEV + 3, split=1, dtype=ht.float64)
+q2_, r2_ = ht.qr(m2)
+check(
+    "qr split=1 (block MGS)",
+    np.allclose(q2_.numpy() @ r2_.numpy(), m2.numpy(), atol=1e-8),
+)
+
+# ---------------------------------------------------------------- sample sort
+from heat_tpu.core import sample_sort
+
+sample_sort.SAMPLE_SORT_THRESHOLD = 1  # force the PSRS collective
+rng_sort = np.random.default_rng(123)  # same data on every process (SPMD)
+sort_data = rng_sort.standard_normal(7 * NDEV + 5).astype(np.float32)
+sv, si = ht.sort(ht.array(sort_data, split=0))
+check("psrs sort values", np.array_equal(sv.numpy(), np.sort(sort_data)))
+check("psrs sort indices", np.array_equal(si.numpy(), np.argsort(sort_data, kind="stable")))
+sample_sort.SAMPLE_SORT_THRESHOLD = 1 << 22
+
+# ---------------------------------------------------------------- sharded io
+import tempfile
+import shutil
+
+from jax.experimental import multihost_utils
+
+io_dir = os.path.join(tempfile.gettempdir(), f"heat_mp_npy_{PORT}")
+io_arr = ht.arange(3 * NDEV + 5, dtype=ht.float64, split=0)
+ht.io.save_npy_from_path(io_arr, io_dir)  # each process writes its shards
+multihost_utils.sync_global_devices("npy_written")
+io_back = ht.load_npy_from_path(io_dir, dtype=ht.float64, split=0)
+check("sharded npy roundtrip", np.array_equal(io_back.numpy(), np.arange(3 * NDEV + 5)))
+multihost_utils.sync_global_devices("npy_read")
+if PID == 0:
+    shutil.rmtree(io_dir, ignore_errors=True)
+
+if ht.io.supports_hdf5():
+    h5_path = os.path.join(tempfile.gettempdir(), f"heat_mp_{PORT}.h5")
+    ht.save_hdf5(io_arr, h5_path, "data")  # serialized process turns inside
+    io_back2 = ht.load_hdf5(h5_path, "data", dtype=ht.float64, split=0)
+    check("sharded hdf5 roundtrip", np.array_equal(io_back2.numpy(), np.arange(3 * NDEV + 5)))
+    multihost_utils.sync_global_devices("h5_read")
+    if PID == 0:
+        os.remove(h5_path)
+
+# ------------------------------------------------------- hierarchical DASO
+# node == process: the reference DASO's exact topology (intra-node DDP over
+# this process's devices, cross-node bf16 averaging over the process
+# boundary — here riding the gloo DCN analog)
+import optax
+
+hc = ht.parallel.HierarchicalCommunication(grid=(NPROC, DEV_PER_PROC))
+check("hier comm nodes == processes", hc.num_nodes == NPROC and hc.node_size == DEV_PER_PROC)
+daso = ht.optim.DASO(
+    local_optimizer=optax.sgd(0.1), total_epochs=100, comm=hc,
+    warmup_epochs=0, cooldown_epochs=0,
+)
+daso.global_skip = 2
+daso.batches_to_wait = 0
+params = daso.replicate({"w": jnp.ones((4,), jnp.float32)})
+grads = {
+    "w": jnp.stack([jnp.full((4,), 1.0 + node, jnp.float32) for node in range(NPROC)])
+}
+def _host(x):
+    """Gather a cross-process global array to every host."""
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+params = daso.step(params, grads)  # batch 0: local step + sync
+w = _host(params["w"])
+mean_traj = 1.0 - 0.1 * np.mean(1.0 + np.arange(NPROC))
+check("daso cross-process sync is a real average", np.allclose(w, mean_traj, atol=2e-2))
+params = daso.step(params, grads)  # batch 1: skipped -> replicas diverge
+w = _host(params["w"])
+check("daso skip leaves replicas diverged", abs(w[0, 0] - w[-1, 0]) > 0.05 * (NPROC - 1))
+
 print(f"[{PID}] MP-OK", flush=True)
