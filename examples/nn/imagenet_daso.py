@@ -63,17 +63,31 @@ def main(epochs: int = 5, batch_size: int = 64, window: int = 128) -> None:
         synthesize_imagenet_h5(h5path)
 
         model = make_model()
-        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        comm = ht.get_comm()
+
+        # The reference's topology: DDP inside a node, DASO across nodes.
+        # Arrange the mesh as (n_node, per_node) — per-node parameter
+        # replicas ride the 'global' axis, intra-node gradient psums the
+        # 'node' axis.  One device degenerates to the plain optimizer.
+        n_node = 2 if comm.size % 2 == 0 and comm.size >= 2 else 1
+        hc = ht.parallel.HierarchicalCommunication(grid=(n_node, comm.size // n_node))
         daso = ht.optim.DASO(
             local_optimizer=optax.adam(1e-3),
             total_epochs=epochs,
+            comm=hc,
             warmup_epochs=1,
             cooldown_epochs=1,
         )
+        dp = ht.nn.DataParallelMultiGPU(model, daso=daso) if n_node > 1 else None
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        if dp is not None:
+            dp.set_params(params)
+
+        def batch_loss(pred, yb):
+            return optax.softmax_cross_entropy_with_integer_labels(pred, yb).mean()
 
         def loss_fn(p, xb, yb):
-            logits = model.apply(p, xb)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+            return batch_loss(model.apply(p, xb), yb)
 
         grad_fn = jax.jit(jax.value_and_grad(loss_fn))
 
@@ -86,16 +100,22 @@ def main(epochs: int = 5, batch_size: int = 64, window: int = 128) -> None:
                 for start in range(0, images.shape[0] - batch_size + 1, batch_size):
                     xb = images[start : start + batch_size]
                     yb = labels[start : start + batch_size]
-                    loss, grads = grad_fn(params, xb, yb)
-                    params = daso.step(params, grads)
-                    losses.append(float(loss))
+                    if dp is not None:
+                        losses.append(dp.step(batch_loss, xb, yb))
+                    else:
+                        loss, grads = grad_fn(params, xb, yb)
+                        params = daso.step(params, grads)
+                        losses.append(float(loss))
             daso.epoch_loss_logic(float(np.mean(losses)))
             daso.next_epoch()  # advances the warmup/cycling/cooldown phases
             print(
                 f"epoch {epoch}: mean loss {np.mean(losses):.4f}, "
                 f"global_skip {daso.global_skip}"
             )
-        params = daso.last_batch(params)
+        if dp is not None:
+            params = daso.collect(daso.last_batch(dp.params))
+        else:
+            params = daso.last_batch(params)
         print("done — final global sync applied")
 
 
