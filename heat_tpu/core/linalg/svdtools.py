@@ -92,6 +92,31 @@ def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int):
     through a remote chip.  Returns (u_fin (m, w), s_fin (w,), v_fin
     (n, w), discarded_sq, total_sq) at full working width w; the host
     slices to the final rank (shape decisions stay outside jit)."""
+    return _hsvd_body(dense, trunc, p, no_of_merges, compute_v=True)
+
+
+@_partial(
+    jax.jit, static_argnames=("trunc", "p", "no_of_merges", "k", "compute_v", "dtype_name")
+)
+def _hsvd_rank_jit(dense, trunc: int, p: int, no_of_merges: int, k: int, compute_v: bool, dtype_name: str):
+    """Fixed-rank hsvd INCLUDING the cast, the rank-k truncation and the
+    error estimate — one device program, zero per-call eager dispatches.
+    The eager version of this tail (astype + four slices + two reductions
+    + re-placements) costs more wall-clock through a tunneled chip than
+    the entire factorization."""
+    dense = dense.astype(jnp.dtype(dtype_name))
+    u, s, v, _disc, total_sq = _hsvd_body(dense, trunc, p, no_of_merges, compute_v)
+    sv = s[:k]
+    approx_sq = jnp.sum(sv.astype(jnp.float32) ** 2)
+    rel_err = jnp.sqrt(
+        jnp.maximum(total_sq - approx_sq, 0.0) / jnp.maximum(total_sq, 1e-30)
+    )
+    if compute_v:
+        return u[:, :k], sv, v[:, :k], rel_err
+    return u[:, :k], sv, rel_err
+
+
+def _hsvd_body(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int, compute_v: bool):
     m, n = dense.shape
 
     # leaf level: column blocks = the canonical shards of the split axis
@@ -103,12 +128,16 @@ def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int):
 
     # leaf truncated SVDs; track the energy each truncation discards so the
     # rtol bound covers leaf+merge losses (reference's a-posteriori bound,
-    # svdtools.py:430)
+    # svdtools.py:430).  ||A||_F^2 falls out of the leaf Gram traces for
+    # free — a separate full-array sum-of-squares pass would re-read the
+    # whole matrix from HBM (measurably as costly as one Gram matmul).
     factors: List[jnp.ndarray] = []
     discarded_sq = jnp.zeros((), jnp.float32)
+    total_sq = jnp.zeros((), jnp.float32)
     for blk in block_cols:
-        us_f, disc = _truncated_us(blk, trunc)
+        us_f, disc, blk_sq = _truncated_us(blk, trunc)
         discarded_sq = discarded_sq + disc
+        total_sq = total_sq + blk_sq
         factors.append(us_f)
 
     # merge tree (levels of no_of_merges-way merges, svdtools.py:330+)
@@ -117,7 +146,7 @@ def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int):
         for i in range(0, len(factors), no_of_merges):
             group = factors[i : i + no_of_merges]
             cat = jnp.concatenate(group, axis=1)
-            us_f, disc = _truncated_us(cat, trunc)
+            us_f, disc, _ = _truncated_us(cat, trunc)
             discarded_sq = discarded_sq + disc
             merged.append(us_f)
         factors = merged
@@ -143,11 +172,14 @@ def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int):
     else:
         u_fin, s_fin, _ = jnp.linalg.svd(us, full_matrices=False)
 
-    # V = A^T U diag(1/s) at full width (sliced by the host)
-    inv_sv = jnp.where(s_fin > 0, 1.0 / jnp.maximum(s_fin, 1e-30), 0.0)
-    v_fin = jnp.matmul(dense.T, u_fin, precision=jax.lax.Precision.HIGHEST) * inv_sv[None, :]
-
-    total_sq = jnp.sum(dense.astype(jnp.float32) ** 2)
+    # V = A^T U diag(1/s) at full width (sliced by the host); skipped
+    # entirely when the caller doesn't want V — it is a second full-size
+    # MXU matmul
+    if compute_v:
+        inv_sv = jnp.where(s_fin > 0, 1.0 / jnp.maximum(s_fin, 1e-30), 0.0)
+        v_fin = jnp.matmul(dense.T, u_fin, precision=jax.lax.Precision.HIGHEST) * inv_sv[None, :]
+    else:
+        v_fin = None
     return u_fin, s_fin, v_fin, discarded_sq, total_sq
 
 
@@ -163,26 +195,40 @@ def _hsvd(
     m, n = A.shape
     comm = A.comm
     dtype = jnp.float32 if not types.heat_type_is_inexact(A.dtype) else A.dtype.jax_type()
-    dense = A._dense().astype(dtype)
 
     if maxrank is None:
         maxrank = min(m, n)
     trunc = min(maxrank + safetyshift, m)
     p = comm.size if A.split == 1 else 1
 
+    if rtol is None:
+        # fixed-rank fast path: cast, factorization, truncation and the
+        # error estimate are ONE device program — every eager dispatch
+        # skipped here is one link round-trip on a tunneled chip
+        k = min(maxrank, trunc)
+        outs = _hsvd_rank_jit(
+            A._dense(), trunc, p, no_of_merges, k, compute_sv, str(jnp.dtype(dtype))
+        )
+        U = DNDarray.from_dense(outs[0], A.split if A.split == 0 else None, A.device, comm)
+        if compute_sv:
+            u_k, sv, v_k, rel_err = outs
+            S = DNDarray.from_dense(sv, None, A.device, comm)
+            V = DNDarray.from_dense(v_k, A.split if A.split == 1 else None, A.device, comm)
+            return U, S, V, rel_err
+        _, _, rel_err = outs
+        return U, rel_err
+
+    dense = A._dense().astype(dtype)
     u_fin, s_fin, v_fin, discarded_sq, total_sq = _hsvd_core(dense, trunc, p, no_of_merges)
 
-    # final truncation to maxrank (drop safetyshift) or rtol bound
-    if rtol is not None:
-        # smallest k with (energy discarded by leaf/merge truncations +
-        # energy of the dropped tail of s_fin) <= rtol^2 * ||A||_F^2
-        kept = jnp.cumsum(s_fin.astype(jnp.float32) ** 2)
-        resid = jnp.sum(s_fin.astype(jnp.float32) ** 2) - kept + discarded_sq
-        ok = np.asarray(resid <= (rtol**2) * total_sq)
-        k = int(np.argmax(ok)) + 1 if ok.any() else int(s_fin.shape[0])
-        k = min(k, maxrank)
-    else:
-        k = min(maxrank, s_fin.shape[0])
+    # rtol path: smallest k with (energy discarded by leaf/merge
+    # truncations + energy of the dropped tail of s_fin) <= rtol^2 *
+    # ||A||_F^2 — k is a host shape decision, so this path syncs once
+    kept = jnp.cumsum(s_fin.astype(jnp.float32) ** 2)
+    resid = jnp.sum(s_fin.astype(jnp.float32) ** 2) - kept + discarded_sq
+    ok = np.asarray(resid <= (rtol**2) * total_sq)
+    k = int(np.argmax(ok)) + 1 if ok.any() else int(s_fin.shape[0])
+    k = min(k, maxrank)
     U = DNDarray.from_dense(u_fin[:, :k], A.split if A.split == 0 else None, A.device, comm)
     sv = s_fin[:k]
 
@@ -246,12 +292,14 @@ def _truncated_us(blk: jnp.ndarray, trunc: int):
         v = v[:, ::-1]
         kk = min(trunc, n)
         disc = jnp.sum(jnp.maximum(lam[kk:].astype(jnp.float32), 0.0))
+        blk_sq = jnp.sum(jnp.maximum(lam.astype(jnp.float32), 0.0))  # tr(G) = ||blk||_F^2
         us = jnp.matmul(blk, v[:, :kk], precision=jax.lax.Precision.HIGHEST)
-        return us, disc
+        return us, disc, blk_sq
     u_full, s_full, _ = jnp.linalg.svd(blk, full_matrices=False)
     kk = min(trunc, s_full.shape[0])
     disc = jnp.sum(s_full[kk:].astype(jnp.float32) ** 2)
-    return u_full[:, :kk] * s_full[:kk][None, :], disc
+    blk_sq = jnp.sum(s_full.astype(jnp.float32) ** 2)
+    return u_full[:, :kk] * s_full[:kk][None, :], disc, blk_sq
 
 
 def _col_slices(n: int, p: int):
