@@ -81,10 +81,16 @@ class _KCluster(BaseEstimator, ClusteringMixin):
 
     @property
     def inertia_(self) -> float:
+        # fits store device scalars so fit() never blocks on the link; the
+        # host conversion happens (once) on first access
+        if self._inertia is not None and not isinstance(self._inertia, float):
+            self._inertia = float(self._inertia)
         return self._inertia
 
     @property
     def n_iter_(self) -> int:
+        if self._n_iter is not None and not isinstance(self._n_iter, int):
+            self._n_iter = int(self._n_iter)
         return self._n_iter
 
     def _initialize_cluster_centers(self, x: DNDarray, oversampling: float = None, iter_multiplier: float = None):
@@ -140,7 +146,8 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         if eval_functional_value:
             from ..core import arithmetics
 
-            self._inertia = float(arithmetics.sum(statistics.min(distances, axis=1) ** 2).item())
+            # stays a lazy 0-d value; inertia_ converts on first access
+            self._inertia = arithmetics.sum(statistics.min(distances, axis=1) ** 2)._dense()
         return labels
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):

@@ -190,17 +190,20 @@ class KMeans(_KCluster):
                     break
             n_iter = i + 1
         else:
-            # whole fit loop on-device: exactly one host sync for the count
+            # whole fit loop on-device, and the iteration count stays a
+            # device scalar — fit() performs ZERO host syncs; n_iter_ and
+            # inertia_ convert lazily on first access (one link RTT each
+            # on a tunneled chip, paid only if the caller looks)
             new, n_iter_dev, _ = _lloyd_loop(
                 xp, centers, x.shape[0], self.n_clusters, self.max_iter, float(self.tol)
             )
             self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
-            n_iter = int(n_iter_dev)
+            n_iter = n_iter_dev
 
         self._n_iter = n_iter
         # final assignment against the converged centers (the reference's
         # last pass only assigns, it does not move centers)
         labels, inertia = self._assign_padded(x)
-        self._inertia = float(inertia)
+        self._inertia = inertia
         self._labels = DNDarray.from_dense(labels[: x.shape[0]], x.split, x.device, x.comm)
         return self

@@ -108,6 +108,6 @@ class KMedians(_KCluster):
             dense, centers, self.n_clusters, self.max_iter, float(self.tol)
         )
         self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
-        self._n_iter = int(n_iter)
+        self._n_iter = n_iter  # lazy host conversion in n_iter_
         self._labels = self._assign_to_cluster(x, eval_functional_value=True)
         return self

@@ -117,6 +117,6 @@ class KMedoids(_KCluster):
         centers = self._cluster_centers._dense().astype(dense.dtype)
         new, n_iter = _kmedoids_loop(dense, centers, self.n_clusters, self.max_iter)
         self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
-        self._n_iter = int(n_iter)
+        self._n_iter = n_iter  # lazy host conversion in n_iter_
         self._labels = self._assign_to_cluster(x, eval_functional_value=True)
         return self
