@@ -333,8 +333,24 @@ def rsvd(
 
     m, n = A.shape
     ell = min(rank + n_oversamples, m, n)
-    dense = A._dense().astype(jnp.float32 if not types.heat_type_is_inexact(A.dtype) else A.dtype.jax_type())
-    omega = ht_random.randn(n, ell, dtype=types.canonical_heat_type(dense.dtype), comm=A.comm)._dense()
+    dtype = jnp.float32 if not types.heat_type_is_inexact(A.dtype) else A.dtype.jax_type()
+    omega = ht_random.randn(n, ell, dtype=types.canonical_heat_type(dtype), comm=A.comm)._dense()
+    k = min(rank, min(ell, m))
+    u_k, s_k, v_k = _rsvd_jit(A._dense(), omega, power_iter, k, str(jnp.dtype(dtype)))
+    U = DNDarray.from_dense(u_k, A.split if A.split == 0 else None, A.device, A.comm)
+    S = DNDarray.from_dense(s_k, None, A.device, A.comm)
+    V = DNDarray.from_dense(v_k, None, A.device, A.comm)
+    return U, S, V
+
+
+@_partial(jax.jit, static_argnames=("power_iter", "k", "dtype_name"))
+def _rsvd_jit(dense, omega, power_iter: int, k: int, dtype_name: str):
+    """The whole randomized factorization (range sampling, power
+    iterations, CholeskyQR2-style orthonormalization, small SVD, rank-k
+    truncation) as one device program — the eager version pays one
+    dispatch round-trip per matmul through a tunneled chip."""
+    dense = dense.astype(jnp.dtype(dtype_name))
+    omega = omega.astype(dense.dtype)
     y = jnp.matmul(dense, omega, precision=jax.lax.Precision.HIGHEST)
     q = _gram_orthonormalize(y)
     for _ in range(power_iter):
@@ -345,8 +361,4 @@ def rsvd(
     b = jnp.matmul(q.T, dense, precision=jax.lax.Precision.HIGHEST)
     u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
     u = jnp.matmul(q, u_b, precision=jax.lax.Precision.HIGHEST)
-    k = min(rank, s.shape[0])
-    U = DNDarray.from_dense(u[:, :k], A.split if A.split == 0 else None, A.device, A.comm)
-    S = DNDarray.from_dense(s[:k], None, A.device, A.comm)
-    V = DNDarray.from_dense(vt[:k].T, None, A.device, A.comm)
-    return U, S, V
+    return u[:, :k], s[:k], vt[:k].T
