@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from functools import partial
 from typing import Optional
 
@@ -41,6 +42,48 @@ from ..parallel.comm import Communication, sanitize_comm
 __all__ = ["scaled_dot_product_attention", "ring_attention", "ulysses_attention"]
 
 _NEG_INF = -1e30
+
+
+def _flash_available() -> bool:
+    """Whether the TPU Pallas flash-attention kernel can be used.
+
+    The kernel's win on TPU is MEMORY, not raw speed: the (h, seq, seq)
+    score tensor never materializes, so full-sequence local attention
+    scales to lengths where the einsum path OOMs.  Opt out with
+    HEAT_TPU_FLASH=0."""
+    if os.environ.get("HEAT_TPU_FLASH", "1") != "1":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention  # noqa: F401
+    except ImportError:  # pragma: no cover - jax always ships it on tpu
+        return False
+    return True
+
+
+def _local_flash(q, k, v, scale, causal, n_true):
+    """Full-sequence attention via the Pallas flash kernel.
+
+    ``q``/``k``/``v`` are (seq, heads, head_dim); padded tail positions
+    (>= n_true) are isolated with segment ids so real tokens never attend
+    padding.  Raises at trace time (caught by callers, who fall back to
+    the einsum path) when the kernel rejects the shape."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention,
+    )
+
+    s = q.shape[0]
+    qb = q.transpose(1, 0, 2)[None].astype(jnp.float32)  # (1, h, s, d)
+    kb = k.transpose(1, 0, 2)[None].astype(jnp.float32)
+    vb = v.transpose(1, 0, 2)[None].astype(jnp.float32)
+    seg = None
+    if n_true < s:
+        ids = (jnp.arange(s) >= n_true).astype(jnp.int32)[None]
+        seg = SegmentIds(q=ids, kv=ids)
+    out = flash_attention(qb, kb, vb, causal=causal, sm_scale=scale, segment_ids=seg)
+    return out[0].transpose(1, 0, 2).astype(q.dtype)
 
 
 def _block_attn_update(o, m, l, q, k, v, q_off, k_off, scale, causal, n_true):
@@ -148,7 +191,7 @@ def _ring_fn(comm, scale, causal, n_true, block):
     )
 
 
-def _ulysses_body(q, k, v, *, comm, scale, causal, n_true):
+def _ulysses_body(q, k, v, *, comm, scale, causal, n_true, use_flash):
     """shard_map body: all_to_all seq->heads, local attention, reverse."""
     name = comm.axis_name
     # (block, h, d) -> (seq, h/p, d): gather sequence, scatter heads
@@ -156,22 +199,32 @@ def _ulysses_body(q, k, v, *, comm, scale, causal, n_true):
     kg = jax.lax.all_to_all(k, name, split_axis=1, concat_axis=0, tiled=True)
     vg = jax.lax.all_to_all(v, name, split_axis=1, concat_axis=0, tiled=True)
     seq = qg.shape[0]
-    scores = (
-        jnp.einsum(
-            "qhd,khd->hqk", qg.astype(jnp.float32), kg,
-            preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST,
+    og = None
+    if use_flash:
+        # each device now holds the FULL sequence for h/p heads — the
+        # shape flash attention wants; the (h/p, seq, seq) score tensor
+        # of the einsum path never materializes
+        try:
+            og = _local_flash(qg, kg, vg, scale, causal, n_true)
+        except Exception:  # trace-time shape rejection -> einsum path
+            og = None
+    if og is None:
+        scores = (
+            jnp.einsum(
+                "qhd,khd->hqk", qg.astype(jnp.float32), kg,
+                preferred_element_type=jnp.float32, precision=jax.lax.Precision.HIGHEST,
+            )
+            * scale
         )
-        * scale
-    )
-    k_pos = jnp.arange(seq)
-    mask = (k_pos < n_true)[None, None, :]
-    if causal:
-        mask = mask & (k_pos[None, None, :] <= k_pos[None, :, None])
-    scores = jnp.where(mask, scores, _NEG_INF)
-    weights = jax.nn.softmax(scores, axis=-1)
-    og = jnp.einsum(
-        "hqk,khd->qhd", weights, vg.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
-    ).astype(q.dtype)
+        k_pos = jnp.arange(seq)
+        mask = (k_pos < n_true)[None, None, :]
+        if causal:
+            mask = mask & (k_pos[None, None, :] <= k_pos[None, :, None])
+        scores = jnp.where(mask, scores, _NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        og = jnp.einsum(
+            "hqk,khd->qhd", weights, vg.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST
+        ).astype(q.dtype)
     # (seq, h/p, d) -> (block, h, d)
     return jax.lax.all_to_all(og, name, split_axis=0, concat_axis=1, tiled=True)
 
@@ -184,8 +237,15 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     n_true: Optional[int] = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
-    """Exact attention via all-to-all sequence parallelism (Ulysses style)."""
+    """Exact attention via all-to-all sequence parallelism (Ulysses style).
+
+    ``use_flash=True`` runs the local full-sequence attention through the
+    Pallas flash kernel (TPU only): the (h/p, seq, seq) score tensor never
+    materializes, trading the einsum path's HIGHEST-precision matmuls for
+    the kernel's default MXU precision (~1e-2 f32 outputs).
+    """
     comm = sanitize_comm(comm)
     seq, h, d = q.shape
     if seq % comm.size:
@@ -194,13 +254,17 @@ def ulysses_attention(
         raise ValueError(f"ulysses needs heads ({h}) divisible by the mesh size ({comm.size})")
     scale = 1.0 / math.sqrt(d) if scale is None else scale
     n_true = seq if n_true is None else n_true
-    return _ulysses_fn(comm, float(scale), bool(causal), int(n_true))(q, k, v)
+    flash = bool(use_flash) and _flash_available()
+    return _ulysses_fn(comm, float(scale), bool(causal), int(n_true), flash)(q, k, v)
 
 
 @functools.lru_cache(maxsize=128)
-def _ulysses_fn(comm, scale, causal, n_true):
+def _ulysses_fn(comm, scale, causal, n_true, use_flash=False):
     """Jitted, cached Ulysses executable (see _ring_fn)."""
-    body = partial(_ulysses_body, comm=comm, scale=scale, causal=causal, n_true=n_true)
+    body = partial(
+        _ulysses_body, comm=comm, scale=scale, causal=causal, n_true=n_true,
+        use_flash=use_flash,
+    )
     return jax.jit(
         jax.shard_map(
             body,
@@ -239,8 +303,22 @@ def scaled_dot_product_attention(
     seq, h, d = q.shape
     scale = 1.0 / math.sqrt(d) if scale is None else scale
 
+    if method not in ("ring", "ulysses", "alltoall", "flash"):
+        raise ValueError(
+            f'method must be "ring", "ulysses", "alltoall" or "flash", got {method!r}'
+        )
+
     if q.split is None:
         qd, kd, vd = q._dense(), k._dense(), v._dense()
+        if method == "flash" and _flash_available():
+            # memory-bounded local kernel (opt-in): scales past the einsum
+            # path's (h, seq, seq) materialization limit at the cost of
+            # the kernel's default MXU precision
+            try:
+                out = _local_flash(qd, kd, vd, scale, causal, seq)
+                return DNDarray.from_dense(out, None, q.device, q.comm)
+            except Exception:
+                pass  # kernel rejected the shape -> einsum path
         scores = (
             jnp.einsum(
                 "qhd,khd->hqk", qd.astype(jnp.float32), kd,
@@ -259,12 +337,18 @@ def scaled_dot_product_attention(
     if q.split != 0:
         raise ValueError(f"attention is sequence-parallel over split=0, got split={q.split}")
 
-    fn = {"ring": ring_attention, "ulysses": ulysses_attention, "alltoall": ulysses_attention}.get(method)
-    if fn is None:
-        raise ValueError(f'method must be "ring", "ulysses" or "alltoall", got {method!r}')
-    out_padded = fn(
-        q.larray_padded, k.larray_padded, v.larray_padded,
-        comm=q.comm, causal=causal, scale=scale, n_true=seq,
-    )
+    # "flash" on a split sequence = Ulysses re-sharding with the flash
+    # local kernel (each device gets the full sequence for its heads)
+    if method == "ring":
+        out_padded = ring_attention(
+            q.larray_padded, k.larray_padded, v.larray_padded,
+            comm=q.comm, causal=causal, scale=scale, n_true=seq,
+        )
+    else:
+        out_padded = ulysses_attention(
+            q.larray_padded, k.larray_padded, v.larray_padded,
+            comm=q.comm, causal=causal, scale=scale, n_true=seq,
+            use_flash=(method == "flash"),
+        )
     sliced = out_padded[:seq]
     return DNDarray.from_dense(sliced, 0, q.device, q.comm)

@@ -95,8 +95,21 @@ class TestValidation:
         with pytest.raises(ValueError):
             ht.nn.scaled_dot_product_attention(
                 ht.array(q, split=0), ht.array(k, split=0), ht.array(v, split=0),
-                method="flash",
+                method="blocked",
             )
+
+    def test_flash_method_routes_to_ulysses(self, ht):
+        # on non-TPU backends "flash" is Ulysses re-sharding with the
+        # einsum local kernel — results must match the reference path
+        q, k, v = _qkv(16)
+        a = ht.nn.scaled_dot_product_attention(
+            ht.array(q, split=0), ht.array(k, split=0), ht.array(v, split=0),
+            method="flash", causal=True,
+        )
+        b = ht.nn.scaled_dot_product_attention(
+            ht.array(q), ht.array(k), ht.array(v), causal=True,
+        )
+        np.testing.assert_allclose(a.numpy(), b.numpy(), atol=1e-5)
 
     def test_rejects_wrong_rank(self, ht):
         q, k, v = _qkv(16)
