@@ -47,6 +47,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["sample_sort_1d", "supports_sample_sort", "SAMPLE_SORT_THRESHOLD"]
@@ -55,13 +56,13 @@ __all__ = ["sample_sort_1d", "supports_sample_sort", "SAMPLE_SORT_THRESHOLD"]
 #: collective over the gather path (tests lower it to force the path).
 SAMPLE_SORT_THRESHOLD = 1 << 22
 
-_SENT = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+# numpy scalar: evaluating jnp.uint64 at import time OverflowErrors when
+# jax_enable_x64 is off (the gate below requires x64, the import must not)
+_SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def supports_sample_sort(a, axis: int, descending: bool) -> bool:
     """Whether the PSRS fast path applies to this sort call."""
-    import numpy as np
-
     return (
         a.ndim == 1
         and a.split == 0

@@ -5,7 +5,7 @@ Mirrors the role of the reference's heat/core/version.py:1-17.
 
 major: int = 0
 """Major version number."""
-minor: int = 1
+minor: int = 2
 """Minor version number."""
 micro: int = 0
 """Micro (patch) version number."""
