@@ -20,6 +20,7 @@ from .printing import *
 from .statistics import *
 from .manipulations import *
 from .indexing import *
+from .fusion import *
 from .napi import *
 from .signal import *
 from .vmap import *

@@ -162,3 +162,58 @@ def test_transpose_padded():
         np.testing.assert_array_equal(t.numpy(), data.T)
         if split is not None:
             assert t.split == 1 - split
+
+
+class TestHtJit:
+    """ht.jit fusion layer (SURVEY build-plan decision 2)."""
+
+    def test_fuses_and_matches_eager(self, ht):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((13, 7))
+        w = rng.standard_normal((7, 4))
+
+        def pipeline(a, b, scale):
+            y = ht.tanh(a @ b) * scale
+            return y - ht.mean(y, axis=0), ht.sum(y)
+
+        fused = ht.jit(pipeline)
+        for split in (None, 0, 1):
+            got, tot = fused(ht.array(x, split=split), ht.array(w), 2.0)
+            want, wtot = pipeline(ht.array(x, split=split), ht.array(w), 2.0)
+            np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-10)
+            np.testing.assert_allclose(float(tot), float(wtot), rtol=1e-10)
+            assert got.split == want.split
+
+    def test_retrace_on_new_shape_and_static(self, ht):
+        calls = []
+
+        @ht.jit
+        def f(a, k):
+            calls.append(1)
+            return a * k
+
+        a = ht.arange(10, dtype=ht.float32, split=0)
+        f(a, 2.0)
+        f(a, 2.0)  # cached: no retrace
+        assert len(calls) == 1
+        f(a, 3.0)  # new static value -> retrace
+        assert len(calls) == 2
+        f(ht.arange(20, dtype=ht.float32, split=0), 3.0)  # new shape
+        assert len(calls) == 3
+
+    def test_rejects_unhashable_static(self, ht):
+        @ht.jit
+        def f(a, opts):
+            return a
+
+        with pytest.raises(TypeError):
+            f(ht.arange(4), np.zeros(3))  # raw ndarray: unhashable static
+
+    def test_container_statics_work(self, ht):
+        @ht.jit
+        def f(a, opts):
+            return a * opts["scale"] + opts["bias"][0]
+
+        a = ht.arange(5, dtype=ht.float32, split=0)
+        got = f(a, {"scale": 2.0, "bias": (1.0,)})
+        np.testing.assert_allclose(got.numpy(), np.arange(5) * 2.0 + 1.0)
