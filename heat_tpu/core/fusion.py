@@ -15,9 +15,13 @@ Semantics and limits (the usual jax.jit contract, surfaced at this level):
 
 * DNDarray arguments become traced values; everything else (ints, strings,
   shapes...) is STATIC — a new compilation per distinct value.
-* The function must be functional over its DNDarray inputs: host syncs
+* The function must be functional over its DNDarray inputs.  Host syncs
   (``float(x)``, ``x.numpy()``, data-dependent Python control flow) raise
-  jax's ConcretizationTypeError inside.
+  jax's ConcretizationTypeError inside.  In-place updates to an ARGUMENT
+  (``a += 1``, ``a[0] = ...``) do NOT raise — they rebind the traced
+  value, so the result is correct but the caller's array is left
+  unmodified (under eager execution the caller's array would mutate).
+  Return what you change.
 * Returned DNDarrays keep the split/device/comm they were constructed
   with inside the trace.
 """
@@ -50,7 +54,10 @@ class _ASpec:
         self.pdtype = str(padded.dtype)
 
     def _key(self):
-        return (self.shape, self.dtype, self.split, self.comm, self.pshape, self.pdtype)
+        return (
+            self.shape, self.dtype, self.split, self.device, self.comm,
+            self.pshape, self.pdtype,
+        )
 
     def __hash__(self):
         return hash(self._key())
@@ -75,6 +82,21 @@ def jit(fn: Callable = None, **jit_kwargs) -> Callable:
     """
     if fn is None:
         return lambda f: jit(f, **jit_kwargs)
+
+    # argument-indexed jax.jit options would be interpreted against the
+    # internal flattened array-leaf signature, not the user's parameters —
+    # silently donating/pinning the wrong argument.  Reject them.
+    _positional = {
+        "static_argnums", "static_argnames", "donate_argnums",
+        "donate_argnames", "in_shardings", "out_shardings",
+    }
+    bad = _positional.intersection(jit_kwargs)
+    if bad:
+        raise TypeError(
+            f"ht.jit does not accept argument-indexed jax.jit options "
+            f"({sorted(bad)}): indices would refer to the internal flattened "
+            f"signature, not your function's parameters"
+        )
 
     cache = {}
 

@@ -146,6 +146,15 @@ check("psrs sort values", np.array_equal(sv.numpy(), np.sort(sort_data)))
 check("psrs sort indices", np.array_equal(si.numpy(), np.argsort(sort_data, kind="stable")))
 sample_sort.SAMPLE_SORT_THRESHOLD = 1 << 22
 
+# ------------------------------------------------------------- pencil fft
+# split-axis FFT rides all_to_all across the process boundary (gloo DCN)
+fft_np = np.random.default_rng(77).standard_normal((4 * NDEV, 2 * NPROC))
+fft_in = ht.array(fft_np, split=0)
+spec = ht.fft.fft(fft_in, axis=0)
+check("pencil fft cross-process", np.allclose(spec.numpy(), np.fft.fft(fft_np, axis=0), atol=1e-10))
+back = ht.fft.ifft(spec, axis=0)
+check("pencil ifft roundtrip", np.allclose(back.numpy().real, fft_np, atol=1e-10))
+
 # ---------------------------------------------------------------- sharded io
 import tempfile
 import shutil

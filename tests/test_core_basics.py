@@ -217,3 +217,19 @@ class TestHtJit:
         a = ht.arange(5, dtype=ht.float32, split=0)
         got = f(a, {"scale": 2.0, "bias": (1.0,)})
         np.testing.assert_allclose(got.numpy(), np.arange(5) * 2.0 + 1.0)
+
+    def test_rejects_positional_jit_options(self, ht):
+        with pytest.raises(TypeError):
+            ht.jit(lambda a: a, donate_argnums=0)
+
+    def test_device_in_cache_key(self, ht):
+        # same shapes on different comms/devices must not share a trace
+        import jax as _jax
+        from heat_tpu.parallel import Communication
+
+        sub = Communication(_jax.devices()[:2])
+        f = ht.jit(lambda a: a * 2)
+        r1 = f(ht.arange(8, dtype=ht.float32, split=0))
+        r2 = f(ht.arange(8, dtype=ht.float32, split=0, comm=sub))
+        assert r1.comm.size != r2.comm.size
+        np.testing.assert_allclose(r1.numpy(), r2.numpy())
