@@ -28,6 +28,7 @@ from linalg import run_linalg_benchmarks
 from manipulations import run_manipulation_benchmarks
 from monitor import RESULTS, sync_floor
 from attention import run_attention_benchmarks
+from fft import run_fft_benchmarks
 from nn import run_nn_benchmarks
 from preprocessing import run_preprocessing_benchmarks
 
@@ -41,6 +42,7 @@ def main():
     run_preprocessing_benchmarks(scale)
     run_nn_benchmarks(scale)
     run_attention_benchmarks(scale)
+    run_fft_benchmarks(scale)
     total = sum(r["seconds"] for r in RESULTS)
     print(json.dumps({"bench": "TOTAL", "seconds": round(total, 3), "count": len(RESULTS)}))
 

@@ -28,6 +28,16 @@ def resplit(array, new_splits: List[Optional[int]]):
     return out
 
 
+@monitor()
+def sort_psrs(array):
+    return ht.sort(array)[0]
+
+
+@monitor()
+def topk_merge(array):
+    return ht.topk(array, 32)[0]
+
+
 def run_manipulation_benchmarks(scale: float = 1.0):
     sizes = [max(int(s * scale), 128) for s in (10000, 20000, 40000)]
     rows = max(int(1000 * scale), 64)
@@ -55,3 +65,20 @@ def run_manipulation_benchmarks(scale: float = 1.0):
             n_elements *= s
         array = ht.reshape(ht.arange(0, n_elements, split=0, dtype=ht.float32), shape)
         resplit(array, [None, 2, 4])
+
+    # PSRS sample-sort + distributed top-k (reference sorts in its
+    # manipulations suite; these are the round-2 no-gather collectives)
+    import jax as _jax
+
+    n_sort = max(int((1 << 22) * scale), 1 << 12)
+    if ht.get_comm().size > 1 and _jax.config.read("jax_enable_x64"):
+        from heat_tpu.core import sample_sort as _ss
+
+        saved = _ss.SAMPLE_SORT_THRESHOLD
+        _ss.SAMPLE_SORT_THRESHOLD = 1
+        try:
+            data = ht.random.rand(n_sort, split=0).astype(ht.float32)
+            sort_psrs(data)
+        finally:
+            _ss.SAMPLE_SORT_THRESHOLD = saved
+        topk_merge(data)
