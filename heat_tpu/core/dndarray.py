@@ -498,10 +498,24 @@ class DNDarray:
         """Canonicalize distribution (dndarray.py:1216-1366).
 
         The reference shuffles chunks to match an arbitrary ragged
-        ``target_map``; on TPU the per-device layout is XLA's concern, so any
-        requested target collapses to the canonical distribution (already in
-        place).  Accepted and ignored for API compatibility.
+        ``target_map``; on TPU the per-device layout is XLA's concern and
+        the canonical distribution is already in place, so a canonical (or
+        omitted) target is a no-op.  A target that genuinely differs from
+        the canonical map cannot be represented in the pad-and-mask model
+        and raises — silently ignoring it would leave callers reading
+        ``lshape`` under a false assumption.
         """
+        if target_map is not None:
+            requested = np.asarray(
+                target_map.numpy() if isinstance(target_map, DNDarray) else target_map
+            )
+            canonical = self.lshape_map
+            if requested.shape != canonical.shape or not (requested == canonical).all():
+                raise NotImplementedError(
+                    "arbitrary (non-canonical) target maps are not representable "
+                    "in the canonical pad-and-mask distribution; use resplit_ to "
+                    "change the split axis instead"
+                )
         return self
 
     def collect_(self, target_rank: int = 0) -> "DNDarray":

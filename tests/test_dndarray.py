@@ -350,3 +350,12 @@ def test_setitem_replicated_keeps_canonical_sharding(ht):
     a[:] = b
     want = a.comm.sharding(None, 2)
     assert a.larray_padded.sharding.is_equivalent_to(want, 2)
+
+
+def test_redistribute_rejects_noncanonical(ht, np2d):
+    a = ht.array(np2d, split=0)
+    bad = a.lshape_map.copy()
+    bad[0, 0] += 1
+    bad[1, 0] -= 1
+    with pytest.raises(NotImplementedError):
+        a.redistribute_(target_map=bad)
