@@ -57,9 +57,10 @@ class TestRingAttention:
             ht.array(q, split=0).larray_padded,
             ht.array(k, split=0).larray_padded,
             ht.array(v, split=0).larray_padded,
+            n_true=2048,  # padded tail on non-divisor meshes is masked
         )
         np.testing.assert_allclose(
-            np.asarray(out), _dense_attention(q, k, v), rtol=2e-4, atol=2e-4
+            np.asarray(out)[:2048], _dense_attention(q, k, v), rtol=2e-4, atol=2e-4
         )
 
 
@@ -67,7 +68,8 @@ class TestUlyssesAttention:
     @pytest.mark.parametrize("seq", [16, 13])
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_dense(self, ht, seq, causal):
-        q, k, v = _qkv(seq)  # h=8 divides the 8-device mesh
+        # heads must divide whatever mesh the CI lane runs (3 or 8)
+        q, k, v = _qkv(seq, h=2 * ht.get_comm().size)
         hq, hk, hv = (ht.array(x, split=0) for x in (q, k, v))
         out = ht.nn.scaled_dot_product_attention(hq, hk, hv, causal=causal, method="ulysses")
         np.testing.assert_allclose(
@@ -75,9 +77,10 @@ class TestUlyssesAttention:
         )
 
     def test_rejects_indivisible_heads(self, ht):
-        q, k, v = _qkv(16, h=6)
+        h_bad = ht.get_comm().size + 1  # never divisible for size > 1
+        q, k, v = _qkv(16, h=h_bad)
         hq, hk, hv = (ht.array(x, split=0) for x in (q, k, v))
-        if hq.comm.size > 1 and 6 % hq.comm.size:
+        if hq.comm.size > 1:
             with pytest.raises(ValueError):
                 ht.nn.scaled_dot_product_attention(hq, hk, hv, method="ulysses")
 
@@ -101,7 +104,7 @@ class TestValidation:
     def test_flash_method_routes_to_ulysses(self, ht):
         # on non-TPU backends "flash" is Ulysses re-sharding with the
         # einsum local kernel — results must match the reference path
-        q, k, v = _qkv(16)
+        q, k, v = _qkv(16, h=2 * ht.get_comm().size)
         a = ht.nn.scaled_dot_product_attention(
             ht.array(q, split=0), ht.array(k, split=0), ht.array(v, split=0),
             method="flash", causal=True,

@@ -10,9 +10,11 @@ import heat_tpu as ht
 
 def test_devices_present():
     import jax
+    import os
 
-    assert len(jax.devices()) == 8
-    assert ht.get_comm().size == 8
+    want = int(os.environ.get("HEAT_TPU_TEST_DEVICES", "8"))
+    assert len(jax.devices()) == want
+    assert ht.get_comm().size == want
 
 
 def test_smoke_arange_split0():
@@ -26,20 +28,22 @@ def test_smoke_arange_split0():
 
 def test_comm_chunk():
     comm = ht.get_comm()
-    # 10 elements over 8 devices: padded to 16, 2 per rank; ranks 5-7 hold
-    # padding only
+    p = comm.size
+    per = -(-10 // p)  # ceil(10/p) rows per rank in the padded layout
     off, lshape, _ = comm.chunk((10,), 0, rank=0)
-    assert (off, lshape) == (0, (2,))
-    off, lshape, _ = comm.chunk((10,), 0, rank=4)
-    assert (off, lshape) == (8, (2,))
-    off, lshape, _ = comm.chunk((10,), 0, rank=5)
-    assert lshape == (0,)
+    assert (off, lshape) == (0, (per,))
+    covered = 0
+    for r in range(p):
+        off, lshape, _ = comm.chunk((10,), 0, rank=r)
+        assert off == min(r * per, 10)
+        covered += lshape[0]
+    assert covered == 10  # true rows partition exactly
 
 
 def test_lshape_map():
     a = ht.arange(10, split=0)
     lmap = a.lshape_map
-    assert lmap.shape == (8, 1)
+    assert lmap.shape == (ht.get_comm().size, 1)
     assert lmap[:, 0].sum() == 10
 
 
@@ -138,7 +142,7 @@ def test_partitioned_protocol():
     a = ht.arange(16, split=0)
     p = a.__partitioned__
     assert p["shape"] == (16,)
-    assert len(p["partitions"]) == 8
+    assert len(p["partitions"]) == ht.get_comm().size
     b = ht.from_partition_dict(
         {
             "shape": (4,),

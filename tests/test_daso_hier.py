@@ -4,8 +4,8 @@ Reference: heat/optim/dp_optimizer.py:64-850 (DASO: node-local DDP sync
 every batch, cross-node bf16 parameter averaging every ``global_skips``
 batches with delayed application) and heat/nn/data_parallel.py:313
 (DataParallelMultiGPU).  The TPU-native topology is a
-(n_node, per_node) mesh; these tests run it as (2, 4) on the virtual
-8-device CPU mesh.
+(n_node, per_node) mesh; these tests derive the grid from the CI
+lane's mesh size (8 -> (2, 4), 3 -> (3, 1)).
 """
 
 import numpy as np
@@ -15,25 +15,30 @@ import heat_tpu as ht
 from heat_tpu.parallel import HierarchicalCommunication
 
 
+def _grid():
+    """(n_node, per_node) that tiles whatever mesh the CI lane runs."""
+    n = ht.get_comm().size
+    return (2, n // 2) if n % 2 == 0 else (n, 1)
+
+
 def test_hier_comm_topology():
-    hc = HierarchicalCommunication(grid=(2, 4))
-    assert hc.num_nodes == 2
-    assert hc.node_size == 4
-    assert hc.size == 8
+    hc = HierarchicalCommunication(grid=_grid())
+    assert (hc.num_nodes, hc.node_size) == _grid()
+    assert hc.size == ht.get_comm().size
     assert hc.global_axis == "global"
     assert hc.node_axis == "node"
     assert hc.is_distributed
-    assert "nodes=2" in repr(hc)
+    assert f"nodes={_grid()[0]}" in repr(hc)
 
 
 def test_hier_comm_bad_grid():
     with pytest.raises(ValueError):
-        HierarchicalCommunication(grid=(3, 4))._ensure()
+        HierarchicalCommunication(grid=(ht.get_comm().size, 4))._ensure()
 
 
 def test_hier_comm_as_data_comm():
     # drop-in Communication: a split array shards over the flattened grid
-    hc = HierarchicalCommunication(grid=(2, 4))
+    hc = HierarchicalCommunication(grid=_grid())
     x = ht.arange(17, dtype=ht.float32, split=0, comm=hc)
     assert x.shape == (17,)
     np.testing.assert_array_equal(x.numpy(), np.arange(17, dtype=np.float32))
@@ -45,16 +50,17 @@ def test_daso_replicate_collect():
     import jax.numpy as jnp
     import optax
 
-    hc = HierarchicalCommunication(grid=(2, 4))
+    hc = HierarchicalCommunication(grid=_grid())
     daso = ht.optim.DASO(
         local_optimizer=optax.sgd(0.1), total_epochs=10, comm=hc,
         warmup_epochs=0, cooldown_epochs=0,
     )
     assert daso.hierarchical
+    n = _grid()[0]
     params = {"w": jnp.ones((4,), jnp.float32), "b": jnp.zeros((2, 3), jnp.float32)}
     stacked = daso.replicate(params)
-    assert stacked["w"].shape == (2, 4)
-    assert stacked["b"].shape == (2, 2, 3)
+    assert stacked["w"].shape == (n, 4)
+    assert stacked["b"].shape == (n, 2, 3)
     back = daso.collect(stacked)
     np.testing.assert_array_equal(np.asarray(back["w"]), np.ones(4))
 
@@ -66,7 +72,7 @@ def test_daso_global_sync_is_a_real_average():
     import jax.numpy as jnp
     import optax
 
-    hc = HierarchicalCommunication(grid=(2, 4))
+    hc = HierarchicalCommunication(grid=_grid())
     daso = ht.optim.DASO(
         local_optimizer=optax.sgd(0.1), total_epochs=100, comm=hc,
         warmup_epochs=0, cooldown_epochs=0,
@@ -74,28 +80,31 @@ def test_daso_global_sync_is_a_real_average():
     daso.global_skip = 4
     daso.batches_to_wait = 0
 
+    n = _grid()[0]
     params = daso.replicate({"w": jnp.ones((4,), jnp.float32)})
-    # node 0 sees gradient 1.0, node 1 sees gradient 3.0 every batch
-    grads = {"w": jnp.stack([jnp.full((4,), 1.0), jnp.full((4,), 3.0)])}
+    # node i sees gradient 1 + 2i every batch (distinct per node)
+    gvals = np.array([1.0 + 2 * i for i in range(n)])
+    grads = {"w": jnp.stack([jnp.full((4,), g, jnp.float32) for g in gvals])}
+    gbar = gvals.mean()
 
-    # batch 0: local step then sync (0 % 4 == 0).  mean(1-0.1, 1-0.3) = 0.8
+    # batch 0: local step then sync (0 % 4 == 0) -> mean(1 - 0.1 * g_i)
     params = daso.step(params, grads)
     w = np.asarray(params["w"], dtype=np.float64)
-    np.testing.assert_allclose(w[0], 0.8, atol=1e-2)
-    np.testing.assert_allclose(w[0], w[1], atol=1e-7)
+    np.testing.assert_allclose(w[0], 1.0 - 0.1 * gbar, atol=1e-2)
+    np.testing.assert_allclose(w[0], w[-1], atol=1e-7)
 
     # batches 1-3: no sync -> replicas diverge by per-node gradients
     for k in range(3):
         params = daso.step(params, grads)
         w = np.asarray(params["w"], dtype=np.float64)
-        assert abs(w[0, 0] - w[1, 0]) > 0.1 * (k + 1) * 1.9, (k, w)
+        assert abs(w[0, 0] - w[-1, 0]) > 0.1 * (k + 1) * (gvals[-1] - gvals[0]) * 0.95, (k, w)
 
     # batch 4: sync -> replicas equal again, at the true cross-node mean
     params = daso.step(params, grads)
     w = np.asarray(params["w"], dtype=np.float64)
-    np.testing.assert_allclose(w[0], w[1], atol=1e-7)
-    # trajectory mean: 0.8 - 4 * 0.1 * mean(1, 3) = 0.0
-    np.testing.assert_allclose(w[0], 0.0, atol=2e-2)
+    np.testing.assert_allclose(w[0], w[-1], atol=1e-7)
+    # trajectory mean: 1 - 5 * 0.1 * mean(g) = 1 - 0.5 * gbar
+    np.testing.assert_allclose(w[0], 1.0 - 0.5 * gbar, atol=3e-2)
 
 
 def test_daso_sync_lowers_to_cross_node_allreduce():
@@ -105,7 +114,7 @@ def test_daso_sync_lowers_to_cross_node_allreduce():
     import jax.numpy as jnp
     import optax
 
-    hc = HierarchicalCommunication(grid=(2, 4))
+    hc = HierarchicalCommunication(grid=_grid())
     daso = ht.optim.DASO(
         local_optimizer=optax.sgd(0.1), total_epochs=10, comm=hc,
         warmup_epochs=0, cooldown_epochs=0,
@@ -119,30 +128,31 @@ def test_daso_delayed_application():
     import jax.numpy as jnp
     import optax
 
-    hc = HierarchicalCommunication(grid=(2, 4))
+    hc = HierarchicalCommunication(grid=_grid())
     daso = ht.optim.DASO(
         local_optimizer=optax.sgd(0.1), total_epochs=100, comm=hc,
         warmup_epochs=0, cooldown_epochs=0,
     )
     daso.global_skip = 2
     daso.batches_to_wait = 1
+    n = _grid()[0]
     params = daso.replicate({"w": jnp.ones((4,), jnp.float32)})
-    grads = {"w": jnp.stack([jnp.full((4,), 1.0), jnp.full((4,), 3.0)])}
+    grads = {"w": jnp.stack([jnp.full((4,), 1.0 + 2 * i, jnp.float32) for i in range(n)])}
 
     # batch 0: sync computed but applied one batch later
     params = daso.step(params, grads)
     w = np.asarray(params["w"])
-    assert abs(w[0, 0] - w[1, 0]) > 0.1  # not yet applied
+    assert abs(w[0, 0] - w[-1, 0]) > 0.1  # not yet applied
     assert daso._pending is not None
     # batch 1: the stale average lands (replacing local progress)
     params = daso.step(params, grads)
     w = np.asarray(params["w"])
-    np.testing.assert_allclose(w[0], w[1], atol=1e-7)
+    np.testing.assert_allclose(w[0], w[-1], atol=1e-7)
     # last_batch force-applies any in-flight average
     params = daso.step(params, grads)  # batch 2: sync scheduled again
     params = daso.last_batch(params)
     w = np.asarray(params["w"])
-    np.testing.assert_allclose(w[0], w[1], atol=1e-7)
+    np.testing.assert_allclose(w[0], w[-1], atol=1e-7)
 
 
 def test_data_parallel_multi_gpu_trains(mlp_factory=None):
@@ -150,7 +160,7 @@ def test_data_parallel_multi_gpu_trains(mlp_factory=None):
     import optax
 
     rng = np.random.default_rng(0)
-    X = rng.normal(size=(64, 8)).astype(np.float32)
+    X = rng.normal(size=(48, 8)).astype(np.float32)  # 48 divides 2- and 3-node grids
     w_true = rng.normal(size=(8,)).astype(np.float32)
     y = (X @ w_true > 0).astype(np.int32)
 
@@ -163,7 +173,7 @@ def test_data_parallel_multi_gpu_trains(mlp_factory=None):
             x = lnn.relu(x)
             return lnn.Dense(2)(x)
 
-    hc = HierarchicalCommunication(grid=(2, 4))
+    hc = HierarchicalCommunication(grid=_grid())
     daso = ht.optim.DASO(
         local_optimizer=optax.adam(1e-2), total_epochs=100, comm=hc,
         warmup_epochs=0, cooldown_epochs=0,
@@ -172,7 +182,7 @@ def test_data_parallel_multi_gpu_trains(mlp_factory=None):
     daso.batches_to_wait = 0
     dp = ht.nn.DataParallelMultiGPU(MLP(), daso=daso)
     dp.init(jax.random.PRNGKey(0), X)
-    assert jax.tree_util.tree_leaves(dp.params)[0].shape[0] == 2  # per-node replicas
+    assert jax.tree_util.tree_leaves(dp.params)[0].shape[0] == _grid()[0]  # per-node replicas
 
     def loss_fn(pred, target):
         return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
@@ -195,8 +205,8 @@ def test_daso_differs_from_plain_dp():
     import optax
 
     rng = np.random.default_rng(1)
-    X = rng.normal(size=(32, 4)).astype(np.float32)
-    y = rng.integers(0, 2, size=(32,)).astype(np.int32)
+    X = rng.normal(size=(48, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(48,)).astype(np.int32)
 
     import flax.linen as lnn
 
@@ -209,7 +219,7 @@ def test_daso_differs_from_plain_dp():
         return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
 
     def run(skip):
-        hc = HierarchicalCommunication(grid=(2, 4))
+        hc = HierarchicalCommunication(grid=_grid())
         daso = ht.optim.DASO(
             local_optimizer=optax.adam(1e-2), total_epochs=100, comm=hc,
             warmup_epochs=0, cooldown_epochs=0,

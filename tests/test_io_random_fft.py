@@ -341,8 +341,9 @@ class TestPencilFFT:
         import importlib
 
         fft_mod = importlib.import_module("heat_tpu.fft.fft")
-        a = ht.array(np.zeros((24, 16, 8)), split=0)
-        fn = fft_mod._pencil_fn(a.comm, "fft", 0, 1, 24, 3, None)
+        p = ht.get_comm().size
+        a = ht.array(np.zeros((3 * p, 2 * p, 8)), split=0)
+        fn = fft_mod._pencil_fn(a.comm, "fft", 0, 1, 3 * p, 3, None)
         txt = fn.lower(a.larray_padded.astype(np.complex128)).compile().as_text()
         assert "all-to-all" in txt
         assert "all-gather" not in txt

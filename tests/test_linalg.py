@@ -73,6 +73,8 @@ def test_qr_ragged_sweep(p_dev):
 
     qr_mod = importlib.import_module("heat_tpu.core.linalg.qr")
 
+    if p_dev > len(jax.devices()):
+        pytest.skip(f"lane has {len(jax.devices())} devices")
     comm = Communication(jax.devices()[:p_dev])
     rng = np.random.default_rng(21)
     tsqr_before = qr_mod._tsqr_fn.cache_info().misses

@@ -85,17 +85,20 @@ def test_halo_shard_map():
     from heat_tpu.parallel.halo import with_halos
 
     comm = ht.get_comm()
-    data = jnp.arange(32.0).reshape(16, 2)
+    p = comm.size
+    rows = 2 * p  # two true rows per shard on any CI mesh
+    data = jnp.arange(float(rows * 2)).reshape(rows, 2)
     a = ht.array(data, split=0)
     out = np.asarray(with_halos(comm, a.larray_padded, 1, 0))
-    assert out.shape == (8, 4, 2)  # 8 shards of 2 rows + 2 halo rows
+    assert out.shape == (p, 4, 2)  # p shards of 2 rows + 2 halo rows
     # middle shard r: rows [2r-1 .. 2r+2]
-    np.testing.assert_allclose(out[3, 1:3], np.asarray(data[6:8]))
-    np.testing.assert_allclose(out[3, 0], np.asarray(data[5]))
-    np.testing.assert_allclose(out[3, 3], np.asarray(data[8]))
+    r = p // 2
+    np.testing.assert_allclose(out[r, 1:3], np.asarray(data[2 * r : 2 * r + 2]))
+    np.testing.assert_allclose(out[r, 0], np.asarray(data[2 * r - 1]))
+    np.testing.assert_allclose(out[r, 3], np.asarray(data[2 * r + 2]))
     # edges zero-filled
     np.testing.assert_allclose(out[0, 0], 0.0)
-    np.testing.assert_allclose(out[7, 3], 0.0)
+    np.testing.assert_allclose(out[p - 1, 3], 0.0)
 
 
 def test_checkpoint_roundtrip(tmp_path):
