@@ -58,8 +58,21 @@ class PCA(BaseEstimator, TransformMixin):
         self.singular_values_ = None
         self.mean_ = None
         self.n_components_ = None
-        self.total_explained_variance_ratio_ = None
+        self._tevr = None
         self.noise_variance_ = None
+
+    @property
+    def total_explained_variance_ratio_(self):
+        # fits store a lazy device scalar (no host sync inside fit); the
+        # conversion happens once on first access
+        v = self._tevr
+        if v is not None and not isinstance(v, float):
+            self._tevr = v = float(v)
+        return v
+
+    @total_explained_variance_ratio_.setter
+    def total_explained_variance_ratio_(self, value):
+        self._tevr = value
 
     def fit(self, X: DNDarray, y=None) -> "PCA":
         """Estimate principal components (pca.py:210)."""
@@ -101,7 +114,7 @@ class PCA(BaseEstimator, TransformMixin):
             self.explained_variance_ = DNDarray.from_dense(ev[:kk], None, X.device, X.comm)
             ratio = ev / jnp.maximum(jnp.sum(ev), 1e-30)
             self.explained_variance_ratio_ = DNDarray.from_dense(ratio[:kk], None, X.device, X.comm)
-            self.total_explained_variance_ratio_ = float(jnp.sum(ratio[:kk]))
+            self._tevr = jnp.sum(ratio[:kk])
             self.n_components_ = kk
         elif self.svd_solver == "hierarchical":
             if rtol is not None:
@@ -113,10 +126,10 @@ class PCA(BaseEstimator, TransformMixin):
             s = S._dense()
             ev = s**2 / max(n - 1, 1)
             self.explained_variance_ = DNDarray.from_dense(ev, None, X.device, X.comm)
-            total_var = float(jnp.sum(centered._dense() ** 2)) / max(n - 1, 1)
-            ratio = ev / max(total_var, 1e-30)
+            total_var = jnp.sum(centered._dense().astype(jnp.float32) ** 2) / max(n - 1, 1)
+            ratio = ev / jnp.maximum(total_var, 1e-30)
             self.explained_variance_ratio_ = DNDarray.from_dense(ratio, None, X.device, X.comm)
-            self.total_explained_variance_ratio_ = 1.0 - float(err) ** 2
+            self._tevr = 1.0 - err**2
             self.n_components_ = int(s.shape[0])
         else:  # randomized
             if k is None:
@@ -128,9 +141,11 @@ class PCA(BaseEstimator, TransformMixin):
             s = S._dense()
             ev = s**2 / max(n - 1, 1)
             self.explained_variance_ = DNDarray.from_dense(ev, None, X.device, X.comm)
-            total_var = float(jnp.sum(centered._dense() ** 2)) / max(n - 1, 1)
-            self.explained_variance_ratio_ = DNDarray.from_dense(ev / max(total_var, 1e-30), None, X.device, X.comm)
-            self.total_explained_variance_ratio_ = float(jnp.sum(ev)) / max(total_var, 1e-30)
+            total_var = jnp.sum(centered._dense().astype(jnp.float32) ** 2) / max(n - 1, 1)
+            self.explained_variance_ratio_ = DNDarray.from_dense(
+                ev / jnp.maximum(total_var, 1e-30), None, X.device, X.comm
+            )
+            self._tevr = jnp.sum(ev) / jnp.maximum(total_var, 1e-30)
             self.n_components_ = k
         return self
 
