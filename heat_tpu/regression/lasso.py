@@ -69,7 +69,7 @@ class Lasso(BaseEstimator, RegressionMixin):
         self.max_iter = max_iter
         self.tol = tol
         self.__theta = None
-        self.n_iter = None
+        self._n_iter = None
 
     @property
     def coef_(self) -> Optional[DNDarray]:
@@ -104,6 +104,14 @@ class Lasso(BaseEstimator, RegressionMixin):
         diff = gt._dense().ravel() - yest._dense().ravel()
         return float(jnp.sqrt(jnp.mean(diff * diff)))
 
+    @property
+    def n_iter(self):
+        # fit stores the device scalar so it never blocks on the link
+        v = self._n_iter
+        if v is not None and not isinstance(v, int):
+            self._n_iter = v = int(v)
+        return v
+
     def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
         """Cyclic coordinate descent (lasso.py:120)."""
         if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
@@ -127,7 +135,7 @@ class Lasso(BaseEstimator, RegressionMixin):
             jnp.asarray(self.tol, jnp.float32),
             self.max_iter,
         )
-        self.n_iter = int(it)  # the loop's only host sync
+        self._n_iter = it  # lazy: n_iter converts on first access
         self.__theta = DNDarray.from_dense(theta.reshape(-1, 1), None, x.device, x.comm)
         return self
 
