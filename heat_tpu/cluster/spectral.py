@@ -6,6 +6,7 @@ Lanczos eigen-embedding -> KMeans on the leading eigenvectors.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Union
 
 import jax.numpy as jnp
@@ -18,6 +19,45 @@ from ..spatial import distance
 from .kmeans import KMeans
 
 __all__ = ["Spectral"]
+
+
+def _make_similarity(metric: str, gamma: float):
+    if metric == "rbf":
+        sigma = float(jnp.sqrt(1.0 / (2.0 * gamma)))
+        return lambda x: distance.rbf(x, sigma=sigma)
+    if metric == "euclidean":
+        # expanded form: one MXU matmul instead of an O(n^2 f) VPU reduce
+        return lambda x: distance.cdist(x, quadratic_expansion=True)
+    raise NotImplementedError(
+        f"Other kernels than rbf and euclidean are currently not supported, got {metric!r}"
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _embed_fn(metric: str, gamma: float, mode: str, boundary: str, threshold: float):
+    """Fused spectral-embedding program, cached per Laplacian config so
+    every Spectral instance with the same settings reuses one compilation
+    (an instance-level cache would recompile on every fresh estimator)."""
+    from ..core import fusion
+    from ..core.linalg import solver
+
+    laplacian = Laplacian(
+        _make_similarity(metric, gamma), definition="norm_sym", mode=mode,
+        threshold_key=boundary, threshold_value=threshold,
+    )
+
+    @fusion.jit
+    def embed(xx, vv, m):
+        L = laplacian.construct(xx)
+        vd = vv._dense()
+        vn = vd / jnp.linalg.norm(vd)
+        V, T = solver.lanczos(L, m, v0=DNDarray.from_dense(vn, None, xx.device, xx.comm))
+        evals, evecs_T = jnp.linalg.eigh(T._dense())
+        # eigenvectors of L approx V @ eigenvectors(T)
+        embedding = V._dense() @ evecs_T
+        return evals, DNDarray.from_dense(embedding, xx.split, xx.device, xx.comm)
+
+    return embed
 
 
 class Spectral(BaseEstimator, ClusteringMixin):
@@ -44,15 +84,7 @@ class Spectral(BaseEstimator, ClusteringMixin):
         self.n_lanczos = n_lanczos
         self.assign_labels = assign_labels
 
-        if metric == "rbf":
-            sigma = jnp.sqrt(1.0 / (2.0 * gamma))
-            sim = lambda x: distance.rbf(x, sigma=float(sigma))
-        elif metric == "euclidean":
-            # expanded form: one MXU matmul instead of an O(n^2 f) VPU reduce
-            sim = lambda x: distance.cdist(x, quadratic_expansion=True)
-        else:
-            raise NotImplementedError(f"Other kernels than rbf and euclidean are currently not supported, got {metric!r}")
-
+        sim = _make_similarity(metric, gamma)
         self._laplacian = Laplacian(
             sim, definition="norm_sym", mode=laplacian, threshold_key=boundary, threshold_value=threshold
         )
@@ -68,17 +100,23 @@ class Spectral(BaseEstimator, ClusteringMixin):
         return self._labels
 
     def _spectral_embedding(self, x: DNDarray):
-        """Laplacian + Lanczos eigendecomposition (spectral.py:120+)."""
-        from ..core.linalg import solver
+        """Laplacian + Lanczos eigendecomposition (spectral.py:120+).
 
-        L = self._laplacian.construct(x)
-        n = L.shape[0]
+        The whole pipeline (similarity, Laplacian, Krylov loop, small
+        eigh, embedding matmul) runs as ONE ht.jit program — dispatched
+        eagerly it is ~20 ops, each a link round-trip on a tunneled chip.
+        The Lanczos start vector is drawn OUTSIDE the trace so the library
+        RNG stream advances per fit instead of being baked into the cache.
+        """
+        from ..core import random as ht_random
+
+        n = x.shape[0]
         m = min(self.n_lanczos, n)
-        V, T = solver.lanczos(L, m)
-        evals, evecs_T = jnp.linalg.eigh(T._dense())
-        # eigenvectors of L approx V @ eigenvectors(T)
-        embedding = V._dense() @ evecs_T
-        return evals, DNDarray.from_dense(embedding, x.split, x.device, x.comm)
+        v0 = ht_random.randn(n, comm=x.comm)
+        embed = _embed_fn(
+            self.metric, float(self.gamma), self.laplacian, self.boundary, float(self.threshold)
+        )
+        return embed(x, v0, m)
 
     def fit(self, x: DNDarray) -> "Spectral":
         """Embed and cluster (spectral.py:172)."""
