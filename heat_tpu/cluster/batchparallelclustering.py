@@ -76,6 +76,33 @@ def _lloyd_batch(key, X, k, max_iter, tol, medians: bool):
     return centers
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("k", "max_iter", "medians", "p"))
+def _bp_fit(dense, key, tol, k: int, max_iter: int, medians: bool, p: int):
+    """The whole batch-parallel fit as one cached compiled program — the
+    unjitted version retraced the vmapped Lloyd loop on every fit (~4s of
+    tracing for a millisecond of compute)."""
+    n = dense.shape[0]
+    if p > 1 and n >= p * k:
+        per = n // p
+        batches = dense[: per * p].reshape(p, per, -1)
+        keys = jax.random.split(key, p + 1)
+        local_centers = jax.vmap(
+            lambda kk, b: _lloyd_batch(kk, b, k, max_iter, tol, medians)
+        )(keys[:p], batches)
+        stacked = local_centers.reshape(p * k, -1)
+        return _lloyd_batch(keys[p], stacked, k, max_iter, tol, medians)
+    return _lloyd_batch(key, dense, k, max_iter, tol, medians)
+
+
+@jax.jit
+def _bp_predict(dense, centers):
+    d = jnp.sum((dense[:, None, :] - centers[None, :, :]) ** 2, axis=-1)
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+
 class _BatchParallelKCluster(BaseEstimator, ClusteringMixin):
     """Shared machinery (batchparallelclustering.py:90)."""
 
@@ -111,21 +138,10 @@ class _BatchParallelKCluster(BaseEstimator, ClusteringMixin):
         seed = self.random_state if self.random_state is not None else 0
         key = jax.random.PRNGKey(seed)
 
-        p = x.comm.size
-        n = dense.shape[0]
-        if p > 1 and n >= p * k:
-            # per-shard local clustering, batched with vmap
-            per = n // p
-            batches = dense[: per * p].reshape(p, per, -1)
-            keys = jax.random.split(key, p + 1)
-            local_centers = jax.vmap(
-                lambda kk, b: _lloyd_batch(kk, b, k, self.max_iter, self.tol, self._medians)
-            )(keys[:p], batches)
-            stacked = local_centers.reshape(p * k, -1)
-            final = _lloyd_batch(keys[p], stacked, k, self.max_iter, self.tol, self._medians)
-        else:
-            final = _lloyd_batch(key, dense, k, self.max_iter, self.tol, self._medians)
-
+        final = _bp_fit(
+            dense, key, jnp.asarray(self.tol, dense.dtype),
+            k, self.max_iter, self._medians, x.comm.size,
+        )
         self._cluster_centers = DNDarray.from_dense(final, None, x.device, x.comm)
         self._labels = self.predict(x)
         return self
@@ -136,8 +152,7 @@ class _BatchParallelKCluster(BaseEstimator, ClusteringMixin):
         dense = x._dense()
         if not types.heat_type_is_inexact(x.dtype):
             dense = dense.astype(jnp.float32)
-        d = jnp.sum((dense[:, None, :] - self._cluster_centers._dense()[None, :, :]) ** 2, axis=-1)
-        labels = jnp.argmin(d, axis=1).astype(jnp.int64)
+        labels = _bp_predict(dense, self._cluster_centers._dense())
         return DNDarray.from_dense(labels, x.split, x.device, x.comm)
 
 
