@@ -84,9 +84,13 @@ class Spectral(BaseEstimator, ClusteringMixin):
         self.n_lanczos = n_lanczos
         self.assign_labels = assign_labels
 
-        sim = _make_similarity(metric, gamma)
+        # kept for API parity / introspection only — the fit path goes
+        # through _embed_fn, which derives an IDENTICAL Laplacian from the
+        # same (metric, gamma, mode, boundary, threshold) config so fused
+        # compilations are shared across estimator instances
         self._laplacian = Laplacian(
-            sim, definition="norm_sym", mode=laplacian, threshold_key=boundary, threshold_value=threshold
+            _make_similarity(metric, gamma), definition="norm_sym", mode=laplacian,
+            threshold_key=boundary, threshold_value=threshold,
         )
         if assign_labels == "kmeans":
             self._cluster = KMeans(n_clusters=n_clusters, init="kmeans++") if n_clusters else KMeans(init="kmeans++")

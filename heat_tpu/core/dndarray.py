@@ -1142,28 +1142,46 @@ def _tpu_complex_ok() -> bool:
     import tempfile
 
     kind = jax.devices()[0].device_kind.replace(" ", "_").replace("/", "_")
-    cache = pathlib.Path(tempfile.gettempdir()) / f"heat_tpu_complex_{kind}.flag"
+    uid = getattr(os, "getuid", lambda: 0)()
+    cache = pathlib.Path(tempfile.gettempdir()) / f"heat_tpu_complex_{kind}_{uid}.flag"
     if cache.exists():
         _TPU_COMPLEX_OK = cache.read_text().strip() == "1"
         return _TPU_COMPLEX_OK
 
     code = (
         "import jax, numpy as np\n"
-        "p = jax.device_put(np.ones((2,), np.complex64), jax.devices()[0])\n"
+        "try:\n"
+        "    d = jax.devices()[0]\n"
+        "except Exception:\n"
+        "    print('INCONCLUSIVE'); raise SystemExit(0)\n"
+        "p = jax.device_put(np.ones((2,), np.complex64), d)\n"
         "print('OK' if np.asarray(p * p)[0].real == 1.0 else 'NO')\n"
     )
     try:
         out = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, timeout=180
         )
-        ok = out.returncode == 0 and b"OK" in out.stdout
+        if b"OK" in out.stdout:
+            ok, conclusive = True, True
+        elif out.returncode == 0 and b"NO" in out.stdout:
+            ok, conclusive = False, True
+        elif out.returncode != 0 and b"INCONCLUSIVE" not in out.stdout:
+            # the probe RAN and died — the complex op itself crashed
+            ok, conclusive = False, True
+        else:
+            # backend init failed (e.g. the parent holds the chip under an
+            # exclusive lock, as on standard TPU VMs): assume supported —
+            # poisoning runtimes admit multiple clients, and demoting
+            # complex to the host on capable hardware is the worse error
+            ok, conclusive = True, False
     except Exception:
-        ok = False
+        ok, conclusive = True, False
     _TPU_COMPLEX_OK = ok
-    try:
-        cache.write_text("1" if ok else "0")
-    except OSError:  # pragma: no cover - read-only tempdir
-        pass
+    if conclusive:
+        try:
+            cache.write_text("1" if ok else "0")
+        except OSError:  # pragma: no cover - read-only tempdir
+            pass
     return _TPU_COMPLEX_OK
 
 

@@ -354,7 +354,13 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
             from .sanitation import sanitize_out
 
             sanitize_out(out, res_v.shape, res_v.split, res_v.device)
-            out._replace(res_v.astype(out.dtype).larray_padded)
+            # rebuild in OUT's layout — swapping in the split-0 padded
+            # backing array would corrupt an out with a different split
+            out._replace(
+                DNDarray.from_dense(
+                    res_v.astype(out.dtype)._dense(), out.split, out.device, out.comm
+                ).larray_padded
+            )
             return out, res_i
         return res_v, res_i
 
@@ -464,8 +470,12 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         and a.comm.size > 1
         and 0 < k <= a.shape[0]
         and out is None
-        # int "smallest" needs a negation that overflows at INT_MIN: dense path
-        and (np.issubdtype(_np_dt, np.floating) or largest)
+        # int "smallest" needs a negation that overflows at INT_MIN, and
+        # bool has no iinfo sentinel: both keep the dense path
+        and (
+            np.issubdtype(_np_dt, np.floating)
+            or (largest and _np_dt != np.dtype(bool))
+        )
     ):
         block = a.larray_padded.shape[0] // a.comm.size
         vals, idx = _topk_merge_fn(a.comm, int(k), bool(largest), a.shape[0], block)(

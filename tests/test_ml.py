@@ -226,3 +226,19 @@ def test_cdist_direct_vs_expanded():
         ht.array(x, split=0), ht.array(y), quadratic_expansion=True
     ).numpy()
     assert np.abs(direct - truth).max() <= np.abs(exp - truth).max()
+
+
+def test_gnb_noninteger_class_labels():
+    """Float-valued class labels must stay distinct (an int32 cast used to
+    collapse 1.2 and 1.7 into one class)."""
+    X = ht.array(
+        np.concatenate([np.full((10, 2), 0.0), np.full((10, 2), 10.0)]).astype(np.float32),
+        split=0,
+    )
+    y = ht.array(np.array([1.2] * 10 + [1.7] * 10), split=0)
+    g = ht.naive_bayes.GaussianNB().fit(X, y)
+    assert g.classes_.shape == (2,)
+    np.testing.assert_allclose(np.asarray(g.theta_.numpy())[:, 0], [0.0, 10.0], atol=1e-5)
+    pred = np.asarray(g.predict(X).numpy())
+    np.testing.assert_allclose(pred[:10], 1.2)
+    np.testing.assert_allclose(pred[10:], 1.7)

@@ -285,3 +285,22 @@ def test_topk_distributed_merge():
     txt = fn.lower(a.larray_padded).compile().as_text()
     # only the tiny (p*k,) candidate gathers appear — never the full array
     assert "all-gather" in txt
+
+
+def test_sort_out_param_different_split(monkeypatch):
+    """PSRS out= must rebuild in OUT's layout, not swap split-0 padding in."""
+    from heat_tpu.core import sample_sort
+
+    monkeypatch.setattr(sample_sort, "SAMPLE_SORT_THRESHOLD", 1)
+    data = np.random.default_rng(1).standard_normal(19)
+    a = ht.array(data, split=0)
+    out = ht.empty(19, dtype=ht.float64, split=None)
+    ht.sort(a, out=out)
+    assert out.split is None and out.shape == (19,)
+    np.testing.assert_array_equal(out.numpy(), np.sort(data))
+
+
+def test_topk_bool_takes_dense_path():
+    b = ht.array(np.array([True, False, True, True, False, True, False, True]), split=0)
+    v, i = ht.topk(b, 3)
+    assert np.asarray(v.numpy()).all()
