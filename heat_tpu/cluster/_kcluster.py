@@ -17,7 +17,7 @@ import jax.numpy as jnp
 _jit_partial = functools.partial(jax.jit, static_argnames=("k",))
 
 from ..core import types
-from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.base import BaseEstimator, ClusteringMixin, lazy_scalar_property
 from ..core.dndarray import DNDarray
 
 __all__ = ["_KCluster"]
@@ -79,19 +79,10 @@ class _KCluster(BaseEstimator, ClusteringMixin):
     def labels_(self) -> DNDarray:
         return self._labels
 
-    @property
-    def inertia_(self) -> float:
-        # fits store device scalars so fit() never blocks on the link; the
-        # host conversion happens (once) on first access
-        if self._inertia is not None and not isinstance(self._inertia, float):
-            self._inertia = float(self._inertia)
-        return self._inertia
-
-    @property
-    def n_iter_(self) -> int:
-        if self._n_iter is not None and not isinstance(self._n_iter, int):
-            self._n_iter = int(self._n_iter)
-        return self._n_iter
+    # fits store device scalars so fit() never blocks on the link; the
+    # host conversion happens (once) on first access
+    inertia_ = lazy_scalar_property("_inertia", float)
+    n_iter_ = lazy_scalar_property("_n_iter", int)
 
     def _initialize_cluster_centers(self, x: DNDarray, oversampling: float = None, iter_multiplier: float = None):
         """Random / kmeans++ / explicit initialization (_kcluster.py:97)."""

@@ -16,7 +16,29 @@ __all__ = [
     "is_clusterer",
     "is_regressor",
     "is_transformer",
+    "lazy_scalar_property",
 ]
+
+
+def lazy_scalar_property(attr: str, kind: type = float, doc: Optional[str] = None) -> property:
+    """Property converting a stored device scalar to a host ``kind`` lazily.
+
+    Fits store 0-d device values in ``attr`` so they never block on the
+    device link; the host conversion happens once, on first access, and the
+    converted value is cached back.  Shared by the cluster/PCA/Lasso/
+    GaussianNB estimators (one pattern, one implementation)."""
+
+    def fget(self):
+        v = getattr(self, attr)
+        if v is not None and not isinstance(v, kind):
+            v = kind(v)
+            setattr(self, attr, v)
+        return v
+
+    def fset(self, value):
+        setattr(self, attr, value)
+
+    return property(fget, fset, doc=doc or f"Lazy host {kind.__name__} of ``{attr}``.")
 
 
 class BaseEstimator:

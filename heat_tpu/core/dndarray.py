@@ -1174,6 +1174,13 @@ def _tpu_complex_ok() -> bool:
             # poisoning runtimes admit multiple clients, and demoting
             # complex to the host on capable hardware is the worse error
             ok, conclusive = True, False
+    except subprocess.TimeoutExpired:
+        # a HUNG probe is exactly the flaky-runtime signature being guarded
+        # against: treat as unsupported for THIS process, but do not cache —
+        # the hang may equally be a contended/locked chip (cf. the
+        # backend-init branch above), and a persisted "0" would demote
+        # complex to the host forever on capable hardware
+        ok, conclusive = False, False
     except Exception:
         ok, conclusive = True, False
     _TPU_COMPLEX_OK = ok

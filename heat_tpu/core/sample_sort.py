@@ -37,8 +37,8 @@ every local sort is B or 2B elements instead of the global N.
 
 Caveats (documented, the gather path remains the fallback): 1-D along the
 split axis, ascending, float32/int32/int64-packable dtypes, global size
-< 2^32.  NaNs follow the total order of their bit pattern rather than
-numpy's NaN-last convention.
+< 2^32.  All NaN bit patterns sort last (as one canonical NaN key),
+matching numpy and the gather path.
 """
 
 from __future__ import annotations
@@ -77,12 +77,14 @@ def supports_sample_sort(a, axis: int, descending: bool) -> bool:
 
 
 def _order_bits(vals):
-    """uint32 whose unsigned order equals the value order."""
+    """uint32 whose unsigned order equals the value order (NaNs sort last)."""
     if jnp.issubdtype(vals.dtype, jnp.floating):
         u = jax.lax.bitcast_convert_type(vals.astype(jnp.float32), jnp.uint32)
         # negative floats: flip all bits; non-negative: flip the sign bit
         mask = jnp.where(u >> 31 == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
-        return u ^ mask
+        # any NaN pattern -> the max key, matching the gather path's and the
+        # reference's NaN-last convention (unpacks to the canonical qNaN)
+        return jnp.where(jnp.isnan(vals), jnp.uint32(0xFFFFFFFF), u ^ mask)
     # int32/int64 in-range: offset shifts the order onto uint32
     return (vals.astype(jnp.int64) + jnp.int64(0x80000000)).astype(jnp.uint32)
 
@@ -105,8 +107,10 @@ def _psrs_fn(comm, m: int, b: int, dtype_name: str):
 
     def body(a_loc):
         # ---- 1. pack (value order bits, global index) into uint64 keys
+        # all size-indexed arithmetic is int64: the gate admits m < 2^32,
+        # so idx*b and per-bucket positions can exceed int32
         idx = jax.lax.axis_index(axis)
-        gid = (idx * b + jnp.arange(b)).astype(jnp.uint64)
+        gid = (idx.astype(jnp.int64) * b + jnp.arange(b, dtype=jnp.int64)).astype(jnp.uint64)
         keys = (_order_bits(a_loc).astype(jnp.uint64) << 32) | gid
         keys = jnp.where(gid < m, keys, _SENT)  # canonical padding -> sentinel
 
@@ -122,7 +126,7 @@ def _psrs_fn(comm, m: int, b: int, dtype_name: str):
         # ---- 4. bucket exchange (reference's Alltoallv, manipulations.py:2600)
         bkt = jnp.searchsorted(pivots, keys, side="left").astype(jnp.int32)  # (b,)
         run_start = jnp.searchsorted(bkt, jnp.arange(p), side="left")  # (p,)
-        col = jnp.arange(b, dtype=jnp.int32) - run_start[bkt].astype(jnp.int32)
+        col = jnp.arange(b, dtype=jnp.int64) - run_start[bkt].astype(jnp.int64)
         send = jnp.full((p, b), _SENT, jnp.uint64).at[bkt, col].set(keys, mode="drop")
         recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
 
@@ -131,13 +135,16 @@ def _psrs_fn(comm, m: int, b: int, dtype_name: str):
         inv = ~recv.reshape(-1)  # order-reversing bijection on uint64
         top, _ = jax.lax.top_k(inv, cap)
         bucket = ~top  # ascending, all real keys first, sentinels last
-        k_real = jnp.sum(bucket != _SENT).astype(jnp.int32)
+        # int64 sum: a bucket may hold > 2^31 keys at the gate's upper bound
+        k_real = jnp.sum((bucket != _SENT).astype(jnp.int64))
 
         # ---- 6. rebalance to the canonical distribution by exact rank
+        # int64 throughout: int32 cumsum/rank would overflow for m >= 2^31
+        # while the gate admits m < 2^32 (x64 is a gate requirement)
         counts = jax.lax.all_gather(k_real[None], axis, axis=0, tiled=True)  # (p,)
         offset = jnp.cumsum(counts) - counts
-        rank = offset[idx] + jnp.arange(cap, dtype=jnp.int32)
-        valid = jnp.arange(cap, dtype=jnp.int32) < k_real
+        rank = offset[idx] + jnp.arange(cap, dtype=jnp.int64)
+        valid = jnp.arange(cap, dtype=jnp.int64) < k_real
         dest = jnp.where(valid, rank // b, p).astype(jnp.int32)  # p -> dropped
         dcol = jnp.where(valid, rank % b, 0).astype(jnp.int32)
         send2 = jnp.full((p, b), _SENT, jnp.uint64).at[dest, dcol].set(bucket, mode="drop")

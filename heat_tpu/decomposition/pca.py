@@ -12,7 +12,7 @@ from typing import Optional, Union
 import jax.numpy as jnp
 
 from ..core import types
-from ..core.base import BaseEstimator, TransformMixin
+from ..core.base import BaseEstimator, TransformMixin, lazy_scalar_property
 from ..core.dndarray import DNDarray
 from ..core.linalg.svd import svd as _exact_svd
 from ..core.linalg import svdtools
@@ -61,18 +61,9 @@ class PCA(BaseEstimator, TransformMixin):
         self._tevr = None
         self.noise_variance_ = None
 
-    @property
-    def total_explained_variance_ratio_(self):
-        # fits store a lazy device scalar (no host sync inside fit); the
-        # conversion happens once on first access
-        v = self._tevr
-        if v is not None and not isinstance(v, float):
-            self._tevr = v = float(v)
-        return v
-
-    @total_explained_variance_ratio_.setter
-    def total_explained_variance_ratio_(self, value):
-        self._tevr = value
+    # fits store a lazy device scalar (no host sync inside fit); the
+    # conversion happens once on first access
+    total_explained_variance_ratio_ = lazy_scalar_property("_tevr", float)
 
     def fit(self, X: DNDarray, y=None) -> "PCA":
         """Estimate principal components (pca.py:210)."""

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import types
-from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.base import BaseEstimator, ClassificationMixin, lazy_scalar_property
 from ..core.dndarray import DNDarray
 
 __all__ = ["GaussianNB"]
@@ -70,9 +70,13 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         self.var_ = None
         self.class_count_ = None
         self.class_prior_ = None
-        self.epsilon_ = None
+        self._epsilon = None
 
     sigma_ = property(lambda self: self.var_)  # alias kept by the reference
+
+    # fits store the device scalar so partial_fit never blocks on the
+    # link; the host conversion happens (once) on first access
+    epsilon_ = lazy_scalar_property("_epsilon", float)
 
     def fit(self, x: DNDarray, y: DNDarray, sample_weight: Optional[DNDarray] = None) -> "GaussianNB":
         """Estimate per-class Gaussian parameters (gaussianNB.py:120)."""
@@ -129,7 +133,7 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         )
         # the smoothing term stays a lazy device scalar: no host sync per
         # partial_fit (it is removed before the next merge, see _gnb_update)
-        self.epsilon_ = eps
+        self._epsilon = eps
         self._eps_applied = eps
         if self.priors is not None:
             pri = self.priors._dense() if isinstance(self.priors, DNDarray) else jnp.asarray(self.priors)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from functools import partial
 
 from ..core import types
-from ..core.base import BaseEstimator, RegressionMixin
+from ..core.base import BaseEstimator, RegressionMixin, lazy_scalar_property
 from ..core.dndarray import DNDarray
 
 
@@ -104,13 +104,8 @@ class Lasso(BaseEstimator, RegressionMixin):
         diff = gt._dense().ravel() - yest._dense().ravel()
         return float(jnp.sqrt(jnp.mean(diff * diff)))
 
-    @property
-    def n_iter(self):
-        # fit stores the device scalar so it never blocks on the link
-        v = self._n_iter
-        if v is not None and not isinstance(v, int):
-            self._n_iter = v = int(v)
-        return v
+    # fit stores the device scalar so it never blocks on the link
+    n_iter = lazy_scalar_property("_n_iter", int)
 
     def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
         """Cyclic coordinate descent (lasso.py:120)."""

@@ -253,6 +253,19 @@ class TestSampleSort:
         b = ht.array(np.arange(64, dtype=np.float64), split=0)
         assert not supports_sample_sort(b, 0, False)  # unpackable dtype
 
+    def test_nans_sort_last(self):
+        # the PSRS path must put every NaN bit pattern last, like numpy
+        # (ADVICE r2: bit-pattern order diverged); the gather-path twin
+        # lives in test_sort_nans_gather_path below
+        data = np.array(
+            [3.0, np.nan, -np.inf, 1.0, -np.float32(np.nan), np.inf, -2.0, np.nan],
+            np.float32,
+        )
+        v, _ = ht.sort(ht.array(data, split=0))
+        got = v.numpy()
+        np.testing.assert_array_equal(got[:5], np.sort(data)[:5])
+        assert np.isnan(got[5:]).all()
+
     def test_sort_out_param(self):
         data = np.random.default_rng(3).standard_normal(40).astype(np.float32)
         a = ht.array(data, split=0)
@@ -285,6 +298,19 @@ def test_topk_distributed_merge():
     txt = fn.lower(a.larray_padded).compile().as_text()
     # only the tiny (p*k,) candidate gathers appear — never the full array
     assert "all-gather" in txt
+
+
+def test_sort_nans_gather_path():
+    """Below SAMPLE_SORT_THRESHOLD ht.sort takes the gather path — its NaN
+    order must agree with PSRS and numpy (NaNs last)."""
+    data = np.array(
+        [3.0, np.nan, -np.inf, 1.0, -np.float32(np.nan), np.inf, -2.0, np.nan],
+        np.float32,
+    )
+    v, _ = ht.sort(ht.array(data, split=0))
+    got = v.numpy()
+    np.testing.assert_array_equal(got[:5], np.sort(data)[:5])
+    assert np.isnan(got[5:]).all()
 
 
 def test_sort_out_param_different_split(monkeypatch):
