@@ -82,15 +82,19 @@ class DNDarray:
 
     def __init__(
         self,
-        array: jax.Array,
+        array: Optional[jax.Array],
         gshape: Tuple[int, ...],
         dtype,
         split: Optional[int],
         device: Device,
         comm: Communication,
         balanced: Optional[bool] = True,
+        planar: Optional[Tuple[jax.Array, jax.Array]] = None,
     ):
+        if array is None and planar is None:
+            raise ValueError("DNDarray needs a backing array or planar planes")
         self.__array = array
+        self.__planar = planar
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = types.canonical_heat_type(dtype)
         self.__split = split
@@ -117,9 +121,54 @@ class DNDarray:
         padded = _pad_to_canonical(arr, gshape, split, comm)
         return DNDarray(padded, gshape, types.canonical_heat_type(arr.dtype), split, device, comm)
 
+    @staticmethod
+    def from_planar(
+        re: jax.Array,
+        im: jax.Array,
+        gshape: Tuple[int, ...],
+        split: Optional[int],
+        device: Optional[Device] = None,
+        comm: Optional[Communication] = None,
+    ) -> "DNDarray":
+        """Wrap a complex array stored as two PADDED real planes (re, im).
+
+        The planar representation keeps complex math executable on runtimes
+        whose accelerator rejects complex dtypes (see :func:`_tpu_complex_ok`):
+        the planes live on the device mesh with canonical sharding and ops
+        that understand planes (fft, complex_math) compute on them directly;
+        anything else transparently materializes the complex array through
+        :attr:`larray_padded` (on the host-CPU backend when the accelerator
+        is complex-less).  Analog of the reference's complex torch storage
+        (heat/core/complex_math.py) re-designed for a complex-less chip."""
+        comm = sanitize_comm(comm)
+        device = sanitize_device(device)
+        if re.shape != im.shape:
+            raise ValueError(f"planes disagree: {re.shape} vs {im.shape}")
+        ctype = types.canonical_heat_type(
+            jnp.complex128 if re.dtype == jnp.float64 else jnp.complex64
+        )
+        return DNDarray(None, gshape, ctype, split, device, comm, planar=(re, im))
+
+    @property
+    def _planar(self) -> Optional[Tuple[jax.Array, jax.Array]]:
+        """The (re, im) planes backing a planar complex array, if any."""
+        return self.__planar
+
+    def __materialize_planar(self) -> jax.Array:
+        re, im = self.__planar
+        ctype = self.__dtype.jax_type()
+        if jax.default_backend() == "tpu" and not _tpu_complex_ok():
+            # complex-less runtime: compose on the host, keep the result on
+            # the CPU backend (the documented home of complex arrays there)
+            comp = (_np_fetch(re) + 1j * _np_fetch(im)).astype(ctype)
+            return jax.device_put(comp, jax.devices("cpu")[0])
+        comp = jax.lax.complex(re, im)  # on-device, sharding preserved
+        return comp if comp.dtype == ctype else comp.astype(ctype)
+
     def _replace(self, padded: jax.Array) -> None:
         """Swap the backing padded array (same shape/dtype/metadata)."""
         self.__array = padded
+        self.__planar = None
 
     def _replace_local(self, local: jax.Array) -> None:
         """Replace this process's local chunk (single-process: everything).
@@ -130,6 +179,8 @@ class DNDarray:
         ``jax.make_array_from_process_local_data`` — no communication, the
         analog of the reference's in-place ``_DNDarray__array`` swap.
         """
+        padded_gshape = self._padded_shape  # planar-safe (read before nulling)
+        self.__planar = None
         if jax.process_count() == 1:
             new = DNDarray.from_dense(local, self.__split, self.__device, self.__comm)
             self.__array = new.larray_padded
@@ -153,7 +204,6 @@ class DNDarray:
                 f"local block must have shape {tuple(lshape)} on process "
                 f"{comm.rank}, got {tuple(local.shape)}"
             )
-        padded_gshape = tuple(self.__array.shape)
         per = padded_gshape[split] // comm.size
         want = per * len(comm.local_participants)
         pad = want - lshape[split]
@@ -169,34 +219,43 @@ class DNDarray:
     # ------------------------------------------------------------------
     @property
     def larray_padded(self) -> jax.Array:
-        """The stored padded global jax.Array."""
+        """The stored padded global jax.Array (materializes planar planes)."""
+        if self.__array is None:
+            self.__array = self.__materialize_planar()
         return self.__array
+
+    @property
+    def _padded_shape(self) -> Tuple[int, ...]:
+        """Shape of the padded buffer without materializing planar planes."""
+        buf = self.__array if self.__array is not None else self.__planar[0]
+        return tuple(int(s) for s in buf.shape)
 
     @property
     def _pad(self) -> int:
         """Number of padding rows along the split axis (0 if divisible)."""
         if self.__split is None:
             return 0
-        return self.__array.shape[self.__split] - self.__gshape[self.__split]
+        return self._padded_shape[self.__split] - self.__gshape[self.__split]
 
     def _dense(self) -> jax.Array:
         """The true-shape global array (slices off padding if any)."""
         if self._pad == 0:
-            return self.__array
+            return self.larray_padded
         sl = tuple(
             slice(0, self.__gshape[d]) if d == self.__split else slice(None)
             for d in range(self.ndim)
         )
-        return self.__array[sl]
+        return self.larray_padded[sl]
 
     def _masked(self, neutral: Scalar) -> jax.Array:
         """Padded array with padding overwritten by ``neutral`` — safe to
         reduce/contract across the split axis."""
+        buf = self.larray_padded
         if self._pad == 0:
-            return self.__array
+            return buf
         s = self.__split
-        idx = jax.lax.broadcasted_iota(jnp.int32, self.__array.shape, s)
-        return jnp.where(idx < self.__gshape[s], self.__array, jnp.asarray(neutral, self.__array.dtype))
+        idx = jax.lax.broadcasted_iota(jnp.int32, buf.shape, s)
+        return jnp.where(idx < self.__gshape[s], buf, jnp.asarray(neutral, buf.dtype))
 
     # ------------------------------------------------------------------
     # properties (dndarray.py:90-360)
@@ -273,7 +332,7 @@ class DNDarray:
         # device shards — purely host-local, no collective (the analog of the
         # reference's per-rank torch tensor, dndarray.py:140)
         split = self.__split
-        shards = self.__array.addressable_shards
+        shards = self.larray_padded.addressable_shards
         if split is None:
             return jnp.asarray(shards[0].data)
         shards = sorted(shards, key=lambda s: s.index[split].start or 0)
@@ -359,7 +418,7 @@ class DNDarray:
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
         """Cast to ``dtype`` (dndarray.py:482)."""
         dtype = types.canonical_heat_type(dtype)
-        src = self.__array
+        src = self.larray_padded
         if (
             jnp.issubdtype(dtype.jax_type(), jnp.complexfloating)
             and jax.default_backend() == "tpu"
@@ -371,6 +430,7 @@ class DNDarray:
         out = DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm)
         if not copy:
             self.__array = casted
+            self.__planar = None
             self.__dtype = dtype
             return self
         return out
@@ -483,6 +543,7 @@ class DNDarray:
         dense = self._dense()
         padded = _pad_to_canonical(dense, self.__gshape, axis, self.__comm)
         self.__array = padded
+        self.__planar = None
         self.__split = axis
         return self
 
@@ -490,7 +551,10 @@ class DNDarray:
         """Out-of-place resplit (manipulations.py:3633)."""
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
-            return DNDarray(self.__array, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm)
+            return DNDarray(
+                self.__array, self.__gshape, self.__dtype, self.__split,
+                self.__device, self.__comm, planar=self.__planar,
+            )
         dense = self._dense()
         return DNDarray.from_dense(dense, axis, self.__device, self.__comm)
 
@@ -539,21 +603,44 @@ class DNDarray:
         key, _ = _convert_key(self, key)
         if isinstance(value, DNDarray):
             value = value._dense()
-        value = jnp.asarray(value, dtype=self.__dtype.jax_type())
+        ctype = self.__dtype.jax_type()
+        if (
+            jnp.issubdtype(ctype, jnp.complexfloating)
+            and jax.default_backend() == "tpu"
+            and not _tpu_complex_ok()
+        ):
+            # build the complex value on the host CPU backend — a complex
+            # constant on the complex-less TPU is itself a poisoning op
+            value = jax.device_put(
+                np.asarray(value).astype(ctype), jax.devices("cpu")[0]
+            )
+        else:
+            value = jnp.asarray(value, dtype=ctype)
         key_p = self._padded_safe_key(key)
         if key_p is not None:
             # fast path: write straight into the padded buffer — no dense
             # slice + re-pad device round trip (one fused scatter on device)
-            out = self.__array.at[key_p].set(value)
-            want = self.__comm.sharding(self.__split, self.ndim)
-            if not out.sharding.is_equivalent_to(want, out.ndim):
+            out = self.larray_padded.at[key_p].set(value)
+            complex_on_host = (
+                jnp.issubdtype(out.dtype, jnp.complexfloating)
+                and jax.default_backend() == "tpu"
+                and not _tpu_complex_ok()
+            )
+            if not complex_on_host:
                 # scatter output sharding followed the value operand; restore
-                # the canonical placement downstream shard_maps rely on
-                out = jax.device_put(out, want)
+                # the canonical placement downstream shard_maps rely on (a
+                # complex buffer on a complex-less runtime stays on the host
+                # CPU backend instead — resharding it onto the mesh would
+                # reintroduce the poisoning the planar storage avoids)
+                want = self.__comm.sharding(self.__split, self.ndim)
+                if not out.sharding.is_equivalent_to(want, out.ndim):
+                    out = jax.device_put(out, want)
             self.__array = out
+            self.__planar = None
             return
         new_dense = self._dense().at[key].set(value)
         self.__array = _pad_to_canonical(new_dense, self.__gshape, self.__split, self.__comm)
+        self.__planar = None
 
     def _padded_safe_key(self, key):
         """Return a key usable directly on the padded buffer, or None.
@@ -904,6 +991,7 @@ class DNDarray:
         idx = jnp.arange(n)
         dense = dense.at[idx, idx].set(jnp.asarray(value, dense.dtype))
         self.__array = _pad_to_canonical(dense, self.__gshape, self.__split, self.__comm)
+        self.__planar = None
         return self
 
     def log(self, out=None):

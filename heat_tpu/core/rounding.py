@@ -31,7 +31,15 @@ def abs(x, out=None, dtype=None):
     the out buffer's dtype (numpy out= semantics)."""
     if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.number):
         raise TypeError("dtype must be a heat data type")
-    res = _local_op(jnp.abs, x, no_cast=True)
+    if isinstance(x, DNDarray) and x._planar is not None:
+        # planar complex: magnitude from the planes, on the device mesh
+        re, im = x._planar
+        mag = jnp.hypot(re, im)
+        res = DNDarray(
+            mag, x.shape, types.canonical_heat_type(mag.dtype), x.split, x.device, x.comm
+        )
+    else:
+        res = _local_op(jnp.abs, x, no_cast=True)
     if dtype is not None:
         res = res.astype(dtype)
     if out is not None:

@@ -1,0 +1,348 @@
+"""Real-pair (planar) FFT kernels: complex transforms on complex-less TPUs.
+
+The tunneled TPU runtime rejects every complex64 op (see
+``core.dndarray._tpu_complex_ok``), so the reference's transform semantics
+(heat/fft/fft.py:40-298) are re-expressed over two REAL planes (re, im).
+The transform itself is built to ride the MXU instead of translating a
+butterfly network:
+
+* length ``n <= _CUTOFF``: the DFT is a literal matrix product with the
+  (symmetric) DFT matrix — ``(batch, n) @ (n, n)`` per plane, a shape the
+  systolic array is built for.  A complex matmul uses the 3-multiplication
+  (Karatsuba) identity, and a purely real input (rfft, the first axis of a
+  real fftn) needs only 2 products.
+* larger ``n = n1 * n2``: Bailey's four-step factorization — reshape to
+  ``(n2, n1)``, DFT the columns, twiddle, DFT the rows, transpose-ravel.
+  Each factor recurses until it fits the matmul base case, so every FLOP
+  is still a matrix product.
+* prime ``n > _CUTOFF``: Bluestein's chirp-z algorithm turns the DFT into
+  a circular convolution of power-of-two length, which the four-step path
+  handles; the chirp filter's spectrum is a host-precomputed constant.
+
+Everything here is pure jnp on real dtypes — traceable, jittable, and
+usable inside ``shard_map`` bodies (the pencil program in fft.py).
+Accuracy: DFT matrices are built in float64 on the host and applied with a
+precision-policy matmul (HIGHEST for f32 planes) — verified against
+``np.fft.fftn`` to ~1e-4 relative for float32, full precision for float64.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "fft_planes",
+    "fftn_planes",
+    "scale_factor",
+    "fft1",
+    "rfft1",
+    "irfft1",
+    "hfft1",
+    "ihfft1",
+]
+
+#: Largest DFT applied as one literal matrix product.  512x512 f32 matrices
+#: are 1 MiB — comfortably resident — and keep the four-step recursion
+#: shallow; the MXU is indifferent in this range.
+_CUTOFF = 512
+
+
+def _precision():
+    # f32 planes want the 6-pass f32-accurate matmul; f64 planes hit the
+    # (software) f64 path where precision flags do not apply
+    env = os.environ.get("HEAT_TPU_FFT_PRECISION", "highest").lower()
+    return {
+        "default": jax.lax.Precision.DEFAULT,
+        "high": jax.lax.Precision.HIGH,
+        "highest": jax.lax.Precision.HIGHEST,
+    }[env]
+
+
+def _mm(a: jax.Array, w: jax.Array) -> jax.Array:
+    return jnp.matmul(a, w, precision=_precision())
+
+
+@functools.lru_cache(maxsize=64)
+def _dft_w(n: int, inverse: bool, dtype: str):
+    """(W_re, W_im, W_re+W_im) for the symmetric n-point DFT matrix."""
+    j = np.arange(n, dtype=np.float64)
+    # angle built from jk mod n keeps the argument small — cos/sin of huge
+    # arguments lose the low bits that ARE the answer
+    jk = np.outer(j, j) % n
+    ang = 2.0 * np.pi * jk / n
+    sign = 1.0 if inverse else -1.0
+    wre = np.cos(ang)
+    wim = sign * np.sin(ang)
+    # NUMPY constants: a jnp array built during a jit trace is a tracer,
+    # and caching a tracer poisons every later trace (leak errors); numpy
+    # operands are lifted fresh into whichever trace uses them
+    return (
+        np.asarray(wre, dtype),
+        np.asarray(wim, dtype),
+        np.asarray(wre + wim, dtype),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _twiddle(n1: int, n2: int, n: int, inverse: bool, dtype: str):
+    """T[j1, k2] = exp(sign * 2*pi*i * j1*k2 / n) for the four-step."""
+    j1 = np.arange(n1, dtype=np.float64)
+    k2 = np.arange(n2, dtype=np.float64)
+    jk = np.outer(j1, k2) % n
+    ang = 2.0 * np.pi * jk / n
+    sign = 1.0 if inverse else -1.0
+    # numpy constants — see _dft_w for why
+    return np.asarray(np.cos(ang), dtype), np.asarray(sign * np.sin(ang), dtype)
+
+
+def _cmul(are, aim, bre, bim):
+    """Elementwise planar complex multiply (a may have aim None == real)."""
+    if aim is None:
+        return are * bre, are * bim
+    return are * bre - aim * bim, are * bim + aim * bre
+
+
+def _apply_w(re, im, w) -> Tuple[jax.Array, jax.Array]:
+    """(..., n) @ DFT matrix, 3-mult complex or 2-mult real-input."""
+    wre, wim, wsum = w
+    if im is None:
+        return _mm(re, wre), _mm(re, wim)
+    t1 = _mm(re, wre)
+    t2 = _mm(im, wim)
+    t3 = _mm(re + im, wsum)
+    return t1 - t2, t3 - t1 - t2
+
+
+@functools.lru_cache(maxsize=512)
+def _largest_factor(n: int, cap: int) -> int:
+    """Largest divisor of n that is <= cap (1 if n is prime past cap)."""
+    best = 1
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            if d <= cap:
+                best = max(best, d)
+            q = n // d
+            if q <= cap:
+                best = max(best, q)
+        d += 1
+    return best
+
+
+def _next_pow2(n: int) -> int:
+    m = 1
+    while m < n:
+        m <<= 1
+    return m
+
+
+def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
+    """Unscaled DFT along the LAST axis; im may be None (real input)."""
+    n = re.shape[-1]
+    dt = str(re.dtype)
+    if n == 1:
+        return re, jnp.zeros_like(re) if im is None else im
+    if n <= _CUTOFF:
+        return _apply_w(re, im, _dft_w(n, inverse, dt))
+    n1 = _largest_factor(n, _CUTOFF)
+    if n1 == 1:
+        return _bluestein_last(re, im, inverse)
+    n2 = n // n1
+    batch = re.shape[:-1]
+    # j = j1 + n1*j2: C-order reshape puts x[j] at [..., j2, j1]
+    re = re.reshape(*batch, n2, n1).swapaxes(-1, -2)  # (..., j1, j2)
+    im = im.reshape(*batch, n2, n1).swapaxes(-1, -2) if im is not None else None
+    re, im = _fft_last(re, im, inverse)  # DFT over j2 -> (..., j1, k2)
+    re, im = _cmul(re, im, *_twiddle(n1, n2, n, inverse, dt))
+    re = re.swapaxes(-1, -2)  # (..., k2, j1)
+    im = im.swapaxes(-1, -2)
+    re, im = _fft_last(re, im, inverse)  # DFT over j1 -> (..., k2, k1)
+    # output index k = k2 + n2*k1: ravel of the (k1, k2) layout
+    re = re.swapaxes(-1, -2).reshape(*batch, n)
+    im = im.swapaxes(-1, -2).reshape(*batch, n)
+    return re, im
+
+
+@functools.lru_cache(maxsize=32)
+def _bluestein_consts(n: int, inverse: bool, dtype: str):
+    """Chirp and the precomputed spectrum of the chirp filter."""
+    m = _next_pow2(2 * n - 1)
+    j = np.arange(n, dtype=np.int64)
+    # j^2 mod 2n keeps the chirp angle small and exact
+    ang = np.pi * ((j * j) % (2 * n)).astype(np.float64) / n
+    sign = 1.0 if inverse else -1.0
+    # c[j] = e^{sign*i*pi*j^2/n}: c[j]*c[k]*conj(c[k-j]) = e^{sign*2*pi*i*jk/n}
+    chirp = np.cos(ang) + 1j * sign * np.sin(ang)
+    a_mul = chirp  # applied to the input and to the output
+    b = np.zeros(m, dtype=np.complex128)
+    conj_c = np.conj(chirp)
+    b[:n] = conj_c
+    b[m - n + 1:] = conj_c[1:n][::-1]  # b[m-j] = conj(c[j])
+    B = np.fft.fft(b)  # host constant — never touches the device
+    # numpy constants — see _dft_w for why
+    return (
+        np.asarray(a_mul.real, dtype),
+        np.asarray(a_mul.imag, dtype),
+        np.asarray(B.real, dtype),
+        np.asarray(B.imag, dtype),
+        m,
+    )
+
+
+def _bluestein_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
+    """Chirp-z DFT for prime n past the matmul cutoff (last axis)."""
+    n = re.shape[-1]
+    are, aim, Bre, Bim, m = _bluestein_consts(n, inverse, str(re.dtype))
+    xre, xim = _cmul(re, im, are, aim)
+    pad = [(0, 0)] * (xre.ndim - 1) + [(0, m - n)]
+    xre, xim = jnp.pad(xre, pad), jnp.pad(xim, pad)
+    Xre, Xim = _fft_last(xre, xim, False)  # m is a power of two -> four-step
+    Cre, Cim = _cmul(Xre, Xim, Bre, Bim)
+    cre, cim = _fft_last(Cre, Cim, True)
+    cre, cim = cre[..., :n] / m, cim[..., :n] / m  # unscaled inverse
+    return _cmul(cre, cim, are, aim)
+
+
+def fft_planes(
+    re: jax.Array,
+    im: Optional[jax.Array],
+    axis: int,
+    inverse: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Unscaled planar DFT along ``axis``; ``im=None`` means real input."""
+    axis = axis % re.ndim
+    last = re.ndim - 1
+    if axis != last:
+        re = jnp.moveaxis(re, axis, last)
+        im = jnp.moveaxis(im, axis, last) if im is not None else None
+    re, im = _fft_last(re, im, inverse)
+    if axis != last:
+        re = jnp.moveaxis(re, last, axis)
+        im = jnp.moveaxis(im, last, axis)
+    return re, im
+
+
+def scale_factor(lengths: Sequence[int], norm: Optional[str], inverse: bool) -> float:
+    """Composite normalization over the transformed axis lengths."""
+    total = 1.0
+    for n in lengths:
+        total *= float(n)
+    if norm in (None, "backward"):
+        return 1.0 / total if inverse else 1.0
+    if norm == "ortho":
+        return total ** -0.5
+    if norm == "forward":
+        return 1.0 if inverse else 1.0 / total
+    raise ValueError(f'norm must be None, "ortho", "backward" or "forward", got {norm!r}')
+
+
+def fftn_planes(
+    re: jax.Array,
+    im: Optional[jax.Array],
+    axes: Sequence[int],
+    inverse: bool,
+    norm: Optional[str],
+) -> Tuple[jax.Array, jax.Array]:
+    """Planar N-D DFT over ``axes`` with numpy norm semantics applied."""
+    for ax in axes:
+        re, im = fft_planes(re, im, ax, inverse)
+    s = scale_factor([re.shape[a] for a in axes], norm, inverse)
+    if s != 1.0:
+        re, im = re * re.dtype.type(s), im * im.dtype.type(s)
+    return re, im
+
+
+# ----------------------------------------------------------------------
+# numpy-semantics 1-D ops on planes (fitting, real/Hermitian kinds, norms)
+# ----------------------------------------------------------------------
+def _fit(re, im, axis: int, n: int):
+    """Truncate / zero-pad planes along ``axis`` to length ``n`` (numpy's
+    pre-transform ``n`` semantics)."""
+    axis = axis % re.ndim
+    cur = re.shape[axis]
+    if n == cur:
+        return re, im
+    if n < cur:
+        sl = tuple(slice(0, n) if d == axis else slice(None) for d in range(re.ndim))
+        return re[sl], None if im is None else im[sl]
+    widths = [(0, n - cur) if d == axis else (0, 0) for d in range(re.ndim)]
+    return jnp.pad(re, widths), None if im is None else jnp.pad(im, widths)
+
+
+def _scaled(re, im, s: float):
+    if s == 1.0:
+        return re, im
+    return re * re.dtype.type(s), None if im is None else im * im.dtype.type(s)
+
+
+def _take(plane, axis: int, idx):
+    return jnp.take(plane, idx, axis=axis)
+
+
+def _hermitian_extend(re, im, axis: int, n_out: int):
+    """Full-length spectrum from its first ``n_out//2+1`` bins.
+
+    b[k] = a[k] for k < m, b[k] = conj(a[n_out-k]) above — numpy's implicit
+    extension in irfft/hfft."""
+    axis = axis % re.ndim
+    m = n_out // 2 + 1
+    re, im = _fit(re, im, axis, m)
+    if im is None:
+        im = jnp.zeros_like(re)
+    ext_idx = jnp.arange(1, n_out - m + 1)[::-1]
+    re_full = jnp.concatenate([re, _take(re, axis, ext_idx)], axis=axis)
+    im_full = jnp.concatenate([im, -_take(im, axis, ext_idx)], axis=axis)
+    return re_full, im_full
+
+
+def fft1(re, im, axis: int, n: Optional[int], norm, inverse: bool):
+    """numpy fft/ifft semantics on planes (complex in, complex out)."""
+    n = n if n is not None else re.shape[axis]
+    re, im = _fit(re, im, axis, n)
+    re, im = fft_planes(re, im, axis, inverse)
+    return _scaled(re, im, scale_factor([n], norm, inverse))
+
+
+def rfft1(re, axis: int, n: Optional[int], norm):
+    """numpy rfft: real input, spectrum truncated at Nyquist."""
+    axis = axis % re.ndim
+    n = n if n is not None else re.shape[axis]
+    re, _ = _fit(re, None, axis, n)
+    fre, fim = fft_planes(re, None, axis, False)
+    m = n // 2 + 1
+    sl = tuple(slice(0, m) if d == axis else slice(None) for d in range(fre.ndim))
+    return _scaled(fre[sl], fim[sl], scale_factor([n], norm, False))
+
+
+def irfft1(re, im, axis: int, n: Optional[int], norm):
+    """numpy irfft: Hermitian-extend, inverse transform, real output."""
+    n_out = n if n is not None else 2 * (re.shape[axis] - 1)
+    re_f, im_f = _hermitian_extend(re, im, axis, n_out)
+    ore, _ = fft_planes(re_f, im_f, axis, True)
+    s = scale_factor([n_out], norm, True)
+    return ore * ore.dtype.type(s) if s != 1.0 else ore
+
+
+def hfft1(re, im, axis: int, n: Optional[int], norm):
+    """numpy hfft: forward transform of the Hermitian-extended signal,
+    real output, forward-family norm scaling (None->1, ortho->1/sqrt,
+    forward->1/n — verified against np.fft.hfft)."""
+    n_out = n if n is not None else 2 * (re.shape[axis] - 1)
+    re_f, im_f = _hermitian_extend(re, im, axis, n_out)
+    ore, _ = fft_planes(re_f, im_f, axis, False)
+    s = scale_factor([n_out], norm, False)
+    return ore * ore.dtype.type(s) if s != 1.0 else ore
+
+
+def ihfft1(re, axis: int, n: Optional[int], norm):
+    """numpy ihfft == conj(rfft)/n with inverse-family norm scaling."""
+    n_in = n if n is not None else re.shape[axis]
+    fre, fim = rfft1(re, axis, n_in, None)
+    fre, fim = _scaled(fre, fim, scale_factor([n_in], norm, True))
+    return fre, -fim

@@ -76,6 +76,186 @@ def _complex_dense(x: DNDarray):
 
 
 # ----------------------------------------------------------------------
+# planar (real-pair) execution: transforms stay ON the accelerator even
+# when the runtime rejects complex dtypes.  Every op below routes through
+# ``_planar_entry`` when ``_use_planar()`` holds; the complex result is a
+# planar-backed DNDarray (two real planes on the mesh) that materializes
+# to a host complex array only if a non-planar-aware op touches it.
+# Matches the reference's on-device pencil FFT capability
+# (heat/fft/fft.py:40-298) on hardware the reference never had to face.
+# ----------------------------------------------------------------------
+import functools as _functools
+import os as _os
+
+from . import _planar as _pl
+
+
+def _use_planar() -> bool:
+    env = _os.environ.get("HEAT_TPU_PLANAR")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no")
+    from ..core.dndarray import _tpu_complex_ok
+
+    return jax.default_backend() == "tpu" and not _tpu_complex_ok()
+
+
+def _planes_in(x: DNDarray):
+    """True-shape (re, im|None) planes of ``x`` on the compute mesh."""
+    if x._planar is not None:
+        re, im = x._planar
+        if x._pad:
+            sl = tuple(
+                slice(0, x.shape[d]) if d == x.split else slice(None)
+                for d in range(x.ndim)
+            )
+            re, im = re[sl], im[sl]
+        return re, im
+    if types.heat_type_is_complexfloating(x.dtype):
+        # complex storage lives on the host CPU backend on complex-less
+        # runtimes: split into planes there, upload real transfers.
+        # device_put needs divisible extents, so pad to canonical first
+        # and slice the pad back off on-mesh.
+        dense = x._dense()
+        re, im = jnp.real(dense), jnp.imag(dense)
+        re = _repad(re, x.shape, x.split, x.comm)
+        im = _repad(im, x.shape, x.split, x.comm)
+        if x.split is not None and re.shape[x.split] != x.shape[x.split]:
+            sl = tuple(
+                slice(0, x.shape[d]) if d == x.split else slice(None)
+                for d in range(x.ndim)
+            )
+            re, im = re[sl], im[sl]
+        return re, im
+    dense = x._dense()
+    if not jnp.issubdtype(dense.dtype, jnp.floating):
+        dense = dense.astype(jnp.float32)
+    return dense, None
+
+
+def _padded_planes(x: DNDarray):
+    """PADDED (re, im) planes with canonical sharding (for shard_map)."""
+    if x._planar is not None:
+        return x._planar
+    if types.heat_type_is_complexfloating(x.dtype):
+        re, im = _planes_in(x)
+        return _repad(re, x.shape, x.split, x.comm), _repad(im, x.shape, x.split, x.comm)
+    buf = x.larray_padded
+    if not jnp.issubdtype(buf.dtype, jnp.floating):
+        buf = buf.astype(jnp.float32)
+    return buf, jnp.zeros_like(buf)
+
+
+def _repad(plane, gshape, split, comm):
+    if split is None:
+        return jax.device_put(plane, comm.sharding(None))
+    pad = comm.pad_amount(gshape[split])
+    if pad:
+        widths = [(0, pad if d == split else 0) for d in range(plane.ndim)]
+        plane = jnp.pad(plane, widths)
+    return jax.device_put(plane, comm.sharding(split))
+
+
+def _wrap_planar(x: DNDarray, re, im, split) -> DNDarray:
+    gshape = tuple(int(s) for s in re.shape)
+    if split is not None and split >= len(gshape):
+        split = None
+    re = _repad(re, gshape, split, x.comm)
+    im = _repad(im, gshape, split, x.comm)
+    return DNDarray.from_planar(re, im, gshape, split, x.device, x.comm)
+
+
+@_functools.lru_cache(maxsize=256)
+def _planar_prog(kind: str, norm, axes_ns):
+    """One jitted program for a whole transform chain (no eager tails —
+    tunneled links make per-op dispatch the dominant cost)."""
+
+    def run(re, im):
+        if kind in ("fft", "ifft"):
+            inv = kind == "ifft"
+            for a, n in axes_ns:
+                re, im = _pl.fft1(re, im, a, n, norm, inv)
+            return re, im
+        if kind in ("rfft", "ihfft"):
+            last_a, last_n = axes_ns[-1]
+            op = _pl.rfft1 if kind == "rfft" else _pl.ihfft1
+            re, im = op(re, last_a, last_n, norm)
+            inv = kind == "ihfft"
+            for a, n in axes_ns[:-1]:
+                re, im = _pl.fft1(re, im, a, n, norm, inv)
+            return re, im
+        # irfft / hfft: complex passes first, the real-output op last
+        inv = kind == "irfft"
+        for a, n in axes_ns[:-1]:
+            re, im = _pl.fft1(re, im, a, n, norm, inv)
+        last_a, last_n = axes_ns[-1]
+        op = _pl.irfft1 if kind == "irfft" else _pl.hfft1
+        return op(re, im, last_a, last_n, norm), None
+
+    return jax.jit(run)
+
+
+@_functools.lru_cache(maxsize=128)
+def _pencil_planar_fn(comm, axis: int, partner: int, n_true: int, ndim: int, norm, inverse: bool):
+    """Planar twin of :func:`_pencil_fn`: the split-axis transform rides two
+    all_to_alls instead of a gather, on (re, im) planes."""
+    from jax.sharding import PartitionSpec as _P
+
+    name = comm.axis_name
+    spec = _P(*[name if d == axis else None for d in range(ndim)])
+
+    def body(re, im):
+        tre = jax.lax.all_to_all(re, name, split_axis=partner, concat_axis=axis, tiled=True)
+        tim = jax.lax.all_to_all(im, name, split_axis=partner, concat_axis=axis, tiled=True)
+        idx = tuple(slice(0, n_true) if d == axis else slice(None) for d in range(ndim))
+        rre, rim = _pl.fft1(tre[idx], tim[idx], axis, None, norm, inverse)
+        widths = [(0, tre.shape[axis] - n_true) if d == axis else (0, 0) for d in range(ndim)]
+        rre, rim = jnp.pad(rre, widths), jnp.pad(rim, widths)
+        return (
+            jax.lax.all_to_all(rre, name, split_axis=axis, concat_axis=partner, tiled=True),
+            jax.lax.all_to_all(rim, name, split_axis=axis, concat_axis=partner, tiled=True),
+        )
+
+    return jax.jit(
+        jax.shard_map(body, mesh=comm.mesh, in_specs=(spec, spec), out_specs=(spec, spec))
+    )
+
+
+def _planar_entry(x: DNDarray, kind: str, axes_ns, norm) -> DNDarray:
+    """Planar transform chain; split-axis complex passes use the pencil."""
+    if kind in ("rfft", "ihfft") and types.heat_type_is_complexfloating(x.dtype):
+        # numpy raises here; silently dropping the imaginary plane would
+        # diverge from every non-planar configuration
+        raise TypeError(f"{kind} requires a real-typed DNDarray, is {x.dtype.__name__}")
+    axes_ns = tuple((int(a), None if n is None else int(n)) for a, n in axes_ns)
+    y = x
+    if kind in ("fft", "ifft") and y.split is not None and y.comm.size > 1:
+        hit = next(((a, n) for a, n in axes_ns if a == y.split and n is None), None)
+        if hit is not None:
+            partner = next(
+                (d for d in range(y.ndim) if d != y.split and y.shape[d] % y.comm.size == 0),
+                None,
+            )
+            if partner is not None:
+                re_p, im_p = _padded_planes(y)
+                fn = _pencil_planar_fn(
+                    y.comm, y.split, partner, y.shape[y.split], y.ndim, norm, kind == "ifft"
+                )
+                o_re, o_im = fn(re_p, im_p)
+                y = DNDarray.from_planar(o_re, o_im, y.shape, y.split, y.device, y.comm)
+                axes_ns = tuple((a, n) for a, n in axes_ns if a != x.split)
+                if not axes_ns:
+                    return y
+    re, im = _planes_in(y)
+    out_re, out_im = _planar_prog(kind, norm, axes_ns)(re, im)
+    split = y.split
+    if out_im is None:  # real output (irfft/hfft)
+        if split is not None and split >= out_re.ndim:
+            split = None
+        return DNDarray.from_dense(out_re, split, y.device, y.comm)
+    return _wrap_planar(y, out_re, out_im, split)
+
+
+# ----------------------------------------------------------------------
 # 1-D transforms (fft.py:299-420)
 # ----------------------------------------------------------------------
 # ----------------------------------------------------------------------
@@ -141,6 +321,8 @@ def fft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str
     """1-D complex FFT along ``axis`` (fft.py:310)."""
     _check(x)
     axis = sanitize_axis(x.shape, axis)
+    if _use_planar():
+        return _planar_entry(x, "fft", ((axis, n),), norm)
     partner = _pencil_partner(x, axis, n)
     if partner is not None:
         return _pencil_transform(x, "fft", axis, partner, norm)
@@ -152,6 +334,8 @@ def ifft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[st
     """1-D inverse FFT (fft.py:575)."""
     _check(x)
     axis = sanitize_axis(x.shape, axis)
+    if _use_planar():
+        return _planar_entry(x, "ifft", ((axis, n),), norm)
     partner = _pencil_partner(x, axis, n)
     if partner is not None:
         return _pencil_transform(x, "ifft", axis, partner, norm)
@@ -165,6 +349,8 @@ def rfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[st
     if types.heat_type_is_complexfloating(x.dtype):
         raise TypeError(f"x must be a real-typed DNDarray, is {x.dtype.__name__}")
     axis = sanitize_axis(x.shape, axis)
+    if _use_planar():
+        return _planar_entry(x, "rfft", ((axis, n),), norm)
     result = jnp.fft.rfft(_complex_dense(x), n=n, axis=axis, norm=norm)
     return _wrap(x, result)
 
@@ -173,6 +359,8 @@ def irfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[s
     """Inverse of rfft, real output (fft.py:700)."""
     _check(x)
     axis = sanitize_axis(x.shape, axis)
+    if _use_planar():
+        return _planar_entry(x, "irfft", ((axis, n),), norm)
     result = jnp.fft.irfft(_complex_dense(x), n=n, axis=axis, norm=norm)
     return _wrap(x, result)
 
@@ -181,6 +369,8 @@ def hfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[st
     """FFT of a Hermitian-symmetric signal (fft.py:478)."""
     _check(x)
     axis = sanitize_axis(x.shape, axis)
+    if _use_planar():
+        return _planar_entry(x, "hfft", ((axis, n),), norm)
     result = jnp.fft.hfft(_complex_dense(x), n=n, axis=axis, norm=norm)
     return _wrap(x, result)
 
@@ -189,6 +379,8 @@ def ihfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[s
     """Inverse Hermitian FFT (fft.py:651)."""
     _check(x)
     axis = sanitize_axis(x.shape, axis)
+    if _use_planar():
+        return _planar_entry(x, "ihfft", ((axis, n),), norm)
     result = jnp.fft.ihfft(_complex_dense(x), n=n, axis=axis, norm=norm)
     return _wrap(x, result)
 
@@ -326,9 +518,17 @@ def _nd_dispatch(native, dense, s, axes, norm, last_kind=None):
     return _host_fftn(dense, s, axes, norm, last_kind=last_kind)
 
 
+def _axes_ns_of(x, s, axes) -> tuple:
+    """(axis, n) pairs with numpy (s, axes) normalization."""
+    s2, axes2 = _nd_axes(x, s, axes)
+    return tuple(zip(axes2, s2))
+
+
 def fft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     """2-D FFT (fft.py:352)."""
     _check(x)
+    if _use_planar():
+        return _planar_entry(x, "fft", _axes_ns_of(x, s, _axes2(x, axes)), norm)
     result = jnp.fft.fft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
     return _wrap(x, result)
 
@@ -336,6 +536,8 @@ def fft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
 def ifft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     """2-D inverse FFT (fft.py:606)."""
     _check(x)
+    if _use_planar():
+        return _planar_entry(x, "ifft", _axes_ns_of(x, s, _axes2(x, axes)), norm)
     result = jnp.fft.ifft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
     return _wrap(x, result)
 
@@ -371,6 +573,8 @@ def fftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    if _use_planar():
+        return _planar_entry(x, "fft", _axes_ns_of(x, s, axes), norm)
     pencil = _pencil_nd(x, "fft", s, axes, norm)
     if pencil is not None:
         return pencil
@@ -386,6 +590,8 @@ def ifftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    if _use_planar():
+        return _planar_entry(x, "ifft", _axes_ns_of(x, s, axes), norm)
     pencil = _pencil_nd(x, "ifft", s, axes, norm)
     if pencil is not None:
         return pencil
@@ -400,6 +606,8 @@ def ifftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
 def rfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     """2-D real FFT (fft.py:922)."""
     _check(x)
+    if _use_planar():
+        return _planar_entry(x, "rfft", _axes_ns_of(x, s, _axes2(x, axes)), norm)
     result = jnp.fft.rfft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
     return _wrap(x, result)
 
@@ -407,6 +615,8 @@ def rfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
 def irfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     """2-D inverse real FFT (fft.py:744)."""
     _check(x)
+    if _use_planar():
+        return _planar_entry(x, "irfft", _axes_ns_of(x, s, _axes2(x, axes)), norm)
     result = jnp.fft.irfft2(_complex_dense(x), s=s, axes=_axes2(x, axes), norm=norm)
     return _wrap(x, result)
 
@@ -416,6 +626,8 @@ def rfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    if _use_planar():
+        return _planar_entry(x, "rfft", _axes_ns_of(x, s, axes), norm)
     dense = _complex_dense(x)
     result = _nd_dispatch(
         lambda: jnp.fft.rfftn(dense, s=s, axes=axes, norm=norm), dense, s, axes, norm,
@@ -429,6 +641,8 @@ def irfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    if _use_planar():
+        return _planar_entry(x, "irfft", _axes_ns_of(x, s, axes), norm)
     dense = _complex_dense(x)
     result = _nd_dispatch(
         lambda: jnp.fft.irfftn(dense, s=s, axes=axes, norm=norm), dense, s, axes, norm,
@@ -440,6 +654,8 @@ def irfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
 def hfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     """2-D Hermitian FFT (fft.py:509)."""
     _check(x)
+    if _use_planar():
+        return _planar_entry(x, "hfft", _axes_ns_of(x, s, _axes2(x, axes)), norm)
     dense = _complex_dense(x)
     result = _nd_dispatch(None, dense, s, _axes2(x, axes), norm, last_kind="hfft")
     return _wrap(x, result)
@@ -450,6 +666,8 @@ def hfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    if _use_planar():
+        return _planar_entry(x, "hfft", _axes_ns_of(x, s, axes), norm)
     dense = _complex_dense(x)
     result = _nd_dispatch(None, dense, s, axes, norm, last_kind="hfft")
     return _wrap(x, result)
@@ -458,6 +676,8 @@ def hfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
 def ihfft2(x: DNDarray, s=None, axes=(-2, -1), norm=None) -> DNDarray:
     """2-D inverse Hermitian FFT (fft.py:672)."""
     _check(x)
+    if _use_planar():
+        return _planar_entry(x, "ihfft", _axes_ns_of(x, s, _axes2(x, axes)), norm)
     dense = _complex_dense(x)
     result = _nd_dispatch(None, dense, s, _axes2(x, axes), norm, last_kind="ihfft")
     return _wrap(x, result)
@@ -468,6 +688,8 @@ def ihfftn(x: DNDarray, s=None, axes=None, norm=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in axes)
+    if _use_planar():
+        return _planar_entry(x, "ihfft", _axes_ns_of(x, s, axes), norm)
     dense = _complex_dense(x)
     result = _nd_dispatch(None, dense, s, axes, norm, last_kind="ihfft")
     return _wrap(x, result)
@@ -506,6 +728,11 @@ def fftshift(x: DNDarray, axes=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in (axes if isinstance(axes, (tuple, list)) else (axes,)))
+    if x._planar is not None:
+        re, im = _planes_in(x)
+        return _wrap_planar(
+            x, jnp.fft.fftshift(re, axes=axes), jnp.fft.fftshift(im, axes=axes), x.split
+        )
     result = jnp.fft.fftshift(x._dense(), axes=axes)
     return _wrap(x, result)
 
@@ -515,5 +742,10 @@ def ifftshift(x: DNDarray, axes=None) -> DNDarray:
     _check(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.shape, a) for a in (axes if isinstance(axes, (tuple, list)) else (axes,)))
+    if x._planar is not None:
+        re, im = _planes_in(x)
+        return _wrap_planar(
+            x, jnp.fft.ifftshift(re, axes=axes), jnp.fft.ifftshift(im, axes=axes), x.split
+        )
     result = jnp.fft.ifftshift(x._dense(), axes=axes)
     return _wrap(x, result)
