@@ -16,7 +16,12 @@ can exceed an iteration's compute).
 ``vs_baseline`` for each config divides by the reference's per-process
 compute path measured in-process: torch CPU doing the equivalent local
 computation (the reference's per-rank torch kernels), on a subset where
-the full size would be unreasonable on one CPU.
+the full size would be unreasonable on one CPU.  Every record carries
+``vs_baseline_kind`` naming that baseline explicitly — the ratios are NOT
+against BASELINE.json's "5x A100+MPI" north star (no A100-class baseline
+exists in this repo).  A window that never clears the link-sync floor
+raises :class:`MeasurementError` and is recorded as an error instead of a
+number (the r2 DP-SGD 1e9 steps/s incident).
 """
 
 from __future__ import annotations
@@ -41,24 +46,61 @@ def _sync_floor() -> float:
     return best
 
 
+class MeasurementError(RuntimeError):
+    """The timing window never rose above the link-sync floor — there is
+    no measurement to report (publishing a clamp bound as throughput is
+    exactly the r2 DP-SGD failure this type exists to prevent)."""
+
+
 def _time_amortized(
-    run_once, fetch_scalar, n_iter: int, sync_floor: float, windows: int = 3
+    run_once,
+    fetch_scalar,
+    n_iter: int,
+    sync_floor: float,
+    windows: int = 3,
+    min_floor_ratio: float = 50.0,
+    max_iter: int = 4096,
 ) -> float:
     """Seconds per iteration: enqueue n_iter runs, one trailing fetch.
 
     Repeats the whole window ``windows`` times and keeps the best — the
     tunnel link's RTT variance between runs can exceed an iteration's
-    compute, and the minimum is the standard noise-robust estimator."""
-    best = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(n_iter):
-            out = run_once()
-        fetch_scalar(out)
-        per = max((time.perf_counter() - t0 - sync_floor) / n_iter, 1e-9)
-        best = min(best, per)
-    return best
+    compute, and the minimum is the standard noise-robust estimator.
+
+    The window must dominate the sync floor: if ``elapsed`` is not at
+    least ``min_floor_ratio`` floors, ``n_iter`` grows (x4) and the
+    window re-runs, so the reported per-iteration time is a measurement
+    rather than link noise.  If even ``max_iter`` iterations cannot clear
+    the floor, raises :class:`MeasurementError` — the caller records an
+    explicit error instead of a fabricated number."""
+    while True:
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n_iter):
+                out = run_once()
+            fetch_scalar(out)
+            elapsed = time.perf_counter() - t0
+            if elapsed > sync_floor:
+                best = min(best, (elapsed - sync_floor) / n_iter)
+        window = best * n_iter
+        if best != float("inf") and window >= min_floor_ratio * sync_floor:
+            return best
+        if n_iter >= max_iter:
+            if best != float("inf") and window > 2.0 * sync_floor:
+                return best  # dominated enough to be meaningful at the cap
+            raise MeasurementError(
+                f"window of {n_iter} iterations ({window:.4f}s) never cleared "
+                f"{min_floor_ratio}x the sync floor ({sync_floor:.4f}s)"
+            )
+        n_iter = min(n_iter * 4, max_iter)
+
+
+#: every ``vs_baseline`` below divides by this baseline — label it so the
+#: ratios cannot be misread as the BASELINE.json "5x A100+MPI" north star
+#: (no A100-class measurement exists in this repo)
+BASELINE_KIND = "torch_cpu_single_process_subset"
 
 
 # ---------------------------------------------------------------- configs
@@ -78,6 +120,7 @@ def bench_smoke(ht, sync_floor):
         "value": round(per * 1e3, 3),
         "unit": "ms",
         "vs_baseline": 1.0,
+        "vs_baseline_kind": "self",
     }
 
 
@@ -243,13 +286,25 @@ def bench_dpsgd(ht, sync_floor):
     }
 
 
+def _fft_scalar(r) -> float:
+    """One scalar that depends on the transform, without materializing a
+    host complex array: planar-backed results read their planes."""
+    if r._planar is not None:
+        re, im = r._planar
+        return float(jnp.sqrt(re[(0,) * re.ndim] ** 2 + im[(0,) * im.ndim] ** 2))
+    return float(jnp.abs(r.larray_padded[(0,) * r.ndim]))
+
+
 def bench_fft3d(ht, sync_floor):
-    """Config 5: 3-D FFT throughput (pencil resplit on a pod; one chip
-    measures the per-chip kernel), standard 5 N log2 N flop count.  On a
-    complex-less TPU runtime the framework's documented fallback runs the
-    transform on the host CPU backend — the number then reports that
-    fallback, not the chip."""
-    s = 128
+    """Config 5: 3-D FFT throughput, standard 5 N log2 N flop count.
+
+    Runs ON the chip via the planar (re, im) real-pair kernels even on
+    complex-less runtimes (heat_tpu/fft/_planar.py).  512^3 so device
+    compute dominates the tunnel's per-program dispatch floor; a Parseval
+    check outside the timed region guards that the measured program is
+    really the transform (the full spectrum is verified against
+    np.fft.fftn at 128^3 in tests/test_io_random_fft.py)."""
+    s = 512
     n = s**3
     ht.random.seed(2)
     x = ht.random.randn(s, s, s, split=0).astype(ht.float32)
@@ -258,29 +313,43 @@ def bench_fft3d(ht, sync_floor):
     def fft():
         return ht.fft.fftn(x)
 
-    fft()
-    per = _time_amortized(
-        fft, lambda r: float(jnp.abs(r.larray_padded[0, 0, 0])), 5, sync_floor
+    r = fft()
+    on_chip = r._planar is not None or (
+        next(iter(r.larray_padded.devices())).platform != "cpu"
     )
+    # Parseval: sum|X|^2 == N * sum|x|^2 (on device, outside the timing)
+    if r._planar is not None:
+        re, im = r._planar
+        spec_energy = float(jnp.sum(re * re + im * im))
+    else:
+        spec_energy = float(jnp.sum(jnp.abs(r.larray_padded) ** 2))
+    sig_energy = float((x * x).sum())
+    parseval = abs(spec_energy / (n * sig_energy) - 1.0)
+    if parseval > 1e-2:
+        raise MeasurementError(f"Parseval check failed: {parseval:.3e}")
+
+    per = _time_amortized(fft, _fft_scalar, 2, sync_floor)
     gflops = 5.0 * n * np.log2(n) / per / 1e9
 
     import torch
 
-    sb = 128
+    sb = s
     xb = torch.randn(sb, sb, sb)
     torch.fft.fftn(xb)
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        r = torch.fft.fftn(xb)
-        _ = r.real.sum().item()
+        r2 = torch.fft.fftn(xb)
+        _ = r2.real.sum().item()
         best = min(best, time.perf_counter() - t0)
     base = 5.0 * sb**3 * np.log2(sb**3) / best / 1e9
     return {
-        "metric": "fft3d_128^3_gflops",
+        "metric": "fft3d_512^3_gflops",
         "value": round(gflops, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(gflops / base, 2),
+        "on_chip": on_chip,
+        "parseval_err": round(parseval, 6),
     }
 
 
@@ -292,6 +361,7 @@ def main() -> None:
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d):
         try:
             r = bench(ht, sync_floor)
+            r.setdefault("vs_baseline_kind", BASELINE_KIND)
         except Exception as e:  # record the failure, keep the grid going
             r = {
                 "metric": bench.__name__,

@@ -50,15 +50,16 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Majority vote over the k nearest neighbors
-        (kneighborsclassifier.py:114-132)."""
+        (kneighborsclassifier.py:114-132).
+
+        The neighbor search is the ring-fused distance->top-k program
+        (spatial.distance.cdist_topk): the (n_test, n_train) matrix is
+        never materialized — peak memory is O(n_test * k) plus one
+        circulating train block (reference materializes the matrix)."""
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
-        # expanded form keeps the n_test x n_train distance on the MXU; the
-        # ranking only needs relative order, so the cancellation loss of the
-        # expanded form cannot change non-tied neighbor sets
-        d = distance.cdist(x, self.x, quadratic_expansion=True)._dense()
-        # k smallest distances -> neighbor indices
-        _, idx = jax.lax.top_k(-d, self.n_neighbors)
+        _, idx_arr = distance.cdist_topk(x, self.x, self.n_neighbors)
+        idx = idx_arr._dense()
         labels_oh = self.y._dense()
         votes = jnp.sum(labels_oh[idx], axis=1)
         pred = jnp.argmax(votes, axis=1).astype(jnp.int64)

@@ -349,7 +349,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     from .sample_sort import sample_sort_1d, supports_sample_sort
 
     if supports_sample_sort(a, axis, descending):
-        res_v, res_i = sample_sort_1d(a)
+        res_v, res_i = sample_sort_1d(a, descending)
         if out is not None:
             from .sanitation import sanitize_out
 
@@ -571,7 +571,24 @@ def unfold(a: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis=None):
     """Unique elements (manipulations.py:3271): local unique + gather in the
-    reference, a global jnp.unique here (eager => dynamic output shape OK)."""
+    reference, a global jnp.unique here (eager => dynamic output shape OK).
+
+    Large 1-D split arrays ride the PSRS sorted distribution: adjacent
+    diff on the sharded sorted values (the shard boundary is one implicit
+    halo, not a gather) + a take of only the distinct positions."""
+    if axis is None and a.ndim == 1 and a.split == 0 and not return_inverse:
+        from .sample_sort import sample_sort_1d, supports_sample_sort
+
+        if supports_sample_sort(a, 0, False):
+            v, _ = sample_sort_1d(a)
+            vd = v._dense()
+            flags = jnp.concatenate(
+                [jnp.ones((1,), bool), vd[1:] != vd[:-1]]
+            )
+            cnt = int(jnp.sum(flags))
+            idx = jnp.nonzero(flags, size=cnt)[0]
+            vals = jnp.take(vd, idx)
+            return DNDarray.from_dense(vals, 0, a.device, a.comm)
     dense = a._dense()
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)

