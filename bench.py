@@ -333,7 +333,9 @@ def bench_fft3d(ht, sync_floor):
 
     import torch
 
-    sb = s
+    # GFLOP/s-normalized rates compare across sizes: the 128^3 subset
+    # baseline avoids minutes of single-core 512^3 FFTs + ~2 GiB host RAM
+    sb = 128
     xb = torch.randn(sb, sb, sb)
     torch.fft.fftn(xb)
     best = float("inf")

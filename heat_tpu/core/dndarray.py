@@ -645,12 +645,14 @@ class DNDarray:
     def _padded_safe_key(self, key):
         """Return a key usable directly on the padded buffer, or None.
 
-        Safe when there is no padding (dense view == padded buffer), or when
-        the key component addressing the split axis is an integer / bounded
-        slice that provably stays inside the true extent (negative indices
-        are resolved against the TRUE extent, which differs from the padded
-        one, so they are normalized here).
-        """
+        Safe when there is no padding (dense view == padded buffer), or
+        when the component addressing the split axis provably never
+        touches the padding rows: an in-bounds integer or bounded slice,
+        an integer index array (negative entries are remapped against the
+        TRUE extent — canonical padding sits at the END of the axis, so
+        non-negative global indices are identical in both buffers), or a
+        1-D boolean mask (padded with False over the padding rows).
+        Components on other axes are unconstrained (no padding there)."""
         keys = list(key) if isinstance(key, tuple) else [key]
         # bool scalars are advanced indexing (numpy adds an axis), not ints —
         # and bool is an int subclass, so screen them out before any int check
@@ -660,31 +662,57 @@ class DNDarray:
             return key
         split = self.__split
         extent = self.__gshape[split]
-        # map each key component to the dimension it addresses
+
+        def consumed(k) -> int:
+            if k is None:
+                return 0
+            if isinstance(k, (jax.Array, np.ndarray)) and k.dtype == np.bool_:
+                return int(k.ndim)
+            return 1
+
+        n_explicit = sum(consumed(k) for k in keys if k is not Ellipsis)
         dim = 0
-        n_explicit = sum(1 for k in keys if k is not None and k is not Ellipsis)
         for i, k in enumerate(keys):
+            if isinstance(k, (list, tuple)):
+                keys[i] = k = np.asarray(k)
             if k is None:
                 continue
             if k is Ellipsis:
                 dim += self.ndim - n_explicit
+                if dim > split:
+                    return None  # padding exposed via the implicit full slice
                 continue
-            if dim == split:
+            c = consumed(k)
+            if dim <= split < dim + c:
                 if isinstance(k, (int, np.integer)):
                     j = int(k) + (extent if k < 0 else 0)
                     if 0 <= j < extent:
                         keys[i] = j
                         return tuple(keys)
                     return None
-                if isinstance(k, slice) and k.step in (None, 1):
+                if isinstance(k, slice):
+                    if k.step not in (None, 1):
+                        return None
                     start, stop, _ = k.indices(extent)
                     if 0 <= start <= stop <= extent:
                         keys[i] = slice(start, stop)
                         return tuple(keys)
+                    return None
+                if isinstance(k, (jax.Array, np.ndarray)):
+                    if k.dtype == np.bool_:
+                        if k.ndim != 1 or k.shape[0] != extent:
+                            return None  # multi-dim masks span other dims too
+                        widths = [(0, self._pad)]
+                        keys[i] = (
+                            np.pad(k, widths) if isinstance(k, np.ndarray) else jnp.pad(k, widths)
+                        )
+                        return tuple(keys)
+                    if jnp.issubdtype(k.dtype, jnp.integer):
+                        mod = np if isinstance(k, np.ndarray) else jnp
+                        keys[i] = mod.where(k < 0, k + extent, k)
+                        return tuple(keys)
                 return None
-            if not isinstance(k, (int, np.integer, slice)):
-                return None  # advanced indexing may interact with the split axis
-            dim += 1
+            dim += c
         return None  # split axis addressed implicitly (full slice over padding)
 
     def __len__(self) -> int:
@@ -1307,16 +1335,17 @@ def _pad_to_canonical(
 
 
 def _convert_key(arr: DNDarray, key):
-    """Normalize an indexing key: DNDarrays -> dense jax arrays; track the
-    output split heuristically (reference computes it exactly via the torch
-    meta-proxy, dndarray.py:1855; here the canonical re-placement in
-    ``from_dense`` makes any valid split correct, just not always optimal).
-    """
+    """Normalize an indexing key: DNDarrays -> dense jax arrays; compute the
+    output split EXACTLY by walking the key through numpy's indexing rules
+    (the analog of the reference's torch meta-proxy, dndarray.py:1855-1863,
+    without allocating anything)."""
     split = arr.split
 
     def conv(k):
         if isinstance(k, DNDarray):
             return k._dense()
+        if isinstance(k, list):
+            return np.asarray(k)  # numpy allows list keys; jnp does not
         return k
 
     if isinstance(key, tuple):
@@ -1324,35 +1353,101 @@ def _convert_key(arr: DNDarray, key):
     else:
         key_t = conv(key)
 
+    return key_t, _exact_out_split(arr, key_t)
+
+
+def _exact_out_split(arr: DNDarray, key_t) -> Optional[int]:
+    """Where the input's split dimension lands in the indexed output.
+
+    Implements numpy's layout rules exactly: ints remove dims, slices map
+    them through, newaxis inserts, a boolean mask of ndim k consumes k
+    input dims, and the advanced-index broadcast block is placed at the
+    position of the first advanced key when the advanced keys are
+    adjacent, else at the front.  When the split dim is consumed by an
+    integer, the output is no longer distributed along it (None); when it
+    feeds the advanced block, the output's split is that block's
+    position."""
+    split = arr.split
     if split is None:
-        return key_t, None
-
-    # advanced indexing (arrays / bool masks anywhere) -> output split 0
-    def is_adv(k):
-        return isinstance(k, (jax.Array, np.ndarray, list)) or (
-            hasattr(k, "dtype") and getattr(k, "ndim", 1) > 0
-        )
-
-    keys = key_t if isinstance(key_t, tuple) else (key_t,)
-    if any(is_adv(k) for k in keys):
-        return key_t, 0
-
-    # basic indexing: count dims removed/kept before the split axis
-    out_split = split
-    dim = 0
-    n_explicit = sum(1 for k in keys if k is not None and k is not Ellipsis)
+        return None
+    keys = list(key_t) if isinstance(key_t, tuple) else [key_t]
+    norm = []
     for k in keys:
+        if isinstance(k, (list, tuple)):
+            k = np.asarray(k)
+        if isinstance(k, (bool, np.bool_)) or (
+            isinstance(k, (jax.Array, np.ndarray))
+            and k.ndim == 0
+            and k.dtype == np.bool_
+        ):
+            return 0  # scalar-bool key: degenerate advanced case
+        norm.append(k)
+
+    def is_array(k):
+        return isinstance(k, (jax.Array, np.ndarray))
+
+    def consumed(k) -> int:
         if k is None:
-            out_split += 1  # newaxis before split shifts it right
-            continue
+            return 0
+        if is_array(k) and k.dtype == np.bool_:
+            return int(k.ndim)
+        return 1  # int, slice, integer array (incl. 0-d)
+
+    n_explicit = sum(consumed(k) for k in norm if k is not Ellipsis)
+    expanded = []
+    for k in norm:
         if k is Ellipsis:
-            dim += arr.ndim - n_explicit
+            expanded.extend([slice(None)] * (arr.ndim - n_explicit))
+        else:
+            expanded.append(k)
+    expanded.extend(
+        [slice(None)] * (arr.ndim - sum(consumed(k) for k in expanded))
+    )
+
+    # advanced block: broadcast rank and adjacency
+    adv_positions = [i for i, k in enumerate(expanded) if is_array(k)]
+    adv_present = bool(adv_positions)
+    if adv_present:
+        ranks = [
+            1 if k.dtype == np.bool_ else int(k.ndim)
+            for k in (expanded[i] for i in adv_positions)
+        ]
+        nb = max(ranks) if ranks else 0
+        contiguous = adv_positions[-1] - adv_positions[0] + 1 == len(adv_positions)
+
+    # walk: build the basic output dims in order, find the split's fate
+    basic_out = []  # entries: ("in", input_dim) | ("new",)
+    first_adv_basic_count = None
+    in_dim = 0
+    split_fate = "kept"
+    for k in expanded:
+        if k is None:
+            basic_out.append(("new",))
             continue
-        if dim >= split + 1:
-            break
-        if isinstance(k, (int, np.integer)):
-            if dim == split:
-                return key_t, None  # split dim consumed
-            out_split -= 1
-        dim += 1
-    return key_t, (out_split if out_split >= 0 else None)
+        if is_array(k):
+            if first_adv_basic_count is None:
+                first_adv_basic_count = len(basic_out)
+            c = consumed(k)
+            if in_dim <= split < in_dim + c:
+                split_fate = "adv"
+            in_dim += c
+            continue
+        if isinstance(k, slice):
+            basic_out.append(("in", in_dim))
+            in_dim += 1
+            continue
+        # integer: removes the dim
+        if in_dim == split:
+            split_fate = "int"
+        in_dim += 1
+
+    if split_fate == "int":
+        return None
+    if adv_present:
+        insert_at = first_adv_basic_count if contiguous else 0
+        if split_fate == "adv":
+            # nb == 0: only 0-d integer arrays — the dim is removed
+            return insert_at if nb > 0 else None
+        pos = basic_out.index(("in", split))
+        return pos + (nb if pos >= insert_at else 0)
+    return basic_out.index(("in", split))

@@ -66,14 +66,21 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
 
 
 def det(a: DNDarray) -> DNDarray:
-    """Determinant via LU (basics.py:159; the reference hand-writes a
-    distributed Gaussian elimination with partial pivoting — XLA's batched
-    LU over the sharded operand replaces it)."""
+    """Determinant via LU (basics.py:159).
+
+    2-D split matrices on a mesh run the distributed blocked LU with
+    partial pivoting (factorizations.py) — the matrix stays row-sharded,
+    matching the reference's hand-distributed Gaussian elimination
+    (basics.py:212-240); batched/replicated inputs use XLA's LU."""
     sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise RuntimeError("Last two dimensions of the array must be square")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
+    from .factorizations import det_dist, supports_dist_factor
+
+    if supports_dist_factor(a):
+        return det_dist(a)
     result = jnp.linalg.det(a._dense())
     split = a.split if a.split is not None and a.split < max(a.ndim - 2, 0) else None
     return DNDarray.from_dense(result, split, a.device, a.comm)
@@ -100,13 +107,20 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
 
 
 def inv(a: DNDarray) -> DNDarray:
-    """Matrix inverse (basics.py:311; the reference's distributed
-    Gauss-Jordan with pivoting becomes XLA's LU-based inverse)."""
+    """Matrix inverse (basics.py:311).
+
+    2-D split matrices run the distributed LU + blocked substitution
+    against the sharded identity (the reference's distributed
+    Gauss-Jordan, basics.py:421+); batched/replicated inputs use XLA."""
     sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise RuntimeError("Last two dimensions of the array must be square")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
+    from .factorizations import inv_dist, supports_dist_factor
+
+    if supports_dist_factor(a):
+        return inv_dist(a)
     result = jnp.linalg.inv(a._dense())
     return DNDarray.from_dense(result, a.split, a.device, a.comm)
 

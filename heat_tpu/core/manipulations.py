@@ -582,9 +582,12 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         if supports_sample_sort(a, 0, False):
             v, _ = sample_sort_1d(a)
             vd = v._dense()
-            flags = jnp.concatenate(
-                [jnp.ones((1,), bool), vd[1:] != vd[:-1]]
-            )
+            neq = vd[1:] != vd[:-1]
+            if jnp.issubdtype(vd.dtype, jnp.floating):
+                # NaN != NaN — collapse the sorted-last NaN run to one
+                # entry like jnp.unique/numpy do
+                neq = neq & ~(jnp.isnan(vd[1:]) & jnp.isnan(vd[:-1]))
+            flags = jnp.concatenate([jnp.ones((1,), bool), neq])
             cnt = int(jnp.sum(flags))
             idx = jnp.nonzero(flags, size=cnt)[0]
             vals = jnp.take(vd, idx)

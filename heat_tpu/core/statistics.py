@@ -298,7 +298,12 @@ def _percentile_sorted_1d(x, q, interpolation: str):
     lo = np.floor(pos).astype(np.int64)
     hi = np.ceil(pos).astype(np.int64)
     sel = select_global_ranks(v, np.concatenate([lo, hi]))
+    # numpy propagates NaN; the pmax in the rank selection does not (an
+    # IEEE max against the -inf fill drops it), so detect NaNs directly
+    has_nan = jnp.isnan(xf._masked(0.0)).any()
     lo_v, hi_v = sel[: len(q_np)], sel[len(q_np):]
+    lo_v = jnp.where(has_nan, jnp.nan, lo_v)
+    hi_v = jnp.where(has_nan, jnp.nan, hi_v)
     frac = jnp.asarray(pos - lo, sel.dtype)
     if interpolation == "linear":
         res = lo_v + frac * (hi_v - lo_v)

@@ -1,0 +1,97 @@
+"""Distributed Cholesky / LU det / inv / solve for split square matrices
+(VERDICT r2 #6; reference heat/core/linalg/basics.py:159-421)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+RNG = np.random.default_rng(0)
+
+
+def _p():
+    return ht.get_comm().size
+
+
+@pytest.mark.parametrize("n_off", [0, 3])
+def test_cholesky_dist(n_off):
+    n = 4 * _p() + n_off
+    A = RNG.standard_normal((n, n)).astype(np.float64)
+    A = A @ A.T + n * np.eye(n)
+    L = ht.linalg.cholesky(ht.array(A, split=0))
+    assert L.split == 0
+    np.testing.assert_allclose(L.numpy(), np.linalg.cholesky(A), rtol=1e-8, atol=1e-8)
+    # split=1 routes through a resplit, same program
+    L1 = ht.linalg.cholesky(ht.array(A, split=1))
+    np.testing.assert_allclose(L1.numpy(), np.linalg.cholesky(A), rtol=1e-8, atol=1e-8)
+
+
+@pytest.mark.parametrize("n_off", [0, 1, 3])
+def test_det_dist(n_off):
+    n = 4 * _p() + n_off
+    A = RNG.standard_normal((n, n)).astype(np.float64)
+    d = float(ht.linalg.det(ht.array(A, split=0)))
+    want = np.linalg.det(A)
+    assert abs(d - want) / max(abs(want), 1e-12) < 1e-8
+    # sign matters: flip two rows
+    B = A.copy()
+    B[[0, 1]] = B[[1, 0]]
+    d2 = float(ht.linalg.det(ht.array(B, split=0)))
+    np.testing.assert_allclose(d2, -want, rtol=1e-8)
+
+
+def test_det_singular():
+    # an exact zero row gives an exactly-zero pivot (duplicated rows do
+    # NOT: the tiny rounding pivot times a huge cofactor product is O(10)
+    # even in numpy — verified against np.linalg.det)
+    n = 4 * _p()
+    A = RNG.standard_normal((n, n)).astype(np.float64)
+    A[2] = 0.0
+    d = float(ht.linalg.det(ht.array(A, split=0)))
+    assert d == 0.0
+
+
+@pytest.mark.parametrize("n_off", [0, 1])
+def test_inv_solve_dist(n_off):
+    n = 4 * _p() + n_off
+    A = RNG.standard_normal((n, n)).astype(np.float64) + n * np.eye(n)
+    inv = ht.linalg.inv(ht.array(A, split=0))
+    assert inv.split == 0
+    np.testing.assert_allclose(inv.numpy(), np.linalg.inv(A), rtol=1e-8, atol=1e-9)
+    b = RNG.standard_normal((n, 3))
+    x = ht.linalg.solve(ht.array(A, split=0), ht.array(b, split=0))
+    np.testing.assert_allclose(x.numpy(), np.linalg.solve(A, b), rtol=1e-8, atol=1e-9)
+    bv = RNG.standard_normal(n)
+    xv = ht.linalg.solve(ht.array(A, split=0), ht.array(bv, split=0))
+    assert xv.shape == (n,)
+    np.testing.assert_allclose(xv.numpy(), np.linalg.solve(A, bv), rtol=1e-8, atol=1e-9)
+
+
+def test_lstsq_pinv_tall_split():
+    p = _p()
+    m, n = 8 * p, 3
+    A = RNG.standard_normal((m, n))
+    b = RNG.standard_normal(m)
+    x, _, rank, _ = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+    np.testing.assert_allclose(
+        x.numpy(), np.linalg.lstsq(A, b, rcond=None)[0], rtol=1e-8
+    )
+    assert int(rank) == n
+    pi = ht.linalg.pinv(ht.array(A, split=0))
+    np.testing.assert_allclose(pi.numpy(), np.linalg.pinv(A), rtol=1e-7, atol=1e-9)
+
+
+def test_factorization_never_materializes_full_matrix():
+    """The compiled per-device program must hold only O(n*b) buffers —
+    a full (n_pad, n_pad) per-device allocation means a gather happened."""
+    if _p() == 1:
+        pytest.skip("needs a mesh")
+    from heat_tpu.core.linalg import factorizations as F
+
+    n = 8 * _p()
+    A = RNG.standard_normal((n, n)).astype(np.float64)
+    a = ht.array(A @ A.T + n * np.eye(n), split=0)
+    buf, _, n_pad = F._square_padded(a)
+    for fn in (F._chol_fn(a.comm, n_pad, str(buf.dtype)), F._lu_fn(a.comm, n_pad, str(buf.dtype))):
+        txt = fn.lower(buf).compile().as_text()
+        assert f"f64[{n_pad},{n_pad}]" not in txt, "full matrix materialized per device"

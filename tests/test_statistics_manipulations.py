@@ -435,3 +435,49 @@ class TestSortedOrderStatistics:
 
         txt = fn.lower(a.larray_padded, jnp.zeros(2, jnp.int64)).compile().as_text()
         assert "all-gather" not in txt or "f32[64]" not in txt  # no full-array gather
+
+
+def test_sorted_orderstats_nan_propagation(monkeypatch):
+    """r3 review: the PSRS fast paths must keep numpy's NaN semantics."""
+    from heat_tpu.core import sample_sort
+
+    monkeypatch.setattr(sample_sort, "SAMPLE_SORT_THRESHOLD", 1)
+    data = np.array([5.0, 1.0, np.nan, 3.0, 2.0, 4.0, 8.0, 7.0], np.float32)
+    a = ht.array(data, split=0)
+    assert np.isnan(float(ht.percentile(a, 50.0)))
+    assert np.isnan(float(ht.median(a)))
+    dn = np.array([3.0, np.nan, 1.0, np.nan, -2.0, np.nan, 1.0, 3.0], np.float32)
+    u = ht.unique(ht.array(dn, split=0)).numpy()
+    want = np.unique(dn)
+    assert u.shape == want.shape
+    np.testing.assert_array_equal(u[:-1], want[:-1])
+    assert np.isnan(u[-1])
+
+
+def test_lstsq_pinv_rank_deficient_falls_back(monkeypatch):
+    """r3 review: duplicated column -> fast path must defer to the SVD."""
+    p = ht.get_comm().size
+    rng = np.random.default_rng(9)
+    A = rng.standard_normal((8 * p, 3))
+    A[:, 2] = A[:, 0]  # rank 2
+    b = rng.standard_normal(8 * p)
+    x, _, _, _ = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+    np.testing.assert_allclose(
+        x.numpy(), np.linalg.lstsq(A, b, rcond=None)[0], rtol=1e-5, atol=1e-6
+    )
+    pi = ht.linalg.pinv(ht.array(A, split=0))
+    np.testing.assert_allclose(pi.numpy(), np.linalg.pinv(A), rtol=1e-5, atol=1e-6)
+
+
+def test_lstsq_contract_full_rank():
+    """resid is the residual sum of squares and sv the true spectrum."""
+    p = ht.get_comm().size
+    rng = np.random.default_rng(10)
+    A = rng.standard_normal((8 * p, 3))
+    b = rng.standard_normal(8 * p)
+    x, resid, rank, sv = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+    xn, rn, kn, svn = np.linalg.lstsq(A, b, rcond=None)
+    np.testing.assert_allclose(x.numpy(), xn, rtol=1e-6)
+    np.testing.assert_allclose(resid.numpy(), rn, rtol=1e-5)
+    assert int(rank) == kn
+    np.testing.assert_allclose(np.sort(sv.numpy())[::-1], svn, rtol=1e-5)
