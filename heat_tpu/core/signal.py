@@ -51,25 +51,62 @@ def convolve(a, v, mode: str = "full") -> DNDarray:
     else:
         compute_jdt = promoted.jax_type()
     signal = a._dense().astype(compute_jdt)
-    kernel = v._dense().astype(compute_jdt)
 
-    k = kernel.shape[0]
-    if mode == "full":
-        pad_l = pad_r = k - 1
-    elif mode == "same":
-        pad_l = pad_r = k // 2
+    if v.split is not None and v.comm.size > 1:
+        # distributed-kernel mode (reference signal.py:267+): the split
+        # kernel is STREAMED — each round replicates one participant's
+        # chunk (the reference's Bcast) and accumulates its shifted
+        # partial convolution; no device ever holds the whole kernel
+        out = _streamed_kernel_conv(signal, v, mode, compute_jdt)
     else:
-        pad_l = pad_r = 0
-    padded = jnp.pad(signal, (pad_l, pad_r))
-    # conv_general_dilated computes correlation; flip the kernel for
-    # convolution semantics
-    lhs = padded[None, None, :]
-    rhs = jnp.flip(kernel)[None, None, :]
-    out = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=(1,), padding="VALID",
-        precision=jax.lax.Precision.HIGHEST,
-    )[0, 0]
+        kernel = v._dense().astype(compute_jdt)
+        k = kernel.shape[0]
+        if mode == "full":
+            pad_l = pad_r = k - 1
+        elif mode == "same":
+            pad_l = pad_r = k // 2
+        else:
+            pad_l = pad_r = 0
+        out = _conv1d_valid(jnp.pad(signal, (pad_l, pad_r)), kernel)
     if types.heat_type_is_exact(promoted):
         out = jnp.round(out)
     out = out.astype(promoted.jax_type())
     return DNDarray.from_dense(out, a.split, a.device, a.comm)
+
+
+def _conv1d_valid(signal, kernel):
+    """VALID correlation with the flipped kernel == convolution."""
+    lhs = signal[None, None, :]
+    rhs = jnp.flip(kernel)[None, None, :]
+    return jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding="VALID",
+        precision=jax.lax.Precision.HIGHEST,
+    )[0, 0]
+
+
+def _streamed_kernel_conv(signal, v, mode, compute_jdt):
+    """Bcast-round convolution with a split kernel (signal.py:267+).
+
+    full(a, v) = sum over kernel chunks c of full(a, chunk_c) shifted by
+    the chunk offset; each round handles one (k/p)-sized chunk, and the
+    mode slice is applied to the accumulated full-length result."""
+    comm = v.comm
+    p = comm.size
+    n = signal.shape[0]
+    k = v.shape[0]
+    kp = v.larray_padded.astype(compute_jdt)
+    b = kp.shape[0] // p
+    out = jnp.zeros((n + k - 1,), compute_jdt)
+    for r in range(p):
+        s = r * b
+        w = min(k, s + b) - s
+        if w <= 0:
+            break
+        chunk = kp[s : s + w]  # one chunk in flight (the Bcast round)
+        part = _conv1d_valid(jnp.pad(signal, (w - 1, w - 1)), chunk)
+        out = out.at[s : s + n + w - 1].add(part)
+    if mode == "full":
+        return out
+    if mode == "same":
+        return out[k // 2 : k // 2 + n]
+    return out[k - 1 : n]

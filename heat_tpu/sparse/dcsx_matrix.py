@@ -116,10 +116,21 @@ class DCSX_matrix:
     # ------------------------------------------------------------------
     def _csr_triple(self):
         """(indptr, indices, data) of the global matrix, compressed along
-        the class's compressed axis."""
+        the class's compressed axis.  Cached — the backing BCOO is never
+        mutated in place (astype/T return new matrices), and accessor
+        chains (indptr/indices/data/lnnz) would otherwise re-run the
+        BCOO->BCSR conversion per property read."""
+        cached = getattr(self, "_triple_cache", None)
+        if cached is not None:
+            return cached
         mat = self.__array if self._compressed_axis == 0 else _transpose_bcoo(self.__array)
         bcsr = jsparse.BCSR.from_bcoo(_sorted(mat))
-        return np.asarray(bcsr.indptr), np.asarray(bcsr.indices), np.asarray(bcsr.data)
+        self._triple_cache = (
+            np.asarray(bcsr.indptr),
+            np.asarray(bcsr.indices),
+            np.asarray(bcsr.data),
+        )
+        return self._triple_cache
 
     def _local_compressed_range(self):
         n = self.__gshape[self._compressed_axis]

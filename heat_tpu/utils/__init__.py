@@ -4,5 +4,6 @@ checkpoint/resume and profiling, SURVEY.md §5)."""
 from . import checkpoint
 from . import data
 from . import profiling
+from . import vision_transforms
 
-__all__ = ["checkpoint", "data", "profiling"]
+__all__ = ["checkpoint", "data", "profiling", "vision_transforms"]

@@ -1,0 +1,141 @@
+"""Image transforms, analog of heat/utils/vision_transforms.py.
+
+The reference is a passthrough to ``torchvision.transforms`` (reference
+vision_transforms.py:10-19).  The TPU-native build provides jnp-backed
+implementations of the common transforms (so pipelines run without torch
+and compose with jax arrays / DNDarrays), and falls back to torchvision
+for anything not implemented here — the same ``__getattr__`` contract.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "CenterCrop",
+    "Compose",
+    "Lambda",
+    "Normalize",
+    "RandomHorizontalFlip",
+    "ToTensor",
+]
+
+
+def _as_jnp(pic):
+    from ..core.dndarray import DNDarray
+
+    if isinstance(pic, DNDarray):
+        return pic._dense()
+    return jnp.asarray(np.asarray(pic))
+
+
+class Compose:
+    """Chain transforms (torchvision.transforms.Compose contract)."""
+
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, pic):
+        for t in self.transforms:
+            pic = t(pic)
+        return pic
+
+    def __repr__(self):
+        inner = ", ".join(repr(t) for t in self.transforms)
+        return f"{type(self).__name__}([{inner}])"
+
+
+class ToTensor:
+    """HWC uint8 [0, 255] -> CHW float32 [0, 1] (torchvision semantics)."""
+
+    def __call__(self, pic):
+        arr = _as_jnp(pic)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3:
+            arr = jnp.transpose(arr, (2, 0, 1))
+        if jnp.issubdtype(arr.dtype, jnp.integer):
+            arr = arr.astype(jnp.float32) / 255.0
+        return arr.astype(jnp.float32)
+
+    def __repr__(self):
+        return "ToTensor()"
+
+
+class Normalize:
+    """Channel-wise (x - mean) / std on CHW arrays."""
+
+    def __init__(self, mean, std, inplace: bool = False):
+        self.mean = jnp.asarray(mean, jnp.float32)
+        self.std = jnp.asarray(std, jnp.float32)
+
+    def __call__(self, pic):
+        arr = _as_jnp(pic)
+        shape = (-1,) + (1,) * (arr.ndim - 1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+    def __repr__(self):
+        return f"Normalize(mean={self.mean.tolist()}, std={self.std.tolist()})"
+
+
+class CenterCrop:
+    """Crop the central (h, w) window of a (..., H, W) array."""
+
+    def __init__(self, size):
+        self.size = (int(size), int(size)) if np.isscalar(size) else tuple(size)
+
+    def __call__(self, pic):
+        arr = _as_jnp(pic)
+        h, w = self.size
+        H, W = arr.shape[-2], arr.shape[-1]
+        top, left = max((H - h) // 2, 0), max((W - w) // 2, 0)
+        return arr[..., top : top + h, left : left + w]
+
+    def __repr__(self):
+        return f"CenterCrop(size={self.size})"
+
+
+class RandomHorizontalFlip:
+    """Flip the last axis with probability p (host RNG — transforms run in
+    the input pipeline, not inside jit)."""
+
+    def __init__(self, p: float = 0.5, rng: np.random.Generator | None = None):
+        self.p = float(p)
+        self.rng = rng or np.random.default_rng()
+
+    def __call__(self, pic):
+        arr = _as_jnp(pic)
+        if self.rng.random() < self.p:
+            return jnp.flip(arr, axis=-1)
+        return arr
+
+    def __repr__(self):
+        return f"RandomHorizontalFlip(p={self.p})"
+
+
+class Lambda:
+    """Wrap a user callable."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, pic):
+        return self.fn(pic)
+
+    def __repr__(self):
+        return "Lambda()"
+
+
+def __getattr__(name):
+    """Fall back to torchvision.transforms for anything not implemented,
+    mirroring the reference's passthrough (vision_transforms.py:10-19)."""
+    try:
+        import torchvision.transforms as tvt
+    except Exception as exc:  # pragma: no cover - torchvision always bundled
+        raise AttributeError(f"module {name} not implemented in heat_tpu") from exc
+    if hasattr(tvt, name):
+        return getattr(tvt, name)
+    raise AttributeError(f"module {name} not implemented in torchvision or heat_tpu")

@@ -21,6 +21,17 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# persistent compilation cache: the suite is compile-dominated (hundreds
+# of unique (shape, dtype, mesh) programs on the virtual mesh); warm
+# reruns skip XLA entirely.  Run parallel with ``pytest -n auto`` (xdist)
+# — workers share this cache, and CI stays inside one timeout window.
+_CACHE_DIR = os.environ.get(
+    "HEAT_TPU_COMPILE_CACHE", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+if _CACHE_DIR != "0":
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import numpy as np
 import pytest
 

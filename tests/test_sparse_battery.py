@@ -1,0 +1,218 @@
+"""Sparse split-sweep battery, the analog of the reference's
+heat/sparse/tests families (test_arithmetics_csr.py 1390 LoC,
+test_dcsrmatrix/test_dcscmatrix, test_factories.py, test_manipulations.py
+— VERDICT r2 #7).  Uses the reference's fixed 5x5 matrices plus scipy
+ground truth for randomized sweeps across splits, formats, and dtypes.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import heat_tpu as ht
+
+# the reference's fixtures (test_arithmetics_csr.py:20-70)
+A = np.array(
+    [
+        [1, 0, 1, 0, 2],
+        [0, 0, 2, 0, 0],
+        [0, 3, 0, 2, 0],
+        [2, 0, 0, 4, 0],
+        [0, 3, 0, 0, 5],
+    ],
+    dtype=np.float32,
+)
+B = np.array(
+    [
+        [1, 0, 0, 0, 3],
+        [0, 0, 2, 0, 0],
+        [0, 1, 0, -1, 0],
+        [2, 0, 0, 1, 0],
+        [0, 0, 0, 4, 1],
+    ],
+    dtype=np.float32,
+)
+
+
+@pytest.fixture(params=[None, 0])
+def split(request):
+    return request.param
+
+
+class TestArithmetics:
+    def test_add_matches_scipy_csr(self, split):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=split)
+        b = ht.sparse.sparse_csr_matrix(sp.csr_matrix(B), split=split)
+        c = a + b
+        want = sp.csr_matrix(A + B)
+        assert isinstance(c, ht.sparse.DCSR_matrix)
+        assert c.shape == (5, 5)
+        np.testing.assert_allclose(c.toarray(), A + B)
+        np.testing.assert_array_equal(np.asarray(c.indptr), want.indptr)
+        np.testing.assert_array_equal(np.asarray(c.indices), want.indices)
+        np.testing.assert_allclose(np.asarray(c.data), want.data)
+
+    def test_mul_matches_scipy_csr(self, split):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=split)
+        b = ht.sparse.sparse_csr_matrix(sp.csr_matrix(B), split=split)
+        c = a * b
+        want = sp.csr_matrix(A * B)
+        np.testing.assert_allclose(c.toarray(), A * B)
+        got = sp.csr_matrix(c.toarray())
+        np.testing.assert_array_equal(got.indptr, want.indptr)
+
+    def test_csc_add_mul(self):
+        a = ht.sparse.sparse_csc_matrix(sp.csc_matrix(A), split=1)
+        b = ht.sparse.sparse_csc_matrix(sp.csc_matrix(B), split=1)
+        c = a + b
+        assert isinstance(c, ht.sparse.DCSC_matrix)
+        assert c.split == 1
+        np.testing.assert_allclose(c.toarray(), A + B)
+        np.testing.assert_allclose((a * b).toarray(), A * B)
+
+    def test_mismatched_patterns_random(self):
+        rng = np.random.default_rng(0)
+        for trial in range(3):
+            d1 = sp.random(23, 17, density=0.2, random_state=trial, format="csr")
+            d2 = sp.random(23, 17, density=0.15, random_state=trial + 10, format="csr")
+            a = ht.sparse.sparse_csr_matrix(d1, split=0)
+            b = ht.sparse.sparse_csr_matrix(d2, split=0)
+            np.testing.assert_allclose(
+                (a + b).toarray(), (d1 + d2).toarray(), rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                (a * b).toarray(), d1.multiply(d2).toarray(), rtol=1e-6
+            )
+
+    def test_errors(self):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A))
+        c = ht.sparse.sparse_csc_matrix(sp.csc_matrix(B))
+        with pytest.raises(TypeError):
+            a + c  # mixed formats (reference raises too)
+        with pytest.raises(TypeError):
+            a + 1.0
+        small = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A[:3]))
+        with pytest.raises(ValueError):
+            a + small
+
+    def test_matmul_family(self, split):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=split)
+        b = ht.sparse.sparse_csr_matrix(sp.csr_matrix(B), split=split)
+        ss = a @ b
+        assert isinstance(ss, ht.sparse.DCSR_matrix)
+        np.testing.assert_allclose(ss.toarray(), A @ B, rtol=1e-5)
+        dense = ht.array(B, split=0)
+        sd = a @ dense
+        np.testing.assert_allclose(sd.numpy(), A @ B, rtol=1e-5)
+        ds = dense @ a  # dense @ sparse
+        np.testing.assert_allclose(ds.numpy(), B @ A, rtol=1e-5)
+
+    def test_sum_reductions(self, split):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=split)
+        np.testing.assert_allclose(float(a.sum()), A.sum(), rtol=1e-6)
+        np.testing.assert_allclose(a.sum(axis=0).numpy(), A.sum(0), rtol=1e-6)
+        np.testing.assert_allclose(a.sum(axis=1).numpy(), A.sum(1), rtol=1e-6)
+
+
+class TestDCSRMatrix:
+    """Accessor battery (reference test_dcsrmatrix.py)."""
+
+    def test_triple_vs_scipy(self, split):
+        want = sp.csr_matrix(A)
+        a = ht.sparse.sparse_csr_matrix(want, split=split)
+        assert a.nnz == want.nnz and a.gnnz == want.nnz
+        np.testing.assert_array_equal(np.asarray(a.indptr), want.indptr)
+        np.testing.assert_array_equal(np.asarray(a.global_indptr), want.indptr)
+        np.testing.assert_array_equal(np.asarray(a.indices), want.indices)
+        np.testing.assert_allclose(np.asarray(a.data), want.data)
+        np.testing.assert_allclose(np.asarray(a.gdata), want.data)
+        assert a.ndim == 2 and a.balanced
+
+    def test_astype_transpose_repr(self):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=0)
+        d = a.astype(ht.float64)
+        assert d.dtype == ht.float64
+        np.testing.assert_allclose(d.toarray(), A)
+        t = a.T
+        assert isinstance(t, ht.sparse.DCSC_matrix)
+        assert t.split == 1
+        np.testing.assert_allclose(t.toarray(), A.T)
+        assert "DCSR_matrix" in repr(a)
+
+    def test_counts_displs(self):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=0)
+        counts, displs = a.counts_displs_nnz()
+        assert sum(counts) == a.gnnz
+        assert displs[0] == 0
+        assert all(
+            displs[i] + counts[i] == displs[i + 1] for i in range(len(counts) - 1)
+        )
+
+
+class TestDCSCMatrix:
+    """Reference test_dcscmatrix.py: the compressed axis is the column."""
+
+    def test_triple_vs_scipy(self):
+        want = sp.csc_matrix(A)
+        a = ht.sparse.sparse_csc_matrix(want, split=1)
+        assert a.split == 1
+        np.testing.assert_array_equal(np.asarray(a.indptr), want.indptr)
+        np.testing.assert_array_equal(np.asarray(a.indices), want.indices)
+        np.testing.assert_allclose(np.asarray(a.data), want.data)
+
+    def test_transpose_roundtrip(self):
+        a = ht.sparse.sparse_csc_matrix(sp.csc_matrix(A), split=1)
+        back = a.T.T
+        assert isinstance(back, ht.sparse.DCSC_matrix)
+        np.testing.assert_allclose(back.toarray(), A)
+
+
+class TestFactories:
+    """Reference test_factories.py: every ingestion route."""
+
+    def test_from_scipy_formats(self):
+        for mk in (sp.csr_matrix, sp.csc_matrix, sp.coo_matrix):
+            a = ht.sparse.sparse_csr_matrix(mk(A), split=0)
+            np.testing.assert_allclose(a.toarray(), A)
+
+    def test_from_dense_dndarray(self, split):
+        a = ht.sparse.sparse_csr_matrix(ht.array(A, split=0), split=split)
+        np.testing.assert_allclose(a.toarray(), A)
+        assert a.nnz == int((A != 0).sum())
+
+    def test_from_torch_sparse(self):
+        torch = pytest.importorskip("torch")
+        t = torch.tensor(A).to_sparse()
+        a = ht.sparse.sparse_csr_matrix(t)
+        np.testing.assert_allclose(a.toarray(), A)
+
+    def test_dtype_override(self):
+        a = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), dtype=ht.float64)
+        assert a.dtype == ht.float64
+
+    def test_csc_factory_split_validation(self):
+        with pytest.raises((ValueError, NotImplementedError)):
+            ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=1)
+        with pytest.raises((ValueError, NotImplementedError)):
+            ht.sparse.sparse_csc_matrix(sp.csc_matrix(A), split=0)
+
+
+class TestManipulations:
+    """Reference test_manipulations.py: conversions both ways."""
+
+    def test_roundtrips(self, split):
+        dense = ht.array(A, split=0)
+        s = ht.sparse.to_sparse_csr(dense)
+        assert isinstance(s, ht.sparse.DCSR_matrix)
+        back = ht.sparse.to_dense(s)
+        np.testing.assert_allclose(back.numpy(), A)
+        c = ht.sparse.to_sparse_csc(ht.array(A, split=1))
+        assert isinstance(c, ht.sparse.DCSC_matrix)
+        np.testing.assert_allclose(ht.sparse.to_dense(c).numpy(), A)
+
+    def test_to_dense_out_param(self):
+        s = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A), split=0)
+        out = ht.empty((5, 5), dtype=ht.float32, split=0)
+        res = ht.sparse.to_dense(s, out=out)
+        np.testing.assert_allclose(out.numpy(), A)
+        assert res is out

@@ -1,0 +1,21 @@
+"""The tutorial notebooks must stay runnable (reference tutorials/local)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted(glob.glob(os.path.join(os.path.dirname(__file__), "..", "tutorials", "local", "*.ipynb"))),
+    ids=lambda p: os.path.basename(p),
+)
+def test_notebook_executes(path):
+    ns = {}
+    nb = json.load(open(path))
+    assert nb["cells"], path
+    for cell in nb["cells"]:
+        if cell["cell_type"] == "code":
+            exec(compile("".join(cell["source"]), path, "exec"), ns)
