@@ -354,13 +354,13 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
             from .sanitation import sanitize_out
 
             sanitize_out(out, res_v.shape, res_v.split, res_v.device)
-            # rebuild in OUT's layout — swapping in the split-0 padded
-            # backing array would corrupt an out with a different split
-            out._replace(
-                DNDarray.from_dense(
-                    res_v.astype(out.dtype)._dense(), out.split, out.device, out.comm
-                ).larray_padded
-            )
+            src = res_v.astype(out.dtype)
+            if out.split == src.split:
+                # same canonical layout — adopt the PSRS backing directly
+                out._replace(src.larray_padded)
+            else:
+                # out has a different split: one reshard via resplit
+                out._replace(src.resplit(out.split).larray_padded)
             return out, res_i
         return res_v, res_i
 

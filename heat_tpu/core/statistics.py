@@ -288,7 +288,11 @@ def _percentile_sorted_1d(x, q, interpolation: str):
     instead of gathering the dense array.  None when the gate declines."""
     from .sample_sort import sample_sort_1d, select_global_ranks, supports_sample_sort
 
-    xf = x if types.heat_type_is_inexact(x.dtype) else x.astype(types.float32)
+    if types.heat_type_is_inexact(x.dtype):
+        xf = x
+    else:
+        # numpy promotes integer input to float64; honor that under x64
+        xf = x.astype(types.float64 if jax.config.jax_enable_x64 else types.float32)
     if not supports_sample_sort(xf, 0, False):
         return None
     v, _ = sample_sort_1d(xf)
@@ -376,6 +380,9 @@ def percentile(
     reduction axis (statistics.py:1490-1532) — O(sketch_size log) instead
     of a full sort, with sampling error ~1/sqrt(sketch_size).
     """
+    q_chk = np.asarray(q, dtype=np.float64)
+    if np.any(q_chk < 0.0) or np.any(q_chk > 100.0):
+        raise ValueError("Percentiles must be in the range [0, 100]")
     qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     axis_s = sanitize_axis(x.shape, axis)
     if not sketched and out is None and x.ndim == 1 and axis_s in (None, 0):

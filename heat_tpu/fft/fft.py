@@ -99,6 +99,15 @@ def _use_planar() -> bool:
     return jax.default_backend() == "tpu" and not _tpu_complex_ok()
 
 
+def _promote_plane(buf):
+    """Promote a plane to at least float32 — jnp.fft promotes f16/bf16 to
+    complex64, so half-precision planes would both lose ~1e-3 accuracy in
+    the DFT matmuls and break jax.lax.complex materialization."""
+    if not jnp.issubdtype(buf.dtype, jnp.floating) or buf.dtype.itemsize < 4:
+        return buf.astype(jnp.float32)
+    return buf
+
+
 def _planes_in(x: DNDarray):
     """True-shape (re, im|None) planes of ``x`` on the compute mesh."""
     if x._planar is not None:
@@ -127,8 +136,7 @@ def _planes_in(x: DNDarray):
             re, im = re[sl], im[sl]
         return re, im
     dense = x._dense()
-    if not jnp.issubdtype(dense.dtype, jnp.floating):
-        dense = dense.astype(jnp.float32)
+    dense = _promote_plane(dense)
     return dense, None
 
 
@@ -139,9 +147,7 @@ def _padded_planes(x: DNDarray):
     if types.heat_type_is_complexfloating(x.dtype):
         re, im = _planes_in(x)
         return _repad(re, x.shape, x.split, x.comm), _repad(im, x.shape, x.split, x.comm)
-    buf = x.larray_padded
-    if not jnp.issubdtype(buf.dtype, jnp.floating):
-        buf = buf.astype(jnp.float32)
+    buf = _promote_plane(x.larray_padded)
     return buf, jnp.zeros_like(buf)
 
 

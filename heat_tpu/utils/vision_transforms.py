@@ -99,17 +99,28 @@ class CenterCrop:
 
 
 class RandomHorizontalFlip:
-    """Flip the last axis with probability p (host RNG — transforms run in
-    the input pipeline, not inside jit)."""
+    """Flip the width axis with probability p (host RNG — transforms run in
+    the input pipeline, not inside jit).
+
+    Width-axis inference follows torchvision: 3-D input that is not
+    channel-first (i.e. HWC, the PIL/numpy layout before ToTensor) flips
+    axis=-2; CHW tensors and 2-D grayscale flip axis=-1.
+    """
 
     def __init__(self, p: float = 0.5, rng: np.random.Generator | None = None):
         self.p = float(p)
         self.rng = rng or np.random.default_rng()
 
+    @staticmethod
+    def _width_axis(arr) -> int:
+        if arr.ndim >= 3 and arr.shape[-1] in (1, 3, 4) and arr.shape[-3] not in (1, 3, 4):
+            return -2  # HWC: last axis is channels, width is -2
+        return -1
+
     def __call__(self, pic):
         arr = _as_jnp(pic)
         if self.rng.random() < self.p:
-            return jnp.flip(arr, axis=-1)
+            return jnp.flip(arr, axis=self._width_axis(arr))
         return arr
 
     def __repr__(self):
