@@ -346,10 +346,10 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """
     axis = sanitize_axis(a.shape, axis)
 
-    from .sample_sort import sample_sort_1d, supports_sample_sort
+    from .sample_sort import sample_sort_along, supports_sample_sort
 
     if supports_sample_sort(a, axis, descending):
-        res_v, res_i = sample_sort_1d(a, descending)
+        res_v, res_i = sample_sort_along(a, axis, descending)
         if out is not None:
             from .sanitation import sanitize_out
 

@@ -381,7 +381,7 @@ def percentile(
     of a full sort, with sampling error ~1/sqrt(sketch_size).
     """
     q_chk = np.asarray(q, dtype=np.float64)
-    if np.any(q_chk < 0.0) or np.any(q_chk > 100.0):
+    if not np.all((q_chk >= 0.0) & (q_chk <= 100.0)):  # NaN fails both too
         raise ValueError("Percentiles must be in the range [0, 100]")
     qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     axis_s = sanitize_axis(x.shape, axis)

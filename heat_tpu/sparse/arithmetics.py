@@ -1,16 +1,21 @@
-"""Sparse elementwise arithmetic, analog of heat/sparse/arithmetics.py
-(add :17, mul :58 via ``__binary_op_csx``, sparse/_operations.py:17-209).
+"""Sparse arithmetic over the sharded nnz planes, analog of
+heat/sparse/arithmetics.py (add :17, mul :58 via ``__binary_op_csx``,
+sparse/_operations.py:17-209).
 
-The reference applies local torch sparse ops per chunk and re-syncs nnz;
-here the global BCOO op (union for add, intersection for mul) is one XLA
-expression.
+The reference applies local torch sparse ops per chunk and Allreduces the
+new nnz; here each op is one jitted shard_map program over the padded
+planes (concat + two-key sort + neighbor merge for union/intersection,
+gather + segment-sum (+ psum/psum_scatter) for the products) followed by
+the same small nnz re-sync.
 """
 
 from __future__ import annotations
 
-from jax.experimental import sparse as jsparse
+import jax.numpy as jnp
+import numpy as np
 
 from ..core.dndarray import DNDarray
+from . import _planes as _pl
 from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
 
 __all__ = ["add", "mul", "sum", "matmul"]
@@ -21,90 +26,190 @@ def _binary_op_csx(op_name, t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
     if not isinstance(t1, DCSX_matrix) or not isinstance(t2, DCSX_matrix):
         raise TypeError(f"both operands must be sparse matrices, got {type(t1)}, {type(t2)}")
     if type(t1) is not type(t2):
-        raise TypeError(f"operands must share the sparse format, got {type(t1).__name__} and {type(t2).__name__}")
+        raise TypeError(
+            f"operands must share the sparse format, got {type(t1).__name__} and {type(t2).__name__}"
+        )
     if t1.shape != t2.shape:
         raise ValueError(f"shapes must match, got {t1.shape} and {t2.shape}")
-    a, b = t1.larray, t2.larray
-    if op_name == "add":
-        res = jsparse.bcoo_sum_duplicates(_bcoo_union_add(a, b))
-    else:
-        res = jsparse.bcoo_sum_duplicates(jsparse.bcoo_sort_indices(jsparse.bcoo_multiply_sparse(a, b)))
+    if t1.split != t2.split:
+        # align layouts through the (split=None) host-free dense of the
+        # smaller... no: re-chunk the unsplit operand onto the mesh
+        t2 = _align_split(t2, t1.split)
     from ..core import types
 
-    dtype = types.canonical_heat_type(res.data.dtype)
-    return type(t1)(res, int(res.nse), t1.shape, dtype, t1.split, t1.device, t1.comm)
+    res_jt = jnp.promote_types(t1.dtype.jax_type(), t2.dtype.jax_type())
+    a = t1 if t1._val.dtype == res_jt else t1.astype(res_jt)
+    b = t2 if t2._val.dtype == res_jt else t2.astype(res_jt)
+    comp, other, val, lnnz_dev, lnnz_host, out_C = _pl.merge_planes(
+        op_name,
+        (a._comp, a._other, a._val),
+        (b._comp, b._other, b._val),
+        a._nshards, a._capacity, b._capacity, a._comp_pad, a._dist, a.comm,
+    )
+    dtype = types.canonical_heat_type(res_jt)
+    return a._with_planes((comp, other, val), lnnz_dev, lnnz_host, out_C, dtype=dtype)
 
 
-def _bcoo_union_add(a, b):
-    import jax.numpy as jnp
+def _align_split(t: DCSX_matrix, split):
+    """Re-chunk a matrix to another split of the same compressed axis
+    (None <-> compressed axis): one host round-trip at ingestion scale."""
+    from .factories import _host_coo
 
-    data = jnp.concatenate([a.data, b.data])
-    idx = jnp.concatenate([a.indices, b.indices], axis=0)
-    return jsparse.bcoo_sort_indices(jsparse.BCOO((data, idx), shape=a.shape))
+    rows, cols, vals, shape = _host_coo(t)
+    return type(t).from_host_coo(rows, cols, vals, shape, split, t.device, t.comm)
 
 
 def add(t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
-    """Element-wise sparse addition (sparse/arithmetics.py:17)."""
+    """Element-wise sparse addition (sparse/arithmetics.py:17): pattern
+    union with duplicate merging."""
     return _binary_op_csx("add", t1, t2)
 
 
-def mul(t1: DCSX_matrix, t2: DCSX_matrix) -> DCSX_matrix:
-    """Element-wise sparse multiplication (sparse/arithmetics.py:58)."""
+def mul(t1, t2):
+    """Element-wise sparse multiplication (sparse/arithmetics.py:58):
+    pattern intersection; scalars scale the value plane in place."""
+    if isinstance(t1, DCSX_matrix) and np.isscalar(t2):
+        return _scalar_mul(t1, t2)
+    if isinstance(t2, DCSX_matrix) and np.isscalar(t1):
+        return _scalar_mul(t2, t1)
     return _binary_op_csx("mul", t1, t2)
+
+
+def _scalar_mul(t: DCSX_matrix, s) -> DCSX_matrix:
+    from ..core import types
+
+    res_jt = jnp.result_type(t._val.dtype, s)  # promote like dense numpy
+    val = t._val.astype(res_jt) * jnp.asarray(s, res_jt)
+    return t._with_planes(
+        (t._comp, t._other, val),
+        t._lnnz_dev, t._lnnz_host, t._capacity,
+        dtype=types.canonical_heat_type(res_jt),
+    )
 
 
 def sum(t: DCSX_matrix, axis=None) -> "DNDarray":
     """Sparse sum reduction to a dense DNDarray.
 
     Beyond the reference's sparse surface (its DCSX has no reductions);
-    axis=None gives the 0-d total, axis 0/1 a dense vector.  BCOO's
-    segment-sum reduction runs on-device; nothing is densified before the
-    reduction."""
-    import jax.numpy as jnp
-
+    axis=None gives the 0-d total, axis 0/1 a dense vector.  Per-shard
+    segment-sums over the planes; the cross-shard combine is a
+    psum_scatter when the reduced axis is the uncompressed one."""
     if not isinstance(t, DCSX_matrix):
         raise TypeError(f"expected a sparse matrix, got {type(t)}")
-    mat = t.larray
     if axis is None:
-        res = jsparse.bcoo_reduce_sum(mat, axes=(0, 1)).todense()
-        return DNDarray.from_dense(jnp.asarray(res), None, t.device, t.comm)
+        res = _pl.sum_planes(
+            t._comp, t._other, t._val, None, t._nshards, t._capacity,
+            t._comp_pad, 0, t._dist, t.comm,
+        )
+        return DNDarray.from_dense(res, None, t.device, t.comm)
     axis = axis if axis >= 0 else axis + 2
     if axis not in (0, 1):
         raise ValueError(f"axis must be 0, 1 or None, got {axis}")
-    res = jsparse.bcoo_reduce_sum(mat, axes=(axis,)).todense()
-    split = 0 if t.split is not None else None
-    return DNDarray.from_dense(res, split, t.device, t.comm)
+    # reducing over `axis` leaves one value per index of the OTHER axis
+    out_axis = 1 - axis
+    axis_is_comp = out_axis == t._compressed_axis
+    other_extent = t.shape[1 - t._compressed_axis]
+    res = _pl.sum_planes(
+        t._comp, t._other, t._val, axis_is_comp, t._nshards, t._capacity,
+        t._comp_pad, other_extent, t._dist, t.comm,
+    )
+    out_len = t.shape[out_axis]
+    if not t._dist:
+        return DNDarray.from_dense(res[:out_len], None, t.device, t.comm)
+    return DNDarray(res, (out_len,), t.dtype, 0, t.device, t.comm)
 
 
 def matmul(a, b):
     """Sparse matrix product: sparse@sparse -> sparse, sparse@dense and
     dense@sparse -> dense DNDarray.
 
-    Beyond the reference's sparse surface; the products lower to XLA's
-    sparse dot (``bcoo_dot_general``), which on TPU feeds the MXU with the
-    gathered rows instead of densifying the operand."""
-    import jax.numpy as jnp
-
+    Beyond the reference's sparse surface.  Row-compressed operands keep
+    whole output rows per shard (one segment-sum, no collective — but the
+    dense operand is gathered per shard, inherent to arbitrary column
+    indices); column-compressed operands contract against the co-chunked
+    rows of the dense operand with NO gather and meet in a psum_scatter.
+    sparse@sparse runs a GEMM-style accumulation into the dense row block
+    per shard, then re-packs (the usual spgemm memory/work tradeoff)."""
     a_sp = isinstance(a, DCSX_matrix)
     b_sp = isinstance(b, DCSX_matrix)
     if not a_sp and not b_sp:
         raise TypeError("at least one operand must be a sparse matrix")
-    ref = a if a_sp else b
     if a_sp and b_sp:
-        res = jsparse.bcoo_sum_duplicates(
-            jsparse.bcoo_sort_indices(a.larray @ b.larray)
-        )
-        from ..core import types
-
-        dtype = types.canonical_heat_type(res.data.dtype)
-        out_shape = (a.shape[0], b.shape[1])
-        return type(a)(res, int(res.nse), out_shape, dtype, a.split, a.device, a.comm)
+        return _spgemm(a, b)
     if a_sp:
-        dense = b._dense() if isinstance(b, DNDarray) else jnp.asarray(b)
-        out = a.larray @ dense
-        split = a.split if a.split == 0 else (b.split if isinstance(b, DNDarray) else None)
-        return DNDarray.from_dense(out, split if split in (0, 1) else None, a.device, a.comm)
-    dense = a._dense() if isinstance(a, DNDarray) else jnp.asarray(a)
-    out = dense @ b.larray
-    split = a.split if isinstance(a, DNDarray) and a.split == 0 else None
-    return DNDarray.from_dense(out, split, b.device, b.comm)
+        return _sp_dense(a, b)
+    return _dense_sp(a, b)
+
+
+def _dense_operand(x, comm):
+    if isinstance(x, DNDarray):
+        return x
+    return DNDarray.from_dense(jnp.asarray(np.asarray(x)), None, None, comm)
+
+
+def _sp_dense(a: DCSX_matrix, b) -> DNDarray:
+    x = _dense_operand(b, a.comm)
+    if a.shape[1] != x.shape[0]:
+        raise ValueError(f"shape mismatch for matmul: {a.shape} @ {x.shape}")
+    m, k = a.shape
+    n = int(x.shape[1]) if x.ndim == 2 else 1
+    xb = x if x.ndim == 2 else x.reshape((int(x.shape[0]), 1))
+    if a._compressed_axis == 0:
+        out = _pl._spmm_comp_rows_prog(
+            a.comm, a._nshards, a._capacity, a._comp_pad, k, n, a._dist
+        )(a._comp, a._other, a._val, xb._dense())
+        if not a._dist:
+            out = out[:m]
+        res = DNDarray(out, (m, n), out.dtype, 0 if a._dist else None, a.device, a.comm)
+    else:
+        # CSC: columns co-chunked with X's rows — no gather of X
+        xs = xb if (not a._dist or xb.split == 0) else xb.resplit(0)
+        x_in = xs.larray_padded if a._dist else xs._dense()
+        m_pad = a.comm.padded_extent(m) if a._dist else m
+        out = _pl._spmm_comp_inner_prog(
+            a.comm, a._nshards, a._capacity, a._comp_pad, m_pad, n, a._dist
+        )(a._comp, a._other, a._val, x_in)
+        res = DNDarray(out, (m, n), out.dtype, 0 if a._dist else None, a.device, a.comm)
+    if x.ndim == 1:
+        res = res.reshape((m,))
+    return res
+
+
+def _dense_sp(a, b: DCSX_matrix) -> DNDarray:
+    e = _dense_operand(a, b.comm)
+    if e.shape[-1] != b.shape[0]:
+        raise ValueError(f"shape mismatch for matmul: {e.shape} @ {b.shape}")
+    vec = e.ndim == 1
+    eb = e.reshape((1, int(e.shape[0]))) if vec else e
+    q = int(eb.shape[0])
+    m, n = b.shape
+    if b._compressed_axis == 0:
+        out = _pl._dense_times_comp_rows_prog(
+            b.comm, b._nshards, b._capacity, b._comp_pad, q, n, b._dist
+        )(b._comp, b._other, b._val, eb._dense())
+        res = DNDarray.from_dense(out, 0 if (isinstance(a, DNDarray) and a.split == 0) else None, b.device, b.comm)
+    else:
+        out = _pl._dense_times_comp_cols_prog(
+            b.comm, b._nshards, b._capacity, b._comp_pad, q, b._dist
+        )(b._comp, b._other, b._val, eb._dense())
+        if not b._dist:
+            out = out[:, :n]
+            res = DNDarray(out, (q, n), out.dtype, None, b.device, b.comm)
+        else:
+            res = DNDarray(out, (q, n), out.dtype, 1, b.device, b.comm)
+    if vec:
+        res = res.reshape((n,))
+    return res
+
+
+def _spgemm(a: DCSX_matrix, b: DCSX_matrix):
+    """sparse @ sparse -> sparse of a's format: dense row-block
+    accumulation per shard (GEMM-style spgemm), then device-side re-pack."""
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"shape mismatch for matmul: {a.shape} @ {b.shape}")
+    from .manipulations import to_sparse_csc, to_sparse_csr
+
+    dense = _sp_dense(a, b.todense())
+    if isinstance(a, DCSR_matrix):
+        return to_sparse_csr(dense)
+    return to_sparse_csc(dense.resplit(1) if a._dist else dense)

@@ -3,11 +3,13 @@ heat/sparse/dcsx_matrix.py (DCSR_matrix/DCSC_matrix, dcsx_matrix.py:19-423).
 
 The reference stores one torch.sparse_csr/csc chunk per rank, split=0 for
 CSR / split=1 for CSC only, with ``global_indptr()`` reconstructed via an
-Exscan-style cumsum of local nnz (:65+).  Here the backing store is a
-global :class:`jax.experimental.sparse.BCOO` (XLA's native batched-sparse
-format); the split is metadata over the canonical row/column chunking, and
-local views (lindptr/lindices/ldata) are materialized on demand from the
-global CSR triple — no communication, same accessors.
+Exscan-style cumsum of local nnz (:65+).  The TPU-native layout shards
+padded COO planes over the device mesh — data/indices aligned to the
+compressed-axis chunks, capacity = max per-shard nnz (static shapes for
+XLA), sentinel-padded tails (see :mod:`._planes`).  All accessors
+(``indptr``/``lindptr``/``indices``/``data``/``lnnz``) are jitted device
+programs over the planes; the only host traffic is the (size,)-int nnz
+re-sync that the reference also performs after every op.
 """
 
 from __future__ import annotations
@@ -17,11 +19,11 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import sparse as jsparse
 
 from ..core import types
 from ..core.devices import Device
 from ..parallel.comm import Communication
+from . import _planes as _pl
 
 __all__ = ["DCSR_matrix", "DCSC_matrix", "DCSX_matrix"]
 
@@ -33,8 +35,11 @@ class DCSX_matrix:
 
     def __init__(
         self,
-        array: jsparse.BCOO,
-        gnnz: int,
+        planes: Tuple[jax.Array, jax.Array, jax.Array],
+        lnnz_dev: jax.Array,
+        lnnz_host: Tuple[int, ...],
+        capacity: int,
+        comp_pad: int,
         gshape: Tuple[int, int],
         dtype,
         split: Optional[int],
@@ -42,8 +47,11 @@ class DCSX_matrix:
         comm: Communication,
         balanced: bool = True,
     ):
-        self.__array = array
-        self.__gnnz = int(gnnz)
+        self._comp, self._other, self._val = planes
+        self._lnnz_dev = lnnz_dev
+        self._lnnz_host = tuple(int(v) for v in lnnz_host)
+        self._capacity = int(capacity)
+        self._comp_pad = int(comp_pad)
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = types.canonical_heat_type(dtype)
         self.__split = split
@@ -51,11 +59,64 @@ class DCSX_matrix:
         self.__comm = comm
 
     # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_host_coo(cls, rows, cols, vals, gshape, split, device, comm) -> "DCSX_matrix":
+        comp, other, val, lnnz_dev, lnnz_host, C, comp_pad = _pl.build_from_host_coo(
+            rows, cols, vals, gshape, cls._compressed_axis, split, comm
+        )
+        return cls(
+            (comp, other, val), lnnz_dev, lnnz_host, C, comp_pad,
+            gshape, val.dtype, split, device, comm,
+        )
+
+    @classmethod
+    def from_dense_padded(cls, x_masked, gshape, split, device, comm) -> "DCSX_matrix":
+        """Device-side packing of a (masked) padded dense buffer."""
+        comp, other, val, lnnz_dev, lnnz_host, C, comp_pad = _pl.pack_from_dense(
+            x_masked, gshape, cls._compressed_axis, split, comm
+        )
+        return cls(
+            (comp, other, val), lnnz_dev, lnnz_host, C, comp_pad,
+            gshape, val.dtype, split, device, comm,
+        )
+
+    def _with_planes(self, planes, lnnz_dev, lnnz_host, capacity, dtype=None, cls=None):
+        cls = cls or type(self)
+        return cls(
+            planes, lnnz_dev, lnnz_host, capacity, self._comp_pad,
+            self.__gshape, dtype or self.__dtype, self.__split, self.__device, self.__comm,
+        )
+
     @property
-    def larray(self) -> jsparse.BCOO:
-        """The underlying BCOO array (global; the process-local chunk of
-        the reference, dcsx_matrix.py:60)."""
-        return self.__array
+    def _nshards(self) -> int:
+        return self.__comm.size if self.__split is not None else 1
+
+    @property
+    def _dist(self) -> bool:
+        return self.__split is not None
+
+    # ------------------------------------------------------------------
+    @property
+    def larray(self):
+        """A global jax BCOO view, assembled on device from the packed
+        planes (interop/back-compat; the planes are the storage)."""
+        from jax.experimental import sparse as jsparse
+
+        indptr = self.indptr
+        indices, data = self._packed()
+        counts = jnp.diff(indptr)
+        comp_ids = jnp.repeat(
+            jnp.arange(self.__gshape[self._compressed_axis], dtype=indices.dtype),
+            counts,
+            total_repeat_length=self.gnnz,
+        )
+        if self._compressed_axis == 0:
+            idx = jnp.stack([comp_ids, indices], axis=1)
+        else:
+            idx = jnp.stack([indices, comp_ids], axis=1)
+        return jsparse.BCOO((data, idx), shape=self.__gshape, indices_sorted=self._compressed_axis == 0)
 
     @property
     def shape(self) -> Tuple[int, int]:
@@ -100,58 +161,67 @@ class DCSX_matrix:
     @property
     def gnnz(self) -> int:
         """Global number of stored values (dcsx_matrix.py:80)."""
-        return self.__gnnz
+        return sum(self._lnnz_host)
 
     @property
     def nnz(self) -> int:
-        return self.__gnnz
+        return self.gnnz
 
     @property
     def lnnz(self) -> int:
-        """Process-local nnz, from the compressed-axis chunk (dcsx_matrix.py:70)."""
-        indptr = self._csr_triple()[0]
-        start, stop = self._local_compressed_range()
-        return int(indptr[stop] - indptr[start])
+        """Process-local nnz (dcsx_matrix.py:70); single-controller mode
+        addresses every shard, so this is the global count."""
+        start, stop = self._local_shard_range()
+        return sum(self._lnnz_host[start:stop])
+
+    def _local_shard_range(self) -> Tuple[int, int]:
+        if self.__split is None or jax.process_count() == 1:
+            return 0, self._nshards
+        parts = self.__comm.local_participants  # pragma: no cover
+        return parts[0], parts[-1] + 1  # pragma: no cover
 
     # ------------------------------------------------------------------
-    def _csr_triple(self):
-        """(indptr, indices, data) of the global matrix, compressed along
-        the class's compressed axis.  Cached — the backing BCOO is never
-        mutated in place (astype/T return new matrices), and accessor
-        chains (indptr/indices/data/lnnz) would otherwise re-run the
-        BCOO->BCSR conversion per property read."""
-        cached = getattr(self, "_triple_cache", None)
-        if cached is not None:
-            return cached
-        mat = self.__array if self._compressed_axis == 0 else _transpose_bcoo(self.__array)
-        bcsr = jsparse.BCSR.from_bcoo(_sorted(mat))
-        self._triple_cache = (
-            np.asarray(bcsr.indptr),
-            np.asarray(bcsr.indices),
-            np.asarray(bcsr.data),
-        )
-        return self._triple_cache
-
-    def _local_compressed_range(self):
-        n = self.__gshape[self._compressed_axis]
-        if self.__split is None or jax.process_count() == 1:
-            return 0, n
-        off, lshape, _ = self.__comm.chunk(self.__gshape, self.__split)  # pragma: no cover
-        return off, off + lshape[self._compressed_axis]
+    # accessors — all device programs over the planes
+    # ------------------------------------------------------------------
+    def _packed(self):
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = _pl.packed_indices_data(
+                self._other, self._val, self._lnnz_dev,
+                self._nshards, self._capacity, self.gnnz, self.__comm,
+            )
+            self._packed_cache = cached
+        return cached
 
     @property
     def indptr(self) -> jnp.ndarray:
-        """Global compressed pointers (``global_indptr``, dcsx_matrix.py:65)."""
-        return jnp.asarray(self._csr_triple()[0])
+        """Global compressed pointers (``global_indptr``, dcsx_matrix.py:65):
+        per-shard local indptrs shifted by the Exscan of shard nnz, fused
+        in one device program."""
+        return _pl.global_indptr(
+            self._comp, self._lnnz_dev, self._nshards, self._capacity,
+            self._comp_pad, self.__gshape[self._compressed_axis],
+            self._dist, self.__comm,
+        )
 
     global_indptr = indptr
 
     @property
     def lindptr(self) -> jnp.ndarray:
         """Local pointers, re-based to the chunk (dcsx_matrix.py:95)."""
-        indptr = self._csr_triple()[0]
-        start, stop = self._local_compressed_range()
-        return jnp.asarray(indptr[start : stop + 1] - indptr[start])
+        blocks = _pl.lindptr_blocks(
+            self._comp, self._nshards, self._capacity, self._comp_pad,
+            self._dist, self.__comm,
+        )
+        if self.__split is None or jax.process_count() == 1:
+            if self._nshards == 1:
+                return blocks
+            # single controller: "local" spans every shard — stitch the
+            # per-shard indptrs into one (still on device)
+            return self.indptr
+        s0, s1 = self._local_shard_range()  # pragma: no cover
+        per = self._comp_pad + 1  # pragma: no cover
+        return blocks[s0 * per : s1 * per]  # pragma: no cover
 
     @property
     def gindptr(self) -> jnp.ndarray:
@@ -161,7 +231,7 @@ class DCSX_matrix:
     @property
     def indices(self) -> jnp.ndarray:
         """Global uncompressed indices (dcsx_matrix.py:110)."""
-        return jnp.asarray(self._csr_triple()[1])
+        return self._packed()[0]
 
     @property
     def gindices(self) -> jnp.ndarray:
@@ -170,14 +240,12 @@ class DCSX_matrix:
 
     @property
     def lindices(self) -> jnp.ndarray:
-        indptr, indices, _ = self._csr_triple()
-        start, stop = self._local_compressed_range()
-        return jnp.asarray(indices[indptr[start] : indptr[stop]])
+        return self._packed()[0] if jax.process_count() == 1 else self._local_packed()[0]
 
     @property
     def data(self) -> jnp.ndarray:
         """Global stored values (dcsx_matrix.py:130)."""
-        return jnp.asarray(self._csr_triple()[2])
+        return self._packed()[1]
 
     @property
     def gdata(self) -> jnp.ndarray:
@@ -186,9 +254,14 @@ class DCSX_matrix:
 
     @property
     def ldata(self) -> jnp.ndarray:
-        indptr, _, data = self._csr_triple()
-        start, stop = self._local_compressed_range()
-        return jnp.asarray(data[indptr[start] : indptr[stop]])
+        return self._packed()[1] if jax.process_count() == 1 else self._local_packed()[1]
+
+    def _local_packed(self):  # pragma: no cover - multi-host only
+        s0, s1 = self._local_shard_range()
+        lo = sum(self._lnnz_host[:s0])
+        hi = sum(self._lnnz_host[:s1])
+        ind, dat = self._packed()
+        return ind[lo:hi], dat[lo:hi]
 
     def is_distributed(self) -> bool:
         """Whether the data is split across participants (dcsx_matrix.py:272)."""
@@ -196,57 +269,64 @@ class DCSX_matrix:
 
     def counts_displs_nnz(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
         """Per-participant (nnz counts, nnz displacements) along the
-        compressed axis (dcsx_matrix.py:278) — computed from the global
-        indptr at the canonical chunk boundaries, the Exscan the reference
-        performs over local nnz."""
-        indptr = self._csr_triple()[0]
-        counts, displs = [], []
-        ax = self._compressed_axis
-        for r in range(self.__comm.size):
-            off, lshape, _ = self.__comm.chunk(self.__gshape, ax, rank=r)
-            displs.append(int(indptr[off]))
-            counts.append(int(indptr[off + lshape[ax]] - indptr[off]))
-        return tuple(counts), tuple(displs)
+        compressed axis (dcsx_matrix.py:278) — straight off the host nnz
+        re-sync metadata, the reference's Exscan over local nnz."""
+        counts = self._lnnz_host
+        displs = tuple(int(v) for v in np.cumsum((0,) + counts[:-1]))
+        return counts, displs
 
     # ------------------------------------------------------------------
     def todense(self):
-        """Convert to a dense DNDarray (manipulations.py:105 ``to_dense``)."""
+        """Convert to a dense DNDarray (manipulations.py:105 ``to_dense``):
+        one scatter-add per shard into the canonical padded layout — the
+        output is already sharded the way a split=``_compressed_axis``
+        DNDarray wants it."""
         from ..core.dndarray import DNDarray
 
-        return DNDarray.from_dense(self.__array.todense(), self.__split, self.__device, self.__comm)
+        other_extent = self.__gshape[1 - self._compressed_axis]
+        padded = _pl.todense_padded(
+            self._comp, self._other, self._val, self._compressed_axis,
+            self._nshards, self._capacity, self._comp_pad, other_extent,
+            self._dist, self.__comm,
+        )
+        if not self._dist:
+            # unsplit: comp_pad may exceed the true extent only when extent==0
+            padded = padded[: self.__gshape[0], : self.__gshape[1]]
+        return DNDarray(
+            padded, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm
+        )
 
     to_dense = todense
 
     def toarray(self) -> np.ndarray:
-        return np.asarray(self.__array.todense())
+        return np.asarray(self.todense()._dense())
 
     def astype(self, dtype) -> "DCSX_matrix":
         dtype = types.canonical_heat_type(dtype)
-        new = jsparse.BCOO(
-            (self.__array.data.astype(dtype.jax_type()), self.__array.indices),
-            shape=self.__array.shape,
+        return self._with_planes(
+            (self._comp, self._other, self._val.astype(dtype.jax_type())),
+            self._lnnz_dev, self._lnnz_host, self._capacity, dtype=dtype,
         )
-        return type(self)(new, self.__gnnz, self.__gshape, dtype, self.__split, self.__device, self.__comm)
 
     @property
     def T(self):
-        """Transpose flips CSR<->CSC (dcsx_matrix.py:380)."""
-        other = DCSC_matrix if isinstance(self, DCSR_matrix) else DCSR_matrix
+        """Transpose flips CSR<->CSC (dcsx_matrix.py:380) — pure metadata:
+        the (comp, other, val) planes of A in (row, col) order ARE the
+        planes of A^T in (col, row) order under the same chunking, so no
+        data moves at all."""
+        other_cls = DCSC_matrix if isinstance(self, DCSR_matrix) else DCSR_matrix
         new_split = None if self.__split is None else 1 - self.__split
-        return other(
-            _transpose_bcoo(self.__array),
-            self.__gnnz,
+        return other_cls(
+            (self._comp, self._other, self._val),
+            self._lnnz_dev, self._lnnz_host, self._capacity, self._comp_pad,
             (self.__gshape[1], self.__gshape[0]),
-            self.__dtype,
-            new_split,
-            self.__device,
-            self.__comm,
+            self.__dtype, new_split, self.__device, self.__comm,
         )
 
     def __repr__(self) -> str:
         cls = type(self).__name__
         return (
-            f"{cls}(gnnz={self.__gnnz}, shape={self.__gshape}, dtype=ht.{self.__dtype.__name__}, "
+            f"{cls}(gnnz={self.gnnz}, shape={self.__gshape}, dtype=ht.{self.__dtype.__name__}, "
             f"split={self.__split})"
         )
 
@@ -286,22 +366,15 @@ class DCSX_matrix:
 
 class DCSR_matrix(DCSX_matrix):
     """Row-compressed distributed sparse matrix; split 0 or None
-    (dcsx_matrix.py:19)."""
+    (dcsx_matrix.py:19).  split=0 shards the nnz planes over the mesh
+    aligned to the canonical row chunks."""
 
     _compressed_axis = 0
 
 
 class DCSC_matrix(DCSX_matrix):
     """Column-compressed distributed sparse matrix; split 1 or None
-    (dcsx_matrix.py:230)."""
+    (dcsx_matrix.py:230).  split=1 shards the nnz planes aligned to the
+    canonical column chunks — a native layout, not a transpose view."""
 
     _compressed_axis = 1
-
-
-def _sorted(m: jsparse.BCOO) -> jsparse.BCOO:
-    return jsparse.bcoo_sort_indices(m)
-
-
-def _transpose_bcoo(m: jsparse.BCOO) -> jsparse.BCOO:
-    idx = m.indices[:, ::-1]
-    return jsparse.bcoo_sort_indices(jsparse.BCOO((m.data, idx), shape=(m.shape[1], m.shape[0])))

@@ -1,11 +1,16 @@
 """Sparse factories, analog of heat/sparse/factories.py
-(sparse_csr_matrix/sparse_csc_matrix, factories.py:25-376)."""
+(sparse_csr_matrix/sparse_csc_matrix, factories.py:25-376).
+
+Ingestion of host formats (scipy/torch/numpy) builds the sharded planes
+host-side — the same policy as the dense factories; dense DNDarrays pack
+on device (one tiny count pull to fix the static capacity, then a single
+jitted packing program per shard).
+"""
 
 from __future__ import annotations
 
-from typing import Optional, Type, Union
+from typing import Optional, Type
 
-import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
 
@@ -18,32 +23,36 @@ from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
 __all__ = ["sparse_csr_matrix", "sparse_csc_matrix"]
 
 
-def _ingest(obj, dtype):
-    """Accept dense arrays/DNDarrays, scipy sparse, torch sparse, or jax
-    BCOO/BCSR (the reference accepts torch/scipy, factories.py:60-200)."""
+def _host_coo(obj):
+    """(rows, cols, vals, shape) host triplets from any supported source
+    (the reference accepts torch/scipy, factories.py:60-200)."""
     if isinstance(obj, DCSX_matrix):
-        return obj.larray
-    if isinstance(obj, jsparse.BCOO):
-        return obj
+        ind = np.asarray(obj.indices)
+        dat = np.asarray(obj.data)
+        comp_g = np.repeat(
+            np.arange(obj.shape[obj._compressed_axis]), np.diff(np.asarray(obj.indptr))
+        )
+        rows, cols = (comp_g, ind) if obj._compressed_axis == 0 else (ind, comp_g)
+        return rows, cols, dat, obj.shape
     if isinstance(obj, jsparse.BCSR):
-        return obj.to_bcoo()
-    if isinstance(obj, DNDarray):
-        return jsparse.BCOO.fromdense(obj._dense())
+        obj = obj.to_bcoo()
+    if isinstance(obj, jsparse.BCOO):
+        idx = np.asarray(obj.indices)
+        return idx[:, 0], idx[:, 1], np.asarray(obj.data), tuple(obj.shape)
     # scipy sparse
     if hasattr(obj, "tocoo") and callable(obj.tocoo):
         coo = obj.tocoo()
-        idx = jnp.stack([jnp.asarray(coo.row), jnp.asarray(coo.col)], axis=1)
-        return jsparse.BCOO((jnp.asarray(coo.data), idx), shape=coo.shape)
-    # torch sparse
+        return np.asarray(coo.row), np.asarray(coo.col), np.asarray(coo.data), coo.shape
+    # torch sparse COO
     if hasattr(obj, "is_sparse") and getattr(obj, "is_sparse", False):
         coo = obj.coalesce()
-        idx = jnp.asarray(np.asarray(coo.indices()).T)
-        return jsparse.BCOO((jnp.asarray(np.asarray(coo.values())), idx), shape=tuple(obj.shape))
-    if hasattr(obj, "layout"):  # torch CSR/CSC
-        dense = np.asarray(obj.to_dense())
-        return jsparse.BCOO.fromdense(jnp.asarray(dense))
-    arr = jnp.asarray(np.asarray(obj))
-    return jsparse.BCOO.fromdense(arr)
+        idx = np.asarray(coo.indices())
+        return idx[0], idx[1], np.asarray(coo.values()), tuple(obj.shape)
+    if hasattr(obj, "layout") and hasattr(obj, "to_dense"):  # torch CSR/CSC
+        obj = np.asarray(obj.to_dense())
+    arr = np.asarray(obj)
+    rows, cols = np.nonzero(arr)
+    return rows, cols, arr[rows, cols], arr.shape
 
 
 def _make(
@@ -66,17 +75,28 @@ def _make(
             f"{cls.__name__} only supports split={allowed} or None, got {split} "
             "(matching the reference, dcsx_matrix.py:30)"
         )
-    bcoo = _ingest(obj, dtype)
-    if bcoo.ndim != 2:
-        raise ValueError(f"sparse matrices must be 2-dimensional, got {bcoo.ndim}")
+
+    if isinstance(obj, DNDarray):
+        if obj.ndim != 2:
+            raise ValueError(f"sparse matrices must be 2-dimensional, got {obj.ndim}")
+        # device-side pack; re-chunk the dense source to the sparse layout
+        x = obj
+        if split is not None and x.split != split:
+            x = x.resplit(split)
+        elif split is None and x.split is not None:
+            x = x.resplit(None)
+        buf = x._masked(0.0) if split is not None else x._dense()
+        res = cls.from_dense_padded(buf, x.shape, split, device, comm)
+    else:
+        rows, cols, vals, shape = _host_coo(obj)
+        if len(shape) != 2:
+            raise ValueError(f"sparse matrices must be 2-dimensional, got {len(shape)}")
+        res = cls.from_host_coo(rows, cols, vals, shape, split, device, comm)
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
-        bcoo = jsparse.BCOO((bcoo.data.astype(dtype.jax_type()), bcoo.indices), shape=bcoo.shape)
-    else:
-        dtype = types.canonical_heat_type(bcoo.data.dtype)
-    bcoo = jsparse.bcoo_sum_duplicates(jsparse.bcoo_sort_indices(bcoo))
-    gnnz = int(bcoo.nse)
-    return cls(bcoo, gnnz, tuple(bcoo.shape), dtype, split, device, comm)
+        if res.dtype != dtype:
+            res = res.astype(dtype)
+    return res
 
 
 def sparse_csr_matrix(obj, dtype=None, copy=None, ndmin: int = 0, order=None, split=None, is_split=None, device=None, comm=None) -> DCSR_matrix:

@@ -1,0 +1,132 @@
+"""Collective-sort guarantees (VERDICT r3 missing #5 + next-round #6):
+mid-size split arrays sort via PSRS (no array-sized all-gather in the
+compiled HLO), the collective reaches axis != 0 via the local moveaxis
+path, and percentile/median below the old 2^22 gate ride it too.
+
+Reference parity: heat/core/manipulations.py:2497-2750 (distributed
+sample-sort at any size).
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import sample_sort as ss
+
+
+def test_threshold_covers_midsize():
+    # the r3 gate was 1<<22; a 2^20 split f64 sort must now be collective
+    assert ss.SAMPLE_SORT_THRESHOLD <= 1 << 20
+
+
+def test_2pow20_f64_sort_is_collective_and_correct():
+    n = 1 << 20
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal(n)
+    x = ht.array(data, split=0)
+    assert ss.supports_sample_sort(x, 0, False)
+    v, idx = ht.sort(x)
+    assert v.split == 0
+    np.testing.assert_array_equal(np.asarray(v.numpy()), np.sort(data))
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), np.argsort(data, kind="stable"))
+
+
+def _hlo_allgather_sizes(text):
+    """Element counts of every all-gather result in an HLO dump."""
+    sizes = []
+    for m in re.finditer(r"=\s*\(?([a-z0-9]+)\[([0-9,]*)\][^)]*\)?\s*all-gather", text):
+        dims = m.group(2)
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        sizes.append(count)
+    return sizes
+
+
+def test_psrs_hlo_has_no_array_sized_allgather():
+    """The PSRS program's only all-gathers are the pivot/count exchanges
+    (O(p^2) elements) — never the array (the gather path it replaces)."""
+    n = 1 << 20
+    x = ht.array(np.random.default_rng(0).standard_normal(n), split=0)
+    comm = x.comm
+    blk = x.larray_padded
+    b = blk.shape[0] // comm.size
+    fn = ss._psrs_fn(comm, n, b, (), str(blk.dtype), False)
+    text = fn.lower(jax.ShapeDtypeStruct(blk.shape, blk.dtype)).compile().as_text()
+    sizes = _hlo_allgather_sizes(text)
+    assert sizes, "expected the small pivot all-gathers to be present"
+    limit = max(comm.size * comm.size * 4, 1024)  # pivots/counts scale, not n
+    assert all(s <= limit for s in sizes), (
+        f"array-sized all-gather leaked into PSRS HLO: {sizes} (limit {limit})"
+    )
+    assert "all-to-all" in text  # the exchange is the all_to_all pair
+
+
+@pytest.mark.parametrize("descending", [False, True])
+def test_axis1_split1_sort_rides_psrs(descending):
+    rows, n = 3, 1 << 18
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((rows, n)).astype(np.float32)
+    x = ht.array(data, split=1)
+    assert ss.supports_sample_sort(x, 1, descending)
+    v, idx = ht.sort(x, axis=1, descending=descending)
+    assert v.split == 1
+    want = np.sort(data, axis=1)
+    if descending:
+        want = want[:, ::-1]
+    np.testing.assert_array_equal(np.asarray(v.numpy()), want)
+    wanti = np.argsort(-data if descending else data, axis=1, kind="stable")
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), wanti)
+
+
+def test_axis1_matches_moveaxis_of_axis0():
+    n = 1 << 18
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((2, n)).astype(np.float64)
+    v1, i1 = ht.sort(ht.array(data, split=1), axis=1)
+    v0, i0 = ht.sort(ht.array(data.T.copy(), split=0), axis=0)
+    np.testing.assert_array_equal(np.asarray(v1.numpy()), np.asarray(v0.numpy()).T)
+    np.testing.assert_array_equal(np.asarray(i1.numpy()), np.asarray(i0.numpy()).T)
+
+
+def test_percentile_below_old_gate_uses_collective(monkeypatch):
+    n = 1 << 18  # below the old 2^22 gate, above the new one
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal(n)
+    x = ht.array(data, split=0)
+    calls = []
+    orig = ss.sample_sort_1d
+    monkeypatch.setattr(ss, "sample_sort_1d", lambda a, d=False: calls.append(1) or orig(a, d))
+    got = ht.percentile(x, [10.0, 50.0, 99.5])
+    assert calls, "percentile did not take the PSRS path below 2^22"
+    np.testing.assert_allclose(
+        np.asarray(got.numpy()), np.percentile(data, [10.0, 50.0, 99.5]), rtol=1e-12
+    )
+    med = ht.median(x)
+    np.testing.assert_allclose(float(med), np.median(data), rtol=1e-12)
+    for bad_q in (-1.0, 101.0, float("nan")):
+        with pytest.raises(ValueError, match="range"):
+            ht.percentile(x, bad_q)
+
+
+def test_unique_below_old_gate():
+    n = 1 << 18
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 5000, n).astype(np.int32)
+    x = ht.array(data, split=0)
+    got = ht.unique(x)
+    np.testing.assert_array_equal(np.asarray(got.numpy()), np.unique(data))
+
+
+def test_sort_out_param_same_split_no_relayout():
+    n = 1 << 18
+    data = np.random.default_rng(13).standard_normal(n).astype(np.float32)
+    x = ht.array(data, split=0)
+    out = ht.empty((n,), dtype=ht.float32, split=0)
+    res, idx = ht.sort(x, out=out)
+    assert res is out
+    np.testing.assert_array_equal(np.asarray(out.numpy()), np.sort(data))

@@ -1,0 +1,161 @@
+"""Distributed-sparse guarantees (VERDICT r3 #1): the nnz planes are
+sharded over the mesh aligned to the compressed-axis chunks, accessors are
+device programs (no host numpy), per-shard storage is the local share of
+nnz (a matrix bigger than one device's budget can exist), and the CSC
+layout computes natively at split=1.
+
+Reference parity: heat/sparse/dcsx_matrix.py:19-423 (per-rank chunks +
+nnz Exscan), heat/sparse/_operations.py:17-209 (split-aware binary ops).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def big():
+    rng = np.random.default_rng(7)
+    m = sp.random(1000, 700, density=0.02, random_state=3, format="csr", dtype=np.float64)
+    return m
+
+
+def test_planes_sharded_over_mesh(big):
+    s = ht.sparse.sparse_csr_matrix(big, split=0)
+    ndev = s.comm.size
+    assert ndev == 8  # conftest virtual mesh
+    for plane in (s._comp, s._other, s._val, s._lnnz_dev):
+        assert isinstance(plane, jax.Array)
+        assert len(plane.sharding.device_set) == ndev
+    # per-shard capacity is the max local share, NOT the global nnz:
+    # storage per device is capacity, so a matrix whose nnz exceeds one
+    # device's budget fits as long as nnz/P does.
+    assert s._capacity < s.gnnz
+    counts, displs = s.counts_displs_nnz()
+    assert s._capacity == max(counts)
+    assert sum(counts) == s.gnnz == big.nnz
+
+
+def test_accessors_are_device_programs(big):
+    s = ht.sparse.sparse_csr_matrix(big, split=0)
+    for name in ("indptr", "indices", "data", "lindptr", "lindices", "ldata"):
+        got = getattr(s, name)
+        assert isinstance(got, jax.Array), f"{name} left the device"
+    truth = big.tocsr()
+    np.testing.assert_array_equal(np.asarray(s.indptr), truth.indptr)
+    np.testing.assert_array_equal(np.asarray(s.indices), truth.indices)
+    np.testing.assert_allclose(np.asarray(s.data), truth.data)
+
+
+def test_ops_stay_sharded(big):
+    other = sp.random(1000, 700, density=0.015, random_state=5, format="csr", dtype=np.float64)
+    a = ht.sparse.sparse_csr_matrix(big, split=0)
+    b = ht.sparse.sparse_csr_matrix(other, split=0)
+    c = a + b
+    assert len(c._val.sharding.device_set) == 8
+    np.testing.assert_allclose(c.toarray(), (big + other).toarray(), rtol=1e-12)
+    d = a * b
+    np.testing.assert_allclose(d.toarray(), big.multiply(other).toarray(), rtol=1e-12)
+    # intersection compacts capacity to <= min of the operands'
+    assert d._capacity <= min(a._capacity, b._capacity) + 1
+
+
+def test_csr_spmm_distributed(big):
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((700, 40))
+    s = ht.sparse.sparse_csr_matrix(big, split=0)
+    out = s @ ht.array(x, split=0)
+    assert out.split == 0
+    np.testing.assert_allclose(out.numpy(), big @ x, rtol=1e-10)
+    # matrix @ vector
+    v = rng.standard_normal(700)
+    got = s @ ht.array(v)
+    np.testing.assert_allclose(got.numpy(), big @ v, rtol=1e-10)
+
+
+def test_csc_native_split1_compute(big):
+    csc = big.tocsc()
+    s = ht.sparse.sparse_csc_matrix(csc, split=1)
+    assert s.split == 1
+    assert len(s._val.sharding.device_set) == 8
+    truth = csc
+    np.testing.assert_array_equal(np.asarray(s.indptr), truth.indptr)
+    np.testing.assert_array_equal(np.asarray(s.indices), truth.indices)
+    np.testing.assert_allclose(np.asarray(s.data), truth.data)
+    # A @ X contracts against the co-chunked dense rows + psum_scatter
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((700, 16))
+    out = s @ ht.array(x, split=0)
+    assert out.split == 0
+    np.testing.assert_allclose(out.numpy(), big @ x, rtol=1e-10)
+    # E @ A keeps whole output columns per shard (no collective)
+    e = rng.standard_normal((9, 1000))
+    out2 = ht.sparse.matmul(e, s)
+    assert out2.split == 1
+    np.testing.assert_allclose(out2.numpy(), e @ big.toarray(), rtol=1e-10)
+    # reductions
+    np.testing.assert_allclose(float(s.sum()), big.sum(), rtol=1e-12)
+    np.testing.assert_allclose(s.sum(axis=0).numpy(), np.asarray(big.sum(0)).ravel(), rtol=1e-10)
+    np.testing.assert_allclose(s.sum(axis=1).numpy(), np.asarray(big.sum(1)).ravel(), rtol=1e-10)
+    # elementwise at split=1
+    o = sp.random(1000, 700, density=0.01, random_state=9, format="csc", dtype=np.float64)
+    b = ht.sparse.sparse_csc_matrix(o, split=1)
+    np.testing.assert_allclose((s + b).toarray(), (big + o).toarray(), rtol=1e-12)
+    np.testing.assert_allclose((s * b).toarray(), big.multiply(o).toarray(), rtol=1e-12)
+
+
+def test_mixed_split_aligns(big):
+    a = ht.sparse.sparse_csr_matrix(big, split=0)
+    b = ht.sparse.sparse_csr_matrix(big)  # split=None
+    c = a + b
+    assert c.split == 0
+    np.testing.assert_allclose(c.toarray(), (2 * big).toarray(), rtol=1e-12)
+
+
+def test_scalar_mul(big):
+    a = ht.sparse.sparse_csr_matrix(big, split=0)
+    c = a * 2.5
+    assert c.gnnz == a.gnnz
+    np.testing.assert_allclose(c.toarray(), (big * 2.5).toarray(), rtol=1e-12)
+    np.testing.assert_allclose((0.5 * a).toarray(), (big * 0.5).toarray(), rtol=1e-12)
+    # float scalar on an integer matrix promotes (dense numpy semantics)
+    imat = sp.csr_matrix(np.array([[2, 0], [0, 3]], np.int32))
+    got = ht.sparse.sparse_csr_matrix(imat, split=0) * 1.5
+    assert got.dtype in (ht.float32, ht.float64)
+    np.testing.assert_allclose(got.toarray(), [[3.0, 0.0], [0.0, 4.5]])
+
+
+def test_spgemm_distributed(big):
+    other = sp.random(700, 300, density=0.02, random_state=21, format="csr", dtype=np.float64)
+    a = ht.sparse.sparse_csr_matrix(big, split=0)
+    b = ht.sparse.sparse_csr_matrix(other, split=0)
+    c = a @ b
+    assert isinstance(c, ht.sparse.DCSR_matrix)
+    assert c.split == 0
+    np.testing.assert_allclose(c.toarray(), (big @ other).toarray(), rtol=1e-10)
+
+
+def test_transpose_is_metadata(big):
+    s = ht.sparse.sparse_csr_matrix(big, split=0)
+    t = s.T
+    assert isinstance(t, ht.sparse.DCSC_matrix) and t.split == 1
+    # the planes are shared, not copied or re-communicated
+    assert t._val is s._val and t._comp is s._comp
+    np.testing.assert_allclose(t.toarray(), big.T.toarray(), rtol=1e-12)
+    tt = t.T
+    assert isinstance(tt, ht.sparse.DCSR_matrix) and tt.split == 0
+
+
+def test_empty_and_tiny():
+    z = sp.csr_matrix((6, 4))
+    s = ht.sparse.sparse_csr_matrix(z, split=0)
+    assert s.gnnz == 0
+    np.testing.assert_allclose(s.toarray(), np.zeros((6, 4)))
+    np.testing.assert_array_equal(np.asarray(s.indptr), np.zeros(7, np.int64))
+    one = sp.csr_matrix(np.eye(3, dtype=np.float32))
+    so = ht.sparse.sparse_csr_matrix(one, split=0)
+    np.testing.assert_allclose((so + so).toarray(), 2 * np.eye(3))
