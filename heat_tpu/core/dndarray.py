@@ -19,10 +19,14 @@ contracts across the split axis must first mask the padding with its own
 neutral element (:meth:`_masked`); element-wise ops can ignore it.  For
 divisible extents there is no padding and no cost.
 
-``balanced`` is therefore always True (the canonical distribution is the
-only one): ``balance_``/``is_balanced`` (dndarray.py:509,1155) are no-ops,
-and ``redistribute_`` (dndarray.py:1216) canonicalizes instead of honoring
-arbitrary ragged target maps — on TPU the local layout belongs to XLA.
+The canonical distribution is the COMPUTE substrate — every op runs on the
+padded canonical buffer and is layout-oblivious under GSPMD.  An arbitrary
+ragged layout from ``redistribute_`` (dndarray.py:1216) is honored as a
+metadata layer on top of it: ``lshape_map``/``counts_displs``/
+``__partitioned__`` report the target map, ``balanced``/``is_balanced``
+turn False while one is active, and the physically-placed ragged buffer is
+materialized lazily (``_ragged_layout``).  ``balance_`` drops the layer —
+no data ever needs to move back because the canonical backing never moved.
 """
 
 from __future__ import annotations
@@ -152,6 +156,10 @@ class DNDarray:
         self.__device = device
         self.__comm = comm
         self.__balanced = True
+        # active ragged layout from redistribute_: (true-lshape map, padded
+        # per-device buffer) — None means the canonical distribution
+        self.__target_map: Optional[np.ndarray] = None
+        self.__ragged_buffer: Optional[jax.Array] = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -225,6 +233,8 @@ class DNDarray:
         """Swap the backing padded array (same shape/dtype/metadata)."""
         self.__array = padded
         self.__planar = None
+        self.__target_map = None
+        self.__ragged_buffer = None
 
     def _replace_local(self, local: jax.Array) -> None:
         """Replace this process's local chunk (single-process: everything).
@@ -237,6 +247,8 @@ class DNDarray:
         """
         padded_gshape = self._padded_shape  # planar-safe (read before nulling)
         self.__planar = None
+        self.__target_map = None
+        self.__ragged_buffer = None
         if jax.process_count() == 1:
             new = DNDarray.from_dense(local, self.__split, self.__device, self.__comm)
             self.__array = new.larray_padded
@@ -318,7 +330,7 @@ class DNDarray:
     # ------------------------------------------------------------------
     @property
     def balanced(self) -> bool:
-        return True
+        return self.__target_map is None
 
     @property
     def comm(self) -> Communication:
@@ -419,7 +431,10 @@ class DNDarray:
     @property
     def lshape_map(self) -> np.ndarray:
         """(comm.size, ndim) true local shapes per participant
-        (dndarray.py:304) — pure metadata, no communication."""
+        (dndarray.py:304) — pure metadata, no communication.  Reflects an
+        active ragged ``redistribute_`` target."""
+        if self.__target_map is not None:
+            return self.__target_map.copy()
         return self.__comm.lshape_map(self.__gshape, self.__split)
 
     @property
@@ -526,13 +541,18 @@ class DNDarray:
     def create_partition_interface(self) -> dict:
         """``__partitioned__`` dict (dndarray.py:688-785): shapes/starts/
         location per partition for Dask/Arkouda-style interop."""
-        lmap = self.lshape_map
+        lmap = self.lshape_map  # ragged-aware
         starts = np.zeros_like(lmap)
         if self.__split is not None:
             starts[1:, self.__split] = np.cumsum(lmap[:-1, self.__split])
         partitions = {}
         for r in range(self.__comm.size):
-            _, _, slices = self.__comm.chunk(self.__gshape, self.__split, rank=r)
+            slices = tuple(
+                slice(int(starts[r, d]), int(starts[r, d] + lmap[r, d]))
+                if d == self.__split
+                else slice(0, s)
+                for d, s in enumerate(self.__gshape)
+            )
 
             def _get(slices=slices):
                 return np.asarray(self._dense()[slices])
@@ -559,8 +579,9 @@ class DNDarray:
     # distribution management
     # ------------------------------------------------------------------
     def is_balanced(self, force_check: bool = False) -> bool:
-        """Always True: only the canonical distribution exists (dndarray.py:1155)."""
-        return True
+        """False only while a ragged ``redistribute_`` target is active
+        (dndarray.py:1155); the compute substrate is always canonical."""
+        return self.__target_map is None
 
     def is_distributed(self) -> bool:
         """Whether data lives on more than one participant (dndarray.py:1166)."""
@@ -568,9 +589,13 @@ class DNDarray:
 
     def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
         """(counts, displacements) along the split axis per participant
-        (dndarray.py:~630): pure sharding metadata."""
+        (dndarray.py:~630): pure sharding metadata (ragged-aware)."""
         if self.__split is None:
             raise ValueError("Non-distributed DNDarray has no counts and displacements")
+        if self.__target_map is not None:
+            counts = tuple(int(c) for c in self.__target_map[:, self.__split])
+            displs = tuple(int(d) for d in np.cumsum((0,) + counts[:-1]))
+            return counts, displs
         counts, displs, _ = self.__comm.counts_displs_shape(self.__gshape, self.__split)
         return tuple(int(c) for c in counts), tuple(int(d) for d in displs)
 
@@ -582,7 +607,11 @@ class DNDarray:
         return self.lshape_map
 
     def balance_(self) -> "DNDarray":
-        """No-op (dndarray.py:509): arrays are always canonically balanced."""
+        """Return to the canonical (balanced) distribution (dndarray.py:509):
+        drops any ragged ``redistribute_`` layout; the canonical backing
+        never moved, so no data shuffles."""
+        self.__target_map = None
+        self.__ragged_buffer = None
         return self
 
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
@@ -601,6 +630,8 @@ class DNDarray:
         self.__array = padded
         self.__planar = None
         self.__split = axis
+        self.__target_map = None
+        self.__ragged_buffer = None
         return self
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
@@ -614,29 +645,93 @@ class DNDarray:
         dense = self._dense()
         return DNDarray.from_dense(dense, axis, self.__device, self.__comm)
 
-    def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
-        """Canonicalize distribution (dndarray.py:1216-1366).
+    @staticmethod
+    def _as_host_int_map(m, name: str) -> np.ndarray:
+        """Host int array from a DNDarray/torch/np map argument; TypeError
+        for non-numeric inputs (reference dndarray.py:1256-1270)."""
+        if isinstance(m, DNDarray):
+            m = m.numpy()
+        elif hasattr(m, "detach"):  # torch tensor
+            m = m.detach().cpu().numpy()
+        arr = np.asarray(m)
+        if not np.issubdtype(arr.dtype, np.number):
+            raise TypeError(f"{name} must be an integer array, got {arr.dtype}")
+        return arr.astype(np.int64)
 
-        The reference shuffles chunks to match an arbitrary ragged
-        ``target_map``; on TPU the per-device layout is XLA's concern and
-        the canonical distribution is already in place, so a canonical (or
-        omitted) target is a no-op.  A target that genuinely differs from
-        the canonical map cannot be represented in the pad-and-mask model
-        and raises — silently ignoring it would leave callers reading
-        ``lshape`` under a false assumption.
-        """
-        if target_map is not None:
-            requested = np.asarray(
-                target_map.numpy() if isinstance(target_map, DNDarray) else target_map
-            )
-            canonical = self.lshape_map
-            if requested.shape != canonical.shape or not (requested == canonical).all():
-                raise NotImplementedError(
-                    "arbitrary (non-canonical) target maps are not representable "
-                    "in the canonical pad-and-mask distribution; use resplit_ to "
-                    "change the split axis instead"
+    def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
+        """Shuffle chunks to match an arbitrary ``target_map``
+        (dndarray.py:1216-1366).
+
+        The reference issues per-rank sends until every rank holds its
+        target rows.  Here the canonical padded buffer stays the compute
+        substrate (every op is layout-oblivious under GSPMD), and the
+        ragged target becomes (a) a metadata layer that ``lshape_map`` /
+        ``counts_displs`` / ``__partitioned__`` report and (b) a physical
+        per-device buffer — one global gather whose index plan follows
+        the target cumsum, so XLA emits a single all-to-all placing each
+        device's target rows in its shard (slots padded to the largest
+        target chunk: the pad-and-mask policy applied to a ragged map).
+        Only the split column of ``target_map`` is consulted, like the
+        reference."""
+        if lshape_map is not None:
+            lm = self._as_host_int_map(lshape_map, "lshape_map")
+            if lm.shape != (self.__comm.size, max(self.ndim, 1)):
+                raise ValueError(
+                    f"lshape_map must have shape ({self.__comm.size}, {self.ndim}), "
+                    f"got {lm.shape}"
                 )
+        if target_map is None:
+            return self
+        tm = self._as_host_int_map(target_map, "target_map")
+        if tm.shape != (self.__comm.size, max(self.ndim, 1)):
+            raise ValueError(
+                f"target_map must have shape ({self.__comm.size}, {self.ndim}), "
+                f"got {tm.shape}"
+            )
+        if self.__split is None:
+            return self  # nothing to redistribute (reference does nothing)
+        extent = self.__gshape[self.__split]
+        counts = tm[:, self.__split]
+        if (counts < 0).any() or int(counts.sum()) != extent:
+            raise ValueError(
+                f"target_map must distribute all {extent} rows of axis "
+                f"{self.__split}, got counts {counts.tolist()}"
+            )
+        canonical = self.__comm.lshape_map(self.__gshape, self.__split)
+        if (counts == canonical[:, self.__split]).all():
+            self.__target_map = None
+            self.__ragged_buffer = None
+            return self
+        full = np.tile(np.asarray(self.__gshape, np.int64), (self.__comm.size, 1))
+        full[:, self.__split] = counts
+        self.__target_map = full
+        self.__ragged_buffer = None  # placed lazily: no consumer, no cost
         return self
+
+    @property
+    def _ragged_layout(self):
+        """(target lshape map, padded per-device buffer) when a ragged
+        ``redistribute_`` is active, else None.  The buffer — each device
+        holding its target rows, slots padded to the largest chunk — is
+        built on first access: one global gather whose index plan follows
+        the target cumsum (XLA emits a single all-to-all), cached until
+        the layout or the data changes."""
+        if self.__target_map is None:
+            return None
+        if self.__ragged_buffer is None:
+            counts = self.__target_map[:, self.__split]
+            cum = np.concatenate([[0], np.cumsum(counts)])
+            bmax = max(int(counts.max()), 1)
+            plan = np.zeros((self.__comm.size, bmax), np.int64)
+            for d in range(self.__comm.size):
+                plan[d, : counts[d]] = cum[d] + np.arange(counts[d])
+            ragged = jnp.take(
+                self._dense(), jnp.asarray(plan.reshape(-1)), axis=self.__split
+            )
+            self.__ragged_buffer = jax.device_put(
+                ragged, self.__comm.sharding(self.__split)
+            )
+        return self.__target_map, self.__ragged_buffer
 
     def collect_(self, target_rank: int = 0) -> "DNDarray":
         """Gather the full array onto every participant (dndarray.py:581's
@@ -1208,10 +1303,14 @@ class DNDarray:
             self.__halo_prev = None
             self.__halo_next = None
             return
-        if halo_size > int(self.lshape_map[:, self.__split].min()):
+        # halos slice at CANONICAL chunk boundaries (the compute layout),
+        # so validate against the canonical map — an active ragged
+        # redistribute_ changes only the reported metadata layout
+        canon = self.__comm.lshape_map(self.__gshape, self.__split)
+        if halo_size > int(canon[:, self.__split].min()):
             raise ValueError(
                 f"halo_size {halo_size} needs to be smaller than the smallest local chunk "
-                f"{int(self.lshape_map[:, self.__split].min())}"
+                f"{int(canon[:, self.__split].min())}"
             )
         self.__halo_size = halo_size
         dense = self._dense()

