@@ -502,6 +502,7 @@ class DNDarray:
         if not copy:
             self.__array = casted
             self.__planar = None
+            self.__ragged_buffer = None  # values changed: re-place lazily
             self.__dtype = dtype
             return self
         return out
@@ -681,6 +682,10 @@ class DNDarray:
                     f"got {lm.shape}"
                 )
         if target_map is None:
+            # no target = balance (the reference's no-target redistribute_
+            # normalizes to the balanced layout): drop any ragged layer
+            self.__target_map = None
+            self.__ragged_buffer = None
             return self
         tm = self._as_host_int_map(target_map, "target_map")
         if tm.shape != (self.__comm.size, max(self.ndim, 1)):
@@ -788,10 +793,12 @@ class DNDarray:
                     out = jax.device_put(out, want)
             self.__array = out
             self.__planar = None
+            self.__ragged_buffer = None
             return
         new_dense = self._dense().at[key].set(value)
         self.__array = _pad_to_canonical(new_dense, self.__gshape, self.__split, self.__comm)
         self.__planar = None
+        self.__ragged_buffer = None
 
     def _padded_safe_key(self, key):
         """Return a key usable directly on the padded buffer, or None.
@@ -1171,6 +1178,7 @@ class DNDarray:
         dense = dense.at[idx, idx].set(jnp.asarray(value, dense.dtype))
         self.__array = _pad_to_canonical(dense, self.__gshape, self.__split, self.__comm)
         self.__planar = None
+        self.__ragged_buffer = None
         return self
 
     def log(self, out=None):

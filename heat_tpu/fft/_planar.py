@@ -48,11 +48,14 @@ __all__ = [
     "ihfft1",
 ]
 
-#: Largest DFT applied as one literal matrix product.  Measured on the
-#: bench chip: radix-64 blocks beat 128/256/512 direct matmuls (the
-#: four-step above 64 trades MXU FLOPs it doesn't need for a few cheap
-#: transposes XLA mostly fuses).
-_CUTOFF = 64
+#: Largest DFT applied as one literal matrix product.  The r4 sweep
+#: (scripts/tune_fft.py, docs/fft_roofline.md) shows the 512³ transform
+#: is HBM-bound on the bench chip — 67-93% of measured stream bandwidth
+#: across ALL (precision, cutoff) configs, differences inside the link's
+#: session variance — so 64 is kept for its MXU-friendly K-depth and
+#: 1.7e-7 accuracy at the HIGHEST default.  Overridable by env for
+#: re-tuning on other hardware.
+_CUTOFF = int(os.environ.get("HEAT_TPU_FFT_CUTOFF", "64"))
 
 
 def _precision():
@@ -144,6 +147,19 @@ def _next_pow2(n: int) -> int:
     return m
 
 
+def _einsum_w(spec: str, re, im, w) -> Tuple[jax.Array, jax.Array]:
+    """Karatsuba complex DFT through an einsum spec (transpose folded
+    into the dot_general instead of materialized between stages)."""
+    wre, wim, wsum = w
+    ein = functools.partial(jnp.einsum, spec, precision=_precision())
+    if im is None:
+        return ein(re, wre), ein(re, wim)
+    t1 = ein(re, wre)
+    t2 = ein(im, wim)
+    t3 = ein(re + im, wsum)
+    return t1 - t2, t3 - t1 - t2
+
+
 def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
     """Unscaled DFT along the LAST axis; im may be None (real input)."""
     n = re.shape[-1]
@@ -157,6 +173,22 @@ def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
         return _bluestein_last(re, im, inverse)
     n2 = n // n1
     batch = re.shape[:-1]
+    if n2 <= _CUTOFF:
+        # single-level four-step fully inside two einsums: the stage
+        # transposes ride the dot_general layouts instead of separate
+        # transpose passes — the transform is HBM-bound on the bench chip
+        # (see the _CUTOFF note), so bytes not moved are time saved.
+        # j = j1 + n1*j2: x[..., j2, j1]; A: DFT over j2 -> [..., k2, j1]
+        re = re.reshape(*batch, n2, n1)
+        im = im.reshape(*batch, n2, n1) if im is not None else None
+        re, im = _einsum_w("...ji,jk->...ki", re, im, _dft_w(n2, inverse, dt))
+        tw_re, tw_im = _twiddle(n1, n2, n, inverse, dt)  # [j1, k2]
+        re, im = _cmul(re, im, tw_re.T, tw_im.T)  # planes are [..., k2, j1]
+        # B: DFT over j1, output laid out [..., k1, k2] so the C-order
+        # ravel IS the k = k2 + n2*k1 output order
+        re, im = _einsum_w("...kj,jl->...lk", re, im, _dft_w(n1, inverse, dt))
+        return re.reshape(*batch, n), im.reshape(*batch, n)
+    # deep factorization: recursive swapaxes formulation
     # j = j1 + n1*j2: C-order reshape puts x[j] at [..., j2, j1]
     re = re.reshape(*batch, n2, n1).swapaxes(-1, -2)  # (..., j1, j2)
     im = im.reshape(*batch, n2, n1).swapaxes(-1, -2) if im is not None else None

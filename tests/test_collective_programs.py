@@ -1,0 +1,192 @@
+"""Collective-level tests — the analog of the reference's
+test_communication.py (2,494 LoC): the explicit collective wrappers and
+the shard_map programs built on them (halo ring, PSRS exchange, pencil
+all_to_all, ring cdist, distributed factorizations) exercised DIRECTLY
+on the 8-device mesh, not only through the ops layer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import heat_tpu as ht
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return ht.get_comm()
+
+
+def _smap(comm, body, n_in=1, out=None):
+    spec = P(comm.axis_name)
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=comm.mesh, in_specs=(spec,) * n_in,
+            out_specs=out if out is not None else spec,
+        )
+    )
+
+
+class TestCollectiveWrappers:
+    def test_psum(self, comm):
+        p = comm.size
+        x = jnp.arange(p, dtype=jnp.float32)
+        got = _smap(comm, lambda v: comm.psum(v))(x)
+        np.testing.assert_allclose(np.asarray(got), np.full(p, np.arange(p).sum()))
+
+    def test_pmax_pmin(self, comm):
+        p = comm.size
+        x = jnp.arange(p, dtype=jnp.float32) * jnp.where(jnp.arange(p) % 2 == 0, 1.0, -1.0)
+        gmax = _smap(comm, lambda v: comm.pmax(v))(x)
+        gmin = _smap(comm, lambda v: comm.pmin(v))(x)
+        assert float(gmax[0]) == float(np.max(np.asarray(x)))
+        assert float(gmin[0]) == float(np.min(np.asarray(x)))
+
+    def test_all_gather_tiled(self, comm):
+        p = comm.size
+        x = jnp.arange(2 * p, dtype=jnp.float32)  # 2 rows per shard
+        got = _smap(comm, lambda v: comm.all_gather(v))(x)
+        # every shard holds the full vector after the gather
+        assert got.shape == (p * 2 * p,)
+        np.testing.assert_allclose(np.asarray(got)[: 2 * p], np.arange(2 * p))
+
+    def test_all_to_all_roundtrip(self, comm):
+        p = comm.size
+        x = jnp.arange(p * p, dtype=jnp.float32).reshape(p * p)
+
+        def body(v):  # (p,) per shard
+            t = comm.all_to_all(v.reshape(p, 1), split_axis=0, concat_axis=1)
+            return comm.all_to_all(t, split_axis=1, concat_axis=0).reshape(p)
+
+        got = _smap(comm, body)(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(x))
+
+    def test_ppermute_ring_shift(self, comm):
+        p = comm.size
+        x = jnp.arange(p, dtype=jnp.float32)
+        got = _smap(comm, lambda v: comm.ring_shift(v, 1))(x)
+        np.testing.assert_allclose(np.asarray(got), np.roll(np.arange(p), 1))
+        got2 = _smap(comm, lambda v: comm.ring_shift(v, -2))(x)
+        np.testing.assert_allclose(np.asarray(got2), np.roll(np.arange(p), -2))
+
+    def test_axis_index(self, comm):
+        p = comm.size
+        got = _smap(comm, lambda v: v + comm.axis_index().astype(jnp.float32))(
+            jnp.zeros(p, jnp.float32)
+        )
+        np.testing.assert_allclose(np.asarray(got), np.arange(p))
+
+    def test_subcomm_split(self, comm):
+        sub = comm.split(list(range(comm.size // 2)))
+        assert sub.size == comm.size // 2
+        a = ht.arange(10, split=0, comm=sub)
+        assert float(a.sum()) == 45.0
+
+    def test_lshape_map_edges(self, comm):
+        p = comm.size
+        # extent < size: high devices empty
+        m = comm.lshape_map((3,), 0)
+        assert m[:, 0].sum() == 3 and (m[3:, 0] == 0).all()
+        # extent 0
+        z = comm.lshape_map((0, 4), 0)
+        assert z[:, 0].sum() == 0
+        # divisible
+        d = comm.lshape_map((2 * p,), 0)
+        assert (d[:, 0] == 2).all()
+
+
+class TestHaloProgram:
+    def test_halo_exchange_ring(self, comm):
+        from heat_tpu.parallel.halo import halo_exchange
+
+        p = comm.size
+        x = jnp.arange(3 * p, dtype=jnp.float32)
+
+        def body(v):  # (3,) per shard
+            prev, nxt = halo_exchange(comm, v, 1)
+            return jnp.concatenate([prev, v, nxt])
+
+        spec = P(comm.axis_name)
+        got = jax.jit(
+            jax.shard_map(body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec)
+        )(x)
+        blocks = np.asarray(got).reshape(p, 5)
+        for r in range(p):
+            want_prev = 3 * r - 1 if r > 0 else 0.0
+            want_next = 3 * (r + 1) if r < p - 1 else 0.0
+            assert blocks[r, 0] == want_prev
+            np.testing.assert_allclose(blocks[r, 1:4], np.arange(3 * r, 3 * r + 3))
+            assert blocks[r, 4] == want_next
+
+    def test_dndarray_halo_matches_reference_semantics(self, comm):
+        x = np.arange(4 * comm.size, dtype=np.float32).reshape(-1, 1)
+        a = ht.array(x, split=0)
+        a.get_halo(2)
+        # single-controller: halos of the local (= global) block are edges
+        assert a.halo_prev is None or a.halo_prev.shape[0] == 2
+
+
+class TestProgramHLOs:
+    """The shard_map programs move data with the intended collectives."""
+
+    def _text(self, fn, *args):
+        return fn.lower(*args).compile().as_text()
+
+    def test_ring_cdist_uses_ppermute_not_gather(self, comm):
+        from heat_tpu.spatial import distance as dist_mod
+
+        p = comm.size
+        bn = bm = 2  # per-device block rows
+        f = 4
+        fn = dist_mod._ring_cdist_fn(comm, "euclidean", False, bn, bm, f, "float32")
+        shp = jax.ShapeDtypeStruct((p * bn, f), np.float32)
+        txt = self._text(fn, shp, shp)
+        assert "collective-permute" in txt
+        assert "all-gather" not in txt
+
+    def test_pencil_uses_all_to_all(self, comm):
+        import importlib
+
+        fft_mod = importlib.import_module("heat_tpu.fft.fft")
+        fn = fft_mod._pencil_planar_kind_fn(comm, "fft", 0, 1, 16, None, 2, None, True)
+        shp = jax.ShapeDtypeStruct((comm.padded_extent(16), comm.size), np.float32)
+        txt = self._text(fn, shp, shp)
+        assert "all-to-all" in txt and "all-gather" not in txt
+
+    def test_psrs_collective_budget(self, comm):
+        """PSRS: exactly two big all_to_all exchange pairs, no array gather."""
+        from heat_tpu.core import sample_sort as ss
+
+        n = 1 << 15
+        b = comm.padded_extent(n) // comm.size
+        fn = ss._psrs_fn(comm, n, b, (), "float32", False)
+        txt = self._text(fn, jax.ShapeDtypeStruct((comm.padded_extent(n),), np.float32))
+        assert txt.count("all-to-all") >= 2
+        for m in __import__("re").finditer(r"=\s*\(?[a-z0-9]+\[([0-9,]*)\][^)]*\)?\s*all-gather", txt):
+            count = int(np.prod([int(d) for d in m.group(1).split(",") if d]))
+            assert count <= max(comm.size**2 * 4, 1024)
+
+    def test_sparse_csc_spmm_uses_reduce_scatter(self, comm):
+        """The CSC contraction meets in a psum_scatter, not a gather of X."""
+        from heat_tpu.sparse import _planes as pl
+
+        p = comm.size
+        fn = pl._spmm_comp_inner_prog(comm, p, 4, 2, 2 * p, 3, True)
+        ishp = jax.ShapeDtypeStruct((p * 4,), np.int32)
+        vshp = jax.ShapeDtypeStruct((p * 4,), np.float32)
+        xshp = jax.ShapeDtypeStruct((2 * p, 3), np.float32)
+        txt = self._text(fn, ishp, ishp, vshp, xshp)
+        assert "reduce-scatter" in txt or "all-reduce" in txt
+        assert "all-gather" not in txt
+
+
+class TestHierarchical:
+    def test_two_level_axes(self):
+        from heat_tpu.parallel.comm import HierarchicalCommunication
+
+        h = HierarchicalCommunication(grid=(2, 4))
+        assert h.size == 8
+        a = ht.arange(16, split=0, comm=h)
+        assert float(a.sum()) == 120.0

@@ -415,7 +415,7 @@ class TestPlanarFFT:
         import importlib
 
         fft_mod = importlib.import_module("heat_tpu.fft.fft")
-        fn = fft_mod._pencil_planar_fn(a.comm, 0, 1, 5 * p, 2, None, False)
+        fn = fft_mod._pencil_planar_kind_fn(a.comm, "fft", 0, 1, 5 * p, None, 2, None, True)
         re, im = fft_mod._padded_planes(a)
         txt = fn.lower(re, im).compile().as_text()
         assert "all-to-all" in txt and "all-gather" not in txt
