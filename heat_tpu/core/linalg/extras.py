@@ -83,17 +83,31 @@ def cholesky(a):
 
 
 def cond(x, p=None):
-    """Condition number with respect to norm ``p``."""
+    """Condition number with respect to norm ``p``.
+
+    .. note:: Beyond the reference's surface; computed as a global
+       ``jnp.linalg`` call on the dense view — a SPLIT operand larger
+       than one device's memory gathers here (no distributed
+       eigensolver yet; see docs/design.md).
+    """
     return _wrap(jnp.linalg.cond(_d(x), p=p), x)
 
 
 def eigh(a, UPLO: str = "L"):
-    """Eigendecomposition of a symmetric/Hermitian matrix."""
+    """Eigendecomposition of a symmetric/Hermitian matrix.
+
+    .. note:: Beyond the reference's surface; computed as a global
+       ``jnp.linalg`` call on the dense view — a SPLIT operand larger
+       than one device's memory gathers here (no distributed
+       eigensolver yet; see docs/design.md).
+    """
     w, v = jnp.linalg.eigh(_d(a), UPLO=UPLO)
     return _wrap(w, a), _wrap(v, a)
 
 
 def eigvalsh(a, UPLO: str = "L"):
+    """Eigenvalues of a symmetric/Hermitian matrix (gathers a split
+    operand to the dense view — see the note on :func:`eigh`)."""
     return _wrap(jnp.linalg.eigvalsh(_d(a), UPLO=UPLO), a)
 
 
@@ -166,12 +180,15 @@ def lstsq(a, b, rcond=None):
 
 
 def matrix_power(a, n: int):
+    """Repeated matrix product (gathers a split operand to the dense
+    view — see the note on :func:`eigh`)."""
     return _wrap(jnp.linalg.matrix_power(_d(a), n), a)
 
 
 def matrix_rank(a, tol=None):
     """Matrix rank as a lazy 0-d array (no forced host sync; ``int()`` it
-    to materialize)."""
+    to materialize).  Gathers a split operand to the dense view for the
+    SVD — see the note on :func:`eigh`."""
     return _wrap(jnp.linalg.matrix_rank(_d(a), rtol=None if tol is None else tol), a)
 
 
