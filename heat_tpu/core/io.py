@@ -27,6 +27,7 @@ from .stride_tricks import sanitize_axis
 
 __all__ = [
     "DataSource",
+    "format",
     "fromfile",
     "fromregex",
     "genfromtxt",
@@ -524,6 +525,11 @@ def memmap(path: str, dtype=types.float32, mode: str = "r", offset: int = 0, sha
     from . import factories
 
     return factories.array(mm, dtype=dtype, split=split, device=device, comm=comm)
+
+
+# np.lib.format parity: the .npy/.npz format helpers are pure host-side
+# file-layout utilities, so numpy's implementation IS the implementation
+format = np.lib.format
 
 
 def open_memmap(path: str, mode: str = "r", dtype=None, shape=None,

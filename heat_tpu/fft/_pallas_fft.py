@@ -1,0 +1,227 @@
+"""Fused Pallas axis-pass for the planar FFT: both four-step DFT stages
+plus the twiddle in ONE VMEM round-trip per tile.
+
+Motivation (docs/fft_roofline.md): XLA's compiled 512³ planar fftn is
+bandwidth-saturated — its own cost analysis reports 43.1 GB accessed per
+transform (6.7× the 48 B/element minimum) and the measured time matches
+that traffic at the measured stream rate, while the MXU idles at ~1% and
+the (precision × radix) sweep moves the time ≤ 12%.  The only lever left
+is moving fewer bytes.  This kernel reads each tile of the two planes
+from HBM once, runs stage-A DFT → twiddle → stage-B DFT entirely in
+VMEM, and writes once.
+
+Mosaic layout discipline (a lane-moving reshape is not compilable):
+``n = n1·n2`` picks ``n1`` = largest divisor ≤ 128 so the HBM view
+``(B, n) -> (B, n2, n1)`` is a pure C-order view with n1 on the lanes,
+j = j1 + n1·j2.  Writing the output index k = k2 + n2·k1:
+
+    stage A (VPU): Y[b, k2, j1] = Σ_j2 x[b, j2, j1]·W_n2^{j2·k2}
+        — an unrolled radix-n2 butterfly over the sublane groups
+          (scalar complex constants; n2 ≤ 8)
+    twiddle (VPU): Y *= W_n^{j1·k2}   (a (n2, n1) lane-vector constant)
+    stage B (MXU): Z[b, k2, k1] = Σ_j1 Y[b, k2, j1]·W_n1[j1, k1]
+        — contracts the LANE dim, K = n1 ≤ 128 deep, Karatsuba 3-mult
+
+    Z's (k2, k1) block order is fixed OUTSIDE by one XLA transpose
+    (flat(k1, k2) = n2·k1 + k2 = k), which the compiler can fuse with
+    the surrounding axis moveaxis.
+
+Real-input passes (the first axis of a real transform) never read or
+fabricate an imaginary plane in HBM — stage A is the 2-mult form.
+On non-TPU backends the kernel runs through the Pallas interpreter, so
+the suite exercises the identical code path.  OPT-IN via
+``HEAT_TPU_FFT_PALLAS=1`` — see :func:`_enabled` for the measured story.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+__all__ = ["eligible", "fused_axis_pass"]
+
+_LANES = 128
+_MAX_RADIX = 8  # stage-A unroll bound
+
+
+def _enabled() -> bool:
+    # OPT-IN (HEAT_TPU_FFT_PALLAS=1): measured on the bench v5e the fused
+    # kernel moves 34% fewer bytes (XLA cost analysis 28.5 vs 43.1 GB per
+    # 512^3 transform) but lands time-neutral (0.068 vs 0.065 s) — the
+    # radix-n2 stage-A butterflies are VPU-bound on this chip's ~5-ops/
+    # element-lane budget (the same balance that parks the Lloyd kernel,
+    # core/kernels.py).  Kept correctness-tested for hardware with a
+    # higher VPU:HBM ratio, per the "Pallas only if profiling demands"
+    # policy; docs/fft_roofline.md carries the measurements.
+    return os.environ.get("HEAT_TPU_FFT_PALLAS", "0") == "1"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.lru_cache(maxsize=512)
+def _split_factors(n: int):
+    """(n1, n2): n1 = largest divisor <= 128 (lane dim), n2 = n/n1 (the
+    small stage-A radix); None when the pair does not exist."""
+    best = None
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            for f in (d, n // d):
+                if f <= _LANES and (best is None or f > best):
+                    best = f
+        d += 1
+    if best is None or best < 2:
+        return None
+    n1 = best
+    n2 = n // n1
+    if n2 > _MAX_RADIX:
+        return None
+    return n1, n2
+
+
+def _tile_rows(batch: int) -> int:
+    for bb in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if batch % bb == 0:
+            return bb
+    return 1
+
+
+def eligible(n: int, batch: int, dtype) -> bool:
+    """f32 planes, a (lane, small-radix) factor pair, non-empty batch."""
+    return (
+        _enabled()
+        and dtype == jnp.float32
+        and batch > 0
+        and n >= 2
+        and _split_factors(n) is not None
+    )
+
+
+def _consts(n: int, inverse: bool):
+    n1, n2 = _split_factors(n)
+    sign = 1.0 if inverse else -1.0
+    # stage-A scalar butterfly constants W_n2^{j2 k2}
+    ang2 = 2.0 * np.pi * (np.outer(np.arange(n2), np.arange(n2)) % n2) / max(n2, 1)
+    c2re = np.cos(ang2)
+    c2im = sign * np.sin(ang2)
+    # lane twiddle W_n^{j1 k2}: shape (n2, n1), row k2
+    angt = 2.0 * np.pi * (np.outer(np.arange(n2), np.arange(n1)) % n) / n
+    twr = np.asarray(np.cos(angt), np.float32)
+    twi = np.asarray(sign * np.sin(angt), np.float32)
+    # stage-B DFT matrix (n1, n1)
+    ang1 = 2.0 * np.pi * (np.outer(np.arange(n1), np.arange(n1)) % n1) / n1
+    w1re = np.cos(ang1)
+    w1im = sign * np.sin(ang1)
+    w1 = (
+        np.asarray(w1re, np.float32),
+        np.asarray(w1im, np.float32),
+        np.asarray(w1re + w1im, np.float32),
+    )
+    return n1, n2, c2re, c2im, (twr, twi), w1
+
+
+def _dot_last(x, w, precision):
+    """(bb, n2, n1) · (n1, m) contracting the LANE dim -> (bb, n2, m)."""
+    return jax.lax.dot_general(
+        x, w,
+        dimension_numbers=(((2,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def _axis_pass_fn(n: int, batch: int, inverse: bool, have_im: bool, prec_name: str):
+    n1, n2, c2re, c2im, tw, w1 = _consts(n, inverse)
+    bb = _tile_rows(batch)
+    precision = getattr(jax.lax.Precision, prec_name.upper())
+
+    def kernel(*refs):
+        if have_im:
+            re_ref, im_ref, twr, twi, w1r, w1i, w1s, ore, oim = refs
+        else:
+            re_ref, twr, twi, w1r, w1i, w1s, ore, oim = refs
+        xre = re_ref[...]  # (bb, n2, n1)
+        xim = im_ref[...] if have_im else None
+
+        # stage A: radix-n2 butterflies over the sublane groups, fused
+        # with the lane twiddle; scalar constants fold at trace time
+        rows_re, rows_im = [], []
+        for k2 in range(n2):
+            acc_re = acc_im = None
+            for j2 in range(n2):
+                cr = float(c2re[j2, k2])
+                ci = float(c2im[j2, k2])
+                xr = xre[:, j2, :]
+                t_re = xr * cr
+                t_im = xr * ci
+                if have_im:
+                    xi = xim[:, j2, :]
+                    t_re = t_re - xi * ci
+                    t_im = t_im + xi * cr
+                acc_re = t_re if acc_re is None else acc_re + t_re
+                acc_im = t_im if acc_im is None else acc_im + t_im
+            tr = twr[k2, :]
+            ti = twi[k2, :]
+            rows_re.append((acc_re * tr - acc_im * ti)[:, None, :])
+            rows_im.append((acc_re * ti + acc_im * tr)[:, None, :])
+        yre = jnp.concatenate(rows_re, axis=1) if n2 > 1 else rows_re[0]
+        yim = jnp.concatenate(rows_im, axis=1) if n2 > 1 else rows_im[0]
+
+        # stage B: full-lane-depth MXU contraction (Karatsuba 3-mult)
+        t1 = _dot_last(yre, w1r[...], precision)
+        t2 = _dot_last(yim, w1i[...], precision)
+        t3 = _dot_last(yre + yim, w1s[...], precision)
+        ore[...] = t1 - t2
+        oim[...] = t3 - t1 - t2
+
+    grid = (batch // bb,)
+    tile = pl.BlockSpec((bb, n2, n1), lambda i: (i, 0, 0))
+    tw_spec = pl.BlockSpec((n2, n1), lambda i: (0, 0))
+    w_spec = pl.BlockSpec((n1, n1), lambda i: (0, 0))
+    in_specs = ([tile, tile] if have_im else [tile]) + [tw_spec, tw_spec, w_spec, w_spec, w_spec]
+    out_shape = (
+        jax.ShapeDtypeStruct((batch, n2, n1), jnp.float32),
+        jax.ShapeDtypeStruct((batch, n2, n1), jnp.float32),
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(tile, tile),
+        interpret=_interpret(),
+    )
+    consts = (tw[0], tw[1], w1[0], w1[1], w1[2])
+
+    def run(re, im=None):
+        args = (re, im) if have_im else (re,)
+        return call(*args, *consts)
+
+    return run
+
+
+def fused_axis_pass(re, im, inverse: bool, prec_name: str):
+    """Last-axis planar DFT of (batch..., n) f32 planes through the fused
+    kernel.  ``im=None`` means real input (no imaginary plane is read)."""
+    n = int(re.shape[-1])
+    n1, n2 = _split_factors(n)
+    batch_dims = re.shape[:-1]
+    batch = 1
+    for s in batch_dims:
+        batch *= int(s)
+    r2 = re.reshape(batch, n2, n1)  # pure view: j = j1 + n1*j2
+    i2 = im.reshape(batch, n2, n1) if im is not None else None
+    fn = _axis_pass_fn(n, batch, bool(inverse), im is not None, prec_name)
+    zre, zim = fn(r2, i2) if im is not None else fn(r2)
+    # Z[b, k2, k1] -> X[k2 + n2*k1]: one transpose, fusable by XLA
+    ore = zre.transpose(0, 2, 1).reshape(*batch_dims, n)
+    oim = zim.transpose(0, 2, 1).reshape(*batch_dims, n)
+    return ore, oim

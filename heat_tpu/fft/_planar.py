@@ -58,15 +58,18 @@ __all__ = [
 _CUTOFF = int(os.environ.get("HEAT_TPU_FFT_CUTOFF", "64"))
 
 
+def _precision_name() -> str:
+    return os.environ.get("HEAT_TPU_FFT_PRECISION", "highest").lower()
+
+
 def _precision():
     # f32 planes want the 6-pass f32-accurate matmul; f64 planes hit the
     # (software) f64 path where precision flags do not apply
-    env = os.environ.get("HEAT_TPU_FFT_PRECISION", "highest").lower()
     return {
         "default": jax.lax.Precision.DEFAULT,
         "high": jax.lax.Precision.HIGH,
         "highest": jax.lax.Precision.HIGHEST,
-    }[env]
+    }[_precision_name()]
 
 
 def _mm(a: jax.Array, w: jax.Array) -> jax.Array:
@@ -171,6 +174,20 @@ def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
     n1 = _largest_factor(n, _CUTOFF)
     if n1 == 1:
         return _bluestein_last(re, im, inverse)
+    # fused Pallas axis pass (OPT-IN, time-neutral on the bench v5e —
+    # docs/fft_roofline.md): both stages + twiddle in one VMEM round-trip;
+    # import only behind the env gate so the XLA path never depends on
+    # the pallas module being importable
+    if re.dtype == jnp.float32 and os.environ.get("HEAT_TPU_FFT_PALLAS", "0") == "1":
+        try:
+            from . import _pallas_fft as _pf
+        except ImportError:  # pragma: no cover - pallas-less jax build
+            _pf = None
+        b_el = 1
+        for s in re.shape[:-1]:
+            b_el *= int(s)
+        if _pf is not None and b_el > 0 and _pf.eligible(n, b_el, re.dtype):
+            return _pf.fused_axis_pass(re, im, inverse, _precision_name())
     n2 = n // n1
     batch = re.shape[:-1]
     if n2 <= _CUTOFF:

@@ -54,6 +54,17 @@ def _plane_sharding(comm, dist: bool):
     return comm.sharding(0 if dist else None)
 
 
+def fetch_host(arr) -> np.ndarray:
+    """Device->host fetch that works when the array spans processes (the
+    multi-host analog of ``DNDarray.numpy``): tiny metadata vectors only
+    (lnnz re-sync), never O(nnz)."""
+    if jax.process_count() > 1 and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)
+
+
 # ----------------------------------------------------------------------
 # construction
 # ----------------------------------------------------------------------
@@ -170,7 +181,7 @@ def pack_from_dense(x_padded, gshape, comp_axis, split, comm):
     counts = _count_nonzero_prog(
         comm, P, rows_loc, x_padded.shape[1], dist, fortran
     )(x_padded)
-    lnnz_host = tuple(int(v) for v in np.asarray(counts))
+    lnnz_host = tuple(int(v) for v in fetch_host(counts))
     C = max(max(lnnz_host), 1)
     prog = _pack_from_dense_prog(
         comm, P, rows_loc, int(x_padded.shape[1]), C, comp_pad, extent, dist, fortran
@@ -284,7 +295,7 @@ def merge_planes(kind, a_planes, b_planes, P, Ca, Cb, comp_pad, dist, comm):
     out_C = (Ca + Cb) if kind == "add" else min(Ca, Cb)
     prog = _merge_prog(comm, kind, P, Ca, Cb, comp_pad, out_C, dist)
     comp, other, val, lnnz_dev = prog(*a_planes, *b_planes)
-    lnnz_host = tuple(int(v) for v in np.asarray(lnnz_dev))
+    lnnz_host = tuple(int(v) for v in fetch_host(lnnz_dev))
     tight = max(max(lnnz_host), 1)
     if tight < out_C:
         comp, other, val = _slice_planes_prog(comm, P, out_C, tight, dist)(comp, other, val)

@@ -299,7 +299,7 @@ class DCSX_matrix:
     to_dense = todense
 
     def toarray(self) -> np.ndarray:
-        return np.asarray(self.todense()._dense())
+        return self.todense().numpy()  # multihost-safe gather
 
     def astype(self, dtype) -> "DCSX_matrix":
         dtype = types.canonical_heat_type(dtype)

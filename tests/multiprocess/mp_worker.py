@@ -138,13 +138,14 @@ check(
 # ---------------------------------------------------------------- sample sort
 from heat_tpu.core import sample_sort
 
+_saved_gate = sample_sort.SAMPLE_SORT_THRESHOLD
 sample_sort.SAMPLE_SORT_THRESHOLD = 1  # force the PSRS collective
 rng_sort = np.random.default_rng(123)  # same data on every process (SPMD)
 sort_data = rng_sort.standard_normal(7 * NDEV + 5).astype(np.float32)
 sv, si = ht.sort(ht.array(sort_data, split=0))
 check("psrs sort values", np.array_equal(sv.numpy(), np.sort(sort_data)))
 check("psrs sort indices", np.array_equal(si.numpy(), np.argsort(sort_data, kind="stable")))
-sample_sort.SAMPLE_SORT_THRESHOLD = 1 << 22
+sample_sort.SAMPLE_SORT_THRESHOLD = _saved_gate
 
 # ------------------------------------------------------------- pencil fft
 # split-axis FFT rides all_to_all across the process boundary (gloo DCN)
@@ -210,5 +211,53 @@ check("daso cross-process sync is a real average", np.allclose(w, mean_traj, ato
 params = daso.step(params, grads)  # batch 1: skipped -> replicas diverge
 w = _host(params["w"])
 check("daso skip leaves replicas diverged", abs(w[0, 0] - w[-1, 0]) > 0.05 * (NPROC - 1))
+
+# ----------------------------------------------------- distributed sparse (r4)
+import scipy.sparse as sp_sparse
+
+sp_np = sp_sparse.random(6 * NDEV + 1, 40, density=0.1, random_state=9, format="csr",
+                         dtype=np.float64)
+from heat_tpu.sparse._planes import fetch_host as _sp_fetch
+
+smat = ht.sparse.sparse_csr_matrix(sp_np, split=0)
+check("sparse planes span the cross-process mesh",
+      len(smat._val.sharding.device_set) == NDEV)
+check("sparse indptr cross-process",
+      np.array_equal(_sp_fetch(smat.indptr), sp_np.indptr))
+dense_x = np.random.default_rng(5).standard_normal((40, 3))
+sp_out = smat @ ht.array(dense_x, split=0)
+check("sparse spmm cross-process", np.allclose(sp_out.numpy(), sp_np @ dense_x, atol=1e-10))
+sp_sum = smat + smat
+check("sparse add cross-process", np.allclose(sp_sum.toarray(), 2 * sp_np.toarray()))
+
+# ------------------------------------------------- ragged redistribute_ (r4)
+rd_np = np.arange(4 * NDEV, dtype=np.float64)
+rd = ht.array(rd_np, split=0)
+tgt = np.zeros((NDEV, 1), np.int64)
+tgt[0] = 3 * NDEV
+tgt[1] = NDEV
+rd.redistribute_(target_map=tgt)
+check("ragged lshape_map", tuple(rd.lshape_map[:2, 0]) == (3 * NDEV, NDEV))
+counts_r, displs_r = rd.counts_displs()
+check("ragged counts_displs", counts_r[0] == 3 * NDEV and displs_r[1] == 3 * NDEV)
+check("ragged values intact", np.array_equal(rd.numpy(), rd_np))
+rd.balance_()
+check("balance_ drops the ragged layer", rd.is_balanced())
+
+# ----------------------------------------------- pencil rfft kind (r4)
+rf_np = np.random.default_rng(31).standard_normal((4 * NDEV, 2 * NPROC))
+rf = ht.fft.rfft(ht.array(rf_np, split=0), axis=0)
+check("real-kind pencil cross-process",
+      np.allclose(rf.numpy(), np.fft.rfft(rf_np, axis=0), atol=1e-10))
+
+# ----------------------------------------------- axis!=0 PSRS (r4)
+_saved_gate = sample_sort.SAMPLE_SORT_THRESHOLD
+sample_sort.SAMPLE_SORT_THRESHOLD = 1
+ax_np = np.random.default_rng(41).standard_normal((3, 5 * NDEV)).astype(np.float64)
+axv, axi = ht.sort(ht.array(ax_np, split=1), axis=1)
+check("axis-1 psrs values", np.array_equal(axv.numpy(), np.sort(ax_np, axis=1)))
+check("axis-1 psrs indices",
+      np.array_equal(axi.numpy(), np.argsort(ax_np, axis=1, kind="stable")))
+sample_sort.SAMPLE_SORT_THRESHOLD = _saved_gate
 
 print(f"[{PID}] MP-OK", flush=True)

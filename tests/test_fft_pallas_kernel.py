@@ -1,0 +1,70 @@
+"""Fused Pallas FFT axis-pass kernel (fft/_pallas_fft.py): opt-in, but
+its correctness is gated here through the interpreter on the virtual
+mesh — complex/real input, inverse, several factorizations, and the
+end-to-end planar fftn with the kernel forced on.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.fft import _pallas_fft as pf
+
+
+@pytest.fixture(autouse=True)
+def kernel_on():
+    os.environ["HEAT_TPU_FFT_PALLAS"] = "1"
+    os.environ["HEAT_TPU_PLANAR"] = "1"
+    try:
+        yield
+    finally:
+        del os.environ["HEAT_TPU_FFT_PALLAS"]
+        del os.environ["HEAT_TPU_PLANAR"]
+
+
+def test_factor_table():
+    assert pf._split_factors(512) == (128, 4)
+    assert pf._split_factors(384) == (128, 3)
+    assert pf._split_factors(96) == (96, 1)
+    assert pf._split_factors(1000) == (125, 8)
+    assert pf._split_factors(131072) is None  # radix too large
+    # a prime <= 128 is a legal single-stage (n1, 1) pair
+    assert pf._split_factors(127) == (127, 1)
+
+
+@pytest.mark.parametrize("n", [512, 384, 256, 96])
+@pytest.mark.parametrize("inverse", [False, True])
+def test_axis_pass_matches_numpy(n, inverse):
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((6, n)).astype(np.float32)
+    y = rng.standard_normal((6, n)).astype(np.float32)
+    import jax.numpy as jnp
+
+    re, im = pf.fused_axis_pass(jnp.asarray(x), jnp.asarray(y), inverse, "highest")
+    got = np.asarray(re) + 1j * np.asarray(im)
+    z = x + 1j * y
+    want = np.fft.ifft(z, axis=-1) * n if inverse else np.fft.fft(z, axis=-1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+
+
+def test_real_input_variant():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, 256)).astype(np.float32)
+    import jax.numpy as jnp
+
+    re, im = pf.fused_axis_pass(jnp.asarray(x), None, False, "highest")
+    got = np.asarray(re) + 1j * np.asarray(im)
+    np.testing.assert_allclose(got, np.fft.fft(x, axis=-1), rtol=2e-4, atol=2e-3)
+
+
+def test_end_to_end_fftn_with_kernel():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((8, 96)).astype(np.float32)
+    a = ht.array(x, split=0)
+    got = ht.fft.fftn(a)
+    assert got._planar is not None
+    np.testing.assert_allclose(got.numpy(), np.fft.fftn(x), rtol=1e-3, atol=5e-3)
+    back = ht.fft.ifftn(got)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=2e-3)
