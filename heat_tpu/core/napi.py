@@ -322,15 +322,25 @@ def append(arr, values, axis=None):
     return _wrap(jnp.append(_d(arr), _d(values), axis=axis), _pick(arr, values))
 
 
+def _index_obj(obj):
+    """numpy-compatible index argument: scalars and slices pass through,
+    sequences/DNDarrays become arrays (jnp rejects bare lists)."""
+    if isinstance(obj, DNDarray):
+        return _d(obj)
+    if isinstance(obj, (list, tuple, np.ndarray)):
+        arr = np.asarray(obj)
+        if arr.size == 0:  # numpy treats [] as an empty INDEX list
+            arr = arr.astype(np.intp)
+        return jnp.asarray(arr)
+    return obj
+
+
 def delete(arr, obj, axis=None):
-    return _wrap(jnp.delete(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), axis=axis), arr)
+    return _wrap(jnp.delete(_d(arr), _index_obj(obj), axis=axis), arr)
 
 
 def insert(arr, obj, values, axis=None):
-    return _wrap(
-        jnp.insert(_d(arr), obj if not isinstance(obj, DNDarray) else _d(obj), _d(values), axis=axis),
-        arr,
-    )
+    return _wrap(jnp.insert(_d(arr), _index_obj(obj), _d(values), axis=axis), arr)
 
 
 def resize(a, new_shape):

@@ -48,12 +48,13 @@ __all__ = [
     "ihfft1",
 ]
 
-#: Largest DFT applied as one literal matrix product.  The r4 sweep
-#: (scripts/tune_fft.py, docs/fft_roofline.md) shows the 512³ transform
-#: is HBM-bound on the bench chip — 67-93% of measured stream bandwidth
-#: across ALL (precision, cutoff) configs, differences inside the link's
-#: session variance — so 64 is kept for its MXU-friendly K-depth and
-#: 1.7e-7 accuracy at the HIGHEST default.  Overridable by env for
+#: Largest DFT applied as one literal matrix product.  The r4 floor-aware
+#: sweep (scripts/tune_fft.py, docs/fft_roofline.md) shows the 512³
+#: transform is HBM-bound: XLA's own cost analysis schedules 43.1 GB per
+#: transform and the measured time sustains ~101% of the same-session
+#: stream bandwidth, while the whole (precision × cutoff) grid spans
+#: only ±12% (0.058-0.075 s).  64 is kept for its MXU-friendly K-depth
+#: and 1.7e-7 accuracy at the HIGHEST default; overridable by env for
 #: re-tuning on other hardware.
 _CUTOFF = int(os.environ.get("HEAT_TPU_FFT_CUTOFF", "64"))
 

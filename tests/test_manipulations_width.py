@@ -1,0 +1,216 @@
+"""Manipulations edge matrix at reference width (heat/core/tests/
+test_manipulations.py, 3,816 LoC): the corner cases the basic sweeps in
+test_statistics_manipulations.py don't reach — empty slices, size-1 and
+uneven split extents, negative/rolled axes, multi-section splits, pad
+modes, insert/delete/append/resize, trim_zeros, ediff1d — all against
+numpy ground truth across splits on the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture(scope="module")
+def m2d():
+    return np.arange(48, dtype=np.float32).reshape(8, 6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_reshape_order_preserved_uneven(split):
+    a = np.arange(91, dtype=np.float32).reshape(13, 7)  # 13, 7 vs 8 devices
+    x = ht.array(a, split=split if split != 1 else 1)
+    np.testing.assert_array_equal(x.reshape((7, 13)).numpy(), a.reshape(7, 13))
+    np.testing.assert_array_equal(x.reshape((91,)).numpy(), a.reshape(91))
+    np.testing.assert_array_equal(x.reshape((13, 7, 1)).numpy(), a.reshape(13, 7, 1))
+    with pytest.raises((ValueError, TypeError)):
+        x.reshape((12, 7))
+    # -1 inference
+    np.testing.assert_array_equal(x.reshape((-1, 13)).numpy(), a.reshape(-1, 13))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_concatenate_axis_and_mixed_splits(m2d, split):
+    b = (m2d * 2.0)[:5]
+    x = ht.array(m2d, split=split)
+    for bsplit in SPLITS:
+        y = ht.array(b, split=bsplit)
+        got = ht.concatenate([x, y], axis=0)
+        np.testing.assert_array_equal(got.numpy(), np.concatenate([m2d, b], 0))
+    got1 = ht.concatenate([x, x, x], axis=1)
+    np.testing.assert_array_equal(got1.numpy(), np.concatenate([m2d] * 3, 1))
+    got_neg = ht.concatenate([x, x], axis=-1)
+    np.testing.assert_array_equal(got_neg.numpy(), np.concatenate([m2d] * 2, -1))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_concatenate_empty_operand(split):
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    empty = np.zeros((0, 3), np.float32)
+    got = ht.concatenate([ht.array(a, split=split), ht.array(empty, split=split)], axis=0)
+    np.testing.assert_array_equal(got.numpy(), a)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("shift,axis", [(3, 0), (-2, 1), (100, 0), ((1, 2), (0, 1)), (5, None)])
+def test_roll_matrix(m2d, split, shift, axis):
+    x = ht.array(m2d, split=split)
+    np.testing.assert_array_equal(
+        ht.roll(x, shift, axis=axis).numpy(), np.roll(m2d, shift, axis=axis)
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("k", [0, 1, 2, 3, -1])
+def test_rot90_all_k(m2d, split, k):
+    x = ht.array(m2d, split=split)
+    np.testing.assert_array_equal(ht.rot90(x, k=k).numpy(), np.rot90(m2d, k=k))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize(
+    "mode,kw",
+    [
+        ("constant", {"constant_values": 3.5}),
+        ("edge", {}),
+        ("reflect", {}),
+        ("wrap", {}),
+    ],
+)
+def test_pad_modes(m2d, split, mode, kw):
+    x = ht.array(m2d, split=split)
+    widths = ((2, 1), (0, 3))
+    got = ht.pad(x, widths, mode=mode, **kw)
+    np.testing.assert_array_equal(got.numpy(), np.pad(m2d, widths, mode=mode, **kw))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_insert_delete_append(split):
+    a = np.arange(20, dtype=np.float32)
+    x = ht.array(a, split=split)
+    np.testing.assert_array_equal(
+        ht.insert(x, 5, 99.0).numpy(), np.insert(a, 5, 99.0)
+    )
+    np.testing.assert_array_equal(
+        ht.delete(x, [0, 3, 19]).numpy(), np.delete(a, [0, 3, 19])
+    )
+    np.testing.assert_array_equal(
+        ht.append(x, ht.array(np.array([77.0, 88.0], np.float32))).numpy(),
+        np.append(a, [77.0, 88.0]),
+    )
+    m = np.arange(12, dtype=np.float32).reshape(3, 4)
+    xm = ht.array(m, split=split)
+    np.testing.assert_array_equal(
+        ht.delete(xm, 1, axis=0).numpy(), np.delete(m, 1, axis=0)
+    )
+    np.testing.assert_array_equal(
+        ht.insert(xm, 2, 5.0, axis=1).numpy(), np.insert(m, 2, 5.0, axis=1)
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_resize_trim_ediff1d(split):
+    a = np.arange(10, dtype=np.float32)
+    x = ht.array(a, split=split)
+    np.testing.assert_array_equal(ht.resize(x, (3, 5)).numpy(), np.resize(a, (3, 5)))
+    np.testing.assert_array_equal(ht.resize(x, (4,)).numpy(), np.resize(a, (4,)))
+    z = np.array([0.0, 0.0, 1.0, 2.0, 0.0, 3.0, 0.0], np.float32)
+    np.testing.assert_array_equal(
+        ht.trim_zeros(ht.array(z, split=split)).numpy(), np.trim_zeros(z)
+    )
+    np.testing.assert_array_equal(
+        ht.ediff1d(x, to_begin=ht.array(np.array([-9.0], np.float32))).numpy(),
+        np.ediff1d(a, to_begin=[-9.0]),
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_tile_and_repeat_axes(m2d, split):
+    x = ht.array(m2d, split=split)
+    np.testing.assert_array_equal(ht.tile(x, (2, 3)).numpy(), np.tile(m2d, (2, 3)))
+    np.testing.assert_array_equal(ht.tile(x, 2).numpy(), np.tile(m2d, 2))
+    np.testing.assert_array_equal(
+        ht.repeat(x, 3, axis=1).numpy(), np.repeat(m2d, 3, axis=1)
+    )
+    np.testing.assert_array_equal(ht.repeat(x, 2).numpy(), np.repeat(m2d, 2))
+    reps = np.array([1, 2, 1, 3, 1, 1, 2, 1])
+    np.testing.assert_array_equal(
+        ht.repeat(x, ht.array(reps), axis=0).numpy(), np.repeat(m2d, reps, axis=0)
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_array_split_ragged(split):
+    a = np.arange(23, dtype=np.float32)
+    x = ht.array(a, split=split)
+    got = ht.array_split(x, 5)
+    want = np.array_split(a, 5)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g.numpy(), w)
+    got_idx = ht.split(x, [3, 9, 20])
+    for g, w in zip(got_idx, np.split(a, [3, 9, 20])):
+        np.testing.assert_array_equal(g.numpy(), w)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_stack_new_axis_positions(m2d, split):
+    x = ht.array(m2d, split=split)
+    for axis in (0, 1, 2, -1):
+        np.testing.assert_array_equal(
+            ht.stack([x, x], axis=axis).numpy(), np.stack([m2d, m2d], axis=axis)
+        )
+    np.testing.assert_array_equal(ht.dstack([x, x]).numpy(), np.dstack([m2d, m2d]))
+    np.testing.assert_array_equal(
+        ht.column_stack([x, x]).numpy(), np.column_stack([m2d, m2d])
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_moveaxis_swapaxes_3d(split):
+    a = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+    x = ht.array(a, split=0 if split == 1 else split)
+    np.testing.assert_array_equal(
+        ht.moveaxis(x, 0, -1).numpy(), np.moveaxis(a, 0, -1)
+    )
+    np.testing.assert_array_equal(ht.swapaxes(x, 0, 2).numpy(), np.swapaxes(a, 0, 2))
+    np.testing.assert_array_equal(ht.rollaxis(x, 2).numpy(), np.rollaxis(a, 2))
+    np.testing.assert_array_equal(
+        ht.transpose(x, (1, 2, 0)).numpy(), np.transpose(a, (1, 2, 0))
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_expand_squeeze_atleast(split):
+    a = np.arange(8, dtype=np.float32)
+    x = ht.array(a, split=split)
+    e = ht.expand_dims(x, 1)
+    np.testing.assert_array_equal(e.numpy(), a[:, None])
+    np.testing.assert_array_equal(ht.squeeze(e).numpy(), a)
+    m = np.arange(6, dtype=np.float32).reshape(1, 6, 1)
+    xm = ht.array(m, split=None)
+    np.testing.assert_array_equal(ht.squeeze(xm, axis=0).numpy(), np.squeeze(m, 0))
+    np.testing.assert_array_equal(ht.atleast_2d(x).numpy(), np.atleast_2d(a))
+    np.testing.assert_array_equal(ht.atleast_3d(x).numpy(), np.atleast_3d(a))
+
+
+def test_flip_empty_and_single():
+    for a in (np.zeros((0, 3), np.float32), np.ones((1, 1), np.float32)):
+        x = ht.array(a, split=0)
+        np.testing.assert_array_equal(ht.flipud(x).numpy(), np.flipud(a))
+        np.testing.assert_array_equal(ht.fliplr(x).numpy(), np.fliplr(a))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_searchsorted_sides(split):
+    a = np.array([1.0, 2.0, 2.0, 3.0, 5.0], np.float32)
+    v = np.array([0.0, 2.0, 4.0, 6.0], np.float32)
+    x = ht.array(a, split=split)
+    for side in ("left", "right"):
+        np.testing.assert_array_equal(
+            ht.searchsorted(x, ht.array(v), side=side).numpy(),
+            np.searchsorted(a, v, side=side),
+        )
