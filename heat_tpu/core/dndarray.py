@@ -1191,10 +1191,10 @@ class DNDarray:
 
         return statistics.max(self, axis, out, keepdims)
 
-    def mean(self, axis=None):
+    def mean(self, axis=None, keepdims: bool = False):
         from . import statistics
 
-        return statistics.mean(self, axis)
+        return statistics.mean(self, axis, keepdims=keepdims)
 
     def median(self, axis=None, keepdims=False):
         from . import statistics

@@ -266,7 +266,7 @@ def maximum(x1, x2, out=None):
     return _binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x, axis=None):
+def mean(x, axis=None, keepdims: bool = False):
     """Arithmetic mean (statistics.py:898).
 
     The padded entries must not contribute: sum with 0-masked padding and
@@ -276,7 +276,7 @@ def mean(x, axis=None):
 
     if not types.heat_type_is_inexact(x.dtype):
         x = x.astype(types.float32)
-    s = arithmetics.sum(x, axis=axis)
+    s = arithmetics.sum(x, axis=axis, keepdims=keepdims)
     n = _axis_count(x, axis)
     return s / n
 

@@ -1,0 +1,189 @@
+"""Statistics edge matrix at reference width (heat/core/tests/
+test_statistics.py family): weighted averages, ddof variance, nan
+variants, cov/corrcoef options, histogram weights/density/ranges,
+quantile interpolations, argmin/argmax ties — numpy ground truth across
+splits on the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+@pytest.fixture(scope="module")
+def vec():
+    return np.random.default_rng(1).standard_normal(37)
+
+
+@pytest.fixture(scope="module")
+def mat():
+    return np.random.default_rng(2).standard_normal((11, 5))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_average_weighted(vec, split):
+    w = np.abs(np.random.default_rng(3).standard_normal(37)) + 0.1
+    x = ht.array(vec, split=split)
+    hw = ht.array(w, split=split)
+    np.testing.assert_allclose(float(ht.average(x, weights=hw)), np.average(vec, weights=w), rtol=1e-12)
+    got, wsum = ht.average(x, weights=hw, returned=True)
+    want, wsum_np = np.average(vec, weights=w, returned=True)
+    np.testing.assert_allclose(float(got), want, rtol=1e-12)
+    np.testing.assert_allclose(float(wsum), wsum_np, rtol=1e-12)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_average_axis_weights(mat, split):
+    w = np.arange(1.0, 12.0)
+    x = ht.array(mat, split=split)
+    np.testing.assert_allclose(
+        ht.average(x, axis=0, weights=ht.array(w, split=split)).numpy(),
+        np.average(mat, axis=0, weights=w),
+        rtol=1e-12,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("ddof", [0, 1, 3])
+def test_var_std_ddof(mat, split, ddof):
+    x = ht.array(mat, split=split)
+    np.testing.assert_allclose(float(ht.var(x, ddof=ddof)), np.var(mat, ddof=ddof), rtol=1e-12)
+    np.testing.assert_allclose(
+        ht.std(x, axis=0, ddof=ddof).numpy(), np.std(mat, axis=0, ddof=ddof), rtol=1e-12
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_nan_statistics(split):
+    a = np.array([1.0, np.nan, 3.0, 4.0, np.nan, 6.0, 7.5, -2.0], np.float64)
+    x = ht.array(a, split=split)
+    np.testing.assert_allclose(float(ht.nanmean(x)), np.nanmean(a), rtol=1e-12)
+    np.testing.assert_allclose(float(ht.nanvar(x)), np.nanvar(a), rtol=1e-12)
+    np.testing.assert_allclose(float(ht.nanstd(x)), np.nanstd(a), rtol=1e-12)
+    np.testing.assert_allclose(float(ht.nanmedian(x)), np.nanmedian(a), rtol=1e-12)
+    np.testing.assert_allclose(float(ht.nanmax(x)), np.nanmax(a))
+    np.testing.assert_allclose(float(ht.nanmin(x)), np.nanmin(a))
+    np.testing.assert_allclose(
+        float(ht.nanpercentile(x, 60.0)), np.nanpercentile(a, 60.0), rtol=1e-12
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_cov_corrcoef_options(mat, split):
+    x = ht.array(mat, split=split)
+    np.testing.assert_allclose(ht.cov(x).numpy(), np.cov(mat), rtol=1e-10)
+    np.testing.assert_allclose(
+        ht.cov(x, rowvar=False).numpy(), np.cov(mat, rowvar=False), rtol=1e-10
+    )
+    np.testing.assert_allclose(ht.cov(x, ddof=0).numpy(), np.cov(mat, ddof=0), rtol=1e-10)
+    np.testing.assert_allclose(ht.corrcoef(x).numpy(), np.corrcoef(mat), rtol=1e-10)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_histogram_options(vec, split):
+    x = ht.array(vec, split=split)
+    w = np.abs(vec) + 0.5
+    for kwargs in (
+        {"bins": 7},
+        {"bins": 12, "range": (-1.5, 1.5)},
+        {"bins": 5, "density": True},
+        {"bins": 6, "weights": w},
+    ):
+        hk = dict(kwargs)
+        if "weights" in hk:
+            hk["weights"] = ht.array(hk["weights"], split=split)
+        h, e = ht.histogram(x, **hk)
+        hn, en = np.histogram(vec, **kwargs)
+        np.testing.assert_allclose(np.asarray(h.numpy()), hn, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(e.numpy()), en, rtol=1e-10)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("interp", ["linear", "lower", "higher", "nearest", "midpoint"])
+def test_quantile_interpolations(vec, split, interp):
+    x = ht.array(vec, split=split)
+    q = [0.0, 0.25, 0.5, 0.9, 1.0]
+    got = ht.quantile(x, q, interpolation=interp)
+    want = np.quantile(vec, q, method=interp)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-12)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_argminmax_ties_first_index(split):
+    a = np.array([3.0, 1.0, 1.0, 5.0, 5.0, 1.0], np.float32)
+    x = ht.array(a, split=split)
+    assert int(ht.argmin(x)) == int(np.argmin(a))
+    assert int(ht.argmax(x)) == int(np.argmax(a))
+    m = np.array([[2.0, 2.0], [1.0, 3.0], [1.0, 0.5]], np.float32)
+    xm = ht.array(m, split=0 if split == 0 else None)
+    np.testing.assert_array_equal(ht.argmin(xm, axis=0).numpy(), np.argmin(m, axis=0))
+    np.testing.assert_array_equal(ht.argmax(xm, axis=1).numpy(), np.argmax(m, axis=1))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_ptp_and_moments(mat, split):
+    x = ht.array(mat, split=split)
+    np.testing.assert_allclose(float(ht.ptp(x)), np.ptp(mat), rtol=1e-12)
+    np.testing.assert_allclose(ht.ptp(x, axis=0).numpy(), np.ptp(mat, axis=0), rtol=1e-12)
+    from scipy import stats as sps
+
+    # heat's default is the unbiased estimator == scipy bias=False
+    np.testing.assert_allclose(
+        float(ht.skew(ht.array(mat[:, 0], split=split))),
+        sps.skew(mat[:, 0], bias=False),
+        rtol=1e-10,
+    )
+    np.testing.assert_allclose(
+        float(ht.kurtosis(ht.array(mat[:, 0], split=split))),
+        sps.kurtosis(mat[:, 0], bias=False),
+        rtol=1e-10,
+    )
+    np.testing.assert_allclose(
+        float(ht.skew(ht.array(mat[:, 0], split=split), unbiased=False)),
+        sps.skew(mat[:, 0], bias=True),
+        rtol=1e-10,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_bincount_weights_minlength(split):
+    a = np.array([0, 1, 1, 3, 2, 1, 7], np.int32)
+    w = np.linspace(0.5, 2.0, 7)
+    x = ht.array(a, split=split)
+    np.testing.assert_array_equal(ht.bincount(x).numpy(), np.bincount(a))
+    np.testing.assert_array_equal(
+        ht.bincount(x, minlength=12).numpy(), np.bincount(a, minlength=12)
+    )
+    np.testing.assert_allclose(
+        ht.bincount(x, weights=ht.array(w, split=split)).numpy(),
+        np.bincount(a, weights=w),
+        rtol=1e-12,
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_digitize_right(vec, split):
+    bins = np.linspace(-2.0, 2.0, 9)
+    x = ht.array(vec, split=split)
+    for right in (False, True):
+        np.testing.assert_array_equal(
+            ht.digitize(x, ht.array(bins), right=right).numpy(),
+            np.digitize(vec, bins, right=right),
+        )
+
+
+def test_keepdims_median_mean_uneven():
+    a = np.random.default_rng(4).standard_normal((13, 3))
+    x = ht.array(a, split=0)  # 13 rows over 8 devices: empty high shards
+    np.testing.assert_allclose(
+        ht.mean(x, axis=0).numpy(), a.mean(axis=0), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.median(x, axis=0).numpy()), np.median(a, axis=0), rtol=1e-12
+    )
+    got = ht.mean(x, axis=1, keepdims=True)
+    assert got.shape == (13, 1)
+    np.testing.assert_allclose(got.numpy(), a.mean(axis=1, keepdims=True), rtol=1e-12)
