@@ -1,0 +1,157 @@
+"""DNDarray object-surface battery at reference width (heat/core/tests/
+test_dndarray.py idiom): properties, conversions, scalar protocols,
+in-place semantics, and local views — every claim against numpy ground
+truth on the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture(scope="module")
+def a_np():
+    return np.arange(24, dtype=np.float32).reshape(4, 6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_size_byte_properties(a_np, split):
+    x = ht.array(a_np, split=split)
+    assert x.size == a_np.size == x.gnumel
+    assert x.ndim == 2
+    assert len(x) == 4
+    assert x.nbytes == a_np.nbytes == x.gnbytes
+    assert x.lnumel <= x.size and x.lnbytes == x.lnumel * 4
+    assert x.stride == (6, 1)
+    assert x.strides == (24, 4)  # bytes, numpy convention
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_shape_after_moves(a_np, split):
+    x = ht.array(a_np, split=split)
+    assert x.T.shape == (6, 4)
+    np.testing.assert_array_equal(x.T.numpy(), a_np.T)
+    assert x.flatten().shape == (24,)
+    assert x.ravel().shape == (24,)
+
+
+def test_scalar_protocols():
+    one = ht.array(np.array([3.5], np.float32), split=0)
+    zero_d = ht.array(np.float32(2.25))
+    assert float(zero_d) == 2.25
+    assert int(ht.array(np.int32(7))) == 7
+    assert bool(ht.array(True))
+    assert one.item() == pytest.approx(3.5)
+    with pytest.raises((ValueError, TypeError)):
+        bool(ht.arange(4, split=0))  # ambiguous like numpy
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_tolist_roundtrip(a_np, split):
+    x = ht.array(a_np, split=split)
+    assert x.tolist() == a_np.tolist()
+    v = ht.arange(5, split=split)
+    assert v.tolist() == list(range(5))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_astype_copy_semantics(a_np, split):
+    x = ht.array(a_np, split=split)
+    y = x.astype(ht.int32)
+    assert y.dtype == ht.int32 and x.dtype == ht.float32  # copy by default
+    np.testing.assert_array_equal(y.numpy(), a_np.astype(np.int32))
+    z = x.astype(ht.float64, copy=False)
+    assert z is x and x.dtype == ht.float64
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_fill_diagonal(split):
+    a = np.zeros((5, 5), np.float32)
+    x = ht.array(a, split=split)
+    x.fill_diagonal(2.5)
+    want = a.copy()
+    np.fill_diagonal(want, 2.5)
+    np.testing.assert_array_equal(x.numpy(), want)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_rich_comparisons_return_dndarrays(a_np, split):
+    x = ht.array(a_np, split=split)
+    mask = x > 10.0
+    assert isinstance(mask, ht.DNDarray)
+    np.testing.assert_array_equal(mask.numpy(), a_np > 10.0)
+    np.testing.assert_array_equal((x == x).numpy(), np.ones_like(a_np, bool))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_reduction_methods_match_functions(a_np, split):
+    x = ht.array(a_np, split=split)
+    assert float(x.sum()) == a_np.sum()
+    assert float(x.prod()) == pytest.approx(np.prod(a_np, dtype=np.float64), rel=1e-5)
+    assert float(x.mean()) == pytest.approx(a_np.mean())
+    assert float(x.max()) == a_np.max() and float(x.min()) == a_np.min()
+    assert bool((x >= 0).all()) and bool((x > 22).any())
+    np.testing.assert_array_equal(x.argmax(axis=1).numpy(), a_np.argmax(axis=1))
+    np.testing.assert_allclose(
+        x.clip(3.0, 17.0).numpy(), a_np.clip(3.0, 17.0), rtol=1e-6
+    )
+    np.testing.assert_allclose(x.round().numpy(), a_np.round())
+    np.testing.assert_allclose(x.abs().numpy(), np.abs(a_np))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_lloc_read_write(split):
+    a = np.arange(16, dtype=np.float32)
+    x = ht.array(a, split=split)
+    # single controller: local == global
+    assert float(x.lloc[3]) == 3.0
+    x.lloc[0] = 99.0
+    assert float(x[0]) == 99.0
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_real_imag_on_real_input(a_np, split):
+    x = ht.array(a_np, split=split)
+    np.testing.assert_array_equal(x.real.numpy(), a_np)
+    np.testing.assert_array_equal(x.imag.numpy(), np.zeros_like(a_np))
+
+
+def test_len_and_iteration_semantics():
+    x = ht.array(np.arange(6, dtype=np.float32).reshape(3, 2), split=0)
+    rows = [r.numpy() for r in x]
+    assert len(rows) == 3
+    np.testing.assert_array_equal(np.stack(rows), np.arange(6).reshape(3, 2))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_partition_interface_shape_consistency(a_np, split):
+    x = ht.array(a_np, split=split)
+    parts = x.__partitioned__
+    assert tuple(parts["shape"]) == x.shape
+    total = 0
+    for key, p in parts["partitions"].items():
+        data = parts["get"](p["data"])
+        assert tuple(p["shape"]) == data.shape
+        total += data.shape[0] if split == 0 else 0
+    if split == 0:
+        assert total == x.shape[0]
+
+
+def test_collect_and_resplit_roundtrip(a_np):
+    x = ht.array(a_np, split=0)
+    x.collect_()
+    assert x.split is None
+    np.testing.assert_array_equal(x.numpy(), a_np)
+    x.resplit_(1)
+    assert x.split == 1
+    np.testing.assert_array_equal(x.numpy(), a_np)
+
+
+def test_flat_property(a_np):
+    x = ht.array(a_np, split=0)
+    f = x.flat
+    vals = f.numpy() if isinstance(f, ht.DNDarray) else np.asarray(list(f))
+    np.testing.assert_array_equal(np.asarray(vals).ravel(), a_np.ravel())
