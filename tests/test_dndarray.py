@@ -102,13 +102,6 @@ def test_inplace_operators_preserve_identity(ht):
     np.testing.assert_allclose(a.numpy(), np.arange(10.0))
 
 
-def test_iteration_and_len(ht):
-    a = ht.arange(12, split=0).reshape((4, 3))
-    assert len(a) == 4
-    rows = [r.numpy() for r in a]
-    np.testing.assert_allclose(np.stack(rows), np.arange(12).reshape(4, 3))
-
-
 def test_contains(ht):
     a = ht.arange(10, split=0)
     assert 5 in a
@@ -219,15 +212,6 @@ def test_conversions(ht):
         b.item()
 
 
-def test_astype_copy_semantics(ht):
-    a = ht.arange(5, dtype=ht.float32, split=0)
-    b = a.astype(ht.int32)
-    assert b.dtype == ht.int32
-    assert a.dtype == ht.float32
-    c = a.astype(ht.float32, copy=False)
-    assert c is a
-
-
 def test_numpy_and_array_protocol(ht, np2d):
     a = ht.array(np2d, split=1)
     np.testing.assert_allclose(np.asarray(a), np2d)
@@ -263,12 +247,6 @@ def test_halo_used_by_convolve(ht):
 
 
 # ---------------------------------------------------------------- misc parity
-
-
-def test_fill_diagonal(ht):
-    a = ht.zeros((5, 5), split=0)
-    a.fill_diagonal(3.0)
-    np.testing.assert_allclose(np.diag(a.numpy()), 3.0 * np.ones(5))
 
 
 def test_rounding_methods(ht):

@@ -152,6 +152,4 @@ def test_collect_and_resplit_roundtrip(a_np):
 
 def test_flat_property(a_np):
     x = ht.array(a_np, split=0)
-    f = x.flat
-    vals = f.numpy() if isinstance(f, ht.DNDarray) else np.asarray(list(f))
-    np.testing.assert_array_equal(np.asarray(vals).ravel(), a_np.ravel())
+    np.testing.assert_array_equal(np.asarray(list(x.flat)), a_np.ravel())
