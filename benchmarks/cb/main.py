@@ -31,6 +31,7 @@ from attention import run_attention_benchmarks
 from fft import run_fft_benchmarks
 from nn import run_nn_benchmarks
 from preprocessing import run_preprocessing_benchmarks
+from sparse import run_sparse_benchmarks
 
 
 def main():
@@ -43,6 +44,7 @@ def main():
     run_nn_benchmarks(scale)
     run_attention_benchmarks(scale)
     run_fft_benchmarks(scale)
+    run_sparse_benchmarks(scale)
     total = sum(r["seconds"] for r in RESULTS)
     print(json.dumps({"bench": "TOTAL", "seconds": round(total, 3), "count": len(RESULTS)}))
 

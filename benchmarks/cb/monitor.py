@@ -29,7 +29,9 @@ RESULTS = []
 def _sync(obj: Any) -> None:
     """Force execution of everything reachable from ``obj`` (one scalar
     fetch per distinct jax array)."""
-    if hasattr(obj, "larray_padded"):
+    if hasattr(obj, "_val") and hasattr(obj, "_comp"):  # DCSX sparse planes
+        _sync(obj._val)
+    elif hasattr(obj, "larray_padded"):
         _sync(obj.larray_padded)
     elif isinstance(obj, jax.Array):
         # fetch ONE element lazily — ravel()/reshape would dispatch a

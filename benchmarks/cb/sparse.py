@@ -1,0 +1,67 @@
+"""Distributed-sparse + redistribution continuous benchmarks (r4).
+
+The reference's cb suite has no sparse workloads (its sparse layer has no
+distributed compute); these track the r4 sharded-planes programs so nnz
+scaling regressions surface on the dashboard like everything else.
+"""
+
+# flake8: noqa
+import numpy as np
+
+import heat_tpu as ht
+from monitor import monitor
+
+
+@monitor()
+def sparse_spmm(smat, dense):
+    return smat @ dense
+
+
+@monitor()
+def sparse_add(a, b):
+    return a + b
+
+
+@monitor()
+def sparse_csc_contract(cmat, dense):
+    return cmat @ dense
+
+
+@monitor()
+def ragged_redistribute(array, target):
+    array.redistribute_(target_map=target)
+    # materialize the physically-placed ragged buffer (it is lazy: without
+    # a consumer the call is metadata-only and the bench would time a no-op)
+    _, placed = array._ragged_layout
+    array.balance_()
+    return placed
+
+
+def run_sparse_benchmarks(scale: float = 1.0):
+    import scipy.sparse as sp
+
+    n = max(int(100_000 * scale), 1024)
+    m = max(int(20_000 * scale), 256)
+    a_np = sp.random(n, m, density=0.001, random_state=0, format="csr", dtype=np.float32)
+    b_np = sp.random(n, m, density=0.001, random_state=1, format="csr", dtype=np.float32)
+    smat = ht.sparse.sparse_csr_matrix(a_np, split=0)
+    bmat = ht.sparse.sparse_csr_matrix(b_np, split=0)
+    dense = ht.random.randn(m, 32, split=0).astype(ht.float32)
+
+    sparse_spmm(smat, dense)
+    sparse_add(smat, bmat)
+
+    cmat = ht.sparse.sparse_csc_matrix(a_np.tocsc(), split=1)
+    sparse_csc_contract(cmat, dense)
+
+    size = ht.get_comm().size
+    if size > 1:
+        rows = max(int(1_000_000 * scale), 4 * size)
+        arr = ht.random.randn(rows, split=0).astype(ht.float32)
+        target = np.zeros((size, 1), np.int64)
+        # skewed layout: the first half of the ranks takes two thirds of
+        # the rows; the last rank absorbs the remainder
+        per_lo = (rows * 2 // 3) // (size // 2)
+        target[: size // 2, 0] = per_lo
+        target[-1, 0] = rows - int(target[:, 0].sum())
+        ragged_redistribute(arr, target)
