@@ -1,0 +1,117 @@
+"""Printing / sanitation / stride-tricks / constants / memory battery —
+the small reference families (heat/core/tests/test_printing.py,
+test_sanitation.py, test_stride_tricks.py, test_constants.py,
+test_memory.py) that previously only had incidental coverage.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestPrinting:
+    def test_repr_and_str_small(self):
+        a = ht.arange(6, split=0)
+        s = str(a)
+        assert "0" in s and "5" in s
+        r = repr(a)
+        assert "DNDarray" in r or "[" in r
+
+    def test_printoptions_threshold(self):
+        big = ht.arange(10_000, split=0)
+        with ht.printoptions(threshold=10):
+            s = str(big)
+        assert "..." in s  # summarized like numpy
+
+    def test_set_get_printoptions_roundtrip(self):
+        saved = ht.get_printoptions()
+        try:
+            ht.set_printoptions(precision=2)
+            assert ht.get_printoptions()["precision"] == 2
+            s = str(ht.array(np.array([1.23456789], np.float64), split=0))
+            assert "1.23456789" not in s
+        finally:
+            ht.set_printoptions(**saved)
+
+    def test_array2string_and_repr_funcs(self):
+        a = ht.array(np.eye(2, dtype=np.float32), split=0)
+        assert "1." in ht.array2string(a)
+        assert "1." in ht.array_str(a)
+
+
+class TestSanitation:
+    def test_sanitize_axis_rules(self):
+        from heat_tpu.core.stride_tricks import sanitize_axis
+
+        assert sanitize_axis((4, 5), 1) == 1
+        assert sanitize_axis((4, 5), -1) == 1
+        assert sanitize_axis((4, 5), None) is None
+        with pytest.raises(ValueError):
+            sanitize_axis((4, 5), 2)
+        with pytest.raises(ValueError):
+            sanitize_axis((4, 5), -3)
+
+    def test_broadcast_shape_rules(self):
+        from heat_tpu.core.stride_tricks import broadcast_shape
+
+        assert broadcast_shape((8, 1), (1, 5)) == (8, 5)
+        assert broadcast_shape((3,), (4, 3)) == (4, 3)
+        assert broadcast_shape((), (2, 2)) == (2, 2)
+        with pytest.raises(ValueError):
+            broadcast_shape((3,), (4,))
+
+    def test_sanitize_out_shape_mismatch(self):
+        out = ht.zeros((3,), split=0)
+        with pytest.raises((ValueError, TypeError)):
+            ht.add(ht.arange(4, split=0), 1, out=out)
+
+    def test_binary_op_comm_mismatch(self):
+        sub = ht.get_comm().split(list(range(ht.get_comm().size // 2)))
+        a = ht.arange(4, split=0)
+        b = ht.arange(4, split=0, comm=sub)
+        with pytest.raises((NotImplementedError, ValueError)):
+            a + b
+
+
+class TestConstants:
+    def test_values_match_numpy(self):
+        assert ht.pi == np.pi
+        assert ht.e == np.e
+        assert ht.inf == np.inf
+        assert np.isnan(ht.nan)
+
+    def test_constants_in_expressions(self):
+        a = ht.array(np.array([0.0, ht.pi / 2], np.float64), split=0)
+        np.testing.assert_allclose(ht.sin(a).numpy(), [0.0, 1.0], atol=1e-12)
+
+
+class TestMemory:
+    def test_copy_is_independent(self):
+        a = ht.arange(8, dtype=ht.float32, split=0)
+        b = ht.copy(a)
+        b[0] = 99.0
+        assert float(a[0]) == 0.0 and float(b[0]) == 99.0
+        assert b.split == a.split and b.dtype == a.dtype
+
+    def test_sanitize_memory_layout_noop(self):
+        # layouts belong to XLA; the API accepts order= and ignores C/F
+        a = ht.array(np.arange(6).reshape(2, 3), split=0, order="C")
+        np.testing.assert_array_equal(a.numpy(), np.arange(6).reshape(2, 3))
+
+
+class TestStrideTricks:
+    def test_broadcast_arrays_shapes(self):
+        a = ht.arange(3, split=0).reshape((1, 3))
+        b = ht.arange(4, split=0).reshape((4, 1))
+        x, y = ht.broadcast_arrays(a, b)
+        assert x.shape == (4, 3) and y.shape == (4, 3)
+        np.testing.assert_array_equal(
+            (x + y).numpy(), np.arange(3)[None] + np.arange(4)[:, None]
+        )
+
+    def test_broadcast_to_readonly_semantics(self):
+        a = ht.arange(3, split=0)
+        t = ht.broadcast_to(a, (5, 3))
+        assert t.shape == (5, 3)
+        np.testing.assert_array_equal(t.numpy(), np.broadcast_to(np.arange(3), (5, 3)))
