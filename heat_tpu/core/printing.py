@@ -90,14 +90,18 @@ import contextlib
 def printoptions(**kwargs):
     """Context manager temporarily applying print options (np.printoptions)."""
     saved = dict(__PRINT_OPTIONS)
+    saved_np = np.get_printoptions()  # set_printoptions mirrors into numpy
     try:
         set_printoptions(**kwargs)
         yield get_printoptions()
     finally:
         # restore the raw dict: set_printoptions skips None values, which
-        # would leak options whose saved value was None (e.g. sci_mode)
+        # would leak options whose saved value was None (e.g. sci_mode) —
+        # and restore the mirrored numpy globals too, or the temporary
+        # threshold/precision would leak into numpy formatting process-wide
         __PRINT_OPTIONS.clear()
         __PRINT_OPTIONS.update(saved)
+        np.set_printoptions(**saved_np)
 
 
 def set_string_function(f, repr: bool = True) -> None:

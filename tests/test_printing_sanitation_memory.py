@@ -23,6 +23,8 @@ class TestPrinting:
         with ht.printoptions(threshold=10):
             s = str(big)
         assert "..." in s  # summarized like numpy
+        # the temporary options must not leak into numpy's globals
+        assert np.get_printoptions()["threshold"] != 10
 
     def test_set_get_printoptions_roundtrip(self):
         saved = ht.get_printoptions()
@@ -67,6 +69,8 @@ class TestSanitation:
             ht.add(ht.arange(4, split=0), 1, out=out)
 
     def test_binary_op_comm_mismatch(self):
+        if ht.get_comm().size < 2:
+            pytest.skip("needs a mesh to build a differing sub-communicator")
         sub = ht.get_comm().split(list(range(ht.get_comm().size // 2)))
         a = ht.arange(4, split=0)
         b = ht.arange(4, split=0, comm=sub)
@@ -96,8 +100,14 @@ class TestMemory:
 
     def test_sanitize_memory_layout_noop(self):
         # layouts belong to XLA; the API accepts order= and ignores C/F
-        a = ht.array(np.arange(6).reshape(2, 3), split=0, order="C")
-        np.testing.assert_array_equal(a.numpy(), np.arange(6).reshape(2, 3))
+        from heat_tpu.core.memory import sanitize_memory_layout
+
+        want = np.arange(6).reshape(2, 3)
+        for order in ("C", "F"):
+            a = ht.array(want, split=0, order=order)
+            np.testing.assert_array_equal(a.numpy(), want)
+            buf = a.larray_padded
+            assert sanitize_memory_layout(buf, order=order) is buf
 
 
 class TestStrideTricks:
