@@ -1,0 +1,138 @@
+"""Linalg width (heat/core/linalg tests family): norm-order grid,
+einsum expression grid across splits, vdot/inner/outer/kron edges, and
+matrix_power negative exponents — numpy ground truth on the mesh.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.fixture(scope="module")
+def m():
+    return np.random.default_rng(0).standard_normal((9, 6))
+
+
+@pytest.fixture(scope="module")
+def v():
+    return np.random.default_rng(1).standard_normal(24)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("ord_", ["fro", "nuc", 1, -1, 2, -2, np.inf, -np.inf])
+def test_matrix_norm_orders(m, split, ord_):
+    x = ht.array(m, split=split)
+    np.testing.assert_allclose(
+        float(ht.linalg.norm(x, ord=ord_)), np.linalg.norm(m, ord=ord_), rtol=1e-8
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize("ord_", [None, 1, 2, 3, np.inf, -np.inf, 0])
+def test_vector_norm_orders(v, split, ord_):
+    x = ht.array(v, split=split)
+    np.testing.assert_allclose(
+        float(ht.linalg.norm(x, ord=ord_)), np.linalg.norm(v, ord=ord_), rtol=1e-10
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_norm_axis_keepdims(m, split):
+    x = ht.array(m, split=split)
+    np.testing.assert_allclose(
+        ht.linalg.norm(x, axis=1).numpy(), np.linalg.norm(m, axis=1), rtol=1e-10
+    )
+    got = ht.linalg.norm(x, axis=0, keepdims=True)
+    assert got.shape == (1, 6)
+    np.testing.assert_allclose(
+        got.numpy(), np.linalg.norm(m, axis=0, keepdims=True), rtol=1e-10
+    )
+
+
+EINSUM_CASES = [
+    ("ij->ji", 1),
+    ("ij->i", 1),
+    ("ij->", 1),
+    ("ij,jk->ik", 2),
+    ("ij,ij->", 2),
+    ("ij,kj->ik", 2),
+    ("i,j->ij", "vec2"),
+    ("ij,j->i", "matvec"),
+]
+
+
+@pytest.mark.parametrize("expr,kind", EINSUM_CASES)
+@pytest.mark.parametrize("split", [None, 0])
+def test_einsum_grid(m, split, expr, kind):
+    a6 = m[:6, :6]
+    if kind == 1:
+        args_np = (a6,)
+    elif kind == 2:
+        args_np = (a6, a6)
+    elif kind == "vec2":
+        args_np = (a6[0], a6[1])
+    else:
+        args_np = (a6, a6[0])
+    args_ht = tuple(ht.array(x, split=split if np.ndim(x) > 1 else (0 if split == 0 else None)) for x in args_np)
+    got = ht.einsum(expr, *args_ht)
+    want = np.einsum(expr, *args_np)
+    got_np = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+    np.testing.assert_allclose(got_np, want, rtol=1e-8, atol=1e-10)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_vdot_inner_outer_kron(v, split):
+    a = v[:12]
+    b = v[12:]
+    ha = ht.array(a, split=split)
+    hb = ht.array(b, split=split)
+    np.testing.assert_allclose(float(ht.vdot(ha, hb)), np.vdot(a, b), rtol=1e-12)
+    np.testing.assert_allclose(float(ht.inner(ha, hb)), np.inner(a, b), rtol=1e-12)
+    np.testing.assert_allclose(ht.outer(ha, hb).numpy(), np.outer(a, b), rtol=1e-12)
+    m1 = np.arange(4.0).reshape(2, 2)
+    m2 = np.arange(6.0).reshape(2, 3)
+    np.testing.assert_allclose(
+        ht.kron(ht.array(m1, split=split), ht.array(m2, split=split)).numpy(),
+        np.kron(m1, m2),
+        rtol=1e-12,
+    )
+
+
+def test_matrix_power_exponent_grid():
+    a = np.array([[2.0, 1.0], [0.5, 3.0]])
+    x = ht.array(a, split=0)
+    for n in (0, 1, 3):
+        np.testing.assert_allclose(
+            ht.linalg.matrix_power(x, n).numpy(), np.linalg.matrix_power(a, n), rtol=1e-10
+        )
+    np.testing.assert_allclose(
+        ht.linalg.matrix_power(x, -1).numpy(), np.linalg.matrix_power(a, -1), rtol=1e-8
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_tensordot_axes_forms(m, split):
+    a = m[:6, :6]
+    x = ht.array(a, split=split)
+    np.testing.assert_allclose(
+        ht.tensordot(x, x, axes=1).numpy(), np.tensordot(a, a, axes=1), rtol=1e-8
+    )
+    np.testing.assert_allclose(
+        ht.tensordot(x, x, axes=([1], [0])).numpy(),
+        np.tensordot(a, a, axes=([1], [0])),
+        rtol=1e-8,
+    )
+    np.testing.assert_allclose(
+        float(ht.tensordot(x, x, axes=2)), np.tensordot(a, a, axes=2), rtol=1e-8
+    )
+
+
+def test_trace_offsets(m):
+    x = ht.array(m, split=0)
+    for off in (-2, 0, 1, 3):
+        np.testing.assert_allclose(
+            float(ht.trace(x, offset=off)), np.trace(m, offset=off), rtol=1e-10
+        )
