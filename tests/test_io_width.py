@@ -1,0 +1,106 @@
+"""IO width (heat/core/tests/test_io.py family): text-format option
+grids, npz bundles, regex parsing, memmap reads, and save/load format
+dispatch across splits.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+@pytest.fixture()
+def m():
+    return np.random.default_rng(0).standard_normal((12, 4)).astype(np.float64)
+
+
+def test_savetxt_loadtxt_option_grid(tmp_path, m):
+    p = str(tmp_path / "grid.txt")
+    ht.savetxt(p, ht.array(m, split=0), fmt="%.10f", delimiter=",", header="cols")
+    txt = open(p).read()
+    assert txt.startswith("# cols")
+    back = ht.loadtxt(p, delimiter=",", split=0, dtype=ht.float64)
+    # fmt wrote 10 decimals: tolerance follows the format, not f64
+    np.testing.assert_allclose(back.numpy(), m, rtol=1e-8, atol=1e-9)
+    # skiprows + usecols
+    sub = ht.loadtxt(p, delimiter=",", skiprows=3, usecols=(0, 2), dtype=ht.float64)
+    np.testing.assert_allclose(
+        sub.numpy(), np.loadtxt(p, delimiter=",", skiprows=3, usecols=(0, 2))
+    )
+
+
+def test_genfromtxt_missing_values(tmp_path):
+    p = str(tmp_path / "gaps.csv")
+    open(p, "w").write("1.0,2.0,\n,5.0,6.0\n7.0,,9.0\n")
+    got = ht.genfromtxt(p, delimiter=",", dtype=ht.float64)
+    want = np.genfromtxt(p, delimiter=",")
+    np.testing.assert_array_equal(np.isnan(got.numpy()), np.isnan(want))
+    np.testing.assert_allclose(
+        np.nan_to_num(got.numpy()), np.nan_to_num(want), rtol=1e-12
+    )
+    filled = ht.genfromtxt(p, delimiter=",", filling_values=-1.0, dtype=ht.float64)
+    np.testing.assert_allclose(
+        filled.numpy(), np.genfromtxt(p, delimiter=",", filling_values=-1.0)
+    )
+
+
+def test_savez_roundtrip(tmp_path, m):
+    p = str(tmp_path / "bundle.npz")
+    ht.savez(p, a=ht.array(m, split=0), b=ht.arange(5, split=0))
+    with np.load(p) as z:
+        np.testing.assert_allclose(z["a"], m)
+        np.testing.assert_array_equal(z["b"], np.arange(5))
+    p2 = str(tmp_path / "bundle2.npz")
+    ht.savez_compressed(p2, x=ht.array(m))
+    with np.load(p2) as z:
+        np.testing.assert_allclose(z["x"], m)
+    assert os.path.getsize(p2) <= os.path.getsize(p) + 512
+
+
+def test_fromregex_parse(tmp_path):
+    p = str(tmp_path / "log.txt")
+    open(p, "w").write("t=1 v=3.5\nt=2 v=4.25\nnoise line\nt=9 v=-1.5\n")
+    got = ht.fromregex(p, r"t=(\d+) v=(-?[\d.]+)", np.dtype("f8,f8"))
+    want = np.fromregex(p, r"t=(\d+) v=(-?[\d.]+)", np.dtype("f8,f8"))
+    got_np = got.numpy()
+    assert got_np.shape[0] == 3
+    np.testing.assert_allclose(got_np[:, 0], want["f0"])
+    np.testing.assert_allclose(got_np[:, 1], want["f1"])
+
+
+def test_memmap_and_open_memmap(tmp_path, m):
+    # np.memmap semantics: RAW binary, no .npy header parsing
+    raw = str(tmp_path / "mm.bin")
+    m.tofile(raw)
+    x = ht.memmap(raw, dtype=ht.float64, shape=m.shape, split=0)
+    np.testing.assert_allclose(x.numpy(), m, rtol=1e-12)
+    # open_memmap is the .npy-aware variant
+    p = str(tmp_path / "mm.npy")
+    np.save(p, m)
+    mm = ht.open_memmap(p, mode="r", split=0)
+    np.testing.assert_allclose(mm.numpy(), m, rtol=1e-12)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_save_load_dispatch_npy(tmp_path, m, split):
+    p = str(tmp_path / f"disp_{split}.npy")
+    ht.save(ht.array(m, split=split), p)
+    back = ht.load(p, split=split, dtype=ht.float64)
+    assert back.split == split
+    np.testing.assert_allclose(back.numpy(), m, rtol=1e-12)
+
+
+def test_load_csv_ragged_guard(tmp_path):
+    p = str(tmp_path / "ragged.csv")
+    open(p, "w").write("1,2,3\n4,5\n")
+    with pytest.raises(Exception):
+        ht.load_csv(p, split=0)
+
+
+def test_fromfile_tofile_roundtrip(tmp_path, m):
+    p = str(tmp_path / "raw.bin")
+    m.astype(np.float32).tofile(p)
+    got = ht.fromfile(p, dtype=ht.float32)
+    np.testing.assert_allclose(got.numpy(), m.astype(np.float32).ravel(), rtol=1e-6)
