@@ -1,0 +1,94 @@
+"""Signal + vmap width (heat/core/tests/test_signal.py, test_vmap.py):
+convolve parameter grid beyond the basic mode sweep — kernel longer than
+the signal, size-1 kernels, dtype mixes, correlate directions — and vmap
+over in/out axes with closures.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_convolve_kernel_longer_than_signal(split):
+    sig = np.array([1.0, 2.0, 3.0], np.float32)
+    ker = np.array([0.5, 1.0, 0.25, -0.5, 2.0], np.float32)
+    # mode='full' accepts the longer kernel (numpy parity)
+    got = ht.convolve(ht.array(sig, split=split), ht.array(ker), mode="full")
+    np.testing.assert_allclose(got.numpy(), np.convolve(sig, ker, mode="full"), rtol=1e-6)
+    # heat semantics (unlike numpy's operand swap): same/valid REJECT a
+    # kernel longer than the signal
+    for mode in ("same", "valid"):
+        with pytest.raises(ValueError, match="filter size"):
+            ht.convolve(ht.array(sig, split=split), ht.array(ker), mode=mode)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_convolve_size_one_kernel(split):
+    sig = np.arange(16, dtype=np.float32)
+    got = ht.convolve(ht.array(sig, split=split), ht.array(np.array([2.0], np.float32)))
+    np.testing.assert_allclose(got.numpy(), 2.0 * sig, rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_convolve_asymmetric_kernel_orientation(split):
+    sig = np.array([0.0, 0.0, 1.0, 0.0, 0.0, 0.0], np.float32)
+    ker = np.array([1.0, 2.0, 4.0], np.float32)  # asymmetric: flips matter
+    got = ht.convolve(ht.array(sig, split=split), ht.array(ker), mode="same")
+    np.testing.assert_allclose(got.numpy(), np.convolve(sig, ker, mode="same"), rtol=1e-6)
+
+
+def test_convolve_int_input_promotes():
+    sig = np.arange(10, dtype=np.int32)
+    ker = np.array([1, 1, 1], np.int32)
+    got = ht.convolve(ht.array(sig, split=0), ht.array(ker), mode="same")
+    np.testing.assert_allclose(got.numpy(), np.convolve(sig, ker, mode="same"))
+
+
+@pytest.mark.parametrize("mode", ["full", "same", "valid"])
+def test_correlate_direction(mode):
+    a = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    v = np.array([0.0, 1.0, 0.5], np.float32)
+    got = ht.correlate(ht.array(a, split=0), ht.array(v), mode=mode)
+    np.testing.assert_allclose(got.numpy(), np.correlate(a, v, mode=mode), rtol=1e-6)
+
+
+class TestVmapWidth:
+    """heat semantics (reference heat/core/vmap.py): the mapped dim of
+    each input IS its split axis; ``out_dims`` names the output dim."""
+
+    def test_maps_over_split_rows(self):
+        m = np.arange(24, dtype=np.float32).reshape(4, 6)
+        f0 = ht.vmap(lambda r: r.sum())
+        np.testing.assert_allclose(
+            f0(ht.array(m, split=0)).numpy(), m.sum(axis=1), rtol=1e-6
+        )
+
+    def test_maps_over_split_cols(self):
+        m = np.arange(24, dtype=np.float32).reshape(4, 6)
+        f1 = ht.vmap(lambda c: c.max())
+        np.testing.assert_allclose(
+            f1(ht.array(m, split=1)).numpy(), m.max(axis=0), rtol=1e-6
+        )
+
+    def test_two_arg_vmap_broadcast_closure(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        w = np.array([0.5, 1.0, -1.0], np.float32)
+        scale = 2.0
+        f = ht.vmap(lambda row, s: row * s * scale)
+        got = f(ht.array(m, split=0), ht.array(w, split=0))
+        np.testing.assert_allclose(got.numpy(), m * w[:, None] * scale, rtol=1e-6)
+
+    def test_out_dims(self):
+        m = np.arange(12, dtype=np.float32).reshape(3, 4)
+        f = ht.vmap(lambda r: r + 1.0, out_dims=1)
+        got = f(ht.array(m, split=0))
+        np.testing.assert_allclose(got.numpy(), (m + 1.0).T, rtol=1e-6)
+
+    def test_rejects_non_dndarray_only_args(self):
+        f = ht.vmap(lambda x: x + 1)
+        with pytest.raises(TypeError):
+            f(3.0)
