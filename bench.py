@@ -163,6 +163,15 @@ def bench_roofline(ht, sync_floor):
     per_s, meta_bw = _time_amortized(lambda: stream(x), lambda o: float(o[0]), 5, sync_floor)
     bw = 2.0 * 4.0 * m / per_s / 1e9
 
+    # per-program dispatch floor: enqueued trivial programs do NOT overlap
+    # through the tunnel, so this serial cost is the latency regime's
+    # roofline — tiny-step metrics (dpsgd) anchor against it, not against
+    # matmul peak (VERDICT r4 weak #8)
+    f0 = jax.jit(lambda v: v + 1.0)
+    z0 = jnp.zeros(())
+    float(f0(z0))
+    per_d, meta_disp = _time_amortized(lambda: f0(z0), lambda o: float(o), 256, sync_floor)
+
     return {
         "metric": "roofline",
         "value": round(peak_f32, 1),
@@ -173,7 +182,11 @@ def bench_roofline(ht, sync_floor):
         "peak_f32_highest_matmul_gflops": round(peak_f32_highest, 1),
         "peak_bf16_matmul_gflops": round(peak_bf16, 1),
         "hbm_stream_gbytes_per_s": round(bw, 1),
-        "timing": {"f32": meta_f32, "f32_highest": meta_hi, "bf16": meta_bf16, "stream": meta_bw},
+        "dispatch_floor_ms": round(per_d * 1e3, 4),
+        "timing": {
+            "f32": meta_f32, "f32_highest": meta_hi, "bf16": meta_bf16,
+            "stream": meta_bw, "dispatch": meta_disp,
+        },
     }
 
 
@@ -209,22 +222,48 @@ def bench_kmeans(ht, sync_floor, roofline=None):
     sync floor from a 2-fit window without requiring floor dominance —
     a systematic inflation.  From r4 on, the window list in ``timing``
     settles regression-vs-noise questions directly."""
-    n, f, k, iters = 1 << 22, 16, 8, 10
+    n, f, k = 1 << 22, 16, 8
     ht.random.seed(1)
     x = ht.random.randn(n, f, split=0)
     x = x.astype(ht.float32)
     float(x.sum())
 
-    def fit():
-        km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=iters, tol=-1.0, random_state=0)
-        km.fit(x)
-        return km
+    def make_fit(iters):
+        def fit():
+            km = ht.cluster.KMeans(
+                n_clusters=k, init="random", max_iter=iters, tol=-1.0, random_state=0
+            )
+            km.fit(x)
+            return km
 
-    fit()  # compile
-    per, meta = _time_amortized(
-        fit, lambda km: float(km.cluster_centers_.sum()), 2, sync_floor, windows=5
-    )
+        return fit
+
+    # convergence loop (VERDICT r4 #3): the fit window must dwarf the
+    # dispatch floor AND the window spread must settle under 10% before
+    # the number is publishable — r4's 40.5% / 143% same-round spreads
+    # could not detect a 2x regression.  Lloyd iterations per fit grow
+    # until both hold (rate is iteration-normalized, so the metric is
+    # unchanged by the workload growth).
+    iters = 100
+    while True:
+        fit = make_fit(iters)
+        fit()  # compile this iteration count
+        per, meta = _time_amortized(
+            fit, lambda km: float(km.cluster_centers_.sum()), 1, sync_floor, windows=5
+        )
+        if meta["spread_pct"] < 10.0 or iters >= 800:
+            break
+        iters *= 2
     pts_per_s = n * iters / per
+
+    # independent second measurement (fresh windows, same program): the
+    # published value must reproduce within the larger of the two spreads
+    per2, meta2 = _time_amortized(
+        fit, lambda km: float(km.cluster_centers_.sum()), meta["n_iter"], sync_floor, windows=3
+    )
+    v1, v2 = n * iters / per, n * iters / per2
+    tol = max(meta["spread_pct"], meta2["spread_pct"], 5.0) / 100.0
+    agreement = abs(v1 - v2) <= tol * max(v1, v2)
 
     # reference per-process path: torch CPU one Lloyd iteration (cdist+argmin
     # +scatter mean, cluster/kmeans.py torch kernels) on a subset
@@ -252,7 +291,11 @@ def bench_kmeans(ht, sync_floor, roofline=None):
         "value": round(pts_per_s / 1e9, 3),
         "unit": "Gpts/s",
         "vs_baseline": round(pts_per_s / base_pts, 2),
+        "lloyd_iters_per_fit": iters,
+        "repeat_value_gpts": round(v2 / 1e9, 3),
+        "repeat_agreement": agreement,
         "timing": meta,
+        "timing_repeat": meta2,
     }
     if roofline:
         # one Lloyd iteration reads the point set once (bandwidth bound:
@@ -401,10 +444,20 @@ def bench_dpsgd(ht, sync_floor, roofline=None):
         "vs_baseline": round(steps_per_s * best, 2),
         "timing": meta,
     }
-    if roofline and step_flops:
-        rec["pct_of_peak_f32"] = round(
-            100.0 * (step_flops / per / 1e9) / roofline["peak_f32_matmul_gflops"], 1
-        )
+    if roofline:
+        # a sub-ms CNN step through the tunnel is LATENCY-bound, so its
+        # regime anchor is the measured per-program dispatch floor — the
+        # fraction of each step that is irreducible link/dispatch cost.
+        # pct_of_peak_f32 stays for completeness but is meaningless as a
+        # quality bar here (VERDICT r4 weak #8).
+        if roofline.get("dispatch_floor_ms"):
+            rec["pct_of_dispatch_floor"] = round(
+                100.0 * (roofline["dispatch_floor_ms"] / 1e3) / per, 1
+            )
+        if step_flops:
+            rec["pct_of_peak_f32"] = round(
+                100.0 * (step_flops / per / 1e9) / roofline["peak_f32_matmul_gflops"], 1
+            )
     return rec
 
 
@@ -480,12 +533,26 @@ def bench_fft3d(ht, sync_floor, roofline=None):
         # a 3-axis transform must touch both f32 planes at least once per
         # axis pass: >= 3 * (read+write) * (re+im) * 4 bytes = 48N bytes.
         # The achieved fraction of stream bandwidth under that minimal
-        # model is the roofline tie (an FFT is bandwidth-, not flop-bound)
+        # model is the roofline tie (an FFT is bandwidth-, not flop-bound).
+        # The MINIMAL model is the honest denominator: bandwidth on XLA's
+        # scheduled bytes rewards wasteful schedules (VERDICT r4 weak #1),
+        # so scheduled bytes are recorded as a diagnostic only.
         eff_bw = 48.0 * n / per / 1e9
         rec["eff_bw_gbytes_minimal_model"] = round(eff_bw, 1)
         rec["pct_of_bw_minimal_model"] = round(
             100.0 * eff_bw / roofline["hbm_stream_gbytes_per_s"], 1
         )
+        try:
+            from heat_tpu.fft.fft import _planar_prog
+
+            prog = _planar_prog("fft", None, ((0, None), (1, None), (2, None)))
+            re_in = x._dense()
+            ca = prog.lower(re_in, None).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            rec["bytes_scheduled_gb"] = round(float(ca.get("bytes accessed", 0.0)) / 1e9, 2)
+        except Exception:
+            pass
     return rec
 
 

@@ -160,7 +160,7 @@ def __binary_op(
     if out is None and where is True and not fn_kwargs:
         planar = _try_planar_binary(operation, t1, t2)
         if planar is not None:
-            return planar
+            return planar._propagate_layout_from(t1, t2)
     ref = t1 if isinstance(t1, DNDarray) else (t2 if isinstance(t2, DNDarray) else None)
     if ref is None:
         t1 = _as_dndarray(t1)
@@ -202,7 +202,8 @@ def __binary_op(
             DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded
         )
         return out
-    return res
+    # an active ragged layout survives elementwise ops (lhs-first)
+    return res._propagate_layout_from(t1, t2)
 
 
 def __local_op(
@@ -220,10 +221,14 @@ def __local_op(
         # ops that decompose plane-wise stay on the mesh
         if operation is jnp.negative:
             re, im = x._planar
-            return DNDarray.from_planar(-re, -im, x.shape, x.split, x.device, x.comm)
+            return DNDarray.from_planar(
+                -re, -im, x.shape, x.split, x.device, x.comm
+            )._propagate_layout_from(x)
         if operation is jnp.positive:
             re, im = x._planar  # fresh wrapper: +x must not alias x
-            return DNDarray.from_planar(re, im, x.shape, x.split, x.device, x.comm)
+            return DNDarray.from_planar(
+                re, im, x.shape, x.split, x.device, x.comm
+            )._propagate_layout_from(x)
     arr = x.larray_padded
     if not no_cast and not (
         types.heat_type_is_inexact(x.dtype)
@@ -243,7 +248,7 @@ def __local_op(
         casted = res._dense().astype(out.dtype.jax_type())
         out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
         return out
-    return res
+    return res._propagate_layout_from(x)
 
 
 def __reduce_op(
@@ -365,4 +370,4 @@ def __cum_op(
         casted = res._dense().astype(out.dtype.jax_type())
         out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
         return out
-    return res
+    return res._propagate_layout_from(x)

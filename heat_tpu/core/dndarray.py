@@ -230,10 +230,13 @@ class DNDarray:
         return comp if comp.dtype == ctype else comp.astype(ctype)
 
     def _replace(self, padded: jax.Array) -> None:
-        """Swap the backing padded array (same shape/dtype/metadata)."""
+        """Swap the backing padded array (same shape/dtype/metadata).
+
+        Mutating VALUES keeps an active ragged layout — ``out=`` and
+        in-place ops preserve the target's distribution like the
+        reference — and only invalidates the lazily placed buffer."""
         self.__array = padded
         self.__planar = None
-        self.__target_map = None
         self.__ragged_buffer = None
 
     def _replace_local(self, local: jax.Array) -> None:
@@ -711,6 +714,42 @@ class DNDarray:
         full[:, self.__split] = counts
         self.__target_map = full
         self.__ragged_buffer = None  # placed lazily: no consumer, no cost
+        return self
+
+    @property
+    def _active_target_map(self) -> Optional[np.ndarray]:
+        """The ragged ``redistribute_`` target map, or None when canonical
+        (internal; see ``_propagate_layout_from``)."""
+        return self.__target_map
+
+    def _propagate_layout_from(self, *sources) -> "DNDarray":
+        """Adopt the first compatible active ragged layout among ``sources``.
+
+        Reference semantics: op results keep the (lhs-first) operand's
+        distribution (heat/core/sanitation.py:32-158).  Because the compute
+        substrate here is always canonical, propagation is metadata-only —
+        the result's ``lshape_map``/``counts_displs``/``__partitioned__``
+        report the adopted map and the physical ragged buffer is placed
+        lazily on first ``_ragged_layout`` access.  A source is compatible
+        when it shares this result's global shape and split; reductions and
+        shape-changing ops therefore return balanced arrays (documented in
+        docs/design.md).  Planar (complex real-pair) results never adopt a
+        layout: ``_ragged_layout`` would have to materialize the complex
+        value through the host, which complex-less TPU runtimes reject."""
+        if self.__planar is not None:
+            return self
+        for src in sources:
+            if not isinstance(src, DNDarray):
+                continue
+            if self.__split != src.split or self.__gshape != src.shape:
+                continue
+            # first compatible operand decides: its balanced layout wins
+            # too (the reference redistributes t2 to t1's map)
+            tm = src._active_target_map
+            if tm is not None:
+                self.__target_map = tm.copy()
+                self.__ragged_buffer = None
+            return self
         return self
 
     @property

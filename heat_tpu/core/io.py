@@ -27,7 +27,6 @@ from .stride_tricks import sanitize_axis
 
 __all__ = [
     "DataSource",
-    "format",
     "fromfile",
     "fromregex",
     "genfromtxt",
@@ -62,8 +61,21 @@ try:  # (io.py:463)
     import netCDF4
 
     __NETCDF = True
+    __NETCDF_BACKEND = "netcdf4"
 except ImportError:
-    __NETCDF = False
+    netCDF4 = None
+    try:
+        # scipy's NetCDF3 reader/writer: same API surface with the
+        # classic-format limits (first-dim-only unlimited, no groups) —
+        # netcdf support does not vanish just because the netCDF4 binding
+        # is absent from the environment
+        from scipy.io import netcdf_file as _scipy_netcdf
+
+        __NETCDF = True
+        __NETCDF_BACKEND = "scipy"
+    except ImportError:  # pragma: no cover
+        __NETCDF = False
+        __NETCDF_BACKEND = None
 
 try:  # (io.py:1205)
     import pandas as pd
@@ -287,27 +299,94 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 if __NETCDF:
 
     def load_netcdf(path, variable, dtype=types.float32, split=None, device=None, comm=None, **kwargs):
-        """Parallel netCDF read (io.py:75)."""
+        """Parallel netCDF read (io.py:75), netCDF4 or scipy-NetCDF3
+        backed (``supports_netcdf``/``netcdf_backend``)."""
+        if not isinstance(path, str):
+            raise TypeError(f"path must be str, not {type(path)}")
+        if not isinstance(variable, str):
+            raise TypeError(f"variable must be str, not {type(variable)}")
         comm = sanitize_comm(comm)
         device = sanitize_device(device)
         dtype = types.canonical_heat_type(dtype)
-        with netCDF4.Dataset(path, "r") as handle:
-            data = np.asarray(handle[variable][:], dtype=np.dtype(dtype.jax_type()))
+        if __NETCDF_BACKEND == "netcdf4":
+            with netCDF4.Dataset(path, "r") as handle:
+                data = np.asarray(handle[variable][:], dtype=np.dtype(dtype.jax_type()))
+        else:
+            with _scipy_netcdf(path, "r", mmap=False) as handle:
+                if variable not in handle.variables:
+                    raise ValueError(f"variable {variable!r} not found in {path}")
+                data = np.asarray(
+                    handle.variables[variable][:], dtype=np.dtype(dtype.jax_type())
+                )
         return DNDarray.from_dense(jax.numpy.asarray(data), sanitize_axis(data.shape, split), device, comm)
 
-    def save_netcdf(data, path, variable, mode: str = "w", **kwargs):
-        """netCDF write (io.py:158)."""
+    def _nc_dim_names(data, dimension_names, variable):
+        if dimension_names is None:
+            # per-VARIABLE default names (the reference's dim template,
+            # io.py:205): file-global dim_{i} defaults would bind a second
+            # appended variable to the first one's dimension sizes
+            return [f"{variable}_dim_{i}" for i in range(max(data.ndim, 1))]
+        if isinstance(dimension_names, str):
+            dimension_names = [dimension_names]
+        if not isinstance(dimension_names, (list, tuple)):
+            raise TypeError(
+                f"dimension_names must be list, tuple or str, not {type(dimension_names)}"
+            )
+        if len(dimension_names) != data.ndim:
+            raise ValueError(
+                f"{len(dimension_names)} dimension names for a {data.ndim}-d array"
+            )
+        return list(dimension_names)
+
+    def save_netcdf(
+        data,
+        path,
+        variable,
+        mode: str = "w",
+        dimension_names=None,
+        is_unlimited: bool = False,
+        file_slices=slice(None),
+        **kwargs,
+    ):
+        """netCDF write (io.py:158) with the reference's append surface:
+        ``mode`` in ``'w'/'a'/'r+'``, custom ``dimension_names``,
+        ``is_unlimited`` record dimensions, and ``file_slices`` writes
+        into an existing variable.  NetCDF3 (scipy backend) allows only
+        the first dimension unlimited, like the classic format."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
-        if jax.process_index() == 0:
+        if not isinstance(path, str):
+            raise TypeError(f"path must be str, not {type(path)}")
+        if not isinstance(variable, str):
+            raise TypeError(f"variable must be str, not {type(variable)}")
+        if mode not in ("w", "a", "r+"):
+            raise ValueError(f"mode must be 'w', 'a' or 'r+', got {mode!r}")
+        dims = _nc_dim_names(data, dimension_names, variable)
+        values = data.numpy()
+        if jax.process_index() != 0:
+            return
+        if __NETCDF_BACKEND == "netcdf4":
             with netCDF4.Dataset(path, mode) as handle:
-                dims = []
-                for i, s in enumerate(data.shape):
-                    name = f"dim_{i}"
-                    handle.createDimension(name, s)
-                    dims.append(name)
-                var = handle.createVariable(variable, data.numpy().dtype, tuple(dims))
-                var[:] = data.numpy()
+                if variable in handle.variables:
+                    handle.variables[variable][file_slices] = values
+                    return
+                for name, s in zip(dims, values.shape):
+                    if name not in handle.dimensions:
+                        handle.createDimension(name, None if is_unlimited else s)
+                var = handle.createVariable(variable, values.dtype, tuple(dims))
+                var[file_slices] = values
+            return
+        sci_mode = "a" if mode == "r+" else mode
+        with _scipy_netcdf(path, sci_mode) as handle:
+            if variable in handle.variables:
+                handle.variables[variable][file_slices] = values
+                return
+            for i, (name, s) in enumerate(zip(dims, values.shape)):
+                if name not in handle.dimensions:
+                    # classic format: only the leading dim may be a record dim
+                    handle.createDimension(name, None if (is_unlimited and i == 0) else s)
+            var = handle.createVariable(variable, values.dtype, tuple(dims))
+            var[file_slices] = values
 
 
 # ----------------------------------------------------------------------

@@ -63,10 +63,15 @@ class SplitTiles:
         ndim, size = arr.ndim, arr.comm.size
         # one chunk policy for every dimension — the canonical (padded)
         # distribution the comm layer actually uses — so the grid is
-        # identical however the array is currently split.
+        # identical however the array is currently split.  The split axis
+        # follows the REPORTED layout (ragged-aware), keeping the tile grid
+        # consistent with lshape_map/__partitioned__ after redistribute_.
         tile_dims = np.zeros((ndim, size), dtype=np.int64)
         for ax in range(ndim):
-            tile_dims[ax] = arr.comm.lshape_map(arr.gshape, ax)[:, ax]
+            if ax == arr.split:
+                tile_dims[ax] = lshape_map[:, ax]
+            else:
+                tile_dims[ax] = arr.comm.lshape_map(arr.gshape, ax)[:, ax]
         self.__tile_dims = tile_dims
         self.__tile_ends_g = np.cumsum(tile_dims, axis=1).astype(np.int64)
         self.__tile_locations = self.set_tile_locations(arr.split, tile_dims, arr)

@@ -336,6 +336,8 @@ class DCSX_matrix:
 
         return arithmetics.add(self, other)
 
+    __radd__ = __add__
+
     def __mul__(self, other):
         from . import arithmetics
 

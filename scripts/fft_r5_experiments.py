@@ -1,0 +1,307 @@
+"""R5 FFT byte-cut experiments (VERDICT r4 #1).
+
+The r4 roofline note pinned the problem: the planar 512^3 transform
+schedules 43.1 GB against a 6.44 GB minimal model because every DFT stage
+is 3 Karatsuba dots + combines + a separate twiddle pass (~112 B/el per
+axis pass).  The candidates here re-express a complex DFT stage as ONE
+real dot over an interleaved representation:
+
+    z[..., 2j+c] (c in {re, im})  @  W2[2j+c, 2k+d]  ->  out[..., 2k+d]
+
+with W2 the real 2x2-block form of the complex DFT matrix, and (for the
+four-step variant) the twiddle folded into the stage-B batched matrices,
+so no separate twiddle pass exists at all.
+
+Each candidate is validated against np.fft.fftn at 128^3, then compiled
+at 512^3 to read XLA's scheduled bytes (cost_analysis) and timed with
+floor-aware amortized windows.  Prints one JSON line per candidate.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+PREC = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}
+
+
+# ----------------------------------------------------------------------
+# interleaved DFT constants
+# ----------------------------------------------------------------------
+@functools.lru_cache(maxsize=64)
+def _wc(n: int, inverse: bool):
+    j = np.arange(n, dtype=np.float64)
+    jk = np.outer(j, j) % n
+    ang = 2.0 * np.pi * jk / n
+    sign = 1.0 if inverse else -1.0
+    return np.cos(ang), sign * np.sin(ang)
+
+
+def _block2(wre, wim, dtype):
+    """Real 2x2-block (interleaved) form of a complex matrix stack.
+
+    wre/wim: (..., J, K) -> (..., J, 2, K, 2) with
+    [c=0,d=0]=re, [c=1,d=0]=-im, [c=0,d=1]=im, [c=1,d=1]=re.
+    """
+    shp = wre.shape[:-2] + (wre.shape[-2], 2, wre.shape[-1], 2)
+    W = np.zeros(shp, np.float64)
+    W[..., 0, :, 0] = wre
+    W[..., 1, :, 0] = -wim
+    W[..., 0, :, 1] = wim
+    W[..., 1, :, 1] = wre
+    return W.astype(dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def w2_full(n: int, inverse: bool, dtype: str):
+    """(2n, 2n) interleaved complex DFT matrix."""
+    wre, wim = _wc(n, inverse)
+    return _block2(wre, wim, dtype).reshape(2 * n, 2 * n)
+
+
+@functools.lru_cache(maxsize=64)
+def w2_real_in(n: int, inverse: bool, dtype: str):
+    """(n, 2n): real input -> interleaved complex output."""
+    wre, wim = _wc(n, inverse)
+    W = np.zeros((n, n, 2), np.float64)
+    W[..., 0] = wre
+    W[..., 1] = wim
+    return W.astype(dtype).reshape(n, 2 * n)
+
+
+@functools.lru_cache(maxsize=64)
+def w2_fourstep(n: int, n1: int, inverse: bool, dtype: str):
+    """Stage matrices for the one-dot-per-stage four-step.
+
+    j = j1 + n1*j2, k = k2 + n2*k1 (C-order (j2, j1) in, (k1, k2) out).
+    Stage A contracts j2 with W_{n2}; stage B contracts j1 with the
+    twiddle FOLDED in: WB[k2, j1, k1] = T[j1, k2] * W_{n1}[j1, k1].
+    Returns (WA (n2,2,n2,2), WB (n2, n1, 2, n1, 2)) block forms.
+    """
+    n2 = n // n1
+    are, aim = _wc(n2, inverse)
+    WA = _block2(are, aim, dtype)
+    bre, bim = _wc(n1, inverse)
+    j1 = np.arange(n1, dtype=np.float64)
+    k2 = np.arange(n2, dtype=np.float64)
+    jk = np.outer(j1, k2) % n
+    ang = 2.0 * np.pi * jk / n
+    sign = 1.0 if inverse else -1.0
+    tre, tim = np.cos(ang), sign * np.sin(ang)  # (n1, k2)
+    # complex product (T * W): [k2, j1, k1]
+    cre = tre.T[:, :, None] * bre[None, :, :] - tim.T[:, :, None] * bim[None, :, :]
+    cim = tre.T[:, :, None] * bim[None, :, :] + tim.T[:, :, None] * bre[None, :, :]
+    WB = _block2(cre, cim, dtype)
+    return WA, WB
+
+
+# ----------------------------------------------------------------------
+# candidate passes.  All operate on an interleaved array z of logical
+# shape (..., n, 2) (real input: no trailing 2) and transform ``axis``.
+# ----------------------------------------------------------------------
+_L = "abefghmn"  # batch letters (never j/i/k/l/c/d)
+
+
+def _spec3(ndim_sp, axis, lhs_core, rhs_core, out_core):
+    """Einsum spec with spatial dims ndim_sp, transform at ``axis``."""
+    lead = _L[:axis]
+    trail = _L[axis + 1 : ndim_sp]
+    return f"{lead}{lhs_core}{trail}c,{rhs_core}->{lead}{out_core}{trail}d"
+
+
+def pass_direct(z, axis, n, inverse, prec, real_in=False):
+    """One-dot direct DFT along ``axis`` of interleaved z."""
+    dt = str(z.dtype)
+    ndim_sp = z.ndim - (0 if real_in else 1)
+    lead = _L[:axis]
+    trail = _L[axis + 1 : ndim_sp]
+    if real_in:
+        W = jnp.asarray(w2_real_in(n, inverse, dt).reshape(n, n, 2))
+        spec = f"{lead}j{trail},jkd->{lead}k{trail}d"
+        return jnp.einsum(spec, z, W, precision=prec)
+    W = jnp.asarray(w2_full(n, inverse, dt).reshape(n, 2, n, 2))
+    spec = f"{lead}j{trail}c,jckd->{lead}k{trail}d"
+    return jnp.einsum(spec, z, W, precision=prec)
+
+
+def pass_fourstep(z, axis, n, n1, inverse, prec, real_in=False):
+    """Two-dot four-step along ``axis`` (twiddle folded into stage B)."""
+    dt = str(z.dtype)
+    n2 = n // n1
+    WA, WB = w2_fourstep(n, n1, inverse, dt)
+    ndim_sp = z.ndim - (0 if real_in else 1)
+    lead = _L[:axis]
+    trail = _L[axis + 1 : ndim_sp]
+    shp = z.shape
+    # split axis n -> (n2, n1): C-order puts x[j1 + n1*j2] at [j2, j1]
+    pre = shp[:axis]
+    post = shp[axis + 1 :]
+    post_sp = post if real_in else post[:-1]  # spatial trail (no c dim)
+    z = z.reshape(*pre, n2, n1, *post)
+    if real_in:
+        WAr = jnp.asarray(WA[:, 0])  # (n2, k2, 2): real input row
+        sA = f"{lead}ji{trail},jkd->{lead}ki{trail}d"
+        y = jnp.einsum(sA, z, WAr, precision=prec)
+    else:
+        sA = f"{lead}ji{trail}c,jckd->{lead}ki{trail}d"
+        y = jnp.einsum(sA, z, jnp.asarray(WA), precision=prec)
+    # y: (..., k2, j1, ..., d); stage B batched over k2, contract (j1, c)
+    sB = f"{lead}kj{trail}c,kjcld->{lead}lk{trail}d"
+    y = jnp.einsum(sB, y, jnp.asarray(WB), precision=prec)
+    # (..., k1, k2, ..., d) -> merge to (..., n, ..., d): k = k2 + n2*k1
+    return y.reshape(*pre, n, *post_sp, 2)
+
+
+def hermitian_extend(z, axis, n_out, shape_sp):
+    """Full interleaved spectrum from its first m = n//2+1 bins: one fused
+    gather (index arithmetic over all spatial axes at once) + concat."""
+    m = z.shape[axis]
+    idx = []
+    for d, s in enumerate(shape_sp):
+        if d == axis:
+            ar = n_out - np.arange(m, n_out)
+        else:
+            ar = np.concatenate([[0], np.arange(s - 1, 0, -1)])
+        sh = [1] * len(shape_sp)
+        sh[d] = -1
+        idx.append(jnp.asarray(ar.reshape(sh)))
+    ext = z[tuple(idx) + (slice(None),)]
+    ext = ext * jnp.asarray([1.0, -1.0], z.dtype)
+    return jnp.concatenate([z, ext], axis=axis)
+
+
+# ----------------------------------------------------------------------
+# full rfftn-3d candidates: x (S,S,S) real -> (re, im) full spectrum
+# ----------------------------------------------------------------------
+def make_v1(prec_name):
+    prec = PREC[prec_name]
+
+    def run(x):
+        S = x.shape[0]
+        m = S // 2 + 1
+        z = pass_direct(x, 2, S, False, prec, real_in=True)  # (S,S,S,2)
+        z = z[:, :, :m]
+        z = pass_direct(z, 1, S, False, prec)
+        z = pass_direct(z, 0, S, False, prec)
+        z = hermitian_extend(z, 2, S, (S, S, S))
+        return z[..., 0], z[..., 1]
+
+    return run
+
+
+def make_v2(prec_name, n1):
+    prec = PREC[prec_name]
+
+    def run(x):
+        S = x.shape[0]
+        m = S // 2 + 1
+        z = pass_fourstep(x, 2, S, n1, False, prec, real_in=True)
+        z = z[:, :, :m]
+        z = pass_fourstep(z, 1, S, n1, False, prec)
+        z = pass_fourstep(z, 0, S, n1, False, prec)
+        z = hermitian_extend(z, 2, S, (S, S, S))
+        return z[..., 0], z[..., 1]
+
+    return run
+
+
+def make_v0():
+    from heat_tpu.fft import _planar as _pl
+
+    def run(x):
+        return _pl.real_fftn(x, [0, 1, 2], None)
+
+    return run
+
+
+# ----------------------------------------------------------------------
+# harness
+# ----------------------------------------------------------------------
+def accuracy(fn, s=128):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((s, s, s)).astype(np.float32)
+    re, im = jax.jit(fn)(jnp.asarray(x))
+    got = np.asarray(re) + 1j * np.asarray(im)
+    want = np.fft.fftn(x)
+    return float(np.linalg.norm(got - want) / np.linalg.norm(want))
+
+
+def measure(fn, s=512, n_iter=32, windows=3):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((s, s, s)).astype(np.float32))
+    jit = jax.jit(fn)
+    lowered = jit.lower(x)
+    comp = lowered.compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    bytes_gb = float(ca.get("bytes accessed", 0.0)) / 1e9
+    re, im = jit(x)
+    float(re[0, 0, 0])  # drain compile
+    f0 = jax.jit(lambda v: v + 1.0)
+    zz = jnp.zeros(())
+    float(f0(zz))
+    floor = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f0(zz))
+        floor = min(floor, time.perf_counter() - t0)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_iter):
+            out = jit(x)
+        float(out[0][0, 0, 0])
+        best = min(best, (time.perf_counter() - t0 - floor) / n_iter)
+    return bytes_gb, best
+
+
+def main():
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    cands = {
+        "v0_current": make_v0(),
+        "v1_direct_highest": make_v1("highest"),
+        "v1_direct_high": make_v1("high"),
+        "v1_direct_default": make_v1("default"),
+        "v2_fourstep64_highest": make_v2("highest", 64),
+        "v2_fourstep64_high": make_v2("high", 64),
+    }
+    n = 512 ** 3
+    for name, fn in cands.items():
+        if only and only not in name:
+            continue
+        try:
+            rel = accuracy(fn)
+            gb, sec = measure(fn)
+            print(
+                json.dumps(
+                    {
+                        "cand": name,
+                        "rel_err_128": float(f"{rel:.3g}"),
+                        "bytes_gb_512": round(gb, 2),
+                        "sec_512": round(sec, 4),
+                        "nominal_gflops": round(5.0 * n * np.log2(n) / sec / 1e9, 1),
+                        "pct_bw_minimal": round(100 * 6.44 / 652.8 / sec, 1),
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as e:
+            print(json.dumps({"cand": name, "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""R5 FFT experiments, round B: merged-minor interleaved representation.
+
+Round A failed spectacularly: any materialized tensor with a trailing
+dim of 2 gets the TPU tile (8, 128) on its last two dims, padding 2->128
+— a 64x memory/traffic blow-up (the compiler refused a 64 GB alloc for
+f32[512,512,512,2]).  So the complex pair must live INSIDE the minor
+dim: z[..., 2k+c] (interleaved), every DFT stage is a plain matmul
+``(..., 2n) @ (2n, 2n)`` with the real 2x2-block DFT matrix, and moving
+the transform to another axis is an explicit "swap-last-two" relayout
+(A, B, 2C) -> (A, C, 2B) whose implementations this script races:
+
+* swap_t: reshape/transpose/reshape (XLA fuses or it dies by tiling)
+* swap_p: one per-row gather through a host-precomputed permutation
+
+Chain for rfftn-3d (x real (S,S,S)):
+  pass Z (plain matmul, real-in W) -> (X, Y, 2Kz), slice to 2m
+  swap -> (X, m, 2Y); pass Y -> (X, m, 2Ky)
+  leading transpose (m, X, 2Ky); swap -> (m, Ky, 2X); pass X -> (m, Ky, 2Kx)
+  Hermitian extension + unstack + axis restore in ONE gather per plane.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from fft_r5_experiments import PREC, _wc, w2_full, w2_real_in, measure, accuracy
+
+
+def swap_t(z, B, C):
+    """(A, B, 2C) -> (A, C, 2B) via transpose."""
+    A = z.shape[0]
+    return z.reshape(A, B, C, 2).swapaxes(1, 2).reshape(A, C, 2 * B)
+
+
+@functools.lru_cache(maxsize=32)
+def _swap_perm(B, C):
+    b, c, d = np.meshgrid(np.arange(B), np.arange(C), np.arange(2), indexing="ij")
+    # out position (c, 2b+d) <- in position (b, 2c+d)
+    perm = np.empty(B * 2 * C, np.int32)
+    perm[c.ravel() * 2 * B + 2 * b.ravel() + d.ravel()] = (
+        b.ravel() * 2 * C + 2 * c.ravel() + d.ravel()
+    )
+    return perm
+
+
+def swap_p(z, B, C):
+    A = z.shape[0]
+    perm = jnp.asarray(_swap_perm(B, C))
+    return jnp.take(z.reshape(A, B * 2 * C), perm, axis=1).reshape(A, C, 2 * B)
+
+
+def _final_planes(z, S, m):
+    """z (m, Ky, 2Kx) -> (re, im) planes (S, S, S) with Hermitian
+    extension along the original Z axis, one fused gather per plane.
+
+    full[x, y, k] = z[k, y, x] for k < m; conj(z[S-k, rev(y), rev(x)]) above.
+    """
+    kz = np.arange(S)
+    lower = kz < m
+    src_k = np.where(lower, kz, S - kz)
+    rev = np.concatenate([[0], np.arange(S - 1, 0, -1)])
+    ix = np.arange(S)
+    # build index arrays for out[x, y, k]
+    K = src_k[None, None, :]
+    Y = np.where(lower[None, None, :], ix[None, :, None], rev[None, :, None])
+    X = np.where(lower[None, None, :], ix[:, None, None], rev[:, None, None])
+    sgn = np.where(lower, 1.0, -1.0).astype(np.float32)[None, None, :]
+    zK, zY, zX = jnp.asarray(K), jnp.asarray(Y), jnp.asarray(X)
+    re = z[zK, zY, 2 * zX]
+    im = z[zK, zY, 2 * zX + 1] * jnp.asarray(sgn)
+    return re, im
+
+
+def make_merged(prec_name, swap):
+    prec = PREC[prec_name]
+
+    def run(x):
+        S = x.shape[0]
+        m = S // 2 + 1
+        dt = str(x.dtype)
+        Wr = jnp.asarray(w2_real_in(S, False, dt))
+        W2 = jnp.asarray(w2_full(S, False, dt))
+        mm = lambda a, w: jax.lax.dot_general(
+            a.reshape(-1, a.shape[-1]), w, (((1,), (0,)), ((), ())), precision=prec
+        ).reshape(*a.shape[:-1], w.shape[1])
+        z = mm(x, Wr)  # (X, Y, 2S)
+        z = z[:, :, : 2 * m]  # minor slice keeps (k, c) pairs
+        z = swap(z, S, m)  # (X, m, 2Y)
+        z = mm(z, W2)  # (X, m, 2Ky)
+        z = jnp.swapaxes(z, 0, 1)  # (m, X, 2Ky) leading transpose
+        z = swap(z, S, S)  # (m, Ky, 2X)
+        z = mm(z, W2)  # (m, Ky, 2Kx)
+        return _final_planes(z, S, m)
+
+    return run
+
+
+def main():
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    cands = {
+        "m_swapT_high": make_merged("high", swap_t),
+        "m_swapP_high": make_merged("high", swap_p),
+        "m_swapT_highest": make_merged("highest", swap_t),
+        "m_swapT_default": make_merged("default", swap_t),
+    }
+    n = 512 ** 3
+    for name, fn in cands.items():
+        if only and only not in name:
+            continue
+        try:
+            rel = accuracy(fn)
+            gb, sec = measure(fn)
+            print(
+                json.dumps(
+                    {
+                        "cand": name,
+                        "rel_err_128": float(f"{rel:.3g}"),
+                        "bytes_gb_512": round(gb, 2),
+                        "sec_512": round(sec, 4),
+                        "nominal_gflops": round(5.0 * n * np.log2(n) / sec / 1e9, 1),
+                        "pct_bw_minimal": round(100 * 6.44 / 652.8 / sec, 1),
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as e:
+            print(json.dumps({"cand": name, "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

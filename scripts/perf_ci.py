@@ -1,0 +1,142 @@
+"""CI perf grid: small anchored measurements that gate regressions.
+
+The chip bench (bench.py) needs the attached TPU; CI runners have none,
+and their absolute speed varies between runner generations.  So the CI
+grid measures each kernel AGAINST same-process anchors (matmul peak and
+stream bandwidth, measured first in the same job) and publishes the
+dimensionless ratio — the quantity that moves when a kernel regresses
+and holds when the runner is merely slower.  ``scripts/perf_gate.py``
+compares a fresh run to the committed ``BENCH_CI.json`` with the
+median-minus-spread rule (VERDICT r4 #7; the reference's cb trigger,
+.github/workflows/bench_trigger.yml).
+
+    python scripts/perf_ci.py > /tmp/current.json
+    python scripts/perf_gate.py BENCH_CI.json /tmp/current.json
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timeit(fn, fetch, windows=5, n_iter=3):
+    fetch(fn())  # compile
+    samples = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_iter):
+            out = fn()
+        fetch(out)
+        samples.append((time.perf_counter() - t0) / n_iter)
+    best = min(samples)
+    med = float(np.median(samples))
+    spread = 100.0 * (med - best) / best if best else 0.0
+    return best, round(spread, 1)
+
+
+def main():
+    import heat_tpu as ht
+
+    results = {}
+
+    # anchors
+    n = 1024
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+    mm = jax.jit(lambda x: x @ x)
+    t_mm, sp = _timeit(lambda: mm(a), lambda o: float(o[0, 0]))
+    anchor_flops = 2.0 * n**3 / t_mm
+    results["anchor_matmul_gflops"] = {"value": round(anchor_flops / 1e9, 1), "spread_pct": sp}
+
+    m = 1 << 24
+    v = jax.random.normal(jax.random.PRNGKey(1), (m,), jnp.float32)
+    st = jax.jit(lambda x: x * 1.000001 + 0.5)
+    t_st, sp = _timeit(lambda: st(v), lambda o: float(o[0]))
+    anchor_bw = 8.0 * m / t_st
+    results["anchor_stream_gbytes"] = {"value": round(anchor_bw / 1e9, 1), "spread_pct": sp}
+
+    # kernels under gate: each publishes rel = achieved/anchor
+    def record(name, per_iter, spread, model_num, anchor):
+        results[name] = {
+            "seconds": round(per_iter, 5),
+            "rel_to_anchor": round(model_num / per_iter / anchor, 4),
+            "spread_pct": spread,
+        }
+
+    # kmeans lloyd iteration (stream-anchored: reads the point set)
+    nk, f, k = 1 << 16, 16, 8
+    ht.random.seed(0)
+    x = ht.random.randn(nk, f, split=0).astype(ht.float32)
+    float(x.sum())
+
+    def fit():
+        km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=10, tol=-1.0, random_state=0)
+        km.fit(x)
+        return km
+
+    per, sp = _timeit(fit, lambda km: float(km.cluster_centers_.sum()), n_iter=1)
+    record("kmeans_lloyd", per / 10, sp, nk * f * 4.0, anchor_bw)
+
+    # hsvd (matmul-anchored)
+    nh, fh = 1 << 16, 64
+    xh = ht.random.randn(nh, fh, split=0).astype(ht.float32)
+    float(xh.sum())
+
+    def fact():
+        u, s, verr = ht.linalg.hsvd_rank(xh, 10, compute_sv=False)
+        return s if hasattr(s, "sum") else u
+
+    per, sp = _timeit(lambda: ht.linalg.hsvd_rank(xh, 10, compute_sv=False)[0],
+                      lambda u: float(u.sum()), n_iter=1)
+    record("hsvd", per, sp, 2.0 * nh * fh * fh, anchor_flops)
+
+    # fft3d 64^3 planar (stream-anchored, minimal 48B/el model)
+    os.environ["HEAT_TPU_PLANAR"] = "1"
+    s3 = 64
+    xf = ht.random.randn(s3, s3, s3, split=0).astype(ht.float32)
+    float(xf.sum())
+
+    def fft():
+        return ht.fft.fftn(xf)
+
+    def fetch_fft(r):
+        re, im = r._planar
+        return float(re[0, 0, 0])
+
+    per, sp = _timeit(fft, fetch_fft, n_iter=2)
+    record("fft3d_64", per, sp, 48.0 * s3**3, anchor_bw)
+
+    # distributed sort (stream-anchored; 2^18 keeps the CI job under a
+    # minute — the PSRS program is the same shape at any extent)
+    xs = ht.random.randn(1 << 18, split=0).astype(ht.float32)
+    float(xs.sum())
+    per, sp = _timeit(lambda: ht.sort(xs)[0], lambda r: float(r[0]), n_iter=1, windows=3)
+    record("sort_psrs", per, sp, 4.0 * (1 << 18), anchor_bw)
+
+    # sparse CSR ring SpMM (stream-anchored on the dense operand)
+    import scipy.sparse as sp_m
+
+    A = sp_m.random(4096, 4096, density=0.01, random_state=0, format="csr", dtype=np.float64)
+    sa = ht.sparse.sparse_csr_matrix(A, split=0)
+    xd = ht.random.randn(4096, 64, split=0).astype(ht.float64)
+    float(xd.sum())
+    per, spd = _timeit(lambda: sa @ xd, lambda r: float(r[0, 0]), n_iter=2)
+    record("sparse_spmm_ring", per, spd, 8.0 * 4096 * 64, anchor_bw)
+
+    print(json.dumps(results, indent=1))
+
+
+if __name__ == "__main__":
+    main()

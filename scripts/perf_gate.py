@@ -1,0 +1,74 @@
+"""Perf regression gate: fail CI when a kernel's anchored ratio drops
+below the committed record's median-minus-spread band (VERDICT r4 #7).
+
+    python scripts/perf_gate.py BENCH_CI.json current.json [--margin-pct 30]
+
+Rule per gated metric (every key with ``rel_to_anchor``):
+
+    threshold = committed.rel * (1 - (committed.spread + current.spread
+                + margin) / 100), clamped to >= 0.5 * committed.rel
+
+    FAIL if current.rel < threshold
+
+The margin absorbs cross-runner microarchitecture variance (the ratios
+are anchored against same-job matmul/stream measurements, which removes
+frequency/core-count scaling but not cache-hierarchy differences); the
+0.5 clamp guarantees a deliberate 2x slowdown always fails.
+"""
+
+import argparse
+import json
+import sys
+
+
+def gate(committed: dict, current: dict, margin_pct: float) -> int:
+    failures = []
+    for name, rec in committed.items():
+        if not isinstance(rec, dict) or "rel_to_anchor" not in rec:
+            continue
+        cur = current.get(name)
+        if cur is None or "rel_to_anchor" not in cur:
+            failures.append(f"{name}: missing from current run")
+            continue
+        want = float(rec["rel_to_anchor"])
+        got = float(cur["rel_to_anchor"])
+        slack = (
+            float(rec.get("spread_pct", 0.0))
+            + float(cur.get("spread_pct", 0.0))
+            + margin_pct
+        )
+        threshold = max(want * (1.0 - slack / 100.0), 0.5 * want)
+        status = "ok" if got >= threshold else "FAIL"
+        print(
+            f"{name}: committed {want:.4f} current {got:.4f} "
+            f"threshold {threshold:.4f} [{status}]"
+        )
+        if got < threshold:
+            failures.append(
+                f"{name}: {got:.4f} < {threshold:.4f} "
+                f"(committed {want:.4f}, slack {slack:.0f}%)"
+            )
+    if failures:
+        print("\nPERF GATE FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nperf gate passed")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("committed")
+    ap.add_argument("current")
+    ap.add_argument("--margin-pct", type=float, default=30.0)
+    args = ap.parse_args()
+    with open(args.committed) as f:
+        committed = json.load(f)
+    with open(args.current) as f:
+        current = json.load(f)
+    sys.exit(gate(committed, current, args.margin_pct))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""netCDF width grid (VERDICT r4 #6, third family): the analog of the
+reference's netCDF battery (heat/core/tests/test_io.py:640-743) —
+load across splits/dtypes, save across splits, append ('a'/'r+') modes,
+dimension names, file_slices writes, and the error surface.  Runs on the
+netCDF4 backend when installed, else scipy's NetCDF3 (core/io.py shim).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+pytestmark = pytest.mark.skipif(
+    not ht.core.io.supports_netcdf(), reason="no netCDF backend"
+)
+
+
+@pytest.fixture
+def nc(tmp_path):
+    return str(tmp_path / "data.nc")
+
+
+DATA = np.arange(4 * 5, dtype=np.float64).reshape(4, 5)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("save_split", [None, 0, 1])
+    @pytest.mark.parametrize("load_split", [None, 0, 1, -1])
+    def test_split_grid(self, nc, save_split, load_split):
+        ht.save_netcdf(ht.array(DATA, split=save_split), nc, "data")
+        out = ht.load_netcdf(nc, "data", dtype=ht.float64, split=load_split)
+        assert out.split == (load_split % 2 if load_split is not None else None)
+        np.testing.assert_array_equal(out.numpy(), DATA)
+
+    @pytest.mark.parametrize(
+        "dtype", [ht.float32, ht.float64, ht.int32, ht.int8]
+    )
+    def test_dtype_grid(self, nc, dtype):
+        ht.save_netcdf(ht.array(DATA), nc, "data")
+        out = ht.load_netcdf(nc, "data", dtype=dtype)
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(
+            out.numpy(), DATA.astype(np.dtype(dtype.jax_type()))
+        )
+
+    def test_1d_and_3d(self, nc):
+        for arr in (np.arange(7.0), np.arange(24.0).reshape(2, 3, 4)):
+            path = nc + f".{arr.ndim}d.nc"
+            ht.save_netcdf(ht.array(arr, split=0), path, "v")
+            np.testing.assert_array_equal(ht.load_netcdf(path, "v", dtype=ht.float64).numpy(), arr)
+
+
+class TestAppendModes:
+    def test_append_second_variable(self, nc):
+        ht.save_netcdf(ht.array(DATA), nc, "first")
+        other = np.linspace(0.0, 1.0, 20).reshape(4, 5)
+        # 'a' adds a variable to an existing file without clobbering
+        ht.save_netcdf(ht.array(other), nc, "second", mode="a",
+                       dimension_names=("dim_0", "dim_1"))
+        np.testing.assert_array_equal(
+            ht.load_netcdf(nc, "first", dtype=ht.float64).numpy(), DATA
+        )
+        np.testing.assert_allclose(
+            ht.load_netcdf(nc, "second", dtype=ht.float64).numpy(), other
+        )
+
+    def test_append_different_shape(self, nc):
+        # default dim names are per-variable: a second variable with a
+        # DIFFERENT shape must not bind to the first one's dimensions
+        ht.save_netcdf(ht.array(DATA), nc, "big")
+        small = np.ones((2, 2))
+        ht.save_netcdf(ht.array(small), nc, "small", mode="a")
+        np.testing.assert_array_equal(
+            ht.load_netcdf(nc, "big", dtype=ht.float64).numpy(), DATA
+        )
+        np.testing.assert_array_equal(
+            ht.load_netcdf(nc, "small", dtype=ht.float64).numpy(), small
+        )
+
+    def test_rplus_overwrites_values(self, nc):
+        ht.save_netcdf(ht.array(DATA), nc, "data")
+        ht.save_netcdf(ht.array(2.5 * DATA), nc, "data", mode="r+")
+        np.testing.assert_allclose(
+            ht.load_netcdf(nc, "data", dtype=ht.float64).numpy(), 2.5 * DATA
+        )
+
+    def test_file_slices_partial_write(self, nc):
+        ht.save_netcdf(ht.array(np.zeros((4, 5))), nc, "data")
+        ht.save_netcdf(
+            ht.array(DATA[1:3]), nc, "data", mode="r+",
+            file_slices=(slice(1, 3), slice(None)),
+        )
+        want = np.zeros((4, 5))
+        want[1:3] = DATA[1:3]
+        np.testing.assert_allclose(
+            ht.load_netcdf(nc, "data", dtype=ht.float64).numpy(), want
+        )
+
+    def test_custom_dimension_names(self, nc):
+        ht.save_netcdf(ht.array(DATA), nc, "data", dimension_names=("lat", "lon"))
+        np.testing.assert_array_equal(
+            ht.load_netcdf(nc, "data", dtype=ht.float64).numpy(), DATA
+        )
+
+    def test_unlimited_leading_dim(self, nc):
+        ht.save_netcdf(ht.array(DATA), nc, "data", is_unlimited=True)
+        np.testing.assert_array_equal(
+            ht.load_netcdf(nc, "data", dtype=ht.float64).numpy(), DATA
+        )
+
+
+class TestErrors:
+    def test_exceptions(self, nc):
+        data = ht.array(DATA)
+        with pytest.raises(TypeError):
+            ht.load_netcdf(1, "data")
+        with pytest.raises(TypeError):
+            ht.load_netcdf(nc, variable=1)
+        with pytest.raises(TypeError):
+            ht.save_netcdf(1, nc, "data")
+        with pytest.raises(TypeError):
+            ht.save_netcdf(data, 1, "data")
+        with pytest.raises(TypeError):
+            ht.save_netcdf(data, nc, 1)
+        with pytest.raises(TypeError):
+            ht.save_netcdf(data, nc, "data", dimension_names=1)
+        with pytest.raises(ValueError):
+            ht.save_netcdf(data, nc, "data", dimension_names=["a"])
+        with pytest.raises(ValueError):
+            ht.save_netcdf(data, nc, "data", mode="x")
+        ht.save_netcdf(data, nc, "data")
+        with pytest.raises(ValueError):
+            ht.load_netcdf(nc, "missing")
+        with pytest.raises((FileNotFoundError, OSError)):
+            ht.load_netcdf(str(nc) + ".nope.nc", "data")
+
+    def test_load_dispatch_by_extension(self, nc):
+        ht.save_netcdf(ht.array(DATA), nc, "data")
+        out = ht.load(nc, "data", dtype=ht.float64)
+        np.testing.assert_array_equal(out.numpy(), DATA)

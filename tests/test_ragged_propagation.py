@@ -1,0 +1,153 @@
+"""Post-op semantics of ragged layouts (VERDICT r4 #5, ADVICE r4 #3).
+
+An active ``redistribute_`` target map PROPAGATES through every
+shape-preserving op (result adopts the lhs operand's layout, the
+reference's sanitation semantics — heat/core/sanitation.py:32-158) and is
+DROPPED by shape-changing ops (reductions, matmul, resplit), which return
+balanced arrays.  Pinned in docs/design.md ("Ragged layouts").
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _ragged_target(size, extent, ndim, split):
+    """A deliberately skewed but valid target map."""
+    counts = np.zeros(size, np.int64)
+    counts[0] = extent // 3
+    counts[-1] = extent - counts[0]
+    tm = np.zeros((size, ndim), np.int64)
+    tm[:, split] = counts
+    return tm
+
+
+@pytest.fixture
+def ragged_pair():
+    data = np.arange(40 * 6, dtype=np.float32).reshape(40, 6)
+    a = ht.array(data, split=0)
+    if a.comm.size < 2:
+        pytest.skip("ragged layouts need a multi-device mesh")
+    b = ht.array(2.0 * data, split=0)
+    tm = _ragged_target(a.comm.size, 40, 2, 0)
+    a.redistribute_(target_map=tm)
+    return a, b, tm, data
+
+
+def test_binary_adopts_lhs_layout(ragged_pair):
+    a, b, tm, data = ragged_pair
+    res = a + b
+    assert not res.is_balanced()
+    np.testing.assert_array_equal(res.lshape_map, a.lshape_map)
+    counts, displs = res.counts_displs()
+    assert counts == tuple(int(c) for c in tm[:, 0])
+    np.testing.assert_allclose(res.numpy(), 3.0 * data)
+    # the adopted layout is physically placeable, like the original's
+    lt = res._ragged_layout
+    assert lt is not None
+    _, buf = lt
+    np.testing.assert_allclose(np.asarray(buf[: int(tm[0, 0])]), 3.0 * data[: int(tm[0, 0])])
+
+
+def test_binary_balanced_lhs_wins_over_ragged_rhs(ragged_pair):
+    a, b, tm, data = ragged_pair
+    # reference: t2 is redistributed to t1's (balanced) layout -> balanced
+    res = b + a
+    assert res.is_balanced()
+    np.testing.assert_allclose(res.numpy(), 3.0 * data)
+
+
+def test_scalar_op_keeps_array_layout(ragged_pair):
+    a, _, tm, data = ragged_pair
+    for res in (a * 2, 2 * a, a + 1, 1 + a):
+        assert not res.is_balanced()
+        assert tuple(res.lshape_map[:, 0]) == tuple(tm[:, 0])
+    np.testing.assert_allclose((2 * a).numpy(), 2.0 * data)
+
+
+def test_unary_and_cum_keep_layout(ragged_pair):
+    a, _, tm, data = ragged_pair
+    u = ht.exp(a * 0.01)
+    assert not u.is_balanced()
+    assert tuple(u.lshape_map[:, 0]) == tuple(tm[:, 0])
+    c = ht.cumsum(a, axis=1)
+    assert not c.is_balanced()
+    assert tuple(c.lshape_map[:, 0]) == tuple(tm[:, 0])
+    np.testing.assert_allclose(c.numpy(), np.cumsum(data, axis=1), rtol=1e-6)
+
+
+def test_shape_changing_ops_drop_to_balanced(ragged_pair):
+    a, b, _, data = ragged_pair
+    s = ht.sum(a, axis=0)
+    assert s.is_balanced()
+    r = a.reshape((6, 40))
+    assert r.is_balanced()
+    m = a.T @ b
+    assert m.is_balanced()
+    out = a.resplit(1)
+    assert out.is_balanced()
+
+
+def test_partitioned_after_op_reports_adopted_layout(ragged_pair):
+    a, b, tm, data = ragged_pair
+    res = a - b
+    parts = res.__partitioned__
+    k0 = (0, 0)
+    assert parts["partitions"][k0]["shape"] == (int(tm[0, 0]), 6)
+    np.testing.assert_allclose(
+        parts["get"](parts["partitions"][k0]["data"]), -data[: int(tm[0, 0])]
+    )
+
+
+def test_tiles_follow_ragged_split(ragged_pair):
+    a, _, tm, _ = ragged_pair
+    tiles = ht.core.tiling.SplitTiles(a)
+    # the split-axis tile dims mirror the reported (ragged) lshape_map
+    np.testing.assert_array_equal(tiles.lshape_map, a.lshape_map)
+    np.testing.assert_array_equal(
+        np.asarray(tiles.tile_dimensions)[a.split if a.split is not None else 0],
+        a.lshape_map[:, a.split],
+    )
+
+
+def test_mutation_invalidates_adopted_buffer(ragged_pair):
+    a, b, tm, data = ragged_pair
+    res = a + b
+    _ = res._ragged_layout  # place the buffer
+    res[0, 0] = -5.0
+    lt = res._ragged_layout
+    assert lt is not None
+    _, buf = lt
+    assert float(buf[0, 0]) == -5.0
+
+
+def test_inplace_and_out_keep_layout(ragged_pair):
+    a, b, tm, data = ragged_pair
+    a += b  # in-place: x is lhs AND out — its layout must survive
+    assert not a.is_balanced()
+    assert tuple(a.lshape_map[:, 0]) == tuple(tm[:, 0])
+    np.testing.assert_allclose(a.numpy(), 3.0 * data)
+    out = ht.zeros_like(b)
+    out.redistribute_(target_map=tm)
+    ht.add(a, b, out=out)  # out= keeps out's own layout
+    assert not out.is_balanced()
+    np.testing.assert_allclose(out.numpy(), 5.0 * data)
+
+
+def test_planar_results_never_adopt(ragged_pair):
+    # complex (planar) results must stay balanced: materializing a ragged
+    # buffer of a planar value would round-trip complex through the host
+    a, _, _, data = ragged_pair
+    f = ht.fft.fft(a, axis=1)
+    res = f * a if f._planar is not None else None
+    if res is not None and res._planar is not None:
+        assert res.is_balanced()
+
+
+def test_balance_drops_adopted_layout(ragged_pair):
+    a, b, _, data = ragged_pair
+    res = a + b
+    res.balance_()
+    assert res.is_balanced()
+    np.testing.assert_allclose(res.numpy(), 3.0 * data)

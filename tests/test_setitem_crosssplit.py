@@ -1,0 +1,117 @@
+"""Cross-split setitem value-distribution grid (VERDICT r4 #6, second
+family): the full (target split) x (value split) x (key kind) matrix from
+the reference's setitem battery (heat/core/tests/test_dndarray.py), where
+the VALUE being assigned is itself distributed differently from the
+target.  Complements tests/test_setitem_widening.py (key-shape corners)
+with the distribution grid.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+TARGET_SPLITS = [None, 0, 1]
+VALUE_SPLITS = [None, 0, 1]
+
+
+def _fresh(split):
+    base = np.arange(9 * 12, dtype=np.float32).reshape(9, 12)
+    return ht.array(base.copy(), split=split), base.copy()
+
+
+KEYS = [
+    ("full", (slice(None), slice(None)), (9, 12)),
+    ("rows", (slice(2, 7), slice(None)), (5, 12)),
+    ("cols", (slice(None), slice(3, 10)), (9, 7)),
+    ("block", (slice(1, 8), slice(2, 11)), (7, 9)),
+    ("strided", (slice(0, 9, 2), slice(1, 12, 3)), (5, 4)),
+    ("row", (4, slice(None)), (12,)),
+]
+
+
+@pytest.mark.parametrize("tsplit", TARGET_SPLITS)
+@pytest.mark.parametrize("vsplit", VALUE_SPLITS)
+def test_distributed_value_grid(tsplit, vsplit):
+    rng = np.random.default_rng(0)
+    for name, key, vshape in KEYS:
+        x, base = _fresh(tsplit)
+        val = rng.standard_normal(vshape).astype(np.float32)
+        vs = vsplit if vsplit is None or vsplit < len(vshape) else None
+        x[key] = ht.array(val, split=vs)
+        base[key] = val
+        np.testing.assert_array_equal(
+            x.numpy(), base, err_msg=f"{name}: target={tsplit} value={vsplit}"
+        )
+
+
+@pytest.mark.parametrize("tsplit", TARGET_SPLITS)
+def test_value_kinds(tsplit):
+    rng = np.random.default_rng(1)
+    val = rng.standard_normal((5, 12)).astype(np.float32)
+    for kind, v in [
+        ("numpy", val),
+        ("list", val.tolist()),
+        ("scalar", 7.25),
+        ("0d", np.float32(3.5)),
+    ]:
+        x, base = _fresh(tsplit)
+        x[2:7] = v
+        base[2:7] = v
+        np.testing.assert_allclose(x.numpy(), base, err_msg=f"{kind} target={tsplit}")
+
+
+@pytest.mark.parametrize("tsplit", TARGET_SPLITS)
+@pytest.mark.parametrize("vsplit", TARGET_SPLITS)
+def test_broadcast_value_distributions(tsplit, vsplit):
+    rng = np.random.default_rng(2)
+    row = rng.standard_normal((12,)).astype(np.float32)
+    x, base = _fresh(tsplit)
+    vs = vsplit if vsplit in (None, 0) else None
+    x[3:8] = ht.array(row, split=vs)  # (12,) broadcast over 5 rows
+    base[3:8] = row
+    np.testing.assert_array_equal(x.numpy(), base)
+
+
+@pytest.mark.parametrize("tsplit", TARGET_SPLITS)
+@pytest.mark.parametrize("vsplit", TARGET_SPLITS)
+def test_uneven_extents_cross_split(tsplit, vsplit):
+    # 13 x 10 does not divide the 8-device mesh on either axis
+    base = np.zeros((13, 10), np.float32)
+    x = ht.array(base.copy(), split=tsplit)
+    val = np.arange(6 * 10, dtype=np.float32).reshape(6, 10)
+    x[4:10] = ht.array(val, split=vsplit)
+    base[4:10] = val
+    np.testing.assert_array_equal(x.numpy(), base)
+    counts, _ = (x.counts_displs() if tsplit is not None else ((), ()))
+    if tsplit is not None:
+        assert sum(counts) == 13 if tsplit == 0 else 10
+
+
+@pytest.mark.parametrize("tsplit", TARGET_SPLITS)
+def test_boolean_and_fancy_with_distributed_values(tsplit):
+    x, base = _fresh(tsplit)
+    mask = base[:, 0] > 40.0
+    val = np.full((int(mask.sum()), 12), -1.0, np.float32)
+    x[ht.array(mask, split=0 if tsplit == 0 else None)] = ht.array(
+        val, split=0 if tsplit == 0 else None
+    )
+    base[mask] = val
+    np.testing.assert_array_equal(x.numpy(), base)
+
+    x2, base2 = _fresh(tsplit)
+    idx = np.asarray([0, 3, 8])
+    val2 = np.full((3, 12), 5.0, np.float32)
+    x2[ht.array(idx)] = ht.array(val2, split=None)
+    base2[idx] = val2
+    np.testing.assert_array_equal(x2.numpy(), base2)
+
+
+@pytest.mark.parametrize("tsplit", TARGET_SPLITS)
+def test_dtype_cast_cross_split(tsplit):
+    x, base = _fresh(tsplit)
+    # f64 values into an f32 target: cast-on-write, numpy semantics
+    val = (np.arange(5 * 12, dtype=np.float64).reshape(5, 12) + 0.5)
+    x[0:5] = ht.array(val, split=0)
+    base[0:5] = val.astype(np.float32)
+    np.testing.assert_array_equal(x.numpy(), base)

@@ -89,8 +89,14 @@ class TestArithmetics:
         c = ht.sparse.sparse_csc_matrix(sp.csc_matrix(B))
         with pytest.raises(TypeError):
             a + c  # mixed formats (reference raises too)
-        with pytest.raises(TypeError):
-            a + 1.0
+        # scalar add applies to the stored values only (reference
+        # sparse/_operations.py:91-99), NOT a densifying numpy-style add
+        s = a + 1.0
+        want = sp.csr_matrix(A).copy()
+        want.data = want.data + 1.0
+        np.testing.assert_allclose(s.toarray(), want.toarray())
+        s2 = 1.0 + a  # __radd__
+        np.testing.assert_allclose(s2.toarray(), want.toarray())
         small = ht.sparse.sparse_csr_matrix(sp.csr_matrix(A[:3]))
         with pytest.raises(ValueError):
             a + small

@@ -1,0 +1,110 @@
+"""Scale-honesty of the r5 sparse programs (VERDICT r4 #2 / weak #4-6).
+
+Pins the three r5 guarantees at the program level, not just by value:
+the CSR SpMM never materializes a full replica of the dense operand (its
+HLO carries a collective-permute ring and no all-gather of X), the
+None<->split re-chunk runs on device (planes in, planes out, correct in
+both directions), and sparse@sparse flows through the same programs (its
+memory bound is the result's per-device dense row block, documented at
+``_spgemm``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import heat_tpu as ht
+from heat_tpu.sparse import _planes as _pl
+
+
+@pytest.fixture(scope="module")
+def mats():
+    a = sp.random(400, 300, density=0.03, random_state=11, format="csr", dtype=np.float64)
+    b = sp.random(300, 50, density=0.05, random_state=12, format="csr", dtype=np.float64)
+    return a, b
+
+
+def test_csr_ring_spmm_hlo_has_no_allgather(mats):
+    a_sp, _ = mats
+    a = ht.sparse.sparse_csr_matrix(a_sp, split=0)
+    x = ht.random.randn(300, 50, split=0).astype(ht.float64)
+    k_pad = a.comm.padded_extent(300)
+    prog = _pl._spmm_comp_rows_ring_prog(
+        a.comm, a._nshards, a._capacity, a._comp_pad, k_pad, 50
+    )
+    hlo = prog.lower(a._comp, a._other, a._val, x.larray_padded).compile().as_text()
+    assert "all-gather" not in hlo, "ring SpMM must not gather X"
+    assert "all-to-all" not in hlo
+    assert "collective-permute" in hlo, "the X ring rides collective-permute"
+
+
+def test_csr_ring_spmm_values(mats):
+    a_sp, _ = mats
+    a = ht.sparse.sparse_csr_matrix(a_sp, split=0)
+    rng = np.random.default_rng(0)
+    xh = rng.standard_normal((300, 50))
+    for xsplit in (0, 1, None):
+        x = ht.array(xh, split=xsplit)
+        got = (a @ x).numpy()
+        np.testing.assert_allclose(got, a_sp @ xh, rtol=1e-10)
+
+
+def test_rechunk_round_trip(mats):
+    a_sp, _ = mats
+    for fmt, ctor in (("csr", ht.sparse.sparse_csr_matrix), ("csc", ht.sparse.sparse_csc_matrix)):
+        src = a_sp.asformat(fmt)
+        dist = ctor(src, split=0 if fmt == "csr" else 1)
+        # split -> None on device
+        from heat_tpu.sparse.arithmetics import _align_split
+
+        rep = _align_split(dist, None)
+        assert rep.split is None
+        np.testing.assert_allclose(rep.toarray(), src.toarray())
+        # planes replicated, sorted, no host numpy types
+        assert isinstance(rep._comp, jax.Array)
+        # None -> split on device
+        back = _align_split(rep, dist.split)
+        assert back.split == dist.split
+        np.testing.assert_allclose(back.toarray(), src.toarray())
+        assert back._lnnz_host == dist._lnnz_host
+        np.testing.assert_array_equal(
+            np.asarray(back._comp), np.asarray(dist._comp)
+        )
+
+
+def test_mixed_split_binary_on_device(mats):
+    a_sp, _ = mats
+    a0 = ht.sparse.sparse_csr_matrix(a_sp, split=0)
+    an = ht.sparse.sparse_csr_matrix(1.5 * a_sp, split=None)
+    res = a0 + an
+    assert res.split == 0
+    np.testing.assert_allclose(res.toarray(), (2.5 * a_sp).toarray(), rtol=1e-12)
+    res2 = an + a0  # aligns a0 to None
+    assert res2.split is None
+    np.testing.assert_allclose(res2.toarray(), (2.5 * a_sp).toarray(), rtol=1e-12)
+
+
+def test_spgemm_values_and_format(mats):
+    a_sp, b_sp = mats
+    want = (a_sp @ b_sp).toarray()
+    a = ht.sparse.sparse_csr_matrix(a_sp, split=0)
+    b = ht.sparse.sparse_csr_matrix(b_sp, split=0)
+    c = a @ b
+    assert isinstance(c, type(a))
+    np.testing.assert_allclose(c.toarray(), want, rtol=1e-10)
+
+
+def test_spgemm_wide_result_stays_sharded(mats):
+    # the per-device bound is the RESULT row block (m/P x n), not m x n:
+    # verify the intermediate/result planes stay sharded over the mesh
+    a_sp = sp.random(800, 600, density=0.01, random_state=1, format="csr")
+    b_sp = sp.random(600, 400, density=0.01, random_state=2, format="csr")
+    a = ht.sparse.sparse_csr_matrix(a_sp, split=0)
+    b = ht.sparse.sparse_csr_matrix(b_sp, split=0)
+    c = a @ b
+    assert len(c._val.sharding.device_set) == a.comm.size
+    np.testing.assert_allclose(
+        c.toarray(), (a_sp @ b_sp).toarray(), rtol=1e-5, atol=1e-6
+    )

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Launch an SPMD heat_tpu program on every host of a Cloud TPU pod slice.
+#
+# On Cloud TPU VMs, the coordinator/topology environment is pre-populated,
+# so the program itself just calls ht.parallel.init() with no arguments
+# (tutorials/hpc/01_pod_bringup.md); launching is "run the same command on
+# every worker", which gcloud does natively:
+#
+#   ./launch_tpu_pod.sh my-pod us-east5-b train.py --epochs 10
+set -euo pipefail
+
+TPU_NAME="$1"; ZONE="$2"; shift 2
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+  --zone "$ZONE" \
+  --worker=all \
+  --command="cd ~/heat_tpu && python $*"
