@@ -84,17 +84,36 @@ def _time_amortized(
     rather than link noise.  If even ``max_iter`` iterations cannot clear
     the floor, raises :class:`MeasurementError` — the caller records an
     explicit error instead of a fabricated number."""
+    def one_window():
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_iter):
+            out = run_once()
+        fetch_scalar(out)
+        return time.perf_counter() - t0
+
     while True:
+        # single probe window decides whether this n_iter clears the
+        # floor; only a passing size pays for the full window set (on a
+        # slow-link session the growth ladder otherwise multiplies the
+        # whole bench by ~3x)
+        probe = one_window()
+        probe_window = max(probe - sync_floor, 0.0)
+        under = probe_window < min_floor_ratio * sync_floor
+        if under and n_iter < max_iter:
+            n_iter = min(n_iter * 4, max_iter)
+            continue
+        # the passing probe is a regular window: seed the sample set with
+        # it so the common no-growth case pays exactly `windows` windows
         samples = []
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            out = None
-            for _ in range(n_iter):
-                out = run_once()
-            fetch_scalar(out)
-            elapsed = time.perf_counter() - t0
+        if probe > sync_floor:
+            samples.append(probe_window / n_iter)
+        while len(samples) < windows:
+            elapsed = one_window()
             if elapsed > sync_floor:
                 samples.append((elapsed - sync_floor) / n_iter)
+            else:  # degenerate link hiccup: count the attempt, move on
+                break
         best = min(samples) if samples else float("inf")
         window = best * n_iter
         ok = samples and window >= min_floor_ratio * sync_floor

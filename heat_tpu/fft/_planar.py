@@ -354,12 +354,25 @@ def _w2_real_in(n: int, m: int, dtype: str):
 
 
 @functools.lru_cache(maxsize=32)
-def _w2_split(n: int, dtype: str):
+def _w2_split(n: int, dtype: str, inverse: bool = False):
     """(2n, n) re and im column blocks of the full interleaved matrix."""
-    W = _w2_full(n, False, dtype)
+    W = _w2_full(n, inverse, dtype)
     return (
         np.ascontiguousarray(W[:, 0::2]),
         np.ascontiguousarray(W[:, 1::2]),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _w2_row_split(n: int, dtype: str, inverse: bool = False):
+    """(n, 2n) row blocks applying the DFT to a SEPARATE re / im plane:
+    out_interleaved = re @ rows_re + im @ rows_im — the plane pair enters
+    the interleaved representation through the first dot, never through a
+    materialized (..., 2) stack (the tiling trap)."""
+    W = _w2_full(n, inverse, dtype)
+    return (
+        np.ascontiguousarray(W[0::2, :]),
+        np.ascontiguousarray(W[1::2, :]),
     )
 
 
@@ -391,26 +404,46 @@ def _revax(a: jax.Array, ax: int) -> jax.Array:
     )
 
 
-def _rfft3_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
-    """Full 3-D spectrum of a real (n0, n1, n2) array, all axes."""
+def _mm_merged(a: jax.Array, w, prec) -> jax.Array:
+    """One matmul along the merged minor dim (the whole DFT stage)."""
+    return jax.lax.dot_general(
+        a.reshape(-1, a.shape[-1]), jnp.asarray(w), (((1,), (0,)), ((), ())),
+        precision=prec,
+    ).reshape(*a.shape[:-1], w.shape[1])
+
+
+def _mid_and_exit(z, n0: int, n1: int, inverse: bool, dt: str, prec):
+    """Shared stage-X / stage-Y / exit pipeline of both interleaved
+    engines: z (lead, n1, 2n0) -> re, im planes (k0, k1, lead)."""
+    lead = int(z.shape[0])
+    z = _mm_merged(z, _w2_full(n0, inverse, dt), prec)  # (lead, n1, 2k0)
+    z = z.reshape(lead, n1, n0, 2).transpose(0, 2, 1, 3).reshape(lead, n0, 2 * n1)
+    wre, wim = _w2_split(n1, dt, inverse)
+    re = _mm_merged(z, wre, prec).transpose(1, 2, 0)  # (k0, k1, lead)
+    im = _mm_merged(z, wim, prec).transpose(1, 2, 0)
+    return re, im
+
+
+def _rfft3_half(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
+    """Half spectrum (k0, k1, n2//2+1) of a real (n0, n1, n2) array —
+    the shared core of fftn (extension follows) and rfftn (this IS the
+    result).  Scaling commutes with the linear Hermitian extension, so
+    it is applied here once."""
     n0, n1, n2 = (int(s) for s in x.shape)
     m2 = n2 // 2 + 1
     dt = str(x.dtype)
     prec = _interleaved_precision()
-
-    def mm(a, w):
-        return jax.lax.dot_general(
-            a.reshape(-1, a.shape[-1]), jnp.asarray(w), (((1,), (0,)), ((), ())),
-            precision=prec,
-        ).reshape(*a.shape[:-1], w.shape[1])
-
-    z = mm(x, _w2_real_in(n2, m2, dt))  # (n0, n1, 2m2)
+    z = _mm_merged(x, _w2_real_in(n2, m2, dt), prec)  # (n0, n1, 2m2)
     z = z.reshape(n0, n1, m2, 2).transpose(2, 1, 0, 3).reshape(m2, n1, 2 * n0)
-    z = mm(z, _w2_full(n0, False, dt))  # (m2, n1, 2k0)
-    z = z.reshape(m2, n1, n0, 2).transpose(0, 2, 1, 3).reshape(m2, n0, 2 * n1)
-    wre, wim = _w2_split(n1, dt)
-    re_lo = mm(z, wre).transpose(1, 2, 0)  # (k0, k1, m2)
-    im_lo = mm(z, wim).transpose(1, 2, 0)
+    re, im = _mid_and_exit(z, n0, n1, False, dt, prec)  # (k0, k1, m2)
+    return _scaled(re, im, scale_factor([n0, n1, n2], norm, False))
+
+
+def _rfft3_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
+    """Full 3-D spectrum of a real (n0, n1, n2) array, all axes."""
+    n2 = int(x.shape[2])
+    m2 = n2 // 2 + 1
+    re_lo, im_lo = _rfft3_half(x, norm)
 
     def upper(p):
         # p[rev(x), rev(y), n2-z] via one roll + one multi-axis lax.rev
@@ -421,7 +454,72 @@ def _rfft3_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
 
     re = jnp.concatenate([re_lo, upper(re_lo)], 2)
     im = jnp.concatenate([im_lo, -upper(im_lo)], 2)
-    return _scaled(re, im, scale_factor([n0, n1, n2], norm, False))
+    return re, im
+
+
+def rfft3_half_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
+    """numpy ``rfftn`` semantics for 3-D real input, all axes: the
+    shared half-spectrum core (:func:`_rfft3_half`) — rfftn stops where
+    fftn's Hermitian extension would begin, so it is strictly cheaper."""
+    return _rfft3_half(x, norm)
+
+
+def irfft3_interleaved(
+    re: jax.Array, im: jax.Array, n_out: int, norm
+) -> jax.Array:
+    """numpy ``irfftn`` semantics: half spectrum (n0, n1, m2) -> real
+    (n0, n1, n_out).  Hermitian-extend the last axis (the cheap
+    roll+rev+concat from the forward engine, run in reverse position),
+    then the inverse pipeline with a REAL-only exit (one dot instead of
+    two: the imaginary output is identically zero and never computed)."""
+    n0, n1, m2 = (int(s) for s in re.shape)
+    dt = str(re.dtype)
+    prec = _interleaved_precision()
+    # extend axis 2 to n_out bins: full[.., k] = conj(full[rev0, rev1, n_out-k])
+    lo_len = min(m2, n_out // 2 + 1)
+    re_l, im_l = (p[:, :, :lo_len] for p in (re, im))
+    if lo_len < n_out // 2 + 1:  # short input: zero-pad like numpy _fit
+        pad = [(0, 0), (0, 0), (0, n_out // 2 + 1 - lo_len)]
+        re_l, im_l = jnp.pad(re_l, pad), jnp.pad(im_l, pad)
+        lo_len = n_out // 2 + 1
+
+    def upper(p):
+        u = p[:, :, 1 : n_out - lo_len + 1]
+        return jax.lax.rev(jnp.roll(u, (-1, -1), (0, 1)), (0, 1, 2))
+
+    fre = jnp.concatenate([re_l, upper(re_l)], 2)
+    fim = jnp.concatenate([im_l, -upper(im_l)], 2)
+    # inverse pipeline: entry over axis 2 via row-split, exit REAL-only
+    rrow, irow = _w2_row_split(n_out, dt, True)
+    z = _mm_merged(fre, rrow, prec) + _mm_merged(fim, irow, prec)
+    z = z.reshape(n0, n1, n_out, 2).transpose(2, 1, 0, 3).reshape(n_out, n1, 2 * n0)
+    z = _mm_merged(z, _w2_full(n0, True, dt), prec)
+    z = z.reshape(n_out, n1, n0, 2).transpose(0, 2, 1, 3).reshape(n_out, n0, 2 * n1)
+    wre, _ = _w2_split(n1, dt, True)
+    out = _mm_merged(z, wre, prec).transpose(1, 2, 0)  # (k0, k1, n_out)
+    s = scale_factor([n0, n1, n_out], norm, True)
+    return out * out.dtype.type(s) if s != 1.0 else out
+
+
+def cfft3_interleaved(
+    re: jax.Array, im: jax.Array, inverse: bool, norm
+) -> Tuple[jax.Array, jax.Array]:
+    """Full 3-D transform of a COMPLEX (re, im) plane pair, all axes.
+
+    Same engine as :func:`_rfft3_interleaved` without the Hermitian
+    half-spectrum: the planes enter the interleaved representation
+    through the first dot's row-split matrices and leave it through the
+    last dot's column-split matrices, so no (..., 2) tensor ever
+    materializes."""
+    n0, n1, n2 = (int(s) for s in re.shape)
+    dt = str(re.dtype)
+    prec = _interleaved_precision()
+
+    rrow, irow = _w2_row_split(n2, dt, inverse)
+    z = _mm_merged(re, rrow, prec) + _mm_merged(im, irow, prec)  # (n0, n1, 2k2)
+    z = z.reshape(n0, n1, n2, 2).transpose(2, 1, 0, 3).reshape(n2, n1, 2 * n0)
+    re_o, im_o = _mid_and_exit(z, n0, n1, inverse, dt, prec)  # (k0, k1, k2)
+    return _scaled(re_o, im_o, scale_factor([n0, n1, n2], norm, inverse))
 
 
 def _interleaved_eligible(re: jax.Array, axes) -> bool:

@@ -170,10 +170,25 @@ def _wrap_planar(x: DNDarray, re, im, split) -> DNDarray:
     return DNDarray.from_planar(re, im, gshape, split, x.device, x.comm)
 
 
-@_functools.lru_cache(maxsize=256)
 def _planar_prog(kind: str, norm, axes_ns):
     """One jitted program for a whole transform chain (no eager tails —
-    tunneled links make per-op dispatch the dominant cost)."""
+    tunneled links make per-op dispatch the dominant cost).  The FFT env
+    knobs are part of the cache key: toggling HEAT_TPU_FFT_INTERLEAVED /
+    _PRECISION / _PALLAS mid-process must reach the next call instead of
+    silently returning a program traced under the old configuration."""
+    cfg = tuple(
+        _os.environ.get(k, "")
+        for k in (
+            "HEAT_TPU_FFT_INTERLEAVED",
+            "HEAT_TPU_FFT_PRECISION",
+            "HEAT_TPU_FFT_PALLAS",
+        )
+    )
+    return _planar_prog_cached(kind, norm, axes_ns, cfg)
+
+
+@_functools.lru_cache(maxsize=256)
+def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
 
     def run(re, im):
         if kind in ("fft", "ifft"):
@@ -187,10 +202,36 @@ def _planar_prog(kind: str, norm, axes_ns):
                 # real input, full lengths: half-spectrum + Hermitian
                 # extension saves ~40% of the MXU work
                 return _pl.real_fftn(re, [a for a, _ in axes_ns], norm)
+            if len(axes_ns) == 3 and all(n is None for _, n in axes_ns):
+                axes_l = [a for a, _ in axes_ns]
+                if im is not None and _pl._interleaved_eligible(re, axes_l):
+                    # complex input, full lengths: the interleaved one-
+                    # dot-per-stage engine (fftn -> filter -> ifftn chains
+                    # stay on the fast path, not just the first transform)
+                    return _pl.cfft3_interleaved(re, im, inv, norm)
+                if im is None and inv and _pl._interleaved_eligible(re, axes_l):
+                    # ifftn of a REAL array: conj(fft(x))/N — one real
+                    # forward pass through the half-spectrum engine
+                    fre, fim = _pl.real_fftn(re, axes_l, None)
+                    return _pl._scaled(
+                        fre, -fim,
+                        _pl.scale_factor([re.shape[a] for a in axes_l], norm, True),
+                    )
             for a, n in axes_ns:
                 re, im = _pl.fft1(re, im, a, n, norm, inv)
             return re, im
         if kind in ("rfft", "ihfft"):
+            if (
+                kind == "rfft"
+                and im is None
+                and len(axes_ns) == 3
+                and all(n is None for _, n in axes_ns)
+                and tuple(a for a, _ in axes_ns) == (0, 1, 2)
+                and _pl._interleaved_eligible(re, [0, 1, 2])
+            ):
+                # rfftn: the interleaved engine stopped at the half
+                # spectrum — strictly cheaper than the full transform
+                return _pl.rfft3_half_interleaved(re, norm)
             last_a, last_n = axes_ns[-1]
             op = _pl.rfft1 if kind == "rfft" else _pl.ihfft1
             re, im = op(re, last_a, last_n, norm)
@@ -200,6 +241,18 @@ def _planar_prog(kind: str, norm, axes_ns):
             return re, im
         # irfft / hfft: complex passes first, the real-output op last
         inv = kind == "irfft"
+        if (
+            kind == "irfft"
+            and im is not None
+            and len(axes_ns) == 3
+            and all(n is None for _, n in axes_ns[:-1])
+            and tuple(a for a, _ in axes_ns) == (0, 1, 2)
+            and _pl._interleaved_eligible(re, [0, 1, 2])
+        ):
+            n_out = axes_ns[-1][1]
+            n_out = int(n_out) if n_out is not None else 2 * (re.shape[2] - 1)
+            if n_out >= 2:
+                return _pl.irfft3_interleaved(re, im, n_out, norm), None
         for a, n in axes_ns[:-1]:
             re, im = _pl.fft1(re, im, a, n, norm, inv)
         last_a, last_n = axes_ns[-1]

@@ -45,6 +45,7 @@ NAV = [
     ("Reference", [
         ("API coverage", "coverage_tables.md"),
         ("Changelog", "CHANGELOG.md"),
+        ("Round 5 notes", "docs/round5_notes.md"),
     ]),
 ]
 
