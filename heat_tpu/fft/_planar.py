@@ -440,21 +440,40 @@ def _rfft3_half(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
 
 
 def _rfft3_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
-    """Full 3-D spectrum of a real (n0, n1, n2) array, all axes."""
-    n2 = int(x.shape[2])
-    m2 = n2 // 2 + 1
-    re_lo, im_lo = _rfft3_half(x, norm)
+    """Full 3-D spectrum of a real (n0, n1, n2) array, all axes.
+
+    Unlike :func:`_rfft3_half` (numpy rfftn halves the LAST axis), the
+    full transform may halve ANY axis — halving axis 0 lets the exit
+    dots land the final (k0, k1, k2) orientation directly (no rotate
+    transpose) and turns the Hermitian extension into a LEADING-axis
+    slab concat.  Measured on the bench chip at 512^3: 27.6 ms vs the
+    shared-core-then-extend formulation's 30.5 (13.5 GB scheduled vs
+    16.7); a variant absorbing the k2 reversal into extra rev-column
+    exit dots measured 28.8 — the extra MXU passes cost more than the
+    saved relayout (docs/round5_notes.md)."""
+    n0, n1, n2 = (int(s) for s in x.shape)
+    m0 = n0 // 2 + 1
+    dt = str(x.dtype)
+    prec = _interleaved_precision()
+    W = jnp.asarray(_w2_real_in(n0, m0, dt))
+    z = jax.lax.dot_general(x, W, (((0,), (0,)), ((), ())), precision=prec)
+    z = z.reshape(n1, n2, m0, 2).transpose(2, 1, 0, 3).reshape(m0, n2, 2 * n1)
+    z = _mm_merged(z, _w2_full(n1, False, dt), prec)  # (m0, n2, 2k1)
+    z = z.reshape(m0, n2, n1, 2).transpose(0, 2, 1, 3).reshape(m0, n1, 2 * n2)
+    wre, wim = _w2_split(n2, dt)
+    re_lo = _mm_merged(z, wre, prec)  # (m0, k1, k2)
+    im_lo = _mm_merged(z, wim, prec)
 
     def upper(p):
-        # p[rev(x), rev(y), n2-z] via one roll + one multi-axis lax.rev
-        # (rev = roll o flip); the chained revax/concat formulation of the
-        # same map measured 1.8x slower on the bench chip
-        u = p[:, :, 1 : n2 - m2 + 1]
-        return jax.lax.rev(jnp.roll(u, (-1, -1), (0, 1)), (0, 1, 2))
+        # p[n0-k0, rev(k1), rev(k2)] via one roll + one multi-axis
+        # lax.rev (rev = roll o flip); the chained revax/concat
+        # formulation measured 1.8x slower on the bench chip
+        u = p[1 : n0 - m0 + 1]
+        return jax.lax.rev(jnp.roll(u, (-1, -1), (1, 2)), (0, 1, 2))
 
-    re = jnp.concatenate([re_lo, upper(re_lo)], 2)
-    im = jnp.concatenate([im_lo, -upper(im_lo)], 2)
-    return re, im
+    re = jnp.concatenate([re_lo, upper(re_lo)], 0)
+    im = jnp.concatenate([im_lo, -upper(im_lo)], 0)
+    return _scaled(re, im, scale_factor([n0, n1, n2], norm, False))
 
 
 def rfft3_half_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
@@ -464,41 +483,54 @@ def rfft3_half_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
     return _rfft3_half(x, norm)
 
 
+@functools.lru_cache(maxsize=32)
+def _w_irfft_exit(m_used: int, n_out: int, dtype: str):
+    """(2*m_used, n_out) c2r exit matrix: the Hermitian extension IS the
+    matrix.  out[x] = sum_k w_k (re_k cos(2pi k x / n) - im_k sin(...))
+    with w_k = 2 for interior bins (each conjugate pair contributes
+    twice) and 1 for DC and (even n) Nyquist; the sin rows are zero at
+    DC/Nyquist, reproducing numpy's c2r indifference to those bins'
+    imaginary parts.  Unscaled (norm handled by scale_factor)."""
+    k = np.arange(m_used, dtype=np.float64)
+    x = np.arange(n_out, dtype=np.float64)
+    ang = 2.0 * np.pi * np.outer(k, x) / n_out
+    w = np.full(m_used, 2.0)
+    w[0] = 1.0
+    if n_out % 2 == 0 and m_used == n_out // 2 + 1:
+        w[-1] = 1.0
+    W = np.zeros((m_used, 2, n_out), np.float64)
+    W[:, 0, :] = w[:, None] * np.cos(ang)
+    W[:, 1, :] = -w[:, None] * np.sin(ang)
+    return np.asarray(W.reshape(2 * m_used, n_out), dtype)
+
+
 def irfft3_interleaved(
     re: jax.Array, im: jax.Array, n_out: int, norm
 ) -> jax.Array:
     """numpy ``irfftn`` semantics: half spectrum (n0, n1, m2) -> real
-    (n0, n1, n_out).  Hermitian-extend the last axis (the cheap
-    roll+rev+concat from the forward engine, run in reverse position),
-    then the inverse pipeline with a REAL-only exit (one dot instead of
-    two: the imaginary output is identically zero and never computed)."""
+    (n0, n1, n_out).
+
+    numpy's own composition order (inverse transforms over axes 0, 1
+    FIRST — on the thin half spectrum, half the traffic of extending
+    first — then the 1-D c2r along axis 2), with the Hermitian extension
+    folded into the exit MATRIX (`_w_irfft_exit`): no extension pass, no
+    final rotate, and the real-only output falls out of one dot."""
     n0, n1, m2 = (int(s) for s in re.shape)
     dt = str(re.dtype)
     prec = _interleaved_precision()
-    # extend axis 2 to n_out bins: full[.., k] = conj(full[rev0, rev1, n_out-k])
-    lo_len = min(m2, n_out // 2 + 1)
-    re_l, im_l = (p[:, :, :lo_len] for p in (re, im))
-    if lo_len < n_out // 2 + 1:  # short input: zero-pad like numpy _fit
-        pad = [(0, 0), (0, 0), (0, n_out // 2 + 1 - lo_len)]
-        re_l, im_l = jnp.pad(re_l, pad), jnp.pad(im_l, pad)
-        lo_len = n_out // 2 + 1
-
-    def upper(p):
-        u = p[:, :, 1 : n_out - lo_len + 1]
-        return jax.lax.rev(jnp.roll(u, (-1, -1), (0, 1)), (0, 1, 2))
-
-    fre = jnp.concatenate([re_l, upper(re_l)], 2)
-    fim = jnp.concatenate([im_l, -upper(im_l)], 2)
-    # inverse pipeline: entry over axis 2 via row-split, exit REAL-only
-    rrow, irow = _w2_row_split(n_out, dt, True)
-    z = _mm_merged(fre, rrow, prec) + _mm_merged(fim, irow, prec)
-    z = z.reshape(n0, n1, n_out, 2).transpose(2, 1, 0, 3).reshape(n_out, n1, 2 * n0)
-    z = _mm_merged(z, _w2_full(n0, True, dt), prec)
-    z = z.reshape(n_out, n1, n0, 2).transpose(0, 2, 1, 3).reshape(n_out, n0, 2 * n1)
-    wre, _ = _w2_split(n1, dt, True)
-    out = _mm_merged(z, wre, prec).transpose(1, 2, 0)  # (k0, k1, n_out)
-    s = scale_factor([n0, n1, n_out], norm, True)
-    return out * out.dtype.type(s) if s != 1.0 else out
+    m_used = n_out // 2 + 1
+    re, _ = _fit(re, None, 2, m_used)
+    im, _ = _fit(im, None, 2, m_used)
+    # axis-0 inverse: entry over the minor after a thin pre-transpose
+    reT = re.transpose(1, 2, 0)  # (n1, mu, n0)
+    imT = im.transpose(1, 2, 0)
+    rrow, irow = _w2_row_split(n0, dt, True)
+    z = _mm_merged(reT, rrow, prec) + _mm_merged(imT, irow, prec)  # (n1, mu, 2k0)
+    z = z.reshape(n1, m_used, n0, 2).transpose(2, 1, 0, 3).reshape(n0, m_used, 2 * n1)
+    z = _mm_merged(z, _w2_full(n1, True, dt), prec)  # (k0, mu, 2k1)
+    z = z.reshape(n0, m_used, n1, 2).transpose(0, 2, 1, 3).reshape(n0, n1, 2 * m_used)
+    out = _mm_merged(z, _w_irfft_exit(m_used, n_out, dt), prec)  # (k0, k1, n_out)
+    return _scaled(out, None, scale_factor([n0, n1, n_out], norm, True))[0]
 
 
 def cfft3_interleaved(
@@ -522,13 +554,81 @@ def cfft3_interleaved(
     return _scaled(re_o, im_o, scale_factor([n0, n1, n2], norm, inverse))
 
 
+# ----------------------------------------------------------------------
+# 2-D variants of the same engine (entry dot -> one re-pair transpose ->
+# exit dots; extension/c2r folded like the 3-D paths)
+# ----------------------------------------------------------------------
+def cfft2_interleaved(re, im, inverse: bool, norm):
+    """Full 2-D transform of a complex plane pair, both axes."""
+    n0, n1 = (int(s) for s in re.shape)
+    dt = str(re.dtype)
+    prec = _interleaved_precision()
+    reT, imT = re.T, im.T  # (n1, n0): entry over axis 0
+    rrow, irow = _w2_row_split(n0, dt, inverse)
+    z = _mm_merged(reT, rrow, prec) + _mm_merged(imT, irow, prec)  # (n1, 2k0)
+    z = z.reshape(n1, n0, 2).transpose(1, 0, 2).reshape(n0, 2 * n1)
+    wre, wim = _w2_split(n1, dt, inverse)
+    re_o = _mm_merged(z, wre, prec)  # (k0, k1)
+    im_o = _mm_merged(z, wim, prec)
+    return _scaled(re_o, im_o, scale_factor([n0, n1], norm, inverse))
+
+
+def rfft2_half_interleaved(x, norm):
+    """numpy ``rfft2``: real (n0, n1) -> (k0, n1//2+1)."""
+    n0, n1 = (int(s) for s in x.shape)
+    m1 = n1 // 2 + 1
+    dt = str(x.dtype)
+    prec = _interleaved_precision()
+    z = _mm_merged(x, _w2_real_in(n1, m1, dt), prec)  # (n0, 2m1)
+    z = z.reshape(n0, m1, 2).transpose(1, 0, 2).reshape(m1, 2 * n0)
+    wre, wim = _w2_split(n0, dt)
+    re = _mm_merged(z, wre, prec).T  # (k0, m1)
+    im = _mm_merged(z, wim, prec).T
+    return _scaled(re, im, scale_factor([n0, n1], norm, False))
+
+
+def rfft2_full_interleaved(x, norm):
+    """Full 2-D spectrum of a real array: half + Hermitian extension
+    along the minor axis (full[x, k] = conj(full[rev x, n1-k]))."""
+    n0, n1 = (int(s) for s in x.shape)
+    m1 = n1 // 2 + 1
+    re_lo, im_lo = rfft2_half_interleaved(x, norm)
+
+    def upper(p):
+        u = p[:, 1 : n1 - m1 + 1]
+        return jax.lax.rev(jnp.roll(u, -1, 0), (0, 1))
+
+    re = jnp.concatenate([re_lo, upper(re_lo)], 1)
+    im = jnp.concatenate([im_lo, -upper(im_lo)], 1)
+    return re, im
+
+
+def irfft2_interleaved(re, im, n_out: int, norm):
+    """numpy ``irfft2``: half spectrum (n0, m1) -> real (n0, n_out),
+    numpy's inverse-then-c2r order with the c2r exit matrix."""
+    n0, m1 = (int(s) for s in re.shape)
+    dt = str(re.dtype)
+    prec = _interleaved_precision()
+    m_used = n_out // 2 + 1
+    re, _ = _fit(re, None, 1, m_used)
+    im, _ = _fit(im, None, 1, m_used)
+    reT, imT = re.T, im.T  # (mu, n0): entry over axis 0
+    rrow, irow = _w2_row_split(n0, dt, True)
+    z = _mm_merged(reT, rrow, prec) + _mm_merged(imT, irow, prec)  # (mu, 2k0)
+    z = z.reshape(m_used, n0, 2).transpose(1, 0, 2).reshape(n0, 2 * m_used)
+    out = _mm_merged(z, _w_irfft_exit(m_used, n_out, dt), prec)  # (k0, n_out)
+    return _scaled(out, None, scale_factor([n0, n_out], norm, True))[0]
+
+
 def _interleaved_eligible(re: jax.Array, axes) -> bool:
     if os.environ.get("HEAT_TPU_FFT_INTERLEAVED", "1") != "1":
         return False
+    nd = re.ndim
     return (
-        re.ndim == 3
+        nd in (2, 3)
+        and len(axes) == nd
         and re.dtype == jnp.float32
-        and sorted(a % 3 for a in axes) == [0, 1, 2]
+        and sorted(a % nd for a in axes) == list(range(nd))
         and all(int(s) >= 2 for s in re.shape)
     )
 
@@ -542,9 +642,11 @@ def real_fftn(re: jax.Array, axes: Sequence[int], norm) -> Tuple[jax.Array, jax.
     conjugated reverse-gather — one bandwidth pass.  The 3-D all-axes f32
     case takes the interleaved one-dot-per-stage path above (2.6x fewer
     scheduled bytes, measured; axis order is irrelevant for a separable
-    full-length transform)."""
+    full-length transform); the 2-D all-axes case its two-stage variant."""
     if _interleaved_eligible(re, axes):
-        return _rfft3_interleaved(re, norm)
+        if re.ndim == 3:
+            return _rfft3_interleaved(re, norm)
+        return rfft2_full_interleaved(re, norm)
     axes = [a % re.ndim for a in axes]
     al = axes[-1]
     n = re.shape[al]

@@ -202,13 +202,15 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
                 # real input, full lengths: half-spectrum + Hermitian
                 # extension saves ~40% of the MXU work
                 return _pl.real_fftn(re, [a for a, _ in axes_ns], norm)
-            if len(axes_ns) == 3 and all(n is None for _, n in axes_ns):
+            if len(axes_ns) in (2, 3) and all(n is None for _, n in axes_ns):
                 axes_l = [a for a, _ in axes_ns]
                 if im is not None and _pl._interleaved_eligible(re, axes_l):
                     # complex input, full lengths: the interleaved one-
                     # dot-per-stage engine (fftn -> filter -> ifftn chains
                     # stay on the fast path, not just the first transform)
-                    return _pl.cfft3_interleaved(re, im, inv, norm)
+                    if re.ndim == 3:
+                        return _pl.cfft3_interleaved(re, im, inv, norm)
+                    return _pl.cfft2_interleaved(re, im, inv, norm)
                 if im is None and inv and _pl._interleaved_eligible(re, axes_l):
                     # ifftn of a REAL array: conj(fft(x))/N — one real
                     # forward pass through the half-spectrum engine
@@ -224,14 +226,16 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
             if (
                 kind == "rfft"
                 and im is None
-                and len(axes_ns) == 3
+                and len(axes_ns) in (2, 3)
                 and all(n is None for _, n in axes_ns)
-                and tuple(a for a, _ in axes_ns) == (0, 1, 2)
-                and _pl._interleaved_eligible(re, [0, 1, 2])
+                and tuple(a for a, _ in axes_ns) == tuple(range(len(axes_ns)))
+                and _pl._interleaved_eligible(re, [a for a, _ in axes_ns])
             ):
-                # rfftn: the interleaved engine stopped at the half
+                # rfftn/rfft2: the interleaved engine stopped at the half
                 # spectrum — strictly cheaper than the full transform
-                return _pl.rfft3_half_interleaved(re, norm)
+                if re.ndim == 3:
+                    return _pl.rfft3_half_interleaved(re, norm)
+                return _pl.rfft2_half_interleaved(re, norm)
             last_a, last_n = axes_ns[-1]
             op = _pl.rfft1 if kind == "rfft" else _pl.ihfft1
             re, im = op(re, last_a, last_n, norm)
@@ -244,15 +248,17 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
         if (
             kind == "irfft"
             and im is not None
-            and len(axes_ns) == 3
+            and len(axes_ns) in (2, 3)
             and all(n is None for _, n in axes_ns[:-1])
-            and tuple(a for a, _ in axes_ns) == (0, 1, 2)
-            and _pl._interleaved_eligible(re, [0, 1, 2])
+            and tuple(a for a, _ in axes_ns) == tuple(range(len(axes_ns)))
+            and _pl._interleaved_eligible(re, [a for a, _ in axes_ns])
         ):
             n_out = axes_ns[-1][1]
-            n_out = int(n_out) if n_out is not None else 2 * (re.shape[2] - 1)
+            n_out = int(n_out) if n_out is not None else 2 * (re.shape[-1] - 1)
             if n_out >= 2:
-                return _pl.irfft3_interleaved(re, im, n_out, norm), None
+                if re.ndim == 3:
+                    return _pl.irfft3_interleaved(re, im, n_out, norm), None
+                return _pl.irfft2_interleaved(re, im, n_out, norm), None
         for a, n in axes_ns[:-1]:
             re, im = _pl.fft1(re, im, a, n, norm, inv)
         last_a, last_n = axes_ns[-1]

@@ -118,6 +118,40 @@ def test_rfftn_irfftn_interleaved(shape):
         os.environ.pop("HEAT_TPU_PLANAR", None)
 
 
+@pytest.mark.parametrize("shape", [(24, 18), (13, 9), (8, 8)])
+def test_2d_engine_all_kinds(shape):
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(shape).astype(np.float32)
+    os.environ["HEAT_TPU_PLANAR"] = "1"
+    try:
+        f = ht.fft.fft2(ht.array(x))
+        want = np.fft.fft2(x)
+        np.testing.assert_allclose(
+            np.asarray(f.numpy()), want, atol=1e-4 * np.abs(want).max(), rtol=1e-3
+        )
+        b = ht.fft.ifft2(f)
+        np.testing.assert_allclose(np.asarray(b.numpy()).real, x, atol=6e-4)
+        rf = ht.fft.rfft2(ht.array(x))
+        wrf = np.fft.rfft2(x)
+        np.testing.assert_allclose(
+            np.asarray(rf.numpy()), wrf, atol=1e-4 * np.abs(wrf).max(), rtol=1e-3
+        )
+        rb = ht.fft.irfft2(rf)
+        np.testing.assert_allclose(np.asarray(rb.numpy()), np.fft.irfft2(wrf), atol=6e-4)
+        m1 = shape[1] // 2 + 1
+        carr = (
+            rng.standard_normal((shape[0], m1))
+            + 1j * rng.standard_normal((shape[0], m1))
+        ).astype(np.complex64)
+        got = ht.fft.irfft2(ht.array(carr))
+        np.testing.assert_allclose(
+            np.asarray(got.numpy()), np.fft.irfft2(carr),
+            atol=3e-5 * max(1.0, np.abs(carr).max()), rtol=1e-3,
+        )
+    finally:
+        os.environ.pop("HEAT_TPU_PLANAR", None)
+
+
 def test_env_gate_and_fallback_agree():
     rng = np.random.default_rng(5)
     x = rng.standard_normal((12, 8, 10)).astype(np.float32)
