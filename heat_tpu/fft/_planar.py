@@ -519,8 +519,7 @@ def irfft3_interleaved(
     dt = str(re.dtype)
     prec = _interleaved_precision()
     m_used = n_out // 2 + 1
-    re, _ = _fit(re, None, 2, m_used)
-    im, _ = _fit(im, None, 2, m_used)
+    re, im = _fit(re, im, 2, m_used)
     # axis-0 inverse: entry over the minor after a thin pre-transpose
     reT = re.transpose(1, 2, 0)  # (n1, mu, n0)
     imT = im.transpose(1, 2, 0)
@@ -610,8 +609,7 @@ def irfft2_interleaved(re, im, n_out: int, norm):
     dt = str(re.dtype)
     prec = _interleaved_precision()
     m_used = n_out // 2 + 1
-    re, _ = _fit(re, None, 1, m_used)
-    im, _ = _fit(im, None, 1, m_used)
+    re, im = _fit(re, im, 1, m_used)
     reT, imT = re.T, im.T  # (mu, n0): entry over axis 0
     rrow, irow = _w2_row_split(n0, dt, True)
     z = _mm_merged(reT, rrow, prec) + _mm_merged(imT, irow, prec)  # (mu, 2k0)
