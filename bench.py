@@ -108,12 +108,16 @@ def _time_amortized(
         samples = []
         if probe > sync_floor:
             samples.append(probe_window / n_iter)
-        while len(samples) < windows:
+        attempts = 0
+        while len(samples) < windows and attempts < 3 * windows:
+            attempts += 1
             elapsed = one_window()
             if elapsed > sync_floor:
                 samples.append((elapsed - sync_floor) / n_iter)
-            else:  # degenerate link hiccup: count the attempt, move on
-                break
+            # a window at/below the sync floor is a link hiccup: skip it
+            # and keep measuring (bounded retries — a dead link must not
+            # loop forever, and an underfull sample set fails the floor
+            # checks below rather than publishing a 1-window "spread")
         best = min(samples) if samples else float("inf")
         window = best * n_iter
         ok = samples and window >= min_floor_ratio * sync_floor

@@ -1,0 +1,24 @@
+"""Shared environment-knob parsing (single source for the precision
+tables that the FFT and hsvd layers both expose)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_PRECISION_TABLE = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}
+
+
+def precision_from_env(var: str, default: str):
+    """``jax.lax.Precision`` from an env var with a diagnostic error."""
+    name = os.environ.get(var, default).strip().lower()
+    if name not in _PRECISION_TABLE:
+        raise ValueError(
+            f"{var}={os.environ.get(var)!r}: expected one of {sorted(_PRECISION_TABLE)}"
+        )
+    return _PRECISION_TABLE[name]

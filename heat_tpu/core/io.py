@@ -363,6 +363,10 @@ if __NETCDF:
             raise ValueError(f"mode must be 'w', 'a' or 'r+', got {mode!r}")
         dims = _nc_dim_names(data, dimension_names, variable)
         values = data.numpy()
+        if values.ndim == 0:
+            # 0-d arrays persist as a length-1 dimension (netCDF has no
+            # true scalars in the classic model; mirrors np.atleast_1d)
+            values = values.reshape(1)
         if jax.process_index() != 0:
             return
         if __NETCDF_BACKEND == "netcdf4":

@@ -85,8 +85,22 @@ def hsvd(
 from functools import partial as _partial
 
 
-@_partial(jax.jit, static_argnames=("trunc", "p", "no_of_merges", "syrk_ok"))
-def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int, syrk_ok: bool = False):
+def _hsvd_env_cfg() -> tuple:
+    """The hsvd env knobs as a static jit-cache key component: toggling
+    HEAT_TPU_HSVD_PRECISION / _SYRK mid-process must reach the next call
+    instead of hitting a program traced under the old setting."""
+    import os
+
+    return (
+        os.environ.get("HEAT_TPU_HSVD_PRECISION", ""),
+        os.environ.get("HEAT_TPU_HSVD_SYRK", ""),
+    )
+
+
+@_partial(
+    jax.jit, static_argnames=("trunc", "p", "no_of_merges", "syrk_ok", "env_cfg")
+)
+def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int, syrk_ok: bool = False, env_cfg: tuple = ()):
     """The whole hierarchical factorization as ONE compiled program —
     eager op-by-op dispatch of the same pipeline measures ~7x slower
     through a remote chip.  Returns (u_fin (m, w), s_fin (w,), v_fin
@@ -97,9 +111,11 @@ def _hsvd_core(dense: jnp.ndarray, trunc: int, p: int, no_of_merges: int, syrk_o
 
 @_partial(
     jax.jit,
-    static_argnames=("trunc", "p", "no_of_merges", "k", "compute_v", "dtype_name", "syrk_ok"),
+    static_argnames=(
+        "trunc", "p", "no_of_merges", "k", "compute_v", "dtype_name", "syrk_ok", "env_cfg",
+    ),
 )
-def _hsvd_rank_jit(dense, trunc: int, p: int, no_of_merges: int, k: int, compute_v: bool, dtype_name: str, syrk_ok: bool = False):
+def _hsvd_rank_jit(dense, trunc: int, p: int, no_of_merges: int, k: int, compute_v: bool, dtype_name: str, syrk_ok: bool = False, env_cfg: tuple = ()):
     """Fixed-rank hsvd INCLUDING the cast, the rank-k truncation and the
     error estimate — one device program, zero per-call eager dispatches.
     The eager version of this tail (astype + four slices + two reductions
@@ -243,7 +259,7 @@ def _hsvd(
         k = min(maxrank, trunc)
         outs = _hsvd_rank_jit(
             A._dense(), trunc, p, no_of_merges, k, compute_sv, str(jnp.dtype(dtype)),
-            syrk_ok=comm.size == 1,
+            syrk_ok=comm.size == 1, env_cfg=_hsvd_env_cfg(),
         )
         U = DNDarray.from_dense(outs[0], A.split if A.split == 0 else None, A.device, comm)
         if compute_sv:
@@ -256,7 +272,8 @@ def _hsvd(
 
     dense = A._dense().astype(dtype)
     u_fin, s_fin, v_fin, discarded_sq, total_sq = _hsvd_core(
-        dense, trunc, p, no_of_merges, syrk_ok=comm.size == 1
+        dense, trunc, p, no_of_merges, syrk_ok=comm.size == 1,
+        env_cfg=_hsvd_env_cfg(),
     )
 
     # rtol path: smallest k with (energy discarded by leaf/merge
@@ -316,19 +333,9 @@ def _gram_precision():
     (VERDICT r4 #4's sanctioned bf16-accumulate move).  Every non-Gram
     matmul in the pipeline stays HIGHEST; set HEAT_TPU_HSVD_PRECISION=
     highest to force full f32 throughout."""
-    import os
+    from .._env import precision_from_env
 
-    name = os.environ.get("HEAT_TPU_HSVD_PRECISION", "high").strip().lower()
-    table = {
-        "default": jax.lax.Precision.DEFAULT,
-        "high": jax.lax.Precision.HIGH,
-        "highest": jax.lax.Precision.HIGHEST,
-    }
-    if name not in table:
-        raise ValueError(
-            f"HEAT_TPU_HSVD_PRECISION={name!r}: expected one of {sorted(table)}"
-        )
-    return table[name]
+    return precision_from_env("HEAT_TPU_HSVD_PRECISION", "high")
 
 
 def _gram_orthonormalize(y: jnp.ndarray, passes: int = 2) -> jnp.ndarray:

@@ -377,20 +377,9 @@ def _w2_row_split(n: int, dtype: str, inverse: bool = False):
 
 
 def _interleaved_precision():
-    name = os.environ.get("HEAT_TPU_FFT_PRECISION")
-    if name is None:
-        return jax.lax.Precision.HIGH
-    table = {
-        "default": jax.lax.Precision.DEFAULT,
-        "high": jax.lax.Precision.HIGH,
-        "highest": jax.lax.Precision.HIGHEST,
-    }
-    key = name.strip().lower()
-    if key not in table:
-        raise ValueError(
-            f"HEAT_TPU_FFT_PRECISION={name!r}: expected one of {sorted(table)}"
-        )
-    return table[key]
+    from ..core._env import precision_from_env
+
+    return precision_from_env("HEAT_TPU_FFT_PRECISION", "high")
 
 
 def _revax(a: jax.Array, ax: int) -> jax.Array:

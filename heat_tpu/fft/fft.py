@@ -224,18 +224,24 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
             return re, im
         if kind in ("rfft", "ihfft"):
             if (
-                kind == "rfft"
-                and im is None
+                im is None
                 and len(axes_ns) in (2, 3)
                 and all(n is None for _, n in axes_ns)
                 and tuple(a for a, _ in axes_ns) == tuple(range(len(axes_ns)))
                 and _pl._interleaved_eligible(re, [a for a, _ in axes_ns])
             ):
                 # rfftn/rfft2: the interleaved engine stopped at the half
-                # spectrum — strictly cheaper than the full transform
-                if re.ndim == 3:
-                    return _pl.rfft3_half_interleaved(re, norm)
-                return _pl.rfft2_half_interleaved(re, norm)
+                # spectrum — strictly cheaper than the full transform.
+                # ihfftn rides the same pass: conj(rfftn)/N (inverse
+                # transforms conj-commute axis by axis)
+                half = (
+                    _pl.rfft3_half_interleaved if re.ndim == 3 else _pl.rfft2_half_interleaved
+                )
+                if kind == "rfft":
+                    return half(re, norm)
+                fre, fim = half(re, None)
+                s = _pl.scale_factor(list(re.shape), norm, True)
+                return _pl._scaled(fre, -fim, s)
             last_a, last_n = axes_ns[-1]
             op = _pl.rfft1 if kind == "rfft" else _pl.ihfft1
             re, im = op(re, last_a, last_n, norm)
@@ -246,8 +252,7 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
         # irfft / hfft: complex passes first, the real-output op last
         inv = kind == "irfft"
         if (
-            kind == "irfft"
-            and im is not None
+            im is not None
             and len(axes_ns) in (2, 3)
             and all(n is None for _, n in axes_ns[:-1])
             and tuple(a for a, _ in axes_ns) == tuple(range(len(axes_ns)))
@@ -256,9 +261,17 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
             n_out = axes_ns[-1][1]
             n_out = int(n_out) if n_out is not None else 2 * (re.shape[-1] - 1)
             if n_out >= 2:
-                if re.ndim == 3:
-                    return _pl.irfft3_interleaved(re, im, n_out, norm), None
-                return _pl.irfft2_interleaved(re, im, n_out, norm), None
+                ir = (
+                    _pl.irfft3_interleaved if re.ndim == 3 else _pl.irfft2_interleaved
+                )
+                if kind == "irfft":
+                    return ir(re, im, n_out, norm), None
+                # hfftn = irfftn(conj a) * N with forward-family norms:
+                # run the c2r engine unscaled, apply hfft's own family
+                lengths = list(re.shape[:-1]) + [n_out]
+                out = ir(re, -im, n_out, "forward")  # inverse-forward = x1
+                s = _pl.scale_factor(lengths, norm, False)
+                return _pl._scaled(out, None, s)[0], None
         for a, n in axes_ns[:-1]:
             re, im = _pl.fft1(re, im, a, n, norm, inv)
         last_a, last_n = axes_ns[-1]

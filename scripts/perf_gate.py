@@ -37,13 +37,17 @@ def gate(committed: dict, current: dict, margin_pct: float) -> int:
             + float(cur.get("spread_pct", 0.0))
             + margin_pct
         )
-        threshold = max(want * (1.0 - slack / 100.0), 0.5 * want)
-        status = "ok" if got >= threshold else "FAIL"
+        hard = 0.5 * want
+        threshold = max(want * (1.0 - slack / 100.0), hard)
+        # strict at the clamp: an exactly-2x slowdown must fail even when
+        # the accumulated slack reaches 50%
+        failed = got < threshold or got <= hard
+        status = "FAIL" if failed else "ok"
         print(
             f"{name}: committed {want:.4f} current {got:.4f} "
             f"threshold {threshold:.4f} [{status}]"
         )
-        if got < threshold:
+        if failed:
             failures.append(
                 f"{name}: {got:.4f} < {threshold:.4f} "
                 f"(committed {want:.4f}, slack {slack:.0f}%)"

@@ -152,6 +152,37 @@ def test_2d_engine_all_kinds(shape):
         os.environ.pop("HEAT_TPU_PLANAR", None)
 
 
+@pytest.mark.parametrize("shape", [(12, 10, 9), (8, 6)])
+@pytest.mark.parametrize("norm", NORMS)
+def test_hfftn_ihfftn_engine(shape, norm):
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal(shape).astype(np.float32)
+    os.environ["HEAT_TPU_PLANAR"] = "1"
+    try:
+        got = ht.fft.ihfftn(ht.array(x), norm=norm)
+        want = np.fft.ihfft(x, axis=-1, norm=norm)
+        for ax in range(len(shape) - 1):
+            want = np.fft.ifft(want, axis=ax, norm=norm)
+        np.testing.assert_allclose(np.asarray(got.numpy()), want, atol=2e-5, rtol=1e-3)
+
+        m = shape[-1]
+        carr = (
+            rng.standard_normal(shape[:-1] + (m,))
+            + 1j * rng.standard_normal(shape[:-1] + (m,))
+        ).astype(np.complex64)
+        goth = ht.fft.hfftn(ht.array(carr), norm=norm)
+        wanth = carr.copy()
+        for ax in range(len(shape) - 1):
+            wanth = np.fft.fft(wanth, axis=ax, norm=norm)
+        wanth = np.fft.hfft(wanth, axis=-1, norm=norm)
+        sc = max(np.abs(wanth).max(), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(goth.numpy()), wanth, atol=2e-4 * sc, rtol=1e-3
+        )
+    finally:
+        os.environ.pop("HEAT_TPU_PLANAR", None)
+
+
 def test_env_gate_and_fallback_agree():
     rng = np.random.default_rng(5)
     x = rng.standard_normal((12, 8, 10)).astype(np.float32)

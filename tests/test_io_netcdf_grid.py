@@ -45,6 +45,13 @@ class TestRoundTrip:
             out.numpy(), DATA.astype(np.dtype(dtype.jax_type()))
         )
 
+    def test_0d_scalar(self, nc):
+        # scalars persist as a length-1 dimension (classic-model netCDF
+        # has no true scalars)
+        ht.save_netcdf(ht.array(np.float64(3.5)), nc, "s")
+        out = ht.load_netcdf(nc, "s", dtype=ht.float64)
+        np.testing.assert_array_equal(out.numpy(), [3.5])
+
     def test_1d_and_3d(self, nc):
         for arr in (np.arange(7.0), np.arange(24.0).reshape(2, 3, 4)):
             path = nc + f".{arr.ndim}d.nc"
