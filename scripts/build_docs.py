@@ -43,6 +43,7 @@ NAV = [
         ("3. Training at scale", "tutorials/hpc/03_training_at_scale.md"),
     ]),
     ("Reference", [
+        ("API reference", "docs/api_reference.md"),
         ("API coverage", "coverage_tables.md"),
         ("Changelog", "CHANGELOG.md"),
         ("Round 5 notes", "docs/round5_notes.md"),
@@ -129,6 +130,17 @@ def build(out_dir: str, skip_notebooks: bool) -> int:
     entries = [s for s in NAV]
     if not skip_notebooks:
         entries = entries + [("Notebooks", NOTEBOOKS)]
+
+    api_md = os.path.join(REPO, "docs", "api_reference.md")
+    if not os.path.exists(api_md):
+        # the API reference is a generated artifact: produce it on demand
+        # so the documented one-command invocation works on a fresh clone
+        import subprocess
+
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "build_api_docs.py")],
+            check=True,
+        )
 
     built = 0
     for section, items in entries:
