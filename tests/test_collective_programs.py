@@ -184,9 +184,15 @@ class TestProgramHLOs:
 
 class TestHierarchical:
     def test_two_level_axes(self):
+        import jax
+        import pytest
+
         from heat_tpu.parallel.comm import HierarchicalCommunication
 
-        h = HierarchicalCommunication(grid=(2, 4))
-        assert h.size == 8
+        n = jax.device_count()
+        if n % 2:  # a 2-level grid needs an even device count (mesh-3 CI lane)
+            pytest.skip("hierarchical grid needs an even device count")
+        h = HierarchicalCommunication(grid=(2, n // 2))
+        assert h.size == n
         a = ht.arange(16, split=0, comm=h)
         assert float(a.sum()) == 120.0

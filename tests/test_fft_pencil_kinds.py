@@ -9,6 +9,7 @@ Reference parity: heat/fft/fft.py:66-137 (the pencil covers every kind).
 import os
 import re as _re
 
+import jax
 import numpy as np
 import pytest
 
@@ -28,7 +29,7 @@ def planar_mode():
         del os.environ["HEAT_TPU_PLANAR"]
 
 
-P = 8  # conftest mesh
+P = jax.device_count()  # conftest mesh (8 default; CI sweeps 3)
 TOL = dict(rtol=2e-4, atol=1e-3)
 
 

@@ -27,7 +27,7 @@ def big():
 def test_planes_sharded_over_mesh(big):
     s = ht.sparse.sparse_csr_matrix(big, split=0)
     ndev = s.comm.size
-    assert ndev == 8  # conftest virtual mesh
+    assert ndev == jax.device_count() > 1  # conftest virtual mesh (8 or 3)
     for plane in (s._comp, s._other, s._val, s._lnnz_dev):
         assert isinstance(plane, jax.Array)
         assert len(plane.sharding.device_set) == ndev
@@ -56,7 +56,7 @@ def test_ops_stay_sharded(big):
     a = ht.sparse.sparse_csr_matrix(big, split=0)
     b = ht.sparse.sparse_csr_matrix(other, split=0)
     c = a + b
-    assert len(c._val.sharding.device_set) == 8
+    assert len(c._val.sharding.device_set) == jax.device_count()
     np.testing.assert_allclose(c.toarray(), (big + other).toarray(), rtol=1e-12)
     d = a * b
     np.testing.assert_allclose(d.toarray(), big.multiply(other).toarray(), rtol=1e-12)
@@ -81,7 +81,7 @@ def test_csc_native_split1_compute(big):
     csc = big.tocsc()
     s = ht.sparse.sparse_csc_matrix(csc, split=1)
     assert s.split == 1
-    assert len(s._val.sharding.device_set) == 8
+    assert len(s._val.sharding.device_set) == jax.device_count()
     truth = csc
     np.testing.assert_array_equal(np.asarray(s.indptr), truth.indptr)
     np.testing.assert_array_equal(np.asarray(s.indices), truth.indices)
