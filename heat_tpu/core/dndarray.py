@@ -996,6 +996,29 @@ class DNDarray:
 
         return arithmetics.mod(other, self)
 
+    def __divmod__(self, other):
+        """numpy parity (beyond the reference's operator set):
+        ``divmod(a, b) == (a // b, a % b)`` elementwise."""
+        from . import arithmetics
+
+        return arithmetics.divmod(self, other)
+
+    def __rdivmod__(self, other):
+        from . import arithmetics
+
+        return arithmetics.divmod(other, self)
+
+    def __contains__(self, item) -> bool:
+        """numpy's membership semantics: ``x in a`` is ``(a == x).any()``,
+        with non-comparable items reporting False like numpy (one
+        collective reduce; beyond the reference's surface)."""
+        from . import logical, relational
+
+        try:
+            return bool(logical.any(relational.eq(self, item)))
+        except TypeError:
+            return False
+
     def __pow__(self, other):
         from . import arithmetics
 

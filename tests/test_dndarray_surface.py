@@ -153,3 +153,18 @@ def test_collect_and_resplit_roundtrip(a_np):
 def test_flat_property(a_np):
     x = ht.array(a_np, split=0)
     np.testing.assert_array_equal(np.asarray(list(x.flat)), a_np.ravel())
+
+
+def test_contains_and_divmod_numpy_parity():
+    """numpy membership and divmod semantics (r5 surface additions)."""
+    a = ht.arange(12, split=0).reshape((3, 4))
+    an = np.arange(12).reshape(3, 4)
+    assert (5 in a) is True and (99 in a) is False
+    q, r = divmod(a, 3)
+    qn, rn = divmod(an, 3)
+    np.testing.assert_array_equal(q.numpy(), qn)
+    np.testing.assert_array_equal(r.numpy(), rn)
+    q2, r2 = divmod(20, ht.array([3, 6]))
+    np.testing.assert_array_equal(q2.numpy(), [6, 3])
+    np.testing.assert_array_equal(r2.numpy(), [2, 2])
+    assert ("foo" in a) is False  # non-comparable items: False like numpy
