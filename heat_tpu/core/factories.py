@@ -128,9 +128,12 @@ def array(
     else:
         # canonical defaults: python float data -> float32, ints -> int32,
         # unless the input already carries an explicit wider dtype
-        if isinstance(data, np.ndarray) and data.dtype == np.float64 and not isinstance(obj, np.ndarray):
+        # numpy scalars (np.generic) carry an explicit dtype just like
+        # ndarrays do and keep it; only dtype-less python data narrows
+        explicit = isinstance(obj, (np.ndarray, np.generic))
+        if isinstance(data, np.ndarray) and data.dtype == np.float64 and not explicit:
             data = _as_jax(data, jnp.float32)
-        elif isinstance(data, np.ndarray) and data.dtype == np.int64 and not isinstance(obj, np.ndarray):
+        elif isinstance(data, np.ndarray) and data.dtype == np.int64 and not explicit:
             data = _as_jax(data, jnp.int32)
         else:
             data = _as_jax(data)

@@ -147,6 +147,8 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
 
 def diag(a: DNDarray, offset: int = 0) -> DNDarray:
     """Extract or construct a diagonal (manipulations.py:580)."""
+    if a.ndim not in (1, 2):
+        raise ValueError(f"input must be 1- or 2-dimensional, got {a.ndim}-d")
     if a.ndim == 1:
         result = jnp.diag(a._dense(), k=offset)
         split = 0 if a.split is not None else None
@@ -167,6 +169,8 @@ def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDa
 
 def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
     """Split along axis 2 (manipulations.py:772)."""
+    if x.ndim < 3:
+        raise ValueError("dsplit only works on arrays of 3 or more dimensions")
     return split(x, indices_or_sections, 2)
 
 
@@ -260,6 +264,8 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
     """Repeat elements (manipulations.py:1780)."""
     if isinstance(repeats, DNDarray):
         repeats = repeats._dense()
+    elif isinstance(repeats, (list, tuple, np.ndarray)):
+        repeats = jnp.asarray(repeats)
     result = jnp.repeat(a._dense(), repeats, axis=axis)
     if axis is None:
         split = 0 if a.split is not None else None
@@ -609,4 +615,6 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
 
 def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
     """Split along axis 0 (manipulations.py:4415)."""
+    if x.ndim < 2:
+        raise ValueError("vsplit only works on arrays of 2 or more dimensions")
     return split(x, indices_or_sections, 0)

@@ -1,0 +1,214 @@
+"""Special-value width for the elementwise families: the analog of the
+reference's test_trigonometrics.py / test_exponential.py /
+test_rounding.py / test_logical.py special-case batteries — inf/nan/-0.0
+propagation, domain edges, degree-radian conversions, logaddexp
+stability, clip/round option grids, nan_to_num replacement grids —
+table-compressed against numpy ground truth on the virtual mesh.
+Complements tests/test_arithmetics_grid.py (finite-value op grids).
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+SPECIAL = np.array(
+    [0.0, -0.0, 1.0, -1.0, 0.5, -0.5, np.inf, -np.inf, np.nan, 1e30, -1e30],
+    np.float32,
+)
+
+
+def _cmp(name, got, want, rtol=1e-5):
+    np.testing.assert_allclose(
+        got, want, rtol=rtol, atol=1e-6, equal_nan=True, err_msg=name
+    )
+
+
+# ------------------------------------------------------- trig special values
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_trig_special_value_grid(split):
+    x = ht.array(SPECIAL, split=split)
+    with np.errstate(all="ignore"):
+        for name in ("sin", "cos", "tan", "arcsin", "arccos", "arctan",
+                     "sinh", "cosh", "tanh", "arcsinh", "arctanh"):
+            _cmp(name, getattr(ht, name)(x).numpy(), getattr(np, name)(SPECIAL))
+        # arccosh domain is [1, inf)
+        dom = np.abs(SPECIAL) + 1.0
+        _cmp("arccosh", ht.arccosh(ht.array(dom, split=split)).numpy(), np.arccosh(dom))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_degree_radian_conversions(split):
+    deg = np.array([0.0, 30, 45, 90, 180, 270, 360, -90, 720], np.float32)
+    x = ht.array(deg, split=split)
+    _cmp("deg2rad", ht.deg2rad(x).numpy(), np.deg2rad(deg))
+    _cmp("radians", ht.radians(x).numpy(), np.radians(deg))
+    rad = np.deg2rad(deg)
+    y = ht.array(rad, split=split)
+    _cmp("rad2deg", ht.rad2deg(y).numpy(), np.rad2deg(rad))
+    _cmp("degrees", ht.degrees(y).numpy(), np.degrees(rad))
+    # round trip
+    _cmp("roundtrip", ht.rad2deg(ht.deg2rad(x)).numpy(), deg, rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_arctan2_quadrant_grid(split):
+    ys = np.array([1.0, 1.0, -1.0, -1.0, 0.0, 0.0, 1.0, -1.0], np.float32)
+    xs = np.array([1.0, -1.0, 1.0, -1.0, 1.0, -1.0, 0.0, 0.0], np.float32)
+    got = ht.arctan2(ht.array(ys, split=split), ht.array(xs, split=split))
+    _cmp("arctan2", got.numpy(), np.arctan2(ys, xs))
+
+
+# ------------------------------------------------ exponential special values
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_exponential_special_value_grid(split):
+    x = ht.array(SPECIAL, split=split)
+    with np.errstate(all="ignore"):
+        for name in ("exp", "expm1", "exp2", "sqrt", "square", "log",
+                     "log2", "log10", "log1p"):
+            _cmp(name, getattr(ht, name)(x).numpy(), getattr(np, name)(SPECIAL))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("name", ["logaddexp", "logaddexp2"])
+def test_logaddexp_stability(split, name):
+    # the naive exp-sum-log overflows on these; the stable form must not
+    a = np.array([1000.0, -1000.0, 0.0, 88.0, -88.0], np.float32)
+    b = np.array([1000.0, -999.0, 0.5, 87.0, -89.0], np.float32)
+    got = getattr(ht, name)(ht.array(a, split=split), ht.array(b, split=split))
+    _cmp(name, got.numpy(), getattr(np, name)(a, b), rtol=1e-5)
+    assert np.isfinite(got.numpy()).all()
+
+
+# ---------------------------------------------------- rounding option grids
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_round_decimals_grid(split):
+    vals = np.array([1.25, -1.25, 2.5, -2.5, 0.125, 123.456, -0.0005], np.float32)
+    x = ht.array(vals, split=split)
+    for dec in (0, 1, 2, -1, -2):
+        _cmp(f"round({dec})", ht.round(x, decimals=dec).numpy(), np.round(vals, dec))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_floor_ceil_trunc_special(split):
+    x = ht.array(SPECIAL, split=split)
+    for name in ("floor", "ceil", "trunc"):
+        _cmp(name, getattr(ht, name)(x).numpy(), getattr(np, name)(SPECIAL))
+    # negative-zero signbit must survive trunc/floor of -0.0
+    neg0 = ht.array(np.array([-0.0], np.float32), split=None)
+    assert np.signbit(ht.trunc(neg0).numpy())[0]
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_clip_variant_grid(split):
+    vals = np.linspace(-5, 5, 11).astype(np.float32)
+    x = ht.array(vals, split=split)
+    for lo, hi in ((-2, 2), (None, 1.5), (-1.5, None), (0, 0)):
+        got = ht.clip(x, lo, hi).numpy()
+        _cmp(f"clip({lo},{hi})", got, np.clip(vals, lo, hi))
+    with pytest.raises((ValueError, TypeError)):
+        ht.clip(x, None, None)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_modf_frexp_roundtrip(split):
+    vals = np.array([1.5, -2.25, 0.0, 3.75, -0.5, 1024.5], np.float32)
+    x = ht.array(vals, split=split)
+    frac, integ = ht.modf(x)
+    nfrac, ninteg = np.modf(vals)
+    _cmp("modf frac", frac.numpy(), nfrac)
+    _cmp("modf int", integ.numpy(), ninteg)
+    mant, expo = ht.frexp(x)
+    _cmp("frexp recompose", mant.numpy() * np.exp2(expo.numpy().astype(np.float32)), vals)
+    _cmp("ldexp", ht.ldexp(mant, expo).numpy(), vals)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_sign_sgn_abs_fabs(split):
+    x = ht.array(SPECIAL, split=split)
+    _cmp("sign", ht.sign(x).numpy(), np.sign(SPECIAL))
+    _cmp("fabs", ht.fabs(x).numpy(), np.fabs(SPECIAL))
+    _cmp("abs", ht.abs(x).numpy(), np.abs(SPECIAL))
+    ints = np.array([-3, 0, 7], np.int32)
+    np.testing.assert_array_equal(ht.sign(ht.array(ints, split=None)).numpy(), np.sign(ints))
+
+
+# ------------------------------------------------------ logical / inf / nan
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_inf_nan_predicates_grid(split):
+    x = ht.array(SPECIAL, split=split)
+    for name in ("isfinite", "isinf", "isnan", "isneginf", "isposinf", "signbit"):
+        np.testing.assert_array_equal(
+            getattr(ht, name)(x).numpy(), getattr(np, name)(SPECIAL), err_msg=name
+        )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_nan_to_num_replacement_grid(split):
+    x = ht.array(SPECIAL, split=split)
+    _cmp("default", ht.nan_to_num(x).numpy(), np.nan_to_num(SPECIAL))
+    got = ht.nan_to_num(x, nan=-1.0, posinf=99.0, neginf=-99.0).numpy()
+    _cmp("custom", got, np.nan_to_num(SPECIAL, nan=-1.0, posinf=99.0, neginf=-99.0))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_logical_ops_with_nan_operands(split):
+    # nan is truthy in logical context, exactly as numpy treats it
+    a = np.array([0.0, 1.0, np.nan, np.inf, -0.0], np.float32)
+    b = np.array([np.nan, 0.0, np.nan, 0.0, 1.0], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    for name in ("logical_and", "logical_or", "logical_xor"):
+        np.testing.assert_array_equal(
+            getattr(ht, name)(ha, hb).numpy(), getattr(np, name)(a, b), err_msg=name
+        )
+    np.testing.assert_array_equal(ht.logical_not(ha).numpy(), np.logical_not(a))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_isclose_allclose_nan_inf_modes(split):
+    a = np.array([1.0, np.nan, np.inf, -np.inf, 1.0 + 1e-9], np.float32)
+    b = np.array([1.0, np.nan, np.inf, np.inf, 1.0], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_array_equal(
+        ht.isclose(ha, hb).numpy(), np.isclose(a, b))
+    np.testing.assert_array_equal(
+        ht.isclose(ha, hb, equal_nan=True).numpy(), np.isclose(a, b, equal_nan=True))
+    assert not ht.allclose(ha, hb)
+    assert bool(ht.allclose(ha, ha, equal_nan=True))
+
+
+# ------------------------------------------------------- fmin/fmax vs nan
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_fmin_fmax_nan_semantics(split):
+    a = np.array([1.0, np.nan, 3.0, np.nan], np.float32)
+    b = np.array([2.0, 2.0, np.nan, np.nan], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    # fmin/fmax ignore a single nan; minimum/maximum propagate it
+    _cmp("fmin", ht.fmin(ha, hb).numpy(), np.fmin(a, b))
+    _cmp("fmax", ht.fmax(ha, hb).numpy(), np.fmax(a, b))
+    _cmp("minimum", ht.minimum(ha, hb).numpy(), np.minimum(a, b))
+    _cmp("maximum", ht.maximum(ha, hb).numpy(), np.maximum(a, b))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_misc_special_functions(split):
+    vals = np.array([0.0, 0.5, -0.5, 2.0, -3.5], np.float32)
+    x = ht.array(vals, split=split)
+    _cmp("sinc", ht.sinc(x).numpy(), np.sinc(vals))
+    _cmp("i0", ht.i0(x).numpy(), np.i0(vals), rtol=1e-4)
+    h = np.array([0.5], np.float32)
+    _cmp(
+        "heaviside",
+        ht.heaviside(x, ht.array(h, split=None)).numpy(),
+        np.heaviside(vals, h),
+    )
+    _cmp("nextafter", ht.nextafter(x, ht.array(np.ones_like(vals), split=split)).numpy(),
+         np.nextafter(vals, 1.0))
+    _cmp("spacing", ht.spacing(x).numpy(), np.spacing(vals), rtol=1e-4)

@@ -1,0 +1,235 @@
+"""Deep width for the factories family: the analog of
+heat/core/tests/test_factories.py's per-factory batteries (arange call
+forms and dtype inference, linspace endpoint/retstep/num grids, logspace
+bases, eye shapes, full/empty/zeros/ones plus the *_like split- and
+dtype-inheritance contracts, meshgrid indexing modes, array ndmin/copy
+semantics, exception contracts), table-compressed against numpy ground
+truth on the virtual mesh.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+# ------------------------------------------------------------------ arange
+
+def test_arange_call_forms():
+    cases = [
+        ((10,), {}),
+        ((2, 10), {}),
+        ((2, 10, 3), {}),
+        ((10, 2, -2), {}),
+        ((0.0, 1.0, 0.25), {}),
+        ((5.5,), {}),
+        ((3, 30, 5), {"dtype": ht.float32}),
+    ]
+    for args, kw in cases:
+        np_kw = {"dtype": np.float32} if kw else {}
+        np.testing.assert_allclose(
+            ht.arange(*args, **kw).numpy(), np.arange(*args, **np_kw),
+            err_msg=f"arange{args}",
+        )
+
+
+def test_arange_dtype_inference():
+    assert ht.arange(5).dtype == ht.int32
+    assert ht.arange(5.0).dtype == ht.float32
+    assert ht.arange(0, 1, 0.1).dtype == ht.float32
+    assert ht.arange(5, dtype=ht.float64).dtype == ht.float64
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_arange_split_matches_numpy(split):
+    # 13 elements on an 8-device mesh: remainder chunks
+    x = ht.arange(13, split=split)
+    assert x.split == split
+    np.testing.assert_array_equal(x.numpy(), np.arange(13))
+
+
+def test_arange_empty_and_negative_ranges():
+    np.testing.assert_array_equal(ht.arange(5, 5).numpy(), np.arange(5, 5))
+    np.testing.assert_array_equal(ht.arange(5, 2).numpy(), np.arange(5, 2))
+    np.testing.assert_array_equal(ht.arange(5, 2, -1).numpy(), np.arange(5, 2, -1))
+
+
+# --------------------------------------------------------------- linspace
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_linspace_grid(split):
+    for start, stop, num, endpoint in [
+        (0, 10, 7, True), (0, 10, 7, False), (-5, 5, 11, True),
+        (3, 3, 5, True), (10, 0, 4, True), (0, 1, 1, True),
+    ]:
+        got = ht.linspace(start, stop, num, endpoint=endpoint, split=split)
+        np.testing.assert_allclose(
+            got.numpy(), np.linspace(start, stop, num, endpoint=endpoint),
+            rtol=1e-6, err_msg=f"linspace({start},{stop},{num},{endpoint})",
+        )
+
+
+def test_linspace_retstep_and_dtype():
+    vals, step = ht.linspace(0, 10, 5, retstep=True)
+    nvals, nstep = np.linspace(0, 10, 5, retstep=True)
+    np.testing.assert_allclose(vals.numpy(), nvals)
+    assert abs(float(step) - nstep) < 1e-12
+    assert ht.linspace(0, 1, 4, dtype=ht.float64).dtype == ht.float64
+
+
+@pytest.mark.parametrize("base", [2.0, 10.0, np.e])
+def test_logspace_bases(base):
+    got = ht.logspace(0, 4, 9, base=base)
+    np.testing.assert_allclose(got.numpy(), np.logspace(0, 4, 9, base=base), rtol=1e-5)
+    got = ht.logspace(2, -2, 5, base=base, endpoint=False)
+    np.testing.assert_allclose(
+        got.numpy(), np.logspace(2, -2, 5, base=base, endpoint=False), rtol=1e-5
+    )
+
+
+# ---------------------------------------------------------------- eye/full
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_eye_shape_grid(split):
+    for shape, want in [
+        (4, np.eye(4)),
+        ((3, 5), np.eye(3, 5)),
+        ((5, 3), np.eye(5, 3)),
+        ((1, 1), np.eye(1)),
+    ]:
+        got = ht.eye(shape, split=split)
+        np.testing.assert_array_equal(got.numpy(), want.astype(got.numpy().dtype))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_full_fill_values(split):
+    for shape, fill in [((6, 5), 3), ((6, 5), -1.5), ((9,), True), ((2, 3, 4), 0)]:
+        got = ht.full(shape, fill, split=split if np.ndim(shape) or split is None else split)
+        np.testing.assert_allclose(got.numpy(), np.full(shape, fill, got.numpy().dtype))
+
+
+@pytest.mark.parametrize("fname", ["zeros", "ones", "empty"])
+@pytest.mark.parametrize("split", SPLITS)
+def test_basic_factories_shape_dtype(fname, split):
+    fn = getattr(ht, fname)
+    for shape in [(7,), (5, 6), (2, 3, 5)]:
+        for dtype in (ht.float32, ht.int32, ht.float64):
+            got = fn(shape, dtype=dtype, split=split)
+            assert got.shape == shape and got.dtype == dtype and got.split == split
+            if fname != "empty":
+                want = getattr(np, fname)(shape)
+                np.testing.assert_allclose(got.numpy().astype(np.float64), want)
+
+
+# ------------------------------------------------------------- *_like grid
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_like_factories_inherit_and_override(split):
+    base = ht.array(np.arange(30.0, dtype=np.float32).reshape(5, 6), split=split)
+    for fname in ("zeros_like", "ones_like", "empty_like"):
+        got = getattr(ht, fname)(base)
+        assert got.shape == base.shape
+        assert got.dtype == base.dtype
+        assert got.split == base.split, fname
+        # dtype override
+        got64 = getattr(ht, fname)(base, dtype=ht.int64)
+        assert got64.dtype == ht.int64
+    fl = ht.full_like(base, 9.5)
+    assert fl.split == base.split and fl.shape == base.shape
+    np.testing.assert_allclose(fl.numpy(), np.full((5, 6), 9.5, np.float32))
+    # split=None means inherit (reference __factory_like semantics);
+    # an explicit axis overrides
+    assert ht.zeros_like(base, split=None).split == base.split
+    assert ht.zeros_like(base, split=1).split == 1
+
+
+# ---------------------------------------------------------------- meshgrid
+
+@pytest.mark.parametrize("indexing", ["xy", "ij"])
+def test_meshgrid_modes(indexing):
+    a, b, c = np.arange(3.0), np.arange(4.0), np.arange(2.0)
+    got = ht.meshgrid(ht.array(a), ht.array(b), ht.array(c), indexing=indexing)
+    want = np.meshgrid(a, b, c, indexing=indexing)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g.numpy(), w)
+
+
+def test_meshgrid_empty_and_single():
+    assert ht.meshgrid() == []
+    (g,) = ht.meshgrid(ht.arange(4))
+    np.testing.assert_array_equal(g.numpy(), np.arange(4))
+    with pytest.raises(ValueError):
+        ht.meshgrid(ht.arange(3), indexing="bad")
+
+
+# ------------------------------------------------------------ array() forms
+
+def test_array_ndmin_and_nested():
+    got = ht.array([[1, 2], [3, 4]], ndmin=3)
+    want = np.array([[1, 2], [3, 4]], ndmin=3)
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(got.numpy(), want)
+    # scalars, nested lists, numpy scalars
+    assert ht.array(5).shape == ()
+    np.testing.assert_array_equal(
+        ht.array([[True, False], [False, True]]).numpy(),
+        np.array([[True, False], [False, True]]),
+    )
+    assert ht.array(np.float64(2.5)).dtype == ht.float64
+
+
+def test_array_dtype_override_and_copy_semantics():
+    src = np.arange(6, dtype=np.int32)
+    got = ht.array(src, dtype=ht.float32)
+    assert got.dtype == ht.float32
+    np.testing.assert_allclose(got.numpy(), src.astype(np.float32))
+    # mutating the source after construction must not change the array
+    arr = ht.array(src, copy=True)
+    src[0] = 99
+    assert arr.numpy()[0] == 0
+
+
+@pytest.mark.parametrize("split", [0, 1])
+def test_array_is_split_assembles_global(split):
+    """is_split declares pre-chunked local data: the analog of the
+    reference's is_split path assembling the global array from per-rank
+    locals (factories.py:207-260)."""
+    full = np.arange(24.0, dtype=np.float32).reshape(4, 6)
+    got = ht.array(full, is_split=split)
+    assert got.split == split
+    assert got.shape[split] % full.shape[split] == 0  # n_devices copies joined
+
+
+def test_factory_exceptions():
+    with pytest.raises((ValueError, TypeError)):
+        ht.zeros((3, 3), split=5)
+    with pytest.raises((ValueError, TypeError)):
+        ht.linspace(0, 1, -3)
+    with pytest.raises((ValueError, TypeError)):
+        ht.array([[1, 2], [3]])  # ragged nested list
+
+
+# ------------------------------------------------- asarray / copy contracts
+
+def test_asarray_passthrough_and_convert():
+    x = ht.arange(5, dtype=ht.float32)
+    assert ht.asarray(x) is x
+    got = ht.asarray([1.0, 2.0])
+    np.testing.assert_allclose(got.numpy(), np.asarray([1.0, 2.0], np.float32))
+    # dtype change forces a new array
+    y = ht.asarray(x, dtype=ht.int32)
+    assert y.dtype == ht.int32
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_fromfunction_like_grid(split):
+    # linspace x arange outer combination exercises both factory paths in
+    # one expression the way the reference's combined cases do
+    row = ht.arange(5, dtype=ht.float32, split=None)
+    col = ht.linspace(0, 1, 4, split=split)
+    got = ht.expand_dims(col, 1) * row
+    want = np.linspace(0, 1, 4)[:, None] * np.arange(5, dtype=np.float32)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
