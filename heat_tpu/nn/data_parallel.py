@@ -61,6 +61,8 @@ class DataParallel:
         self.params = None
         self._apply = module.apply if hasattr(module, "apply") else module
         self._train_step = None
+        self._epoch_fn = None
+        self._programs = {}
 
     # ------------------------------------------------------------------
     def init(self, key, sample_input) -> "DataParallel":
@@ -81,6 +83,8 @@ class DataParallel:
         if self._optimizer is not None:
             self._opt_state = jax.device_put(self._optimizer.init(self.params), rep)
         self._train_step = None
+        self._epoch_fn = None
+        self._programs = {}
 
     # ------------------------------------------------------------------
     def _forward_params(self):
@@ -117,40 +121,154 @@ class DataParallel:
 
         return jax.value_and_grad(total_loss)(self.params)
 
+    @staticmethod
+    def _loss_key(loss_fn: Callable):
+        """Cache key for a loss function: the code object plus the
+        IDENTITY of every piece of captured state (closure cells, default
+        args, a bound method's ``__self__``).  A fresh lambda per loop
+        iteration capturing the same objects reuses the compiled program;
+        a lambda capturing *different* state (``lambda p, t, w=w: ...``
+        with a new ``w``) rebuilds instead of silently evaluating the old
+        trace.  The instance keeps a strong reference to the cached
+        function, so the ids it compares against cannot be recycled.
+        Callables without a code object (``functools.partial``, C
+        callables) key on their own identity — recreate them per call and
+        each call retraces.  Like ``jax.jit`` itself, IN-PLACE mutation of
+        a captured object (``obj.w = 2.0`` behind a bound method) is not
+        observable: traced state is baked at compile time; rebind a new
+        function/object to change it."""
+        fn = getattr(loss_fn, "__func__", loss_fn)
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return (id(loss_fn),)
+
+        def _cell_id(c):
+            try:
+                return id(c.cell_contents)
+            except ValueError:  # empty cell (e.g. unbound recursive name)
+                return id(c)
+
+        return (
+            code,
+            id(getattr(loss_fn, "__self__", None)),
+            tuple(id(d) for d in fn.__defaults__ or ()),
+            tuple(sorted((k, id(v)) for k, v in (fn.__kwdefaults__ or {}).items())),
+            tuple(_cell_id(c) for c in fn.__closure__ or ()),
+        )
+
+    _PROGRAM_CACHE_SIZE = 8
+
+    def _build(self, loss_fn: Callable) -> None:
+        """Compile (and cache) the fused step body and the scanned epoch
+        over it.  A small FIFO dict keyed by :meth:`_loss_key` holds the
+        last few losses' programs, so alternating objectives (task/aux,
+        GAN-style) dispatch from cache instead of retracing every call; a
+        genuinely new loss rebuilds instead of silently reusing the old
+        closure."""
+        key = self._loss_key(loss_fn)
+        cached = self._programs.get(key)
+        if cached is not None:
+            self._train_step, self._epoch_fn = cached[0], cached[1]
+            return
+        apply = self._apply
+        optimizer = self._optimizer
+        import optax
+
+        def body(params, opt_state, xb, yb):
+            def total_loss(p):
+                return loss_fn(apply(p, xb), yb)
+
+            loss, grads = jax.value_and_grad(total_loss)(params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return loss, optax.apply_updates(params, updates), opt_state
+
+        @jax.jit
+        def epoch(params, opt_state, xs, ys):
+            def scan_body(carry, batch):
+                loss, p, s = body(*carry, *batch)
+                return (p, s), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                scan_body, (params, opt_state), (xs, ys)
+            )
+            return params, opt_state, losses
+
+        self._train_step = jax.jit(body)
+        self._epoch_fn = epoch
+        # the loss_fn strong ref pins the key's ids for the entry's lifetime
+        self._programs[key] = (self._train_step, self._epoch_fn, loss_fn)
+        while len(self._programs) > self._PROGRAM_CACHE_SIZE:
+            self._programs.pop(next(iter(self._programs)))
+        self._batch_sharding = NamedSharding(self.comm.mesh, P(self.comm.axis_name))
+        self._stack_sharding = NamedSharding(
+            self.comm.mesh, P(None, self.comm.axis_name)
+        )
+
     def step(self, loss_fn: Callable, x, y) -> float:
         """One fused train step: forward, backward, optimizer update —
         compiled once and cached (the whole of the reference's hook
         machinery plus DataParallelOptimizer.step, dp_optimizer.py:851)."""
         if self._optimizer is None:
             raise RuntimeError("construct DataParallel with an optimizer to use step()")
-        if self._train_step is None:
-            batch_sharding = NamedSharding(self.comm.mesh, P(self.comm.axis_name))
-            rep = NamedSharding(self.comm.mesh, P())
-            apply = self._apply
-            optimizer = self._optimizer
-
-            @jax.jit
-            def train_step(params, opt_state, xb, yb):
-                def total_loss(p):
-                    return loss_fn(apply(p, xb), yb)
-
-                loss, grads = jax.value_and_grad(total_loss)(params)
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                import optax
-
-                params = optax.apply_updates(params, updates)
-                return loss, params, opt_state
-
-            self._train_step = train_step
-            self._batch_sharding = batch_sharding
+        self._build(loss_fn)
 
         xd = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
         yd = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)
         if xd.shape[0] % self.comm.size == 0:
             xd = jax.device_put(xd, self._batch_sharding)
-            yd = jax.device_put(yd, NamedSharding(self.comm.mesh, P(self.comm.axis_name)))
+            yd = jax.device_put(yd, self._batch_sharding)
         loss, self.params, self._opt_state = self._train_step(self.params, self._opt_state, xd, yd)
         return float(loss)
+
+    def train_steps(self, loss_fn: Callable, xs, ys) -> jnp.ndarray:
+        """Run a whole stack of train steps as ONE device program.
+
+        ``xs``/``ys`` carry a leading step axis: ``xs[k]`` is step *k*'s
+        batch (each batch sharded over the mesh axis exactly as in
+        :meth:`step`).  A ``lax.scan`` threads (params, opt_state) through
+        the fused forward/backward/update body, so per-step host dispatch
+        — the dominant cost of tiny steps on a remote or tunneled link —
+        is paid once per *stack* instead of once per step.  This is the
+        TPU-native replacement for the reference's per-iteration python
+        loop over ``DataParallel`` (data_parallel.py:150) +
+        ``DataParallelOptimizer.step`` (dp_optimizer.py:851): steady-state
+        training stages a queue of batches in HBM and scans them.
+
+        Returns the per-step losses (a device-resident ``(n_steps,)``
+        array; fetch at epoch boundaries, not per step).
+        """
+        if self._optimizer is None:
+            raise RuntimeError("construct DataParallel with an optimizer to use train_steps()")
+        if self.params is None:
+            raise RuntimeError("call init() or set_params() first")
+        self._build(loss_fn)
+        xd, yd = self._stage_stack(xs, ys)
+        self.params, self._opt_state, losses = self._epoch_fn(
+            self.params, self._opt_state, xd, yd
+        )
+        return losses
+
+    def _stage_stack(self, xs, ys):
+        """Place a (n_steps, batch, ...) stack with each batch sharded over
+        the mesh axis.  Already-staged arrays pass through untouched, so a
+        caller looping epochs over the same stack pays the transfer once."""
+        xd = xs._dense() if isinstance(xs, DNDarray) else jnp.asarray(xs)
+        yd = ys._dense() if isinstance(ys, DNDarray) else jnp.asarray(ys)
+        if xd.shape[0] != yd.shape[0]:
+            raise ValueError(
+                f"step axes disagree: xs has {xd.shape[0]} batches, ys {yd.shape[0]}"
+            )
+        if (
+            xd.ndim >= 2
+            and yd.ndim >= 2
+            and xd.shape[1] % self.comm.size == 0
+            and yd.shape[1] % self.comm.size == 0
+        ):
+            if getattr(xd, "sharding", None) != self._stack_sharding:
+                xd = jax.device_put(xd, self._stack_sharding)
+            if getattr(yd, "sharding", None) != self._stack_sharding:
+                yd = jax.device_put(yd, self._stack_sharding)
+        return xd, yd
 
 
 class DataParallelMultiGPU(DataParallel):
@@ -202,14 +320,19 @@ class DataParallelMultiGPU(DataParallel):
             daso = DASO(local_optimizer=optimizer, total_epochs=1, comm=comm,
                         warmup_epochs=0, cooldown_epochs=0)
         self.daso = daso
+        self._hier_step = None
+        self._hier_programs = {}
 
     # -- per-node replica parameter state ------------------------------
     def set_params(self, params) -> None:
         if self.daso is None or not self.daso.hierarchical:
             super().set_params(params)
+            self._hier_step = None
+            self._hier_programs = {}
             return
         self.params = self.daso.replicate(params)
-        self._train_step = None
+        self._hier_step = None
+        self._hier_programs = {}
 
     def _forward_params(self):
         # inference runs on the node-0 replica (identical everywhere after
@@ -225,7 +348,13 @@ class DataParallelMultiGPU(DataParallel):
             return super().step(loss_fn, x, y)
         comm = self.comm
         n_node = comm.num_nodes
-        if self._train_step is None:
+        # own cache slots: the base _build programs have a different
+        # signature, and mixing step()/train_steps() must not collide
+        hier_key = self._loss_key(loss_fn)
+        hier_cached = self._hier_programs.get(hier_key)
+        if hier_cached is not None:
+            self._hier_step = hier_cached[0]
+        else:
             apply = self._apply
 
             @jax.jit
@@ -236,8 +365,12 @@ class DataParallelMultiGPU(DataParallel):
                 losses, grads = jax.vmap(jax.value_and_grad(node_loss))(stacked, xn, yn)
                 return losses.mean(), grads
 
-            self._train_step = grad_step
-            self._batch_sharding = NamedSharding(
+            self._hier_step = grad_step
+            # the loss_fn strong ref pins the key's ids for the entry's life
+            self._hier_programs[hier_key] = (grad_step, loss_fn)
+            while len(self._hier_programs) > self._PROGRAM_CACHE_SIZE:
+                self._hier_programs.pop(next(iter(self._hier_programs)))
+            self._hier_sharding = NamedSharding(
                 comm.mesh, P(comm.global_axis, comm.node_axis)
             )
 
@@ -249,11 +382,22 @@ class DataParallelMultiGPU(DataParallel):
         xn = xd.reshape((n_node, b // n_node) + xd.shape[1:])
         yn = yd.reshape((n_node, b // n_node) + yd.shape[1:])
         if (b // n_node) % comm.node_size == 0:
-            xn = jax.device_put(xn, self._batch_sharding)
-            yn = jax.device_put(yn, self._batch_sharding)
-        loss, grads = self._train_step(self.params, xn, yn)
+            xn = jax.device_put(xn, self._hier_sharding)
+            yn = jax.device_put(yn, self._hier_sharding)
+        loss, grads = self._hier_step(self.params, xn, yn)
         self.params = self.daso.step(self.params, grads)
         return float(loss)
+
+    def train_steps(self, loss_fn: Callable, xs, ys) -> jnp.ndarray:
+        """Always raises: DASO's skipped/delayed global sync is host-side
+        control flow between steps and cannot ride inside one scanned
+        program (and every constructible instance with an optimizer owns a
+        hierarchical DASO)."""
+        raise NotImplementedError(
+            "train_steps does not drive the DASO hierarchical sync "
+            "protocol; call step() per batch (DASO decides syncs between "
+            "steps), or use a plain DataParallel for scanned epochs"
+        )
 
     def collect_params(self):
         """One coherent (node-0) parameter pytree (after :meth:`DASO.last_batch`

@@ -242,3 +242,166 @@ def test_partial_h5_error_propagation_and_early_break(tmp_path):
     it.close()
     with pytest.raises(StopIteration):
         next(it)
+
+
+def test_train_steps_matches_sequential_steps(mlp):
+    """The scanned multi-step program must walk the identical parameter
+    trajectory as K sequential step() dispatches over the same batches."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    rng = np.random.default_rng(7)
+    n_steps, batch = 5, 16
+    xs = rng.standard_normal((n_steps, batch, 4)).astype(np.float32)
+    ys = (xs @ np.array([1.0, -2.0, 0.5, 3.0], np.float32) > 0).astype(np.int32)
+
+    def loss_fn(pred, target):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
+
+    dp_seq = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    dp_seq.init(jax.random.PRNGKey(1), jnp.asarray(xs[0]))
+    seq_losses = [dp_seq.step(loss_fn, xs[k], ys[k]) for k in range(n_steps)]
+
+    dp_scan = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    dp_scan.init(jax.random.PRNGKey(1), jnp.asarray(xs[0]))
+    losses = dp_scan.train_steps(loss_fn, xs, ys)
+
+    assert losses.shape == (n_steps,)
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-5, atol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(dp_seq.params),
+        jax.tree_util.tree_leaves(dp_scan.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+    # the scanned state stays usable for further single steps
+    more = dp_scan.step(loss_fn, xs[0], ys[0])
+    assert np.isfinite(more)
+
+
+def test_train_steps_stack_is_batch_sharded(mlp):
+    """The staged batch stack must shard over the mesh axis (axis 1), not
+    the step axis — the scan slices steps, the mesh splits each batch."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    dp = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    n_dev = dp.comm.size
+    xs = jnp.ones((3, 2 * n_dev, 4), jnp.float32)
+    ys = jnp.zeros((3, 2 * n_dev), jnp.int32)
+    dp.init(jax.random.PRNGKey(0), xs[0])
+
+    def loss_fn(pred, target):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
+
+    losses = dp.train_steps(loss_fn, xs, ys)
+    assert losses.shape == (3,)
+    # the arrays the program actually consumes carry the stack sharding
+    xd, yd = dp._stage_stack(xs, ys)
+    assert xd.sharding == dp._stack_sharding
+    assert yd.sharding == dp._stack_sharding
+    assert dp._stack_sharding.spec == jax.sharding.PartitionSpec(
+        None, dp.comm.axis_name
+    )
+    # already-staged arrays pass through without another transfer
+    xd2, _ = dp._stage_stack(xd, yd)
+    assert xd2 is xd
+    with pytest.raises(ValueError):
+        dp.train_steps(loss_fn, xs, ys[:2])
+
+
+def test_step_rebuilds_on_new_loss_fn(mlp):
+    """A different loss_fn must recompile the cached programs, not silently
+    train against the first one's closure."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    dp = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    x = jnp.ones((16, 4), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    dp.init(jax.random.PRNGKey(0), x)
+
+    def xent(pred, target):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, target).mean()
+
+    def big_constant(pred, target):
+        return jnp.float32(42.0) + 0.0 * xent(pred, target)
+
+    l1 = dp.step(xent, x, y)
+    l2 = dp.step(big_constant, x, y)
+    assert abs(l2 - 42.0) < 1e-5, "second loss_fn was ignored by the cache"
+    losses = dp.train_steps(big_constant, jnp.ones((2, 16, 4)), jnp.zeros((2, 16), jnp.int32))
+    np.testing.assert_allclose(np.asarray(losses), 42.0, rtol=1e-6)
+    losses = dp.train_steps(xent, jnp.ones((2, 16, 4)), jnp.zeros((2, 16), jnp.int32))
+    assert float(losses[0]) != 42.0
+    assert l1 != 42.0
+
+
+def test_loss_cache_reuses_closure_free_lambdas(mlp):
+    """Fresh closure-free lambdas with the same code must hit the compiled
+    program cache (keyed on __code__), not re-trace every step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    dp = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    x = jnp.ones((16, 4), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    dp.init(jax.random.PRNGKey(0), x)
+    builds = []
+    for _ in range(3):
+        dp.step(lambda pred, target: (pred * 0.0).sum() + 0.0 * target.sum(), x, y)
+        builds.append(dp._train_step)
+    assert builds[0] is builds[1] is builds[2]
+
+
+def test_multigpu_train_steps_guard(mlp):
+    """Hierarchical DASO training cannot ride one scanned program; the
+    subclass must say so instead of silently bypassing the sync protocol."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from heat_tpu.parallel import HierarchicalCommunication
+
+    size = ht.get_comm().size
+    if size % 4 != 0:
+        pytest.skip("needs a mesh divisible into (n/4 x 4) nodes")
+    hc = HierarchicalCommunication(grid=(size // 4, 4))
+    daso = ht.optim.DASO(
+        local_optimizer=optax.sgd(1e-2), total_epochs=2, comm=hc,
+        warmup_epochs=0, cooldown_epochs=0,
+    )
+    dpm = ht.nn.DataParallelMultiGPU(mlp, daso=daso)
+    dpm.set_params(mlp.init(jax.random.PRNGKey(0), jnp.ones((8, 4))))
+    with pytest.raises(NotImplementedError):
+        dpm.train_steps(
+            lambda p, t: p.sum() * 0.0, jnp.ones((2, 8, 4)), jnp.zeros((2, 8), jnp.int32)
+        )
+
+
+def test_loss_cache_kwdefaults_and_alternation(mlp):
+    """Keyword-only defaults are captured state (distinct programs), and
+    alternating between two losses dispatches from the program cache."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    dp = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    x = jnp.ones((16, 4), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    dp.init(jax.random.PRNGKey(0), x)
+
+    def mk(w):
+        return lambda pred, target, *, s=w: (pred * 0.0).sum() + s + 0.0 * target.sum()
+
+    la, lb = mk(jnp.float32(1.0)), mk(jnp.float32(41.0))
+    assert abs(dp.step(la, x, y) - 1.0) < 1e-5
+    assert abs(dp.step(lb, x, y) - 41.0) < 1e-5, "kwdefault state was ignored"
+    prog_a = dp._programs[dp._loss_key(la)][0]
+    prog_b = dp._programs[dp._loss_key(lb)][0]
+    assert abs(dp.step(la, x, y) - 1.0) < 1e-5
+    assert abs(dp.step(lb, x, y) - 41.0) < 1e-5
+    assert dp._programs[dp._loss_key(la)][0] is prog_a
+    assert dp._programs[dp._loss_key(lb)][0] is prog_b
