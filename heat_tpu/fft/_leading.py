@@ -1,0 +1,311 @@
+"""Leading-contraction 3-D FFT engine (r5, second generation).
+
+The r5 interleaved engine (``_planar._rfft3_interleaved``) pays two
+"re-pair transposes" between its three DFT dots — ~9.4 ms of the 27.6 ms
+512^3 transform on the bench v5e, pure relayout moving zero new
+information.  This engine removes them entirely:
+
+* every DFT stage contracts the LEADING dim of the operand
+  (``dot_general`` with lhs contracting dim 0 — the grad-style
+  transposed dot the MXU runs natively; measured at full speed, same
+  scheduled bytes as a minor-dim dot), so the stage's output cycles the
+  axis order and the next transform axis arrives in front without any
+  transpose;
+* the complex pair lives in SEPARATE re/im planes; each stage is two
+  dots against the concatenated ``[W_re | W_im]`` matrix plus one fused
+  elementwise combine (the column blocks are lane-aligned slices);
+* the real-input transform halves axis 0 to ``m = n0 // 2`` bins
+  (perfect tile alignment, unlike the 257-bin half spectrum) and
+  carries the Nyquist bin through a tiny side chain;
+* the Hermitian extension — pass-count-bound in XLA (measured 12.5 ms:
+  roll/rev/concat each materialize) — is a Pallas kernel that emits one
+  output row per grid step: lower rows are DMA copies, upper rows are
+  the mirrored source row rev-rolled THROUGH THE MXU (one permutation
+  matrix on each side, manual bf16x2 split since Mosaic lowers only
+  DEFAULT/HIGHEST dot precision; the permutation matrix is exact in
+  bf16, so the error is the 2^-17 split truncation, below the HIGH
+  matmul policy's own 2.5e-5).  Measured 4.5 ms.
+
+Measured end to end on the bench v5e at 512^3 f32 (same session):
+22.7 ms vs 27.6 interleaved / 65.4 r4 — 9.7 GB scheduled vs 13.5 /
+43.1 — ~43% of the 48 B/element minimal-model bandwidth.  Reference
+semantics: heat/fft/fft.py:100-137 (fftn), verified against
+``np.fft.fftn`` to ~2.7e-5 relative (HIGH default policy).
+
+Norm scaling is folded into the exit-stage matrices (host f64
+constants), so every norm mode ships at the default-path cost.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "leading_eligible",
+    "rfft3_leading",
+    "cfft3_leading",
+]
+
+
+def _precision():
+    from ._planar import _interleaved_precision
+
+    return _interleaved_precision()
+
+
+@functools.lru_cache(maxsize=64)
+def _cs(n: int, inverse: bool):
+    """Host f64 (cos, sign*sin) planes of the n-point DFT matrix."""
+    j = np.arange(n, dtype=np.float64)
+    jk = np.outer(j, j) % n
+    ang = 2.0 * np.pi * jk / n
+    sign = 1.0 if inverse else -1.0
+    return np.cos(ang), sign * np.sin(ang)
+
+
+@functools.lru_cache(maxsize=64)
+def _w_entry_half(n: int, m: int, dt: str, part: str):
+    """(n, m) real-input entry matrix for bins 0..m-1 (axis-0 halving)."""
+    c, s = _cs(n, False)
+    w = c if part == "re" else s
+    return np.asarray(w[:, :m], dt)
+
+
+@functools.lru_cache(maxsize=64)
+def _w_cat(n: int, dt: str, inverse: bool, scale: float):
+    """(n, 2n) ``[W_re | W_im] * scale`` stage matrix (scale folds the
+    norm factor into the exit stage — no post-scaling pass)."""
+    c, s = _cs(n, inverse)
+    return np.asarray(np.concatenate([c, s], 1) * scale, dt)
+
+
+@functools.lru_cache(maxsize=16)
+def _perm_bf(n: int):
+    """Exact-in-bf16 rev-roll permutation: P[a, b] = 1 iff a = (n-b) % n.
+
+    Symmetric (the map is an involution), so one matrix serves both the
+    sublane and the lane side of the extension kernel's MXU reversal."""
+    p = np.zeros((n, n), np.float32)
+    p[(n - np.arange(n)) % n, np.arange(n)] = 1.0
+    return jnp.asarray(p, jnp.bfloat16)
+
+
+def _dg0(a: jax.Array, w, prec) -> jax.Array:
+    """Leading-dim contraction: (K, ...rest) x (K, N) -> (...rest, N)."""
+    return jax.lax.dot_general(
+        a, jnp.asarray(w), (((0,), (0,)), ((), ())), precision=prec
+    )
+
+
+def _stage(re, im, wcat, n: int, prec):
+    """One complex DFT stage over the LEADING dim: two cat-dots + fused
+    combine.  Output planes have the transformed axis's bins in the
+    minor dim and the former trailing dims rotated to the front."""
+    zr = _dg0(re, wcat, prec)
+    zi = _dg0(im, wcat, prec)
+    return zr[..., :n] - zi[..., n:], zr[..., n:] + zi[..., :n]
+
+
+# ----------------------------------------------------------------------
+# Hermitian extension kernel (axis 0): out rows 0..m-1 copy the half
+# spectrum, row m is the Nyquist plane, rows m+1..n-1 are the mirrored
+# source row with both trailing axes index-mapped k -> (n-k) % n.
+#
+# The fused variant consumes the exit stage's RAW cat-dot outputs
+# (zr, zi of shape (m, n1, 2*n2)) and performs the plane combine
+# (re = zr[..., :n2] - zi[..., n2:], im = zr[..., n2:] + zi[..., :n2])
+# inside VMEM — deleting the 3.2 GB combine pass the XLA stage pays
+# (measured −3 ms at 512^3 on the bench v5e).
+# ----------------------------------------------------------------------
+def _ext_fused_kernel_factory(m: int, n2: int):
+    from jax.experimental import pallas as pl
+
+    def kern(p1_ref, p2_ref, zr_ref, zi_ref, nyr_ref, nyi_ref, ore_ref, oim_ref):
+        p = pl.program_id(0)
+
+        def combined():
+            zr = zr_ref[0]
+            zi = zi_ref[0]
+            return zr[:, :n2] - zi[:, n2:], zr[:, n2:] + zi[:, :n2]
+
+        @pl.when(p < m)
+        def _():
+            cre, cim = combined()
+            ore_ref[0] = cre
+            oim_ref[0] = cim
+
+        @pl.when(p == m)
+        def _():
+            ore_ref[0] = nyr_ref[...]
+            oim_ref[0] = nyi_ref[...]
+
+        @pl.when(p > m)
+        def _():
+            pj = p1_ref[...]
+            pk = p2_ref[...]
+
+            def d(a, b):
+                return jax.lax.dot_general(
+                    a, b, ((((1,), (0,))), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            def revroll(s):
+                hi = s.astype(jnp.bfloat16)
+                lo = (s - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                t_hi = d(hi, pk).astype(jnp.bfloat16)
+                t_lo = d(lo, pk).astype(jnp.bfloat16)
+                return d(pj, t_hi) + d(pj, t_lo)
+
+            cre, cim = combined()
+            ore_ref[0] = revroll(cre)
+            oim_ref[0] = -revroll(cim)
+
+    return kern
+
+
+def _ext_fused_pallas(zr, zi, nyr, nyi):
+    """Raw exit-dot planes (m, n1, 2*n2) + Nyquist -> full (2m, n1, n2)."""
+    from jax.experimental import pallas as pl
+
+    m, n1, n2t = (int(s) for s in zr.shape)
+    n2 = n2t // 2
+    n = 2 * m
+
+    def src(pidx):
+        return jnp.where(pidx < m, pidx, jnp.where(pidx == m, 0, n - pidx))
+
+    return pl.pallas_call(
+        _ext_fused_kernel_factory(m, n2),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((n1, n1), lambda p: (0, 0)),
+            pl.BlockSpec((n2, n2), lambda p: (0, 0)),
+            pl.BlockSpec((1, n1, 2 * n2), lambda p: (src(p), 0, 0)),
+            pl.BlockSpec((1, n1, 2 * n2), lambda p: (src(p), 0, 0)),
+            pl.BlockSpec((n1, n2), lambda p: (0, 0)),
+            pl.BlockSpec((n1, n2), lambda p: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n1, n2), lambda p: (p, 0, 0)),
+            pl.BlockSpec((1, n1, n2), lambda p: (p, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, n1, n2), zr.dtype),
+            jax.ShapeDtypeStruct((n, n1, n2), zi.dtype),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(_perm_bf(n1), _perm_bf(n2), zr, zi, nyr, nyi)
+
+
+def _ext_xla(ere, eim, nyr, nyi):
+    """XLA fallback extension (roll/rev/concat — pass-count-bound but
+    portable; used on CPU and for shapes the kernel's tiles dislike)."""
+    from ._planar import hermitian_upper
+
+    m = int(ere.shape[0])
+    return (
+        jnp.concatenate([ere, nyr[None], hermitian_upper(ere, m - 1)], 0),
+        jnp.concatenate([eim, nyi[None], -hermitian_upper(eim, m - 1)], 0),
+    )
+
+
+def _use_pallas_ext(n1: int, n2: int) -> bool:
+    if os.environ.get("HEAT_TPU_FFT_EXT_PALLAS", "1") != "1":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    # one (1, n1, n2) row block per step: keep the tiles exact
+    return n1 % 8 == 0 and n2 % 128 == 0 and n1 >= 8 and n2 >= 128
+
+
+def leading_eligible(re: jax.Array, axes, im_present: bool) -> bool:
+    """3-D all-axes f32 full-length transforms; the real path (no im)
+    additionally halves axis 0, so n0 must be even."""
+    if os.environ.get("HEAT_TPU_FFT_LEADING", "1") != "1":
+        return False
+    nd = re.ndim
+    if nd != 3 or len(axes) != 3 or re.dtype != jnp.float32:
+        return False
+    if sorted(a % nd for a in axes) != list(range(nd)):
+        return False
+    if any(int(s) < 2 for s in re.shape):
+        return False
+    if not im_present and int(re.shape[0]) % 2 != 0:
+        return False
+    return True
+
+
+def rfft3_leading(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
+    """Full 3-D spectrum of a real (n0, n1, n2) array, all axes.
+
+    Axis 0 is halved to m = n0//2 bins (the Nyquist bin rides a side
+    chain), the three stages contract the leading dim in turn — the
+    final stage lands the (k0, k1, k2) orientation with no transposes —
+    and the Hermitian upper half is assembled by the extension kernel."""
+    from ._planar import scale_factor
+
+    n0, n1, n2 = (int(s) for s in x.shape)
+    m = n0 // 2
+    dt = str(x.dtype)
+    prec = _precision()
+    s = scale_factor([n0, n1, n2], norm, False)
+
+    re = _dg0(x, _w_entry_half(n0, m, dt, "re"), prec)  # (n1, n2, m)
+    im = _dg0(x, _w_entry_half(n0, m, dt, "im"), prec)
+    wc1 = _w_cat(n1, dt, False, 1.0)
+    wc2 = _w_cat(n2, dt, False, float(s))  # norm folded into the exit
+    mre, mim = _stage(re, im, wc1, n1, prec)  # (n2, m, n1)
+    fuse_ext = _use_pallas_ext(n1, n2)
+    if fuse_ext:
+        # leave the exit planes UNcombined — the extension kernel folds
+        # the combine into its row pass (one fewer full-size HBM pass)
+        zr2 = _dg0(mre, wc2, prec)  # (m, n1, 2n2)
+        zi2 = _dg0(mim, wc2, prec)
+    else:
+        ere, eim = _stage(mre, mim, wc2, n2, prec)  # (m, n1, n2)
+
+    # Nyquist side chain: bin n0/2 of the axis-0 DFT is the alternating
+    # sum, then an ordinary 2-D transform of that (real) plane
+    alt = jnp.asarray(
+        np.where(np.arange(n0) % 2 == 0, 1.0, -1.0).astype(dt)
+    )
+    nyq = jnp.tensordot(alt, x, ((0,), (0,)))  # (n1, n2)
+    a = _dg0(nyq, wc1, prec)  # (n2, 2n1)
+    br = _dg0(a[:, :n1], wc2, prec)  # (n1, 2n2)
+    bi = _dg0(a[:, n1:], wc2, prec)
+    nyr = br[:, :n2] - bi[:, n2:]
+    nyi = br[:, n2:] + bi[:, :n2]
+
+    if fuse_ext:
+        return _ext_fused_pallas(zr2, zi2, nyr, nyi)
+    return _ext_xla(ere, eim, nyr, nyi)
+
+
+def cfft3_leading(
+    re: jax.Array, im: jax.Array, inverse: bool, norm
+) -> Tuple[jax.Array, jax.Array]:
+    """Full 3-D transform of a complex plane pair, all axes: three
+    leading-contraction stages, no transposes, norm folded into the
+    exit matrices.  Replaces the interleaved engine's entry/mid/exit +
+    two re-pair transposes (measured 46.4 ms -> ~20 ms at 512^3)."""
+    from ._planar import scale_factor
+
+    n0, n1, n2 = (int(s) for s in re.shape)
+    dt = str(re.dtype)
+    prec = _precision()
+    s = scale_factor([n0, n1, n2], norm, inverse)
+
+    w0 = _w_cat(n0, dt, inverse, 1.0)
+    w1 = _w_cat(n1, dt, inverse, 1.0)
+    w2 = _w_cat(n2, dt, inverse, float(s))
+    re, im = _stage(re, im, w0, n0, prec)  # (n1, n2, n0)
+    re, im = _stage(re, im, w1, n1, prec)  # (n2, n0, n1)
+    re, im = _stage(re, im, w2, n2, prec)  # (n0, n1, n2)
+    return re, im

@@ -6,7 +6,7 @@ The tunneled TPU runtime rejects every complex64 op (see
 The transform itself is built to ride the MXU instead of translating a
 butterfly network:
 
-* length ``n <= _CUTOFF``: the DFT is a literal matrix product with the
+* length ``n <= _cutoff()``: the DFT is a literal matrix product with the
   (symmetric) DFT matrix — ``(batch, n) @ (n, n)`` per plane, a shape the
   systolic array is built for.  A complex matmul uses the 3-multiplication
   (Karatsuba) identity, and a purely real input (rfft, the first axis of a
@@ -15,7 +15,7 @@ butterfly network:
   ``(n2, n1)``, DFT the columns, twiddle, DFT the rows, transpose-ravel.
   Each factor recurses until it fits the matmul base case, so every FLOP
   is still a matrix product.
-* prime ``n > _CUTOFF``: Bluestein's chirp-z algorithm turns the DFT into
+* prime ``n > _cutoff()``: Bluestein's chirp-z algorithm turns the DFT into
   a circular convolution of power-of-two length, which the four-step path
   handles; the chirp filter's spectrum is a host-precomputed constant.
 
@@ -48,15 +48,16 @@ __all__ = [
     "ihfft1",
 ]
 
-#: Largest DFT applied as one literal matrix product.  The r4 floor-aware
-#: sweep (scripts/tune_fft.py, docs/fft_roofline.md) shows the 512³
-#: transform is HBM-bound: XLA's own cost analysis schedules 43.1 GB per
-#: transform and the measured time sustains ~101% of the same-session
-#: stream bandwidth, while the whole (precision × cutoff) grid spans
-#: only ±12% (0.058-0.075 s).  64 is kept for its MXU-friendly K-depth
-#: and 1.7e-7 accuracy at the HIGHEST default; overridable by env for
-#: re-tuning on other hardware.
-_CUTOFF = int(os.environ.get("HEAT_TPU_FFT_CUTOFF", "64"))
+def _cutoff() -> int:
+    """Largest DFT applied as one literal matrix product.  The r4
+    floor-aware sweep (scripts/tune_fft.py, docs/fft_roofline.md) shows
+    the 512³ transform is HBM-bound: the whole (precision × cutoff) grid
+    spans only ±12%.  64 is kept for its MXU-friendly K-depth and 1.7e-7
+    accuracy at the HIGHEST default; overridable by env for re-tuning on
+    other hardware.  Read at call time so the knob participates in
+    fft.py's program-cache key (a module-load snapshot would make the
+    keyed retrace trace the stale value)."""
+    return int(os.environ.get("HEAT_TPU_FFT_CUTOFF", "64"))
 
 
 def _precision_name() -> str:
@@ -192,9 +193,10 @@ def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
     """Unscaled DFT along the LAST axis; im may be None (real input)."""
     n = re.shape[-1]
     dt = str(re.dtype)
+    cutoff = _cutoff()
     if n == 1:
         return re, jnp.zeros_like(re) if im is None else im
-    if n <= _CUTOFF:
+    if n <= cutoff:
         return _apply_w(re, im, _dft_w(n, inverse, dt))
     use_direct = n <= _direct_cap() and re.dtype == jnp.float32
     if use_direct and os.environ.get("HEAT_TPU_FFT_PALLAS", "0") != "1":
@@ -208,7 +210,7 @@ def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
         if im is None:
             return _mm(re, wre), _mm(re, wim)
         return _mm(re, wre) - _mm(im, wim), _mm(re, wim) + _mm(im, wre)
-    n1 = _largest_factor(n, _CUTOFF)
+    n1 = _largest_factor(n, cutoff)
     if n1 == 1:
         return _bluestein_last(re, im, inverse)
     # fused Pallas axis pass (OPT-IN, time-neutral on the bench v5e —
@@ -227,11 +229,11 @@ def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
             return _pf.fused_axis_pass(re, im, inverse, _precision_name())
     n2 = n // n1
     batch = re.shape[:-1]
-    if n2 <= _CUTOFF:
+    if n2 <= cutoff:
         # single-level four-step fully inside two einsums: the stage
         # transposes ride the dot_general layouts instead of separate
         # transpose passes — the transform is HBM-bound on the bench chip
-        # (see the _CUTOFF note), so bytes not moved are time saved.
+        # (see the _cutoff note), so bytes not moved are time saved.
         # j = j1 + n1*j2: x[..., j2, j1]; A: DFT over j2 -> [..., k2, j1]
         re = re.reshape(*batch, n2, n1)
         im = im.reshape(*batch, n2, n1) if im is not None else None
@@ -429,6 +431,17 @@ def _revax(a: jax.Array, ax: int) -> jax.Array:
     )
 
 
+def hermitian_upper(p: jax.Array, rows: int) -> jax.Array:
+    """Upper-half mirror of a leading-axis half spectrum: rows 1..rows of
+    ``p`` evaluated at ``p[n0-k0, (n1-k1)%n1, (n2-k2)%n2]`` — one roll +
+    one multi-axis ``lax.rev`` (rev = roll o flip; the chained
+    revax/concat formulation measured 1.8x slower on the bench chip).
+    Negate the result for the imaginary plane.  Shared by the
+    interleaved engine and the leading engine's XLA extension fallback."""
+    u = p[1 : rows + 1]
+    return jax.lax.rev(jnp.roll(u, (-1, -1), (1, 2)), (0, 1, 2))
+
+
 def _mm_merged(a: jax.Array, w, prec) -> jax.Array:
     """One matmul along the merged minor dim (the whole DFT stage)."""
     return jax.lax.dot_general(
@@ -490,11 +503,7 @@ def _rfft3_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
     im_lo = _mm_merged(z, wim, prec)
 
     def upper(p):
-        # p[n0-k0, rev(k1), rev(k2)] via one roll + one multi-axis
-        # lax.rev (rev = roll o flip); the chained revax/concat
-        # formulation measured 1.8x slower on the bench chip
-        u = p[1 : n0 - m0 + 1]
-        return jax.lax.rev(jnp.roll(u, (-1, -1), (1, 2)), (0, 1, 2))
+        return hermitian_upper(p, n0 - m0)
 
     re = jnp.concatenate([re_lo, upper(re_lo)], 0)
     im = jnp.concatenate([im_lo, -upper(im_lo)], 0)
@@ -668,6 +677,10 @@ def real_fftn(re: jax.Array, axes: Sequence[int], norm) -> Tuple[jax.Array, jax.
     full-length transform); the 2-D all-axes case its two-stage variant."""
     if _interleaved_eligible(re, axes):
         if re.ndim == 3:
+            from . import _leading
+
+            if _leading.leading_eligible(re, axes, False):
+                return _leading.rfft3_leading(re, norm)
             return _rfft3_interleaved(re, norm)
         return rfft2_full_interleaved(re, norm)
     axes = [a % re.ndim for a in axes]

@@ -182,6 +182,10 @@ def _planar_prog(kind: str, norm, axes_ns):
             "HEAT_TPU_FFT_INTERLEAVED",
             "HEAT_TPU_FFT_PRECISION",
             "HEAT_TPU_FFT_PALLAS",
+            "HEAT_TPU_FFT_LEADING",
+            "HEAT_TPU_FFT_EXT_PALLAS",
+            "HEAT_TPU_FFT_DIRECT_CAP",
+            "HEAT_TPU_FFT_CUTOFF",
         )
     )
     return _planar_prog_cached(kind, norm, axes_ns, cfg)
@@ -209,6 +213,10 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
                     # dot-per-stage engine (fftn -> filter -> ifftn chains
                     # stay on the fast path, not just the first transform)
                     if re.ndim == 3:
+                        from . import _leading
+
+                        if _leading.leading_eligible(re, axes_l, True):
+                            return _leading.cfft3_leading(re, im, inv, norm)
                         return _pl.cfft3_interleaved(re, im, inv, norm)
                     return _pl.cfft2_interleaved(re, im, inv, norm)
                 if im is None and inv and _pl._interleaved_eligible(re, axes_l):

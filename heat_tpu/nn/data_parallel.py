@@ -123,15 +123,18 @@ class DataParallel:
 
     @staticmethod
     def _loss_key(loss_fn: Callable):
-        """Cache key for a loss function: the code object plus the
+        """``(key, pins)`` for a loss function: the code object plus the
         IDENTITY of every piece of captured state (closure cells, default
         args, a bound method's ``__self__``).  A fresh lambda per loop
         iteration capturing the same objects reuses the compiled program;
         a lambda capturing *different* state (``lambda p, t, w=w: ...``
         with a new ``w``) rebuilds instead of silently evaluating the old
-        trace.  The instance keeps a strong reference to the cached
-        function, so the ids it compares against cannot be recycled.
-        Callables without a code object (``functools.partial``, C
+        trace.  ``pins`` holds the exact objects whose ids appear in the
+        key — the cache entry must keep it alive, because the function
+        object alone pins its closure CELLS, not their historical
+        contents: rebinding the enclosing variable frees the old contents
+        and a later object at the recycled address would alias the stale
+        key.  Callables without a code object (``functools.partial``, C
         callables) key on their own identity — recreate them per call and
         each call retraces.  Like ``jax.jit`` itself, IN-PLACE mutation of
         a captured object (``obj.w = 2.0`` behind a bound method) is not
@@ -140,23 +143,43 @@ class DataParallel:
         fn = getattr(loss_fn, "__func__", loss_fn)
         code = getattr(fn, "__code__", None)
         if code is None:
-            return (id(loss_fn),)
+            return (id(loss_fn),), (loss_fn,)
 
-        def _cell_id(c):
+        cells = []
+        for c in fn.__closure__ or ():
             try:
-                return id(c.cell_contents)
+                cells.append(c.cell_contents)
             except ValueError:  # empty cell (e.g. unbound recursive name)
-                return id(c)
-
-        return (
+                cells.append(c)
+        bound_self = getattr(loss_fn, "__self__", None)
+        defaults = tuple(fn.__defaults__ or ())
+        kwdefaults = sorted((fn.__kwdefaults__ or {}).items())
+        key = (
             code,
-            id(getattr(loss_fn, "__self__", None)),
-            tuple(id(d) for d in fn.__defaults__ or ()),
-            tuple(sorted((k, id(v)) for k, v in (fn.__kwdefaults__ or {}).items())),
-            tuple(_cell_id(c) for c in fn.__closure__ or ()),
+            id(bound_self),
+            tuple(id(d) for d in defaults),
+            tuple((k, id(v)) for k, v in kwdefaults),
+            tuple(id(c) for c in cells),
         )
+        pins = (loss_fn, bound_self, defaults, tuple(v for _, v in kwdefaults), tuple(cells))
+        return key, pins
 
     _PROGRAM_CACHE_SIZE = 8
+
+    def _cached_program(self, cache: dict, loss_fn: Callable, build: Callable):
+        """Shared keyed-FIFO program cache (``_build`` and the
+        hierarchical ``step``): returns ``build()``'s value, cached under
+        :meth:`_loss_key` with the key's referent objects pinned for the
+        entry's lifetime."""
+        key, pins = self._loss_key(loss_fn)
+        cached = cache.get(key)
+        if cached is not None:
+            return cached[0]
+        value = build()
+        cache[key] = (value, pins)
+        while len(cache) > self._PROGRAM_CACHE_SIZE:
+            cache.pop(next(iter(cache)))
+        return value
 
     def _build(self, loss_fn: Callable) -> None:
         """Compile (and cache) the fused step body and the scanned epoch
@@ -165,43 +188,40 @@ class DataParallel:
         GAN-style) dispatch from cache instead of retracing every call; a
         genuinely new loss rebuilds instead of silently reusing the old
         closure."""
-        key = self._loss_key(loss_fn)
-        cached = self._programs.get(key)
-        if cached is not None:
-            self._train_step, self._epoch_fn = cached[0], cached[1]
-            return
-        apply = self._apply
-        optimizer = self._optimizer
-        import optax
+        def build():
+            apply = self._apply
+            optimizer = self._optimizer
+            import optax
 
-        def body(params, opt_state, xb, yb):
-            def total_loss(p):
-                return loss_fn(apply(p, xb), yb)
+            def body(params, opt_state, xb, yb):
+                def total_loss(p):
+                    return loss_fn(apply(p, xb), yb)
 
-            loss, grads = jax.value_and_grad(total_loss)(params)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            return loss, optax.apply_updates(params, updates), opt_state
+                loss, grads = jax.value_and_grad(total_loss)(params)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                return loss, optax.apply_updates(params, updates), opt_state
 
-        @jax.jit
-        def epoch(params, opt_state, xs, ys):
-            def scan_body(carry, batch):
-                loss, p, s = body(*carry, *batch)
-                return (p, s), loss
+            @jax.jit
+            def epoch(params, opt_state, xs, ys):
+                def scan_body(carry, batch):
+                    loss, p, s = body(*carry, *batch)
+                    return (p, s), loss
 
-            (params, opt_state), losses = jax.lax.scan(
-                scan_body, (params, opt_state), (xs, ys)
+                (params, opt_state), losses = jax.lax.scan(
+                    scan_body, (params, opt_state), (xs, ys)
+                )
+                return params, opt_state, losses
+
+            self._batch_sharding = NamedSharding(
+                self.comm.mesh, P(self.comm.axis_name)
             )
-            return params, opt_state, losses
+            self._stack_sharding = NamedSharding(
+                self.comm.mesh, P(None, self.comm.axis_name)
+            )
+            return jax.jit(body), epoch
 
-        self._train_step = jax.jit(body)
-        self._epoch_fn = epoch
-        # the loss_fn strong ref pins the key's ids for the entry's lifetime
-        self._programs[key] = (self._train_step, self._epoch_fn, loss_fn)
-        while len(self._programs) > self._PROGRAM_CACHE_SIZE:
-            self._programs.pop(next(iter(self._programs)))
-        self._batch_sharding = NamedSharding(self.comm.mesh, P(self.comm.axis_name))
-        self._stack_sharding = NamedSharding(
-            self.comm.mesh, P(None, self.comm.axis_name)
+        self._train_step, self._epoch_fn = self._cached_program(
+            self._programs, loss_fn, build
         )
 
     def step(self, loss_fn: Callable, x, y) -> float:
@@ -350,11 +370,7 @@ class DataParallelMultiGPU(DataParallel):
         n_node = comm.num_nodes
         # own cache slots: the base _build programs have a different
         # signature, and mixing step()/train_steps() must not collide
-        hier_key = self._loss_key(loss_fn)
-        hier_cached = self._hier_programs.get(hier_key)
-        if hier_cached is not None:
-            self._hier_step = hier_cached[0]
-        else:
+        def build():
             apply = self._apply
 
             @jax.jit
@@ -365,14 +381,12 @@ class DataParallelMultiGPU(DataParallel):
                 losses, grads = jax.vmap(jax.value_and_grad(node_loss))(stacked, xn, yn)
                 return losses.mean(), grads
 
-            self._hier_step = grad_step
-            # the loss_fn strong ref pins the key's ids for the entry's life
-            self._hier_programs[hier_key] = (grad_step, loss_fn)
-            while len(self._hier_programs) > self._PROGRAM_CACHE_SIZE:
-                self._hier_programs.pop(next(iter(self._hier_programs)))
             self._hier_sharding = NamedSharding(
                 comm.mesh, P(comm.global_axis, comm.node_axis)
             )
+            return grad_step
+
+        self._hier_step = self._cached_program(self._hier_programs, loss_fn, build)
 
         xd = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
         yd = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)

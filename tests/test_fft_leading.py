@@ -1,0 +1,134 @@
+"""Leading-contraction 3-D FFT engine (fft/_leading.py).
+
+Numpy is ground truth throughout; the engine's default HIGH matmul
+policy bounds f32 relative error around ~3e-5 at test sizes, so the
+tolerances here are a few 1e-4.  Reference semantics:
+heat/fft/fft.py:100-137 (fftn/ifftn).
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.fft import _leading, _planar
+
+
+def _rel(a, b):
+    d = np.abs(a - b)
+    return d.max() / max(np.abs(b).max(), 1e-12)
+
+
+SHAPES = [(16, 16, 16), (8, 16, 32), (32, 8, 16), (6, 10, 12), (4, 4, 4)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_rfft3_leading_matches_numpy(shape):
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal(shape).astype(np.float32)
+    re, im = _leading.rfft3_leading(np.asarray(x), None)
+    ref = np.fft.fftn(x.astype(np.float64))
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4
+
+
+@pytest.mark.parametrize("norm", [None, "ortho", "forward", "backward"])
+def test_rfft3_leading_norms(norm):
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((8, 8, 8)).astype(np.float32)
+    re, im = _leading.rfft3_leading(np.asarray(x), norm)
+    ref = np.fft.fftn(x.astype(np.float64), norm=norm)
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4
+
+
+@pytest.mark.parametrize("inverse", [False, True])
+@pytest.mark.parametrize("shape", [(16, 16, 16), (8, 16, 32), (6, 10, 12)])
+def test_cfft3_leading_matches_numpy(shape, inverse):
+    rng = np.random.default_rng(11)
+    xr = rng.standard_normal(shape).astype(np.float32)
+    xi = rng.standard_normal(shape).astype(np.float32)
+    re, im = _leading.cfft3_leading(np.asarray(xr), np.asarray(xi), inverse, None)
+    z = xr.astype(np.float64) + 1j * xi.astype(np.float64)
+    ref = np.fft.ifftn(z) if inverse else np.fft.fftn(z)
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4
+
+
+def test_leading_matches_interleaved_engine():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((16, 16, 16)).astype(np.float32)
+    rl, il = _leading.rfft3_leading(np.asarray(x), None)
+    ri, ii = _planar._rfft3_interleaved(np.asarray(x), None)
+    assert _rel(np.asarray(rl), np.asarray(ri)) < 5e-4
+    assert _rel(np.asarray(il) + 0.0, np.asarray(ii) + 0.0) < 5e-4
+
+
+def test_eligibility_gates():
+    import jax.numpy as jnp
+    import jax
+
+    re3 = jax.numpy.zeros((8, 8, 8), jnp.float32)
+    assert _leading.leading_eligible(re3, [0, 1, 2], False)
+    # odd leading axis only blocks the REAL (halved) path
+    re_odd = jax.numpy.zeros((7, 8, 8), jnp.float32)
+    assert not _leading.leading_eligible(re_odd, [0, 1, 2], False)
+    assert _leading.leading_eligible(re_odd, [0, 1, 2], True)
+    # wrong rank / dtype / partial axes
+    assert not _leading.leading_eligible(jnp.zeros((8, 8), jnp.float32), [0, 1], False)
+    assert not _leading.leading_eligible(
+        jnp.zeros((8, 8, 8), jnp.float64), [0, 1, 2], False
+    )
+    assert not _leading.leading_eligible(re3, [0, 1], False)
+
+
+def test_fftn_user_path_rides_leading(monkeypatch):
+    """ht.fft.fftn on an eligible cube goes through the leading engine
+    (the engine's odd-shape fallback keeps parity for the rest)."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((8, 12, 16)).astype(np.float32)
+    out = ht.fft.fftn(ht.array(x))
+    ref = np.fft.fftn(x.astype(np.float64))
+    assert _rel(out.numpy(), ref) < 5e-4
+    # complex input path
+    z = x + 1j * rng.standard_normal((8, 12, 16)).astype(np.float32)
+    out_c = ht.fft.fftn(ht.array(z.astype(np.complex64)))
+    assert _rel(out_c.numpy(), np.fft.fftn(z.astype(np.complex128))) < 5e-4
+    out_i = ht.fft.ifftn(ht.array(z.astype(np.complex64)))
+    assert _rel(out_i.numpy(), np.fft.ifftn(z.astype(np.complex128))) < 5e-4
+
+
+def test_leading_disabled_env(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_FFT_LEADING", "0")
+    import jax.numpy as jnp
+
+    assert not _leading.leading_eligible(
+        jnp.zeros((8, 8, 8), jnp.float32), [0, 1, 2], False
+    )
+
+
+def test_ext_fused_interpret_matches_xla(monkeypatch):
+    """The combine-folding variant agrees with combine-then-extend."""
+    rng = np.random.default_rng(10)
+    m, n1, n2 = 8, 8, 128
+    zr = rng.standard_normal((m, n1, 2 * n2)).astype(np.float32)
+    zi = rng.standard_normal((m, n1, 2 * n2)).astype(np.float32)
+    nyr = rng.standard_normal((n1, n2)).astype(np.float32)
+    nyi = rng.standard_normal((n1, n2)).astype(np.float32)
+    got = _leading._ext_fused_pallas(zr, zi, nyr, nyi)
+    ere = zr[..., :n2] - zi[..., n2:]
+    eim = zr[..., n2:] + zi[..., :n2]
+    ref = _leading._ext_xla(ere, eim, nyr, nyi)
+    assert _rel(np.asarray(got[0]), np.asarray(ref[0])) < 2e-4
+    assert _rel(np.asarray(got[1]), np.asarray(ref[1])) < 2e-4
+
+
+def test_rfft3_leading_fused_ext_path(monkeypatch):
+    """Force the fused-extension branch (interpret mode off-TPU) on an
+    aligned shape and pin it against numpy."""
+    monkeypatch.setattr(_leading, "_use_pallas_ext", lambda n1, n2: True)
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((16, 8, 128)).astype(np.float32)
+    re, im = _leading.rfft3_leading(np.asarray(x), None)
+    ref = np.fft.fftn(x.astype(np.float64))
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4

@@ -399,9 +399,39 @@ def test_loss_cache_kwdefaults_and_alternation(mlp):
     la, lb = mk(jnp.float32(1.0)), mk(jnp.float32(41.0))
     assert abs(dp.step(la, x, y) - 1.0) < 1e-5
     assert abs(dp.step(lb, x, y) - 41.0) < 1e-5, "kwdefault state was ignored"
-    prog_a = dp._programs[dp._loss_key(la)][0]
-    prog_b = dp._programs[dp._loss_key(lb)][0]
+    prog_a = dp._programs[dp._loss_key(la)[0]][0]
+    prog_b = dp._programs[dp._loss_key(lb)[0]][0]
     assert abs(dp.step(la, x, y) - 1.0) < 1e-5
     assert abs(dp.step(lb, x, y) - 41.0) < 1e-5
-    assert dp._programs[dp._loss_key(la)][0] is prog_a
-    assert dp._programs[dp._loss_key(lb)][0] is prog_b
+    assert dp._programs[dp._loss_key(la)[0]][0] is prog_a
+    assert dp._programs[dp._loss_key(lb)[0]][0] is prog_b
+
+
+def test_loss_cache_pins_captured_state(mlp):
+    """The cache entry pins the objects whose ids form the key: rebinding
+    the enclosing variable must not let a recycled address alias a stale
+    entry (the id lives in the key; the pin keeps it valid)."""
+    import gc
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    dp = ht.nn.DataParallel(mlp, optimizer=optax.sgd(1e-2))
+    x = jnp.ones((16, 4), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    dp.init(jax.random.PRNGKey(0), x)
+
+    losses = []
+    for i in range(3):
+        w = jnp.float32(float(i))  # rebinding frees the previous object...
+        out = dp.step(
+            lambda pred, target: (pred * 0.0).sum() + w + 0.0 * target.sum(), x, y
+        )
+        losses.append(out)
+        gc.collect()
+        # ...but every entry's key ids stay pinned by the entry itself
+        for key, entry in dp._programs.items():
+            pinned_ids = {id(o) for o in entry[1][4]}  # closure pins
+            for cid in key[4]:
+                assert cid in pinned_ids
+    assert losses == [0.0, 1.0, 2.0], "a stale program served a new capture"
