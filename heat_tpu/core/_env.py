@@ -14,11 +14,16 @@ _PRECISION_TABLE = {
 }
 
 
-def precision_from_env(var: str, default: str):
-    """``jax.lax.Precision`` from an env var with a diagnostic error."""
+def precision_name_from_env(var: str, default: str) -> str:
+    """Normalized precision name from an env var with a diagnostic error."""
     name = os.environ.get(var, default).strip().lower()
     if name not in _PRECISION_TABLE:
         raise ValueError(
             f"{var}={os.environ.get(var)!r}: expected one of {sorted(_PRECISION_TABLE)}"
         )
-    return _PRECISION_TABLE[name]
+    return name
+
+
+def precision_from_env(var: str, default: str):
+    """``jax.lax.Precision`` from an env var with a diagnostic error."""
+    return _PRECISION_TABLE[precision_name_from_env(var, default)]

@@ -78,6 +78,16 @@ def _w_entry_half(n: int, m: int, dt: str, part: str):
 
 
 @functools.lru_cache(maxsize=64)
+def _w_entry_cat(n: int, m: int, dt: str):
+    """(n, 2m) ``[re-bins 0..m-1 | im-bins 0..m-1]`` entry matrix: one
+    dot reads x once (the two-dot form reads it twice); the mid stage's
+    kernel picks the column blocks apart via BlockSpec index maps, so
+    the halves are never slice-copied."""
+    c, s = _cs(n, False)
+    return np.asarray(np.concatenate([c[:, :m], s[:, :m]], 1), dt)
+
+
+@functools.lru_cache(maxsize=64)
 def _w_cat(n: int, dt: str, inverse: bool, scale: float):
     """(n, 2n) ``[W_re | W_im] * scale`` stage matrix (scale folds the
     norm factor into the exit stage — no post-scaling pass)."""
@@ -90,10 +100,21 @@ def _perm_bf(n: int):
     """Exact-in-bf16 rev-roll permutation: P[a, b] = 1 iff a = (n-b) % n.
 
     Symmetric (the map is an involution), so one matrix serves both the
-    sublane and the lane side of the extension kernel's MXU reversal."""
+    sublane and the lane side of the extension kernel's MXU reversal.
+    Host numpy, like every other weight cache here — converted at the
+    pallas_call boundary (a cached device array would pin HBM for the
+    process lifetime and go stale across backend re-initialization)."""
     p = np.zeros((n, n), np.float32)
     p[(n - np.arange(n)) % n, np.arange(n)] = 1.0
-    return jnp.asarray(p, jnp.bfloat16)
+    return np.asarray(p, jnp.bfloat16)
+
+
+def _precision_is_high() -> bool:
+    """The Pallas kernels' manual bf16 splits ARE the HIGH error class;
+    any other requested precision must take the XLA paths."""
+    from ..core._env import precision_name_from_env
+
+    return precision_name_from_env("HEAT_TPU_FFT_PRECISION", "high") == "high"
 
 
 def _dg0(a: jax.Array, w, prec) -> jax.Array:
@@ -110,6 +131,154 @@ def _stage(re, im, wcat, n: int, prec):
     zr = _dg0(re, wcat, prec)
     zi = _dg0(im, wcat, prec)
     return zr[..., :n] - zi[..., n:], zr[..., n:] + zi[..., :n]
+
+
+# ----------------------------------------------------------------------
+# Fused stage kernel: both cat-dots + the plane combine in one pass, so
+# the (zr, zi) intermediates never round-trip HBM — the XLA stage's
+# combine alone re-reads 2x and re-writes 1x the stage volume.  The dots
+# run as manual bf16x3 splits (x_hi*w_hi + x_lo*w_hi + x_hi*w_lo), the
+# same error class as the HIGH matmul policy the engine defaults to
+# (measured 1.2e-5 relative agreement); when HEAT_TPU_FFT_PRECISION
+# demands HIGHEST the XLA stage runs instead.  Measured at the 512^3 mid
+# stage: 4.44 ms vs 6.69 (the 4.2 ms bf16x3 MXU bound plus DMA overlap).
+# ----------------------------------------------------------------------
+@functools.lru_cache(maxsize=64)
+def _w_cat_bf(n: int, inverse: bool, scale: float):
+    """(w_hi, w_lo) bf16 split of the (n, 2n) cat stage matrix."""
+    w = np.asarray(_w_cat(n, "float32", inverse, scale))
+    hi = w.astype(np.float32).astype(jnp.bfloat16)
+    lo = (w - np.asarray(hi, np.float32)).astype(jnp.bfloat16)
+    return np.asarray(hi), np.asarray(lo)
+
+
+def _stage_kernel_factory(n: int):
+    from jax.experimental import pallas as pl
+
+    def kern(wh_ref, wl_ref, re_ref, im_ref, ore_ref, oim_ref):
+        wh = wh_ref[...]
+        wl = wl_ref[...]
+
+        def d(a, b):
+            return jax.lax.dot_general(
+                a, b, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        def cat_dot(x):
+            xh = x.astype(jnp.bfloat16)
+            xl = (x - xh.astype(jnp.float32)).astype(jnp.bfloat16)
+            return d(xh, wh) + d(xl, wh) + d(xh, wl)
+
+        zr = cat_dot(re_ref[...])  # (TM, 2n)
+        zi = cat_dot(im_ref[...])
+        ore_ref[...] = zr[:, :n] - zi[:, n:]
+        oim_ref[...] = zr[:, n:] + zi[:, :n]
+
+    return kern
+
+
+def _stage_tile(m_total: int) -> Optional[int]:
+    for tm in (256, 128):
+        if m_total % tm == 0:
+            return tm
+    return None
+
+
+def _use_fused_stage(k: int, m_total: int, n: int) -> bool:
+    if os.environ.get("HEAT_TPU_FFT_STAGE_PALLAS", "1") != "1":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if not _precision_is_high():
+        return False
+    # resident W pair: 2 * (n * 2n) bf16 — cap at ~8 MB of VMEM
+    if n > 1024 or n % 128 != 0 or k % 8 != 0:
+        return False
+    return _stage_tile(m_total) is not None
+
+
+def _stage_call(n, k, m_total, tm, re_map, im_map, re_op, im_op, inverse, scale):
+    """Shared ``pallas_call`` scaffold of both fused-stage entries: the
+    variants differ only in how their input index maps address the re/im
+    planes (separate arrays vs column blocks of one cat tensor)."""
+    from jax.experimental import pallas as pl
+
+    wh, wl = _w_cat_bf(n, inverse, scale)
+    return pl.pallas_call(
+        _stage_kernel_factory(n),
+        grid=(m_total // tm,),
+        in_specs=[
+            pl.BlockSpec((k, 2 * n), lambda i: (0, 0)),
+            pl.BlockSpec((k, 2 * n), lambda i: (0, 0)),
+            pl.BlockSpec((k, tm), re_map),
+            pl.BlockSpec((k, tm), im_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, n), lambda i: (i, 0)),
+            pl.BlockSpec((tm, n), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_total, n), re_op.dtype),
+            jax.ShapeDtypeStruct((m_total, n), im_op.dtype),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(wh, wl, re_op, im_op)
+
+
+def _stage_fused_pallas(re, im, n: int, inverse: bool, scale: float):
+    """Fused stage on 2-D views: (K, M) planes -> (M, n) planes."""
+    k = int(re.shape[0])
+    rest = tuple(int(s) for s in re.shape[1:])
+    m_total = 1
+    for s in rest:
+        m_total *= s
+    tm = _stage_tile(m_total)
+    ore, oim = _stage_call(
+        n, k, m_total, tm,
+        lambda i: (0, i), lambda i: (0, i),
+        re.reshape(k, m_total), im.reshape(k, m_total),
+        inverse, scale,
+    )
+    return ore.reshape(*rest, n), oim.reshape(*rest, n)
+
+
+def _stage_fused_pallas_blocked(z, n: int, m: int, inverse: bool, scale: float):
+    """Fused stage reading a BLOCK-CAT operand: z is (K, B, 2m) with re
+    bins in columns [0, m) and im bins in [m, 2m) of every B-row — the
+    entry dot's natural output.  The re/im halves are addressed by
+    BlockSpec index maps (tile i of the re plane is block ``b*(2m/tm)+j``
+    of the flat view), so no slice ever materializes."""
+    k = int(z.shape[0])
+    b = int(z.shape[1])
+    m_total = b * m
+    tm = _stage_tile(m)  # tiles must stay inside one m-block
+    z2 = z.reshape(k, b * 2 * m)
+    per_m = m // tm
+
+    def re_map(i):
+        return (0, (i // per_m) * (2 * per_m) + (i % per_m))
+
+    def im_map(i):
+        return (0, (i // per_m) * (2 * per_m) + per_m + (i % per_m))
+
+    ore, oim = _stage_call(
+        n, k, m_total, tm, re_map, im_map, z2, z2, inverse, scale
+    )
+    return ore.reshape(b, m, n), oim.reshape(b, m, n)
+
+
+def _stage_auto(re, im, n: int, inverse: bool, scale: float, prec):
+    """Fused kernel when eligible, else the XLA cat-dot stage (with the
+    scale folded into the matrix either way)."""
+    k = int(re.shape[0])
+    m_total = 1
+    for s in re.shape[1:]:
+        m_total *= int(s)
+    if _use_fused_stage(k, m_total, n):
+        return _stage_fused_pallas(re, im, n, inverse, scale)
+    dt = str(re.dtype)
+    return _stage(re, im, _w_cat(n, dt, inverse, float(scale)), n, prec)
 
 
 # ----------------------------------------------------------------------
@@ -221,6 +390,10 @@ def _use_pallas_ext(n1: int, n2: int) -> bool:
         return False
     if jax.default_backend() != "tpu":
         return False
+    # the kernel's bf16x2 MXU reversal is HIGH-class accuracy; a HIGHEST
+    # run must not silently cap the mirrored upper half at ~2^-17
+    if not _precision_is_high():
+        return False
     # one (1, n1, n2) row block per step: keep the tiles exact
     return n1 % 8 == 0 and n2 % 128 == 0 and n1 >= 8 and n2 >= 128
 
@@ -257,11 +430,16 @@ def rfft3_leading(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
     prec = _precision()
     s = scale_factor([n0, n1, n2], norm, False)
 
-    re = _dg0(x, _w_entry_half(n0, m, dt, "re"), prec)  # (n1, n2, m)
-    im = _dg0(x, _w_entry_half(n0, m, dt, "im"), prec)
     wc1 = _w_cat(n1, dt, False, 1.0)
     wc2 = _w_cat(n2, dt, False, float(s))  # norm folded into the exit
-    mre, mim = _stage(re, im, wc1, n1, prec)  # (n2, m, n1)
+    if _use_fused_stage(n1, n2 * m, n1) and _stage_tile(m) is not None:
+        # one cat entry dot (x read once) feeding the blocked mid kernel
+        z = _dg0(x, _w_entry_cat(n0, m, dt), prec)  # (n1, n2, 2m)
+        mre, mim = _stage_fused_pallas_blocked(z, n1, m, False, 1.0)
+    else:
+        re = _dg0(x, _w_entry_half(n0, m, dt, "re"), prec)  # (n1, n2, m)
+        im = _dg0(x, _w_entry_half(n0, m, dt, "im"), prec)
+        mre, mim = _stage_auto(re, im, n1, False, 1.0, prec)  # (n2, m, n1)
     fuse_ext = _use_pallas_ext(n1, n2)
     if fuse_ext:
         # leave the exit planes UNcombined — the extension kernel folds
@@ -298,14 +476,10 @@ def cfft3_leading(
     from ._planar import scale_factor
 
     n0, n1, n2 = (int(s) for s in re.shape)
-    dt = str(re.dtype)
     prec = _precision()
     s = scale_factor([n0, n1, n2], norm, inverse)
 
-    w0 = _w_cat(n0, dt, inverse, 1.0)
-    w1 = _w_cat(n1, dt, inverse, 1.0)
-    w2 = _w_cat(n2, dt, inverse, float(s))
-    re, im = _stage(re, im, w0, n0, prec)  # (n1, n2, n0)
-    re, im = _stage(re, im, w1, n1, prec)  # (n2, n0, n1)
-    re, im = _stage(re, im, w2, n2, prec)  # (n0, n1, n2)
+    re, im = _stage_auto(re, im, n0, inverse, 1.0, prec)  # (n1, n2, n0)
+    re, im = _stage_auto(re, im, n1, inverse, 1.0, prec)  # (n2, n0, n1)
+    re, im = _stage_auto(re, im, n2, inverse, float(s), prec)  # (n0, n1, n2)
     return re, im

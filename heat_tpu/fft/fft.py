@@ -184,6 +184,7 @@ def _planar_prog(kind: str, norm, axes_ns):
             "HEAT_TPU_FFT_PALLAS",
             "HEAT_TPU_FFT_LEADING",
             "HEAT_TPU_FFT_EXT_PALLAS",
+            "HEAT_TPU_FFT_STAGE_PALLAS",
             "HEAT_TPU_FFT_DIRECT_CAP",
             "HEAT_TPU_FFT_CUTOFF",
         )

@@ -122,6 +122,52 @@ def test_ext_fused_interpret_matches_xla(monkeypatch):
     assert _rel(np.asarray(got[1]), np.asarray(ref[1])) < 2e-4
 
 
+def test_stage_fused_interpret_matches_xla(monkeypatch):
+    """The fused stage kernel (bf16x3 in-kernel dots + combine) agrees
+    with the XLA cat-dot stage to the HIGH policy's error class."""
+    rng = np.random.default_rng(4)
+    n = 128  # transformed axis (= contracted dim)
+    re = rng.standard_normal((n, 4, 64)).astype(np.float32)
+    im = rng.standard_normal((n, 4, 64)).astype(np.float32)
+    got = _leading._stage_fused_pallas(np.asarray(re), np.asarray(im), n, False, 1.0)
+    import jax
+
+    wcat = _leading._w_cat(n, "float32", False, 1.0)
+    ref = _leading._stage(
+        np.asarray(re), np.asarray(im), wcat, n, jax.lax.Precision.HIGHEST
+    )
+    assert _rel(np.asarray(got[0]), np.asarray(ref[0])) < 2e-4
+    assert _rel(np.asarray(got[1]), np.asarray(ref[1])) < 2e-4
+
+
+def test_rfft3_leading_all_kernels_forced(monkeypatch):
+    """Force every Pallas path (cat entry + blocked mid kernel + fused
+    extension) through the full real transform in interpret mode and pin
+    against numpy.  m = n0//2 = 128 tiles, so the blocked branch engages."""
+    monkeypatch.setattr(_leading, "_use_pallas_ext", lambda n1, n2: True)
+    monkeypatch.setattr(_leading, "_use_fused_stage", lambda k, m, n: True)
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((256, 8, 128)).astype(np.float32)
+    re, im = _leading.rfft3_leading(np.asarray(x), None)
+    ref = np.fft.fftn(x.astype(np.float64))
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4
+
+
+def test_stage_blocked_interpret_matches_plain(monkeypatch):
+    """The blocked-operand kernel (index-mapped re/im halves of a cat
+    tensor) matches the separate-planes kernel."""
+    rng = np.random.default_rng(14)
+    k, b, m, n = 128, 4, 128, 128
+    z = rng.standard_normal((k, b, 2 * m)).astype(np.float32)
+    got = _leading._stage_fused_pallas_blocked(np.asarray(z), n, m, False, 1.0)
+    re = z[..., :m]
+    im = z[..., m:]
+    ref = _leading._stage_fused_pallas(np.asarray(re), np.asarray(im), n, False, 1.0)
+    assert np.allclose(np.asarray(got[0]), np.asarray(ref[0]), atol=1e-5)
+    assert np.allclose(np.asarray(got[1]), np.asarray(ref[1]), atol=1e-5)
+
+
 def test_rfft3_leading_fused_ext_path(monkeypatch):
     """Force the fused-extension branch (interpret mode off-TPU) on an
     aligned shape and pin it against numpy."""
