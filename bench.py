@@ -279,14 +279,56 @@ def bench_kmeans(ht, sync_floor, roofline=None):
         iters *= 2
     pts_per_s = n * iters / per
 
-    # independent second measurement (fresh windows, same program): the
-    # published value must reproduce within the larger of the two spreads
-    per2, meta2 = _time_amortized(
-        fit, lambda km: float(km.cluster_centers_.sum()), meta["n_iter"], sync_floor, windows=3
+    # independent second measurement, INTERLEAVED with the first: eight
+    # windows alternate between sample A and sample B, so a monotone
+    # link-RTT drift (the tunnel's per-minute weather) degrades both
+    # samples equally and the agreement flag tests PROGRAM
+    # reproducibility — two sequential measurement blocks, the r5a
+    # formulation, disagreed 7% on a 0.1%-spread metric purely because
+    # the link shifted between the blocks.
+    n_it = meta["n_iter"]
+    wins_a, wins_b = [], []
+    attempts = 0
+    while (len(wins_a) < 4 or len(wins_b) < 4) and attempts < 16:
+        attempts += 1
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_it):
+            out = fit()
+        float(out.cluster_centers_.sum())
+        elapsed = time.perf_counter() - t0
+        if elapsed <= sync_floor:
+            continue  # link hiccup window, skip (bounded retries)
+        (wins_a if attempts % 2 == 1 else wins_b).append(
+            (elapsed - sync_floor) / n_it
+        )
+    per_a = min(wins_a) if wins_a else per
+    per_b = min(wins_b) if wins_b else per
+    v1, v2 = n * iters / per_a, n * iters / per_b
+    all_wins = wins_a + wins_b
+    spread_ab = (
+        100.0 * (float(np.median(all_wins)) - min(all_wins)) / min(all_wins)
+        if all_wins
+        else 0.0
     )
-    v1, v2 = n * iters / per, n * iters / per2
-    tol = max(meta["spread_pct"], meta2["spread_pct"], 5.0) / 100.0
+    meta2 = {
+        "windows_a": len(wins_a),
+        "windows_b": len(wins_b),
+        "interleaved": True,
+        "spread_pct": round(spread_ab, 1),
+        "per_iter_s_a": [round(s, 6) for s in wins_a],
+        "per_iter_s_b": [round(s, 6) for s in wins_b],
+    }
+    # the tolerance absorbs BOTH samples' own dispersion (the old
+    # sequential formulation used both blocks' spreads too)
+    tol = max(meta["spread_pct"], spread_ab, 5.0) / 100.0
     agreement = abs(v1 - v2) <= tol * max(v1, v2)
+    # publish from the interleaved windows so the shipped value is the
+    # quantity the agreement flag actually covers (the first block's
+    # role is the workload-convergence loop; a link drift between it
+    # and the interleaved block must not ship an unreproducible number)
+    if all_wins:
+        pts_per_s = n * iters / min(all_wins)
 
     # reference per-process path: torch CPU one Lloyd iteration (cdist+argmin
     # +scatter mean, cluster/kmeans.py torch kernels) on a subset

@@ -447,7 +447,7 @@ def rfft3_leading(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
         zr2 = _dg0(mre, wc2, prec)  # (m, n1, 2n2)
         zi2 = _dg0(mim, wc2, prec)
     else:
-        ere, eim = _stage(mre, mim, wc2, n2, prec)  # (m, n1, n2)
+        ere, eim = _stage_auto(mre, mim, n2, False, float(s), prec)  # (m, n1, n2)
 
     # Nyquist side chain: bin n0/2 of the axis-0 DFT is the alternating
     # sum, then an ordinary 2-D transform of that (real) plane
