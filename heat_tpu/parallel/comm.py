@@ -344,6 +344,43 @@ class Communication:
             concat_axis=concat_axis, tiled=True,
         )
 
+    def psum_scatter(self, x, axis_name: Optional[str] = None, scatter_dimension: int = 0):
+        """Reduce-scatter: the sum lands shard-wise instead of replicated
+        (the reference's Reduce_scatter, communication.py; the sparse
+        SpMM meet-step uses it directly)."""
+        return jax.lax.psum_scatter(
+            x, axis_name or self.axis_name,
+            scatter_dimension=scatter_dimension, tiled=True,
+        )
+
+    def pscan(self, x, axis_name: Optional[str] = None, inclusive: bool = True):
+        """Prefix sum over mesh ranks (the reference's Scan / Exscan,
+        communication.py:2010-2086) as log2(size) ``ppermute`` rounds —
+        ranks outside a round's permutation receive zeros, which is the
+        additive identity, so no masking is needed.  The round count and
+        rank range come from the NAMED axis (an override may address a
+        sub-axis whose size differs from ``self.size``)."""
+        name = axis_name or self.axis_name
+        n = int(dict(self.mesh.shape)[name]) if name != self.axis_name else self.size
+        acc = x
+        shift = 1
+        while shift < n:
+            prev = jax.lax.ppermute(
+                acc, name, [(i, i + shift) for i in range(n - shift)]
+            )
+            acc = acc + prev
+            shift *= 2
+        if inclusive:
+            return acc
+        # exclusive scan: the inclusive result of the previous rank
+        # (rank 0 receives the zero fill — MPI's Exscan leaves rank 0
+        # undefined; zero is this layer's defined value)
+        return jax.lax.ppermute(acc, name, [(i, i + 1) for i in range(n - 1)])
+
+    def exscan(self, x, axis_name: Optional[str] = None):
+        """Exclusive prefix sum (zero at rank 0)."""
+        return self.pscan(x, axis_name, inclusive=False)
+
     def ppermute(self, x, perm, axis_name: Optional[str] = None):
         return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
 

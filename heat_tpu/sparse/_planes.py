@@ -458,7 +458,7 @@ def _sum_other_prog(comm, P: int, C: int, other_pad: int, dist: bool):
 
     def body(comp, other, val):
         seg = jax.ops.segment_sum(val, other, num_segments=other_pad)
-        return jax.lax.psum_scatter(seg, comm.axis_name, scatter_dimension=0, tiled=True)
+        return comm.psum_scatter(seg)
 
     if not dist:
         return jax.jit(
@@ -552,7 +552,7 @@ def _spmm_comp_inner_prog(comm, P: int, C: int, comp_pad: int, m_pad: int, n: in
         xr = jnp.take(x_loc, comp, axis=0, mode="fill", fill_value=0)
         contrib = val[:, None] * xr
         out = jax.ops.segment_sum(contrib, other, num_segments=m_pad)
-        return jax.lax.psum_scatter(out, comm.axis_name, scatter_dimension=0, tiled=True)
+        return comm.psum_scatter(out)
 
     if not dist:
         def run(comp, other, val, x_loc):
