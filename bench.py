@@ -1,7 +1,7 @@
 """Benchmark driver: the full BASELINE grid on the attached chip.
 
 Emits one JSON line per BASELINE config (smoke, KMeans, hSVD north star,
-DP-SGD, 3-D FFT), then a final summary line whose top-level fields are the
+DP-SGD, 3-D FFT, dispatch-amortization), then a final summary line whose top-level fields are the
 hSVD north star (so single-metric consumers keep working) with the whole
 grid attached under ``"all"`` — BENCH_r{N}.json then records every config
 each round and rounds stay comparable (BASELINE.md targets table).
@@ -642,6 +642,75 @@ def bench_fft3d(ht, sync_floor, roofline=None):
     return rec
 
 
+def bench_dispatch(ht, sync_floor, roofline=None):
+    """Config 6: dispatch-layer amortization smoke metrics (ISSUE 1).
+
+    ``dispatch_cache_hit_rate`` — fraction of executable-cache lookups
+    served without a retrace across two passes of a fixed mixed op
+    sequence (the iterative-ML shape: identical shapes every pass;
+    anything below ~0.5 here means repeated shapes are recompiling).
+    ``dispatches_per_kmeans_iter`` — launches per Lloyd iteration for a
+    20-iteration fit (the on-device while_loop should hold this far
+    below 1.0).  ``fused_ops_per_dispatch`` — elementwise/reduce ops
+    folded per launch for the fixed sequence; > 1 means chain fusion is
+    collapsing op chains.  Emitted every round so BENCH_r{N}.json tracks
+    dispatch amortization alongside throughput."""
+    from heat_tpu.core import dispatch
+
+    ht.random.seed(5)
+    n = 1 << 16
+    a = ht.random.randn(n, split=0).astype(ht.float32)
+    b = ht.random.randn(n, split=0).astype(ht.float32)
+    c = ht.random.randn(n, split=0).astype(ht.float32)
+
+    def sequence():
+        s1 = float(((a * b + c) / 2.0 - b).sum())
+        s2 = float(ht.exp(a * 0.5).mean())
+        return s1 + s2
+
+    sequence()  # compile pass
+    dispatch.reset_stats()
+    sequence()  # measured pass: should be all hits
+    seq = dispatch.cache_stats()
+
+    # fused-chain latency through the warm cache (device-bound number)
+    per, meta = _time_amortized(
+        lambda: ((a * b + c) / 2.0 - b).sum(),
+        lambda r: float(r),
+        32,
+        sync_floor,
+    )
+
+    x = ht.random.randn(1 << 12, 8, split=0).astype(ht.float32)
+    km_iters = 20
+    km = ht.cluster.KMeans(n_clusters=4, init="random", max_iter=km_iters,
+                           tol=-1.0, random_state=0)
+    km.fit(x)  # compile
+    dispatch.reset_stats()
+    km = ht.cluster.KMeans(n_clusters=4, init="random", max_iter=km_iters,
+                           tol=-1.0, random_state=0)
+    km.fit(x)
+    ks = dispatch.cache_stats()
+    km_dispatches = ks["dispatches"] + ks["external_dispatches"]
+
+    return {
+        "metric": "dispatch_cache_hit_rate",
+        "value": round(seq["hit_rate"], 3),
+        "unit": "fraction",
+        "vs_baseline": 1.0,
+        "vs_baseline_kind": "self",
+        "dispatch_cache_hit_rate": round(seq["hit_rate"], 3),
+        "dispatches_per_kmeans_iter": round(km_dispatches / km_iters, 3),
+        "kmeans_fit_dispatches": km_dispatches,
+        "fused_ops_per_dispatch": round(
+            seq["fused_ops"] / seq["dispatches"], 2
+        ) if seq["dispatches"] else 0.0,
+        "donations": seq["donations"],
+        "fused_chain_5op_ms": round(per * 1e3, 4),
+        "timing": meta,
+    }
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -654,7 +723,7 @@ def main() -> None:
     except Exception as e:  # anchors are advisory; keep the grid going
         roofline = None
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
-    for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d):
+    for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d, bench_dispatch):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

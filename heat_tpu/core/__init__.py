@@ -27,6 +27,7 @@ from .vmap import *
 from .tiling import *
 from .io import *
 from . import devices
+from . import dispatch
 from . import types
 from . import random
 from . import io

@@ -26,10 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.comm import sanitize_comm
+from . import dispatch
 from . import types
 from .devices import sanitize_device
 from .dndarray import DNDarray
-from .sanitation import sanitize_out
+from .sanitation import sanitize_out, store_out
 from .stride_tricks import broadcast_shape, sanitize_axis
 
 __all__ = []
@@ -147,6 +148,45 @@ def _try_planar_binary(operation, t1, t2) -> Optional[DNDarray]:
     return DNDarray.from_planar(rr, ii, ref.shape, ref.split, ref.device, ref.comm)
 
 
+#: python-number operand types eligible for the cached-leaf fast track.
+#: np scalars keep the generic factories conversion (their dtype handling
+#: — x64 demotion, unsigned kinds — lives there); complex scalars too:
+#: under x64 factories picks complex128 while the leaf would be
+#: complex64, which could flip precision-sensitive comparisons.
+_PY_NUMBERS = (builtins.int, builtins.float, builtins.bool)
+
+
+def _try_scalar_fast(operation, t1, t2, fn_kwargs) -> Optional[DNDarray]:
+    """Array (op) python-scalar without the factories round trip: the
+    scalar becomes a cached 0-d leaf (same canonical dtype the generic
+    conversion would produce, so promotion is identical) and the op joins
+    the carrier's pending chain.  None -> take the generic path."""
+    if isinstance(t1, DNDarray) and isinstance(t2, _PY_NUMBERS):
+        arr, scalar, scalar_first = t1, t2, False
+    elif isinstance(t2, DNDarray) and isinstance(t1, _PY_NUMBERS):
+        arr, scalar, scalar_first = t2, t1, True
+    else:
+        return None
+    if arr.ndim == 0 or (arr.split is not None and arr.shape[arr.split] == 1):
+        return None
+    if not _fusable(arr):
+        return None
+    try:
+        leaf = dispatch.scalar_leaf(scalar, types.heat_type_of(scalar).jax_type())
+    except Exception:
+        return None  # e.g. int out of the canonical dtype's range
+    src = arr._fusion_source
+    args = (leaf, src) if scalar_first else (src, leaf)
+    node = dispatch.make_node(operation, args, fn_kwargs)
+    if (
+        node is None
+        or node.shape != arr._padded_shape
+        or types.heat_type_is_complexfloating(node.dtype)
+    ):
+        return None
+    return DNDarray.from_pending(node, arr.shape, arr.split, arr.device, arr.comm)
+
+
 def __binary_op(
     operation: Callable,
     t1,
@@ -157,10 +197,14 @@ def __binary_op(
 ) -> DNDarray:
     """Generic distributed binary operation (_operations.py:22)."""
     fn_kwargs = fn_kwargs or {}
-    if out is None and where is True and not fn_kwargs:
-        planar = _try_planar_binary(operation, t1, t2)
-        if planar is not None:
-            return planar._propagate_layout_from(t1, t2)
+    if out is None and where is True:
+        if not fn_kwargs:
+            planar = _try_planar_binary(operation, t1, t2)
+            if planar is not None:
+                return planar._propagate_layout_from(t1, t2)
+        fast = _try_scalar_fast(operation, t1, t2, fn_kwargs)
+        if fast is not None:
+            return fast._propagate_layout_from(t1, t2)
     ref = t1 if isinstance(t1, DNDarray) else (t2 if isinstance(t2, DNDarray) else None)
     if ref is None:
         t1 = _as_dndarray(t1)
@@ -172,38 +216,85 @@ def __binary_op(
 
     out_shape = broadcast_shape(t1.shape, t2.shape)
 
-    # fast path: identical layout, no broadcasting -> operate on padded buffers
-    if t1.shape == t2.shape == out_shape and t1.split == t2.split:
-        result = operation(t1.larray_padded, t2.larray_padded, **fn_kwargs)
-        res = DNDarray(
-            jax.device_put(result, t1.comm.sharding(t1.split)),
-            out_shape,
-            types.canonical_heat_type(result.dtype),
-            t1.split,
-            t1.device,
-            t1.comm,
-        )
+    # fast paths: (a) identical layout, no broadcasting — operate on the
+    # padded buffers; (b) one operand is 0-d — it broadcasts elementwise
+    # against the carrier's padded buffer (pad rows stay garbage-in,
+    # garbage-out).  Both defer as a pending fusion node when possible:
+    # the chain compiles as one executable at its first forcing boundary.
+    same_layout = t1.shape == t2.shape == out_shape and t1.split == t2.split
+    scalar_fast = not same_layout and (
+        (t1.ndim == 0 and t1.split is None and t2.shape == out_shape
+         and (t2.split is None or t2.shape[t2.split] != 1))
+        or (t2.ndim == 0 and t2.split is None and t1.shape == out_shape
+            and (t1.split is None or t1.shape[t1.split] != 1))
+    )
+    if same_layout or scalar_fast:
+        carrier = t1 if t1.shape == out_shape else t2
+        node = None
+        if _fusable(t1, t2):
+            node = dispatch.make_node(
+                operation, (_fusion_arg(t1), _fusion_arg(t2)), fn_kwargs
+            )
+            if node is not None and node.shape != carrier._padded_shape:
+                node = None  # op degenerated the padded layout: eager path
+        if node is not None and not types.heat_type_is_complexfloating(node.dtype):
+            res = DNDarray.from_pending(
+                node, out_shape, carrier.split, carrier.device, carrier.comm
+            )
+        else:
+            a1 = t1.larray_padded if t1.shape == out_shape else t1._dense()
+            a2 = t2.larray_padded if t2.shape == out_shape else t2._dense()
+            result = dispatch.eager_apply(operation, (a1, a2), fn_kwargs)
+            res = DNDarray(
+                jax.device_put(result, carrier.comm.sharding(carrier.split)),
+                out_shape,
+                types.canonical_heat_type(result.dtype),
+                carrier.split,
+                carrier.device,
+                carrier.comm,
+            )
     else:
         out_split = _out_split_binary(t1, t2, out_shape)
-        result = operation(t1._dense(), t2._dense(), **fn_kwargs)
+        result = dispatch.eager_apply(
+            operation, (t1._dense(), t2._dense()), fn_kwargs
+        )
         res = DNDarray.from_dense(result, out_split, t1.device, t1.comm)
 
     if where is not True and where is not None:
         where_nd = _as_dndarray(where, ref)
         base = out if out is not None else None
-        base_dense = base._dense() if base is not None else jnp.zeros(out_shape, result.dtype)
+        base_dense = (
+            base._dense() if base is not None
+            else jnp.zeros(out_shape, res.dtype.jax_type())
+        )
         sel = jnp.where(where_nd._dense(), res._dense(), base_dense)
         res = DNDarray.from_dense(sel, res.split, res.device, res.comm)
 
     if out is not None:
-        sanitize_out(out, out_shape, res.split, res.device)
-        casted = res._dense().astype(out.dtype.jax_type())
-        out._replace(
-            DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded
-        )
-        return out
+        return store_out(res, out)
     # an active ragged layout survives elementwise ops (lhs-first)
     return res._propagate_layout_from(t1, t2)
+
+
+def _fusable(*operands: DNDarray) -> bool:
+    """Whether these operands may ride the lazy fusion path: fusion on,
+    no planar storage, no complex dtypes (complex arrays can be
+    host-backed on complex-less runtimes — their placement logic must
+    not be bypassed)."""
+    if not dispatch.fusion_enabled():
+        return False
+    for t in operands:
+        if t._planar is not None or types.heat_type_is_complexfloating(t.dtype):
+            return False
+    return True
+
+
+def _fusion_arg(t: DNDarray):
+    """The fused-program operand for ``t``: its pending chain or padded
+    buffer for layout carriers, its dense 0-d value for scalars."""
+    if t.ndim == 0:
+        return t._dense()
+    return t._fusion_source
 
 
 def __local_op(
@@ -229,25 +320,35 @@ def __local_op(
             return DNDarray.from_planar(
                 re, im, x.shape, x.split, x.device, x.comm
             )._propagate_layout_from(x)
-    arr = x.larray_padded
-    if not no_cast and not (
-        types.heat_type_is_inexact(x.dtype)
-    ):
-        arr = arr.astype(jnp.float32)
-    result = operation(arr, **kwargs)
-    res = DNDarray(
-        result,
-        x.shape,
-        types.canonical_heat_type(result.dtype),
-        x.split,
-        x.device,
-        x.comm,
-    )
+    needs_cast = not no_cast and not types.heat_type_is_inexact(x.dtype)
+    node = None
+    if _fusable(x):
+        src = x._fusion_source
+        if needs_cast:
+            src = dispatch.cast_node(src, jnp.float32)
+        node = dispatch.make_node(operation, (src,), kwargs) if src is not None else None
+        if node is not None and (
+            node.shape != x._padded_shape
+            or types.heat_type_is_complexfloating(node.dtype)
+        ):
+            node = None  # shape-changing or complex-producing op: eager
+    if node is not None:
+        res = DNDarray.from_pending(node, x.shape, x.split, x.device, x.comm)
+    else:
+        arr = x.larray_padded
+        if needs_cast:
+            arr = arr.astype(jnp.float32)
+        result = dispatch.eager_apply(operation, (arr,), kwargs)
+        res = DNDarray(
+            result,
+            x.shape,
+            types.canonical_heat_type(result.dtype),
+            x.split,
+            x.device,
+            x.comm,
+        )
     if out is not None:
-        sanitize_out(out, x.shape, x.split, x.device)
-        casted = res._dense().astype(out.dtype.jax_type())
-        out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
-        return out
+        return store_out(res, out)
     return res._propagate_layout_from(x)
 
 
@@ -280,6 +381,7 @@ def __reduce_op(
         axes = (axis,)
 
     split_reduced = x.split is not None and x.split in axes
+    mask = None
     if split_reduced and x._pad > 0:
         if neutral is None:
             arr = x._dense()
@@ -287,11 +389,19 @@ def __reduce_op(
             out_split = _reduced_split(x.split, axes, keepdims, reduced=True)
             res = DNDarray.from_dense(result, out_split, x.device, x.comm)
             return _finalize_reduce(res, out)
-        arr = x._masked(neutral)
-    else:
-        arr = x.larray_padded
+        mask = (x.split, x.shape[x.split], neutral)
 
-    result = operation(arr, axis=(axis if axis is not None else None), keepdims=keepdims, **kwargs)
+    # a reduction is a fusion boundary: any pending elementwise chain,
+    # the neutral-element pad masking, and the reduction itself compile
+    # as ONE cached executable
+    red_kwargs = dict(kwargs)
+    red_kwargs["axis"] = axis if axis is not None else None
+    red_kwargs["keepdims"] = keepdims
+    if x._planar is None and not types.heat_type_is_complexfloating(x.dtype):
+        result = dispatch.chain_apply(operation, x._fusion_source, red_kwargs, mask=mask)
+    else:
+        arr = x._masked(neutral) if mask is not None else x.larray_padded
+        result = operation(arr, **red_kwargs)
 
     if split_reduced or x.split is None:
         out_split = None if not keepdims or x.split is None else None
@@ -313,10 +423,7 @@ def __reduce_op(
 
 def _finalize_reduce(res: DNDarray, out: Optional[DNDarray]) -> DNDarray:
     if out is not None:
-        sanitize_out(out, res.shape, res.split, res.device)
-        casted = res._dense().astype(out.dtype.jax_type())
-        out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
-        return out
+        return store_out(res, out)
     return res
 
 
@@ -353,8 +460,14 @@ def __cum_op(
     axis = sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative ops over flattened arrays: pass an int axis")
-    arr = x._masked(neutral) if (x.split == axis and x._pad > 0) else x.larray_padded
-    result = operation(arr, axis=axis)
+    mask = (x.split, x.shape[axis], neutral) if (x.split == axis and x._pad > 0) else None
+    # scan boundary: pending chain + pad masking + cum-op fuse into one
+    # cached executable (the reference's local-cumop + Exscan + combine)
+    if x._planar is None and not types.heat_type_is_complexfloating(x.dtype):
+        result = dispatch.chain_apply(operation, x._fusion_source, {"axis": axis}, mask=mask)
+    else:
+        arr = x._masked(neutral) if mask is not None else x.larray_padded
+        result = operation(arr, axis=axis)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_type())
     res = DNDarray(
@@ -366,8 +479,5 @@ def __cum_op(
         x.comm,
     )
     if out is not None:
-        sanitize_out(out, res.shape, res.split, res.device)
-        casted = res._dense().astype(out.dtype.jax_type())
-        out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
-        return out
+        return store_out(res, out)
     return res._propagate_layout_from(x)

@@ -287,7 +287,10 @@ def sum(a, axis=None, out=None, keepdims=False):
 # in-place variants (reference: `_`-suffixed functions bound as DNDarray
 # methods and `__i*__` dunders, e.g. add_ arithmetics.py:135,195-196).
 # Functional substrate underneath: compute out-of-place, then swap the
-# backing array with a cast-safety check (dndarray._iop).
+# backing array with a cast-safety check (dndarray._iop).  Under the
+# dispatch layer the out-of-place result is a PENDING chain, so `a += b`
+# compiles as one cached executable whose output aliases a's donated
+# backing buffer when it is provably unshared (core/dispatch.cast_store).
 # ----------------------------------------------------------------------
 from .dndarray import _iop as __iop  # noqa: E402
 
@@ -485,14 +488,26 @@ def heaviside(t1, t2, out=None, where=True):
     return _binary_op(jnp.heaviside, t1, t2, out, where)
 
 
+def _nancumsum_op(a, axis):
+    return jnp.nancumsum(a, axis=axis)
+
+
+def _nancumprod_op(a, axis):
+    return jnp.nancumprod(a, axis=axis)
+
+
 def nancumsum(t, axis, dtype=None, out=None):
-    """Cumulative sum treating NaN as zero (numpy extension)."""
-    return _cum_op(lambda a, axis: jnp.nancumsum(a, axis=axis), t, axis, 0, out, dtype)
+    """Cumulative sum treating NaN as zero (numpy extension).
+
+    Module-level op callable (not a per-call lambda): the dispatch-layer
+    executable cache keys on the callable's identity, and a fresh lambda
+    per call would miss forever."""
+    return _cum_op(_nancumsum_op, t, axis, 0, out, dtype)
 
 
 def nancumprod(t, axis, dtype=None, out=None):
     """Cumulative product treating NaN as one (numpy extension)."""
-    return _cum_op(lambda a, axis: jnp.nancumprod(a, axis=axis), t, axis, 1, out, dtype)
+    return _cum_op(_nancumprod_op, t, axis, 1, out, dtype)
 
 
 def ediff1d(ary, to_end=None, to_begin=None):

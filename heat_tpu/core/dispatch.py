@@ -1,0 +1,709 @@
+"""Transparent cached-executable dispatch for the generic op wrappers.
+
+Every NumPy-level op funnels through the four wrappers in
+``core/_operations.py``.  Before this module they executed as *eager*
+``jnp`` calls: one Python dispatch + one XLA executable launch per op, so
+a chain like ``(a * b + c).sum()`` paid four launches — the measured
+bottleneck of the bench history (dpsgd only beats the dispatch floor by
+hand-batching steps, kmeans idles against the link-sync floor).  This
+module gives the hot paths the two levers ``fusion.jit`` offers opt-in,
+without any user opt-in:
+
+1. **Executable cache** — op applications route through ``jax.jit``-
+   compiled closures keyed by ``(op, abstract spec of operands, static
+   kwargs)``.  Repeated shapes (the only case in iterative ML: kmeans /
+   lasso / PCA / DASO loops) hit a compiled executable instead of
+   re-dispatching through the jnp eager machinery.  Hit/miss/dispatch
+   counters are exposed via :func:`cache_stats`.
+
+2. **Lazy elementwise chain fusion** — element-wise results carry a small
+   pending-expression node (:class:`PendingExpr`: bounded depth,
+   element-wise only, same padded layout) instead of a concrete buffer.
+   Materialization is deferred until a reduction, collective, indexing,
+   print, or host read forces it — every such boundary funnels through
+   ``DNDarray.larray_padded`` — at which point the whole chain compiles
+   as ONE fused XLA computation through the cache.  A reduction/cum-op
+   consuming a pending chain folds the chain, the pad-masking, and the
+   reduction into a single cached executable (:func:`chain_apply`).
+
+3. **Buffer donation** — in-place ops (``resplit_``, ``out=`` stores,
+   ``__iadd__``-style dunders) donate the target's dead backing buffer to
+   the compiled program (``donate_argnums``), letting XLA reuse the HBM
+   allocation instead of holding both generations live.  Donation is
+   gated on a CPython refcount proof that the buffer is unshared
+   (:func:`_refcount_at_most`): two DNDarrays sharing a backing array, a
+   pending expression holding the buffer as a leaf, or a user-held
+   ``larray_padded`` reference all suppress donation (donating a shared
+   buffer would poison every other holder).
+
+Environment knobs (all default-on):
+
+* ``HEAT_TPU_DISPATCH_CACHE=0`` — disable the executable cache (ops run
+  as plain eager jnp calls; fusion is disabled too).
+* ``HEAT_TPU_FUSION=0`` — disable lazy chain fusion only.
+* ``HEAT_TPU_FUSION_DEPTH`` — max pending-chain depth before a subchain
+  is materialized (default 16).
+* ``HEAT_TPU_DONATE=0`` — disable buffer donation.
+
+See ``docs/dispatch.md`` for the cache-key, donation, and
+fusion-boundary semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import warnings
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "PendingExpr",
+    "cache_enabled",
+    "cache_stats",
+    "chain_apply",
+    "clear_cache",
+    "eager_apply",
+    "fusion_enabled",
+    "make_node",
+    "materialize",
+    "record_external_dispatch",
+    "reset_stats",
+]
+
+
+def _env_flag(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+_CACHE_ENABLED = _env_flag("HEAT_TPU_DISPATCH_CACHE", True)
+_FUSION_ENABLED = _env_flag("HEAT_TPU_FUSION", True)
+_DONATE_ENABLED = _env_flag("HEAT_TPU_DONATE", True)
+FUSION_DEPTH = int(os.environ.get("HEAT_TPU_FUSION_DEPTH", "16"))
+_CACHE_MAXSIZE = int(os.environ.get("HEAT_TPU_DISPATCH_CACHE_SIZE", "1024"))
+
+
+def cache_enabled() -> bool:
+    """Whether the executable cache is active."""
+    return _CACHE_ENABLED
+
+
+def fusion_enabled() -> bool:
+    """Whether lazy elementwise chain fusion is active."""
+    return _CACHE_ENABLED and _FUSION_ENABLED
+
+
+# ----------------------------------------------------------------------
+# counters + cache
+# ----------------------------------------------------------------------
+_ZERO = dict(hits=0, misses=0, dispatches=0, fused_ops=0, donations=0,
+             external_dispatches=0)
+_counters = dict(_ZERO)
+
+#: LRU of compiled executables.  Bounded because op callables created
+#: inline (lambdas/partials) key by object identity and would otherwise
+#: accumulate one dead entry per call.
+_cache: "OrderedDict[Any, Callable]" = OrderedDict()
+
+#: (op, arg avals, kwargs) -> ShapeDtypeStruct; jax.eval_shape costs
+#: ~1 ms per call, far too slow to pay per dispatch.
+_aval_cache: dict = {}
+
+
+def cache_stats() -> dict:
+    """Snapshot of the dispatch counters.
+
+    ``hits``/``misses`` count executable-cache lookups, ``dispatches``
+    the compiled-program launches issued through this layer,
+    ``fused_ops`` the number of elementwise/reduce ops folded into those
+    launches (fused_ops >> dispatches means fusion is working), and
+    ``donations`` the in-place launches that donated a dead buffer.
+    ``external_dispatches`` are launches recorded by consumers with their
+    own jitted programs (kmeans' Lloyd loop, lasso's CD loop,
+    ``fusion.jit``).  ``hit_rate`` is hits / (hits + misses), 0.0 before
+    any lookup."""
+    s = dict(_counters)
+    total = s["hits"] + s["misses"]
+    s["hit_rate"] = (s["hits"] / total) if total else 0.0
+    s["cache_size"] = len(_cache)
+    return s
+
+
+def reset_stats() -> None:
+    """Zero all counters (the compiled cache itself is kept)."""
+    _counters.update(_ZERO)
+
+
+def clear_cache() -> None:
+    """Drop every compiled executable and zero the counters."""
+    _cache.clear()
+    _aval_cache.clear()
+    reset_stats()
+
+
+def record_external_dispatch(n: int = 1) -> None:
+    """Count ``n`` executable launches made outside this layer (consumers
+    with their own jitted programs: kmeans/lasso loops, ``fusion.jit``)."""
+    _counters["external_dispatches"] += n
+
+
+def _note_lookup(hit: bool) -> None:
+    _counters["hits" if hit else "misses"] += 1
+
+
+# ----------------------------------------------------------------------
+# pending expressions
+# ----------------------------------------------------------------------
+class PendingExpr:
+    """One deferred elementwise op over pending/concrete operands.
+
+    ``args`` holds :class:`PendingExpr` children and/or concrete
+    ``jax.Array`` leaves; ``shape``/``dtype`` are the abstract result
+    (from a cached ``jax.eval_shape``), so metadata queries never force
+    materialization.  Nodes are immutable: leaves are captured as the
+    *buffers* they were at op time, so later in-place mutation of an
+    operand DNDarray cannot change an already-built chain's value."""
+
+    __slots__ = ("op", "args", "kwargs", "shape", "dtype", "depth", "nops")
+
+    def __init__(self, op, args, kwargs, shape, dtype, depth, nops):
+        self.op = op
+        self.args = args
+        self.kwargs = kwargs
+        self.shape = shape
+        self.dtype = dtype
+        self.depth = depth
+        self.nops = nops
+
+
+def _kw_key(kwargs: dict) -> Tuple:
+    key = tuple(sorted(kwargs.items()))
+    hash(key)  # TypeError for unhashable values -> caller falls back
+    return key
+
+
+def _leaf_spec(buf) -> Tuple:
+    return (tuple(buf.shape), buf.dtype, getattr(buf, "sharding", None))
+
+
+def _abstract_eval(op, arg_avals: Tuple, kw_key: Tuple, kwargs: dict):
+    k = (op, arg_avals, kw_key)
+    out = _aval_cache.get(k)
+    if out is None:
+        out = jax.eval_shape(
+            lambda *a: op(*a, **kwargs),
+            *[jax.ShapeDtypeStruct(s, d) for (s, d) in arg_avals],
+        )
+        if len(_aval_cache) > 4 * _CACHE_MAXSIZE:
+            _aval_cache.clear()
+        _aval_cache[k] = out
+    return out
+
+
+def make_node(op, args: Sequence, kwargs: Optional[dict] = None) -> Optional[PendingExpr]:
+    """Build a pending elementwise node, or None when it cannot be fused
+    (fusion disabled, unhashable kwargs, abstract eval failed).
+
+    ``args`` entries are PendingExpr or concrete jax.Array.  A child at
+    the depth limit is materialized on the spot so chains stay bounded."""
+    if not fusion_enabled():
+        return None
+    kwargs = kwargs or {}
+    try:
+        kw_key = _kw_key(kwargs)
+    except TypeError:
+        return None
+    args = tuple(
+        materialize(a) if isinstance(a, PendingExpr) and a.depth >= FUSION_DEPTH else a
+        for a in args
+    )
+    arg_avals = []
+    depth = 1
+    nops = 1
+    for a in args:
+        if isinstance(a, PendingExpr):
+            depth = max(depth, a.depth + 1)
+            nops += a.nops
+            arg_avals.append((a.shape, a.dtype))
+        else:
+            arg_avals.append((tuple(a.shape), a.dtype))
+    try:
+        aval = _abstract_eval(op, tuple(arg_avals), kw_key, kwargs)
+    except Exception:
+        return None
+    if not isinstance(aval, jax.ShapeDtypeStruct):
+        return None  # multi-output ops don't fuse
+    return PendingExpr(op, args, kwargs, tuple(aval.shape), aval.dtype, depth, nops)
+
+
+def _astype(a, *, dtype):
+    return a.astype(dtype)
+
+
+#: (type, value, dtype) -> 0-d jax.Array.  Scalar operands used to pay a
+#: full factories.array round trip (0-d DNDarray + device_put) on EVERY
+#: op — the profile-dominant cost of a chain like (a*b+c)/2.0.  Reusing
+#: one leaf object also dedups the compiled program's inputs.
+_scalar_cache: dict = {}
+
+
+def scalar_leaf(value, dtype):
+    """Cached 0-d constant leaf for a Python-number operand.
+
+    Built as a NUMPY scalar, never ``jnp.asarray``: inside an active
+    trace (``ht.jit`` bodies) jnp constants come back as tracers, and a
+    cached tracer leaks into every later call outside the trace.  A
+    numpy constant is always concrete, converts on the compiled call,
+    and constant-folds when the consumer itself is being traced."""
+    key = (type(value), value, dtype)
+    buf = _scalar_cache.get(key)
+    if buf is None:
+        buf = np.asarray(value, dtype)
+        if len(_scalar_cache) > 512:
+            _scalar_cache.clear()
+        _scalar_cache[key] = buf
+    return buf
+
+
+def cast_node(x, dtype) -> Optional[PendingExpr]:
+    """Pending ``astype`` node (the __local_op float32 pre-cast)."""
+    return make_node(_astype, (x,), {"dtype": dtype})
+
+
+def _mask_pad(a, *, split, extent, neutral):
+    """Overwrite the canonical padding rows with ``neutral`` (the fused
+    equivalent of ``DNDarray._masked``)."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, a.shape, split)
+    return jnp.where(idx < extent, a, jnp.asarray(neutral, a.dtype))
+
+
+# ----------------------------------------------------------------------
+# linearization + compiled-program cache
+# ----------------------------------------------------------------------
+def _linearize(root):
+    """DAG -> (topo-ordered node list, deduped leaf list, leaf arg-slot
+    counts).  Node refs are ``(is_node, index)`` pairs; shared subtrees
+    and repeated leaves dedupe by object identity, so a buffer is passed
+    to the compiled program exactly once however often it appears."""
+    nodes: list = []
+    node_ix: dict = {}
+    leaves: list = []
+    leaf_ix: dict = {}
+    leaf_slots: dict = {}
+
+    def walk(n):
+        if isinstance(n, PendingExpr):
+            ix = node_ix.get(id(n))
+            if ix is None:
+                refs = tuple(walk(a) for a in n.args)
+                nodes.append((n.op, n.kwargs, refs))
+                ix = len(nodes) - 1
+                node_ix[id(n)] = ix
+            return (True, ix)
+        ix = leaf_ix.get(id(n))
+        if ix is None:
+            leaves.append(n)
+            ix = len(leaves) - 1
+            leaf_ix[id(n)] = ix
+        leaf_slots[ix] = leaf_slots.get(ix, 0) + 1
+        return (False, ix)
+
+    walk(root)
+    return nodes, leaves, leaf_slots
+
+
+def _program_key(tag: str, nodes, leaves, extra: Tuple = ()) -> Tuple:
+    nk = tuple((op, _kw_key(kwargs), refs) for op, kwargs, refs in nodes)
+    lk = tuple(_leaf_spec(l) for l in leaves)
+    key = (tag, nk, lk) + extra
+    hash(key)
+    return key
+
+
+def _build_program(nodes):
+    def program(*leaves):
+        vals = []
+        for op, kwargs, refs in nodes:
+            args = [vals[i] if is_node else leaves[i] for (is_node, i) in refs]
+            vals.append(op(*args, **kwargs))
+        return vals[-1]
+    return program
+
+
+def _eval_nodes(nodes, leaves):
+    """Uncached eager evaluation (cache disabled / unhashable key)."""
+    return _build_program(nodes)(*leaves)
+
+
+def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
+    entry = _cache.get(key)
+    if entry is not None:
+        _cache.move_to_end(key)
+        _note_lookup(True)
+        return entry
+    _note_lookup(False)
+    jit_kwargs: dict = {}
+    if out_sharding is not None:
+        jit_kwargs["out_shardings"] = out_sharding
+    if donate_argnums:
+        jit_kwargs["donate_argnums"] = donate_argnums
+    entry = jax.jit(builder(), **jit_kwargs)
+    _cache[key] = entry
+    while len(_cache) > _CACHE_MAXSIZE:
+        _cache.popitem(last=False)
+    return entry
+
+
+def _run(compiled, leaves, n_ops: int, donated: bool = False):
+    _counters["dispatches"] += 1
+    _counters["fused_ops"] += n_ops
+    if donated:
+        _counters["donations"] += 1
+        with warnings.catch_warnings():
+            # XLA may decline an unusable donation (layout mismatch);
+            # that is a perf note, not a user-facing condition
+            warnings.filterwarnings("ignore", message=".*[Dd]onat")
+            return compiled(*leaves)
+    return compiled(*leaves)
+
+
+# ----------------------------------------------------------------------
+# entry points
+# ----------------------------------------------------------------------
+def materialize(expr: PendingExpr, out_sharding=None):
+    """Compile-and-run a pending chain as one executable through the
+    cache; returns the concrete jax.Array.  ``out_sharding`` (the array's
+    canonical NamedSharding) pins the result placement the eager path
+    used to establish with a per-op device_put."""
+    nodes, leaves, _ = _linearize(expr)
+    if not _CACHE_ENABLED:
+        return _eval_nodes(nodes, leaves)
+    try:
+        key = _program_key("expr", nodes, leaves, (out_sharding,))
+    except TypeError:
+        return _eval_nodes(nodes, leaves)
+    compiled = _get_compiled(key, lambda: _build_program(nodes), out_sharding=out_sharding)
+    return _run(compiled, leaves, len(nodes))
+
+
+def eager_apply(op, args: Sequence, kwargs: Optional[dict] = None):
+    """Immediate op application through a cached executable (the slow
+    binary path, helpers with concrete operands).  Falls back to a plain
+    eager call when caching is off or the key is unhashable."""
+    kwargs = kwargs or {}
+    if not _CACHE_ENABLED:
+        return op(*args, **kwargs)
+    try:
+        key = ("apply", op, _kw_key(kwargs),
+               tuple(_leaf_spec(a) for a in args))
+        hash(key)
+    except TypeError:
+        return op(*args, **kwargs)
+    compiled = _get_compiled(key, lambda: (lambda *a: op(*a, **kwargs)))
+    return _run(compiled, args, 1)
+
+
+def chain_apply(op, x, kwargs: Optional[dict] = None, mask=None):
+    """Apply ``op(arr, **kwargs)`` where ``x`` is a pending chain or a
+    concrete buffer: the chain, the optional pad-masking, and the op
+    itself compile as ONE cached executable (the reduction/cum-op
+    boundary of the fusion design).
+
+    ``mask``: None, or ``(split, true_extent, neutral)`` — the padding
+    rows are overwritten with ``neutral`` before ``op`` (the fused analog
+    of ``DNDarray._masked``)."""
+    kwargs = dict(kwargs or {})
+    if isinstance(x, PendingExpr):
+        nodes, leaves, _ = _linearize(x)
+        root = (True, len(nodes) - 1)
+    else:
+        nodes, leaves = [], [x]
+        root = (False, 0)
+    if mask is not None:
+        split, extent, neutral = mask
+        nodes.append((_mask_pad,
+                      {"split": int(split), "extent": int(extent), "neutral": neutral},
+                      (root,)))
+        root = (True, len(nodes) - 1)
+    nodes.append((op, kwargs, (root,)))
+    if not _CACHE_ENABLED:
+        return _eval_nodes(nodes, leaves)
+    try:
+        key = _program_key("chain", nodes, leaves)
+    except TypeError:
+        return _eval_nodes(nodes, leaves)
+    compiled = _get_compiled(key, lambda: _build_program(nodes))
+    return _run(compiled, leaves, len(nodes))
+
+
+# ----------------------------------------------------------------------
+# donation-aware in-place paths
+# ----------------------------------------------------------------------
+def _probe_inner(obj):
+    return sys.getrefcount(obj)
+
+
+def _probe_outer(obj):
+    # mirrors caller -> repad/cast_store -> _refcount_at_most -> getrefcount
+    return _probe_inner(obj)
+
+
+class _ProbeHolder:
+    __slots__ = ("x", "args")
+
+
+def _calibrate_plumbing() -> int:
+    """Measured refcount of an object whose ONLY owner is one attribute,
+    observed through the exact call shape the donation checks use
+    (owner attribute + caller argument temp + two call frames +
+    getrefcount's own argument).  Calibrated empirically because the
+    per-frame reference cost depends on the CPython version's calling
+    convention."""
+    h = _ProbeHolder()
+    h.x = object()
+    return _probe_outer(h.x)
+
+
+def _probe_leaf_site(dst, src):
+    # mirrors cast_store's leaf check: one arg-slot tuple ref, the
+    # deduped leaves list, the scan loop's binding, then the helper call
+    leaves = [src.args[0]]
+    for _i, leaf in enumerate(leaves):
+        if leaf is dst:
+            return _probe_inner(dst)
+    return -1  # pragma: no cover
+
+
+def _calibrate_leaf_site() -> int:
+    """Refcount of a single-arg-slot, otherwise-unshared buffer at
+    cast_store's leaf-donation check (owner attribute + plumbing + the
+    arg-slot tuple + leaves list + loop binding)."""
+    h = _ProbeHolder()
+    h.x = object()
+    h.args = (h.x,)
+    return _probe_leaf_site(h.x, h)
+
+
+#: refcount of a provably-unshared buffer at the check site
+_RC_BASE = _calibrate_plumbing()
+#: same, at the leaf-donation site with exactly one arg-slot reference
+_RC_LEAF_BASE = _calibrate_leaf_site()
+
+
+def _refcount_at_most(buf, extra: int = 0) -> bool:
+    """CPython proof that ``buf`` has no holders beyond its owner
+    attribute, the call plumbing (calibrated ``_RC_BASE``), and ``extra``
+    known internal references (leaf lists, expression arg slots).  A
+    shared backing array, a pending-expression leaf elsewhere, or a
+    user-held ``larray_padded`` all push the count higher and suppress
+    donation — the safe direction."""
+    if not _DONATE_ENABLED or buf is None:
+        return False
+    try:
+        return sys.getrefcount(buf) <= _RC_BASE + extra
+    except Exception:  # pragma: no cover - non-CPython
+        return False
+
+
+def _expr_private(root: PendingExpr, leaf_buf) -> bool:
+    """Exact CPython proof that every chain node from which ``leaf_buf``
+    is REACHABLE has no holder outside the chain itself (another
+    DNDarray's pending attribute, a user variable).  Required before
+    donating a LEAF buffer the chain consumes: a shared sub-expression
+    that can reach the leaf would materialize later against the deleted
+    buffer.  Nodes that cannot reach the leaf (e.g. the ``g * 0.1``
+    sub-chain of ``w += g * 0.1``, still referenced by the dunder's
+    temporary) are irrelevant and may be shared freely.
+
+    Reference accounting per checked node: the ``order`` list entry +
+    the loop variable + the getrefcount argument + one per arg-slot in
+    parent nodes; the root additionally carries its owner's
+    ``__pending`` attribute, the caller's ``src`` parameter, and this
+    function's ``root`` parameter."""
+    slots: dict = {}
+    seen: set = set()
+    order: list = []
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        order.append(n)
+        for a in n.args:
+            if isinstance(a, PendingExpr):
+                slots[id(a)] = slots.get(id(a), 0) + 1
+                stack.append(a)
+
+    reaches: dict = {}
+
+    def _reaches(n: PendingExpr) -> bool:
+        r = reaches.get(id(n))
+        if r is None:
+            reaches[id(n)] = False  # cycle guard (DAGs only, but cheap)
+            r = any(
+                (a is leaf_buf)
+                or (isinstance(a, PendingExpr) and _reaches(a))
+                for a in n.args
+            )
+            reaches[id(n)] = r
+        return r
+
+    for n in order:
+        _reaches(n)
+    for n in order:
+        if not reaches.get(id(n)):
+            continue
+        if n is root:
+            allowed = _RC_BASE + 2 + slots.get(id(n), 0)
+        else:
+            allowed = 3 + slots.get(id(n), 0)
+        try:
+            if sys.getrefcount(n) > allowed:
+                return False
+        except Exception:  # pragma: no cover - non-CPython
+            return False
+    return True
+
+
+def _refcount_leaf_at_most(buf, slots: int) -> bool:
+    """Leaf-donation variant of :func:`_refcount_at_most`: compares
+    against the calibrated leaf-site base (which already includes one
+    arg-slot reference) plus any additional arg-slot references."""
+    if not _DONATE_ENABLED or buf is None:
+        return False
+    try:
+        return sys.getrefcount(buf) <= _RC_LEAF_BASE + (slots - 1)
+    except Exception:  # pragma: no cover - non-CPython
+        return False
+
+
+def repad(buf, old_slice, pad_widths, sharding, donate: bool = False):
+    """Slice off the old padding, pad the new split axis, and place with
+    the new canonical sharding — one cached executable (the body of
+    ``resplit_``, which the eager path ran as slice + pad + device_put).
+
+    ``old_slice``: None or ``(axis, true_extent)``; ``pad_widths``: None
+    or the full jnp.pad width spec.  ``donate=True`` donates ``buf``
+    (the array's dead backing buffer) when a refcount proof shows it is
+    unshared.  Call with the buffer in argument position (no extra local
+    bindings) so the calibrated refcount accounting holds."""
+    donate = donate and _refcount_at_most(buf)
+    if pad_widths is not None:
+        pad_widths = tuple((int(a), int(b)) for a, b in pad_widths)
+        if not any(b for _, b in pad_widths) and not any(a for a, _ in pad_widths):
+            pad_widths = None
+    if old_slice is not None:
+        old_slice = (int(old_slice[0]), int(old_slice[1]))
+
+    def build():
+        def program(x):
+            if old_slice is not None:
+                ax, ext = old_slice
+                x = jax.lax.slice_in_dim(x, 0, ext, axis=ax)
+            if pad_widths is not None:
+                x = jnp.pad(x, pad_widths)
+            return x
+        return program
+
+    if not _CACHE_ENABLED:
+        return jax.device_put(build()(buf), sharding)
+    try:
+        key = ("repad", _leaf_spec(buf), old_slice, pad_widths, sharding, donate)
+        hash(key)
+    except TypeError:
+        return jax.device_put(build()(buf), sharding)
+    compiled = _get_compiled(
+        key, build, donate_argnums=(0,) if donate else None, out_sharding=sharding
+    )
+    return _run(compiled, (buf,), 1, donated=donate)
+
+
+def cast_store(dst_buf, src, dtype, out_sharding=None):
+    """Compute ``src`` (pending chain or concrete buffer) cast to
+    ``dtype`` as one cached executable, donating ``dst_buf`` — the
+    ``out=`` / in-place target's about-to-die backing buffer — when a
+    refcount proof shows it is unshared.
+
+    Two donation shapes:
+
+    * ``dst_buf`` IS a leaf of the chain (the ``a += b`` case): that leaf
+      argument is donated, the classic ``donate_argnums`` aliasing.
+    * ``dst_buf`` is not an operand (``mul(x, y, out=z)``): it is passed
+      as an extra trailing argument, donated, so XLA may reuse its
+      allocation for the output.
+
+    Pass ``dst_buf`` in argument position (no extra local binding in the
+    caller); the refcount proof compares against the calibrated call
+    plumbing plus the leaf-list and arg-slot references when it is a
+    leaf."""
+    if isinstance(src, PendingExpr):
+        nodes, leaves, leaf_slots = _linearize(src)
+        root = (True, len(nodes) - 1)
+    else:
+        nodes, leaves, leaf_slots = [], [src], {0: 1}
+        root = (False, 0)
+    nodes.append((_astype, {"dtype": dtype}, (root,)))
+
+    donate_ix = None
+    trailing_dst = False
+    if dst_buf is not None and _DONATE_ENABLED:
+        for i, leaf in enumerate(leaves):
+            if leaf is dst_buf:
+                # the `a += b` aliasing case: donating an OPERAND needs
+                # both proofs — the buffer itself is unshared (beyond
+                # the calibrated plumbing: the leaves-list entry, this
+                # loop's `leaf` binding, and one per expression arg-slot)
+                # AND the whole chain is private (no other DNDarray
+                # holds a sub-expression that would later materialize
+                # against the deleted buffer)
+                if (
+                    isinstance(src, PendingExpr)
+                    and _refcount_leaf_at_most(dst_buf, leaf_slots.get(i, 1))
+                    and _expr_private(src, dst_buf)
+                ):
+                    donate_ix = i
+                break
+        else:
+            # dst is not an operand: donated as an extra trailing
+            # argument so XLA may reuse its allocation for the output
+            if _refcount_at_most(dst_buf):
+                donate_ix = len(leaves)
+                trailing_dst = True
+
+    if trailing_dst:
+        n_real = len(leaves)
+        inner = _build_program(nodes)
+
+        def build():
+            def program(*args):
+                return inner(*args[:n_real])
+            return program
+
+        leaves = leaves + [dst_buf]
+    else:
+        def build():
+            return _build_program(nodes)
+
+    if not _CACHE_ENABLED:
+        return _eval_nodes(nodes, leaves if not trailing_dst else leaves[:-1])
+    try:
+        key = _program_key(
+            "cast_store", nodes, leaves,
+            (out_sharding, donate_ix, trailing_dst),
+        )
+    except TypeError:
+        return _eval_nodes(nodes, leaves if not trailing_dst else leaves[:-1])
+    compiled = _get_compiled(
+        key, build,
+        donate_argnums=(donate_ix,) if donate_ix is not None else None,
+        out_sharding=out_sharding,
+    )
+    return _run(compiled, leaves, len(nodes), donated=donate_ix is not None)

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.comm import Communication, get_comm, sanitize_comm
+from . import dispatch as _dispatch
 from . import types
 from .devices import Device, get_device, sanitize_device
 from .stride_tricks import sanitize_axis
@@ -145,11 +146,15 @@ class DNDarray:
         comm: Communication,
         balanced: Optional[bool] = True,
         planar: Optional[Tuple[jax.Array, jax.Array]] = None,
+        pending: Optional["_dispatch.PendingExpr"] = None,
     ):
-        if array is None and planar is None:
-            raise ValueError("DNDarray needs a backing array or planar planes")
+        if array is None and planar is None and pending is None:
+            raise ValueError(
+                "DNDarray needs a backing array, planar planes, or a pending expression"
+            )
         self.__array = array
         self.__planar = planar
+        self.__pending = pending
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = types.canonical_heat_type(dtype)
         self.__split = split
@@ -208,10 +213,50 @@ class DNDarray:
         )
         return DNDarray(None, gshape, ctype, split, device, comm, planar=(re, im))
 
+    @staticmethod
+    def from_pending(
+        expr: "_dispatch.PendingExpr",
+        gshape: Tuple[int, ...],
+        split: Optional[int],
+        device: Optional[Device] = None,
+        comm: Optional[Communication] = None,
+    ) -> "DNDarray":
+        """Wrap a pending elementwise chain (core/dispatch.py).
+
+        The expression's abstract shape is the PADDED layout; ``gshape``
+        is the true global shape.  Materialization is deferred until the
+        first :attr:`larray_padded` access — a reduction, collective,
+        indexing, print, or host read — at which point the whole chain
+        compiles as one fused executable through the dispatch cache."""
+        return DNDarray(
+            None, gshape, types.canonical_heat_type(expr.dtype), split,
+            sanitize_device(device), sanitize_comm(comm), pending=expr,
+        )
+
     @property
     def _planar(self) -> Optional[Tuple[jax.Array, jax.Array]]:
         """The (re, im) planes backing a planar complex array, if any."""
         return self.__planar
+
+    @property
+    def _pending(self) -> Optional["_dispatch.PendingExpr"]:
+        """The deferred elementwise chain backing this array, if any."""
+        return self.__pending
+
+    @property
+    def _fusion_source(self):
+        """What a downstream fused program should consume: the pending
+        chain when one is attached, else the concrete padded buffer."""
+        if self.__pending is not None:
+            return self.__pending
+        return self.larray_padded
+
+    def _donation_source(self) -> Optional[jax.Array]:
+        """The concrete padded backing buffer for donation accounting
+        (None when planar- or pending-backed: nothing donatable).  Pass
+        the result straight into the donating call — binding it to an
+        extra local would defeat the refcount proof."""
+        return self.__array
 
     def __materialize_planar(self) -> jax.Array:
         re, im = self.__planar
@@ -237,6 +282,7 @@ class DNDarray:
         reference — and only invalidates the lazily placed buffer."""
         self.__array = padded
         self.__planar = None
+        self.__pending = None
         self.__ragged_buffer = None
 
     def _replace_local(self, local: jax.Array) -> None:
@@ -250,6 +296,7 @@ class DNDarray:
         """
         padded_gshape = self._padded_shape  # planar-safe (read before nulling)
         self.__planar = None
+        self.__pending = None
         self.__target_map = None
         self.__ragged_buffer = None
         if jax.process_count() == 1:
@@ -290,16 +337,43 @@ class DNDarray:
     # ------------------------------------------------------------------
     @property
     def larray_padded(self) -> jax.Array:
-        """The stored padded global jax.Array (materializes planar planes)."""
+        """The stored padded global jax.Array.  This is THE fusion
+        boundary: a pending elementwise chain compiles and runs here as
+        one cached executable (reductions, collectives, indexing,
+        printing, and host reads all funnel through this property);
+        planar planes materialize here too."""
         if self.__array is None:
-            self.__array = self.__materialize_planar()
+            if self.__pending is not None:
+                self.__array = _dispatch.materialize(
+                    self.__pending, self.__comm.sharding(self.__split)
+                )
+                self.__pending = None
+            else:
+                self.__array = self.__materialize_planar()
         return self.__array
 
     @property
     def _padded_shape(self) -> Tuple[int, ...]:
-        """Shape of the padded buffer without materializing planar planes."""
-        buf = self.__array if self.__array is not None else self.__planar[0]
+        """Shape of the padded buffer without materializing planar planes
+        or pending chains."""
+        if self.__array is not None:
+            buf = self.__array
+        elif self.__pending is not None:
+            return tuple(int(s) for s in self.__pending.shape)
+        else:
+            buf = self.__planar[0]
         return tuple(int(s) for s in buf.shape)
+
+    @property
+    def _padded_dtype(self):
+        """dtype of the padded buffer without materializing pending
+        chains (planar arrays materialize: their composed dtype is the
+        storage dtype)."""
+        if self.__array is not None:
+            return self.__array.dtype
+        if self.__pending is not None:
+            return self.__pending.dtype
+        return self.larray_padded.dtype
 
     @property
     def _pad(self) -> int:
@@ -505,6 +579,7 @@ class DNDarray:
         if not copy:
             self.__array = casted
             self.__planar = None
+            self.__pending = None
             self.__ragged_buffer = None  # values changed: re-place lazily
             self.__dtype = dtype
             return self
@@ -629,10 +704,35 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        dense = self._dense()
-        padded = _pad_to_canonical(dense, self.__gshape, axis, self.__comm)
+        if self.__planar is not None or (
+            jnp.issubdtype(self.__dtype.jax_type(), jnp.complexfloating)
+            and jax.default_backend() == "tpu"
+            and not _tpu_complex_ok()
+        ):
+            # complex on a complex-less runtime: the host-CPU placement
+            # logic lives in _pad_to_canonical; no donation
+            dense = self._dense()
+            padded = _pad_to_canonical(dense, self.__gshape, axis, self.__comm)
+        else:
+            # one cached executable: slice old padding + pad new split +
+            # reshard, donating the dead backing buffer when unshared
+            old_slice = (
+                (self.__split, self.__gshape[self.__split]) if self._pad > 0 else None
+            )
+            pad_widths = None
+            if axis is not None:
+                pad = self.__comm.pad_amount(self.__gshape[axis])
+                if pad:
+                    pad_widths = tuple(
+                        (0, pad if d == axis else 0) for d in range(self.ndim)
+                    )
+            padded = _dispatch.repad(
+                self.larray_padded, old_slice, pad_widths,
+                self.__comm.sharding(axis), donate=True,
+            )
         self.__array = padded
         self.__planar = None
+        self.__pending = None
         self.__split = axis
         self.__target_map = None
         self.__ragged_buffer = None
@@ -645,6 +745,7 @@ class DNDarray:
             return DNDarray(
                 self.__array, self.__gshape, self.__dtype, self.__split,
                 self.__device, self.__comm, planar=self.__planar,
+                pending=self.__pending,
             )
         dense = self._dense()
         return DNDarray.from_dense(dense, axis, self.__device, self.__comm)
@@ -832,11 +933,13 @@ class DNDarray:
                     out = jax.device_put(out, want)
             self.__array = out
             self.__planar = None
+            self.__pending = None
             self.__ragged_buffer = None
             return
         new_dense = self._dense().at[key].set(value)
         self.__array = _pad_to_canonical(new_dense, self.__gshape, self.__split, self.__comm)
         self.__planar = None
+        self.__pending = None
         self.__ragged_buffer = None
 
     def _padded_safe_key(self, key):
@@ -1240,6 +1343,7 @@ class DNDarray:
         dense = dense.at[idx, idx].set(jnp.asarray(value, dense.dtype))
         self.__array = _pad_to_canonical(dense, self.__gshape, self.__split, self.__comm)
         self.__planar = None
+        self.__pending = None
         self.__ragged_buffer = None
         return self
 
@@ -1442,7 +1546,21 @@ def _iop(self: DNDarray, result: DNDarray) -> DNDarray:
         raise TypeError(f"cannot cast {result.dtype} back to {self.dtype} for in-place operation")
     if result.split != self.split:
         result = result.resplit(self.split)
-    casted = result.larray_padded.astype(self.dtype.jax_type())
+    jdt = self.dtype.jax_type()
+    if (
+        result._planar is None
+        and not jnp.issubdtype(jdt, jnp.complexfloating)
+        and result._padded_shape == self._padded_shape
+    ):
+        # one cached executable: the pending chain (if any) + the cast,
+        # donating this array's dead backing buffer when unshared — the
+        # `a += b` path aliases a's buffer to the output
+        casted = _dispatch.cast_store(
+            self._donation_source(), result._fusion_source, jdt,
+            self.comm.sharding(self.split),
+        )
+    else:
+        casted = result.larray_padded.astype(jdt)
     self._replace(casted)
     return self
 

@@ -3,7 +3,11 @@ program.
 
 SURVEY.md build-plan decision 2: the library is eager (every op dispatches
 a cached executable) so sklearn-style loops just work, "offer ht.jit-style
-fusion on top".  ``ht.jit`` is that layer: it traces the wrapped function
+fusion on top".  Since the dispatch layer landed (core/dispatch.py), the
+eager path itself routes ops through cached executables and lazily fuses
+element-wise chains by DEFAULT — ``ht.jit`` remains the explicit tool for
+fusing ACROSS non-elementwise boundaries (reductions, matmuls, whole
+pipelines) into one program.  ``ht.jit`` traces the wrapped function
 once per (structure, DNDarray shapes/dtypes/splits, static values), so a
 whole pipeline of ops — elementwise chains, reductions, linalg — fuses
 into a single device program with one dispatch.  On a tunneled chip each
@@ -49,9 +53,10 @@ class _ASpec:
         self.split = x.split
         self.device = x.device
         self.comm = x.comm
-        padded = x.larray_padded
-        self.pshape = tuple(padded.shape)
-        self.pdtype = str(padded.dtype)
+        # metadata-only: a pending fusion chain must not be forced just
+        # to build a cache key (core/dispatch.py)
+        self.pshape = x._padded_shape
+        self.pdtype = str(x._padded_dtype)
 
     def _key(self):
         return (
@@ -115,6 +120,8 @@ def jit(fn: Callable = None, **jit_kwargs) -> Callable:
                 "got an unhashable non-array argument"
             ) from None
 
+        from . import dispatch as _dispatch
+
         entry = cache.get(key)
         if entry is None:
             out_side = {}
@@ -141,6 +148,9 @@ def jit(fn: Callable = None, **jit_kwargs) -> Callable:
             cache[key] = entry
 
         compiled, out_side = entry
+        # user-level fusion rides the same accounting as the transparent
+        # dispatch layer: one compiled launch, however many ops inside
+        _dispatch.record_external_dispatch()
         out_arrays = compiled(*arrays)
         rebuilt_out = [
             DNDarray(arr, *meta) if meta is not None else arr

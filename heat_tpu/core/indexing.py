@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from . import types
 from .dndarray import DNDarray
 
 __all__ = ["nonzero", "where"]
@@ -17,7 +18,7 @@ def nonzero(x: DNDarray) -> DNDarray:
     idx = jnp.nonzero(dense)
     stacked = jnp.stack(idx, axis=1) if x.ndim > 1 else idx[0]
     split = 0 if x.split is not None else None
-    return DNDarray.from_dense(stacked.astype(jnp.int64), split, x.device, x.comm)
+    return DNDarray.from_dense(stacked.astype(types.canonical_dtype(jnp.int64)), split, x.device, x.comm)
 
 
 def where(cond: DNDarray, x=None, y=None) -> DNDarray:

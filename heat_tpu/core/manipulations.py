@@ -239,11 +239,43 @@ def moveaxis(x: DNDarray, source, destination) -> DNDarray:
     return basics.transpose(x, perm)
 
 
-def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
-    """Pad an array (manipulations.py:1352)."""
-    result = jnp.pad(array._dense(), pad_width, mode=mode, **(
-        {"constant_values": constant_values} if mode == "constant" else {}
-    ))
+#: numpy's mode -> accepted keyword table (np.pad docs); forwarding an
+#: unrelated kwarg silently changes nothing, so it is rejected loudly
+_PAD_MODE_KWARGS = {
+    "constant": {"constant_values"},
+    "edge": set(),
+    "empty": set(),
+    "linear_ramp": {"end_values"},
+    "maximum": {"stat_length"},
+    "mean": {"stat_length"},
+    "median": {"stat_length"},
+    "minimum": {"stat_length"},
+    "reflect": {"reflect_type"},
+    "symmetric": {"reflect_type"},
+    "wrap": set(),
+}
+
+
+def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0, **kwargs) -> DNDarray:
+    """Pad an array (manipulations.py:1352).
+
+    Mode-specific keywords (``reflect_type``, ``stat_length``,
+    ``end_values``, ...) forward to ``jnp.pad`` after validation against
+    the mode, matching ``np.pad``'s contract."""
+    if callable(mode):
+        result = jnp.pad(array._dense(), pad_width, mode=mode, **kwargs)
+        return DNDarray.from_dense(result, array.split, array.device, array.comm)
+    allowed = _PAD_MODE_KWARGS.get(mode)
+    if allowed is None:
+        raise ValueError(f"mode '{mode}' is not supported")
+    if mode == "constant":
+        kwargs.setdefault("constant_values", constant_values)
+    unexpected = set(kwargs) - allowed
+    if unexpected:
+        raise ValueError(
+            f"unsupported keyword arguments for mode '{mode}': {sorted(unexpected)}"
+        )
+    result = jnp.pad(array._dense(), pad_width, mode=mode, **kwargs)
     return DNDarray.from_dense(result, array.split, array.device, array.comm)
 
 
@@ -374,7 +406,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     idx = jnp.argsort(dense, axis=axis, descending=descending, stable=True)
     values = jnp.take_along_axis(dense, idx, axis=axis)
     res_v = DNDarray.from_dense(values, a.split, a.device, a.comm)
-    res_i = DNDarray.from_dense(idx.astype(jnp.int64), a.split, a.device, a.comm)
+    res_i = DNDarray.from_dense(idx.astype(types.canonical_dtype(jnp.int64)), a.split, a.device, a.comm)
     if out is not None:
         from .sanitation import sanitize_out
 
@@ -489,7 +521,7 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         )
         return (
             DNDarray.from_dense(vals, None, a.device, a.comm),
-            DNDarray.from_dense(idx.astype(jnp.int64), None, a.device, a.comm),
+            DNDarray.from_dense(idx.astype(types.canonical_dtype(jnp.int64)), None, a.device, a.comm),
         )
     dense = a._dense()
     moved = jnp.moveaxis(dense, dim, -1)
@@ -501,7 +533,7 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     vals = jnp.moveaxis(vals, -1, dim)
     idx = jnp.moveaxis(idx, -1, dim)
     res_v = DNDarray.from_dense(vals, a.split, a.device, a.comm)
-    res_i = DNDarray.from_dense(idx.astype(jnp.int64), a.split, a.device, a.comm)
+    res_i = DNDarray.from_dense(idx.astype(types.canonical_dtype(jnp.int64)), a.split, a.device, a.comm)
     if out is not None:
         if not (isinstance(out, tuple) and len(out) == 2):
             raise TypeError("out must be a (values, indices) tuple of DNDarrays")

@@ -65,7 +65,11 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=N
 
 
 def __str__(dndarray) -> str:
-    """Format a DNDarray (printing.py:184)."""
+    """Format a DNDarray (printing.py:184).
+
+    Printing is a fusion boundary: a pending elementwise chain behind the
+    array compiles and runs as one cached executable on the
+    ``larray_padded``/``numpy()`` access below (core/dispatch.py)."""
     if _LOCAL_PRINTING:
         data = np.asarray(dndarray.larray)
         return (

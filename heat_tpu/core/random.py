@@ -116,7 +116,7 @@ def permutation(x, split=None, device=None, comm=None) -> DNDarray:
     key = _next_key()
     if isinstance(x, int):
         data = jax.random.permutation(key, x)
-        data = data.astype(jnp.int64)
+        data = data.astype(types.canonical_dtype(jnp.int64))
         return _wrap(data, split, device, comm)
     if isinstance(x, DNDarray):
         data = jax.random.permutation(key, x._dense(), axis=0)

@@ -27,6 +27,7 @@ __all__ = [
     "sanitize_out",
     "sanitize_sequence",
     "scalar_to_1d",
+    "store_out",
 ]
 
 
@@ -106,6 +107,40 @@ def sanitize_out(
         raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
     if tuple(out.shape) != tuple(output_shape):
         raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {tuple(out.shape)}")
+
+
+def store_out(res: DNDarray, out: DNDarray) -> DNDarray:
+    """Validate ``out`` and write ``res``'s values into it (dtype-cast),
+    the shared tail of every ``out=`` path in the op wrappers.
+
+    When the layouts line up (same split, same padded shape, non-complex)
+    the store is ONE cached executable through :mod:`.dispatch`: any
+    pending elementwise chain behind ``res``, plus the cast, compile
+    together, and ``out``'s dead backing buffer is donated so XLA can
+    reuse its allocation.  Otherwise it falls back to the generic
+    dense-slice + re-pad path."""
+    sanitize_out(out, res.shape, res.split, res.device)
+    from . import dispatch
+
+    jdt = out.dtype.jax_type()
+    if (
+        res.split == out.split
+        and res._planar is None
+        and out._planar is None
+        and not jnp.issubdtype(jdt, jnp.complexfloating)
+        and not types.heat_type_is_complexfloating(res.dtype)
+        and res._padded_shape == out._padded_shape
+    ):
+        out._replace(
+            dispatch.cast_store(
+                out._donation_source(), res._fusion_source, jdt,
+                out.comm.sharding(out.split),
+            )
+        )
+        return out
+    casted = res._dense().astype(jdt)
+    out._replace(DNDarray.from_dense(casted, out.split, out.device, out.comm).larray_padded)
+    return out
 
 
 def scalar_to_1d(x: DNDarray) -> DNDarray:

@@ -69,7 +69,9 @@ def argmax(x, axis=None, out=None, keepdims=False, **kwargs):
     """Index of the maximum (statistics.py:33; distributed via custom
     MPI_ARGMAX in the reference, a plain global argmax here)."""
     res = _dense_reduce(
-        lambda a, ax, kd: jnp.argmax(a, axis=ax, keepdims=kd).astype(jnp.int64), x, axis, keepdims
+        lambda a, ax, kd: jnp.argmax(a, axis=ax, keepdims=kd).astype(
+            types.canonical_dtype(jnp.int64)
+        ), x, axis, keepdims
     )
     return _to_out(res, out)
 
@@ -77,7 +79,9 @@ def argmax(x, axis=None, out=None, keepdims=False, **kwargs):
 def argmin(x, axis=None, out=None, keepdims=False, **kwargs):
     """Index of the minimum (statistics.py:119)."""
     res = _dense_reduce(
-        lambda a, ax, kd: jnp.argmin(a, axis=ax, keepdims=kd).astype(jnp.int64), x, axis, keepdims
+        lambda a, ax, kd: jnp.argmin(a, axis=ax, keepdims=kd).astype(
+            types.canonical_dtype(jnp.int64)
+        ), x, axis, keepdims
     )
     return _to_out(res, out)
 
@@ -85,11 +89,9 @@ def argmin(x, axis=None, out=None, keepdims=False, **kwargs):
 def _to_out(res: DNDarray, out: Optional[DNDarray]) -> DNDarray:
     if out is None:
         return res
-    from .sanitation import sanitize_out
+    from .sanitation import store_out
 
-    sanitize_out(out, res.shape, res.split, res.device)
-    out._replace(DNDarray.from_dense(res._dense().astype(out.dtype.jax_type()), out.split, out.device, out.comm).larray_padded)
-    return out
+    return store_out(res, out)
 
 
 def average(x, axis=None, weights=None, returned=False):
@@ -161,7 +163,7 @@ def bucketize(input, boundaries, out_int32: bool = False, right: bool = False, o
     b = boundaries._dense() if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
     side = "left" if right else "right"
     result = jnp.searchsorted(b, input._dense(), side=side)
-    result = result.astype(jnp.int32 if out_int32 else jnp.int64)
+    result = result.astype(jnp.int32 if out_int32 else types.canonical_dtype(jnp.int64))
     res = DNDarray.from_dense(result, input.split, input.device, input.comm)
     return _to_out(res, out)
 

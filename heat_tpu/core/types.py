@@ -65,6 +65,7 @@ __all__ = [
     "csingle",
     "complex128",
     "cdouble",
+    "canonical_dtype",
     "canonical_heat_type",
     "heat_type_of",
     "heat_type_is_exact",
@@ -317,6 +318,30 @@ def canonical_heat_type(a_type: Union[str, Type[datatype], Any]) -> Type[datatyp
         return __type_mappings[np.dtype(jnp.dtype(a_type)).name]
     except Exception:
         raise TypeError(f"data type {a_type!r} is not understood")
+
+
+#: 64-bit types and their x64-less stand-ins (canonical_dtype)
+_X64_DEMOTIONS: dict = {}
+
+
+def canonical_dtype(a_type: Union[str, Type[datatype], Any]):
+    """The jnp dtype actually representable under the current x64 setting.
+
+    Without ``jax_enable_x64``, a 64-bit ``astype`` request quietly
+    truncates inside jax and emits a ``UserWarning`` per call site (the
+    int64->int32 spam in the 8-device dryrun tail).  Internal code paths
+    route their dtype requests through this helper so x64-less runs ask
+    for the canonical 32-bit width directly (int64 -> int32, uint64 ->
+    uint32, float64 -> float32, complex128 -> complex64) and stay silent;
+    with x64 enabled it is the identity.  Returns the backing jnp dtype,
+    ready for ``astype``/factory calls."""
+    t = canonical_heat_type(a_type)
+    if not jax.config.jax_enable_x64:
+        t = _X64_DEMOTIONS.get(t, t)
+    return t.jax_type()
+
+
+_X64_DEMOTIONS.update({int64: int32, uint64: uint32, float64: float32, complex128: complex64})
 
 
 def heat_type_of(obj: Any) -> Type[datatype]:

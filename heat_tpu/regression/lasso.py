@@ -13,9 +13,13 @@ import jax.numpy as jnp
 
 from functools import partial
 
-from ..core import types
+from ..core import dispatch, types
 from ..core.base import BaseEstimator, RegressionMixin, lazy_scalar_property
 from ..core.dndarray import DNDarray
+
+
+def _soft_threshold_op(d, *, lam):
+    return jnp.sign(d) * jnp.maximum(jnp.abs(d) - lam, 0.0)
 
 
 @partial(jax.jit, static_argnames=("max_iter",))
@@ -92,12 +96,17 @@ class Lasso(BaseEstimator, RegressionMixin):
         return self.__theta
 
     def soft_threshold(self, rho):
-        """Soft-thresholding operator (lasso.py:80)."""
+        """Soft-thresholding operator (lasso.py:80).
+
+        The sign/max/abs chain runs as ONE cached executable through the
+        dispatch layer — a regularization-path sweep calling this per
+        lambda re-uses the compiled program instead of paying three
+        eager launches each time."""
+        lam = float(self.__lam)
         if isinstance(rho, DNDarray):
-            d = rho._dense()
-            out = jnp.sign(d) * jnp.maximum(jnp.abs(d) - self.__lam, 0.0)
+            out = dispatch.eager_apply(_soft_threshold_op, (rho._dense(),), {"lam": lam})
             return DNDarray.from_dense(out, rho.split, rho.device, rho.comm)
-        return jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - self.__lam, 0.0)
+        return dispatch.eager_apply(_soft_threshold_op, (jnp.asarray(rho),), {"lam": lam})
 
     def rmse(self, gt: DNDarray, yest: DNDarray) -> float:
         """Root mean squared error (lasso.py:100)."""
@@ -122,6 +131,9 @@ class Lasso(BaseEstimator, RegressionMixin):
         X = jnp.concatenate([jnp.ones((n, 1), xd.dtype), xd], axis=1)
         col_sq = jnp.sum(X * X, axis=0)
 
+        # one launch for the whole coordinate-descent fit — the same
+        # dispatch-amortization shape as the kmeans Lloyd loop
+        dispatch.record_external_dispatch()
         theta, it = _cd_loop(
             X,
             yd,

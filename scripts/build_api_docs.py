@@ -25,6 +25,7 @@ jax.config.update("jax_platforms", "cpu")
 #: (section title, module path, note)
 MODULES = [
     ("Top level", "heat_tpu", "factories, arithmetics, manipulations and the rest of the numpy-style surface"),
+    ("Dispatch", "heat_tpu.core.dispatch", "cached-executable dispatch, chain fusion, buffer donation (docs/dispatch.md)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),
     ("Linear algebra", "heat_tpu.core.linalg.basics", None),
     ("QR / SVD / solvers", "heat_tpu.core.linalg.qr", None),
@@ -106,6 +107,15 @@ def main():
         "Generated from live docstrings by `scripts/build_api_docs.py` — do not edit.",
         "Reference `file:line` citations inside each docstring point at the",
         "upstream component the export mirrors.",
+        "",
+        "> **Note for `ht.jit` users:** executable caching and elementwise chain",
+        "> fusion are now the DEFAULT behavior of the eager op surface — every op",
+        "> dispatches through a cached compiled executable, and elementwise",
+        "> chains defer and fuse into one XLA computation automatically (see",
+        "> [dispatch.md](dispatch.md)).  `ht.jit` is still worth reaching for",
+        "> when you want a whole pipeline — reductions, matmuls, control flow —",
+        "> fused into a single program; for plain elementwise chains feeding a",
+        "> reduction it no longer buys anything over the default path.",
         "",
     ]
     total = 0

@@ -1,0 +1,204 @@
+"""Dispatch-layer tests: executable caching, lazy elementwise chain
+fusion, and fusion-boundary semantics (ISSUE 1 tentpole).
+
+The contract under test (docs/dispatch.md):
+
+* a repeated-shape op sequence compiles once — the second pass performs
+  ZERO retraces (no new cache misses) and yields identical values;
+* a >= 4-op elementwise chain stays pending until a forcing boundary
+  (reduction, print, indexing, host read) and then materializes as a
+  SINGLE compiled dispatch;
+* the kmeans inner loop issues a bounded number of dispatches,
+  independent of the iteration count (dispatch amortization).
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import dispatch
+
+pytestmark = pytest.mark.skipif(
+    not dispatch.cache_enabled(), reason="dispatch cache disabled via env"
+)
+
+needs_fusion = pytest.mark.skipif(
+    not dispatch.fusion_enabled(), reason="chain fusion disabled via env"
+)
+
+
+def _chain_inputs(n=64):
+    ht.random.seed(42)
+    a = ht.random.randn(n, split=0).astype(ht.float32)
+    b = ht.random.randn(n, split=0).astype(ht.float32)
+    c = ht.random.randn(n, split=0).astype(ht.float32)
+    return a, b, c
+
+
+def _sequence(a, b, c):
+    """A fixed mixed op sequence: elementwise chain, scalar broadcast,
+    unary, reduction, cum-op."""
+    r1 = ((a * b + c) / 2.0 - b).sum()
+    r2 = ht.exp(a * 0.5).mean()
+    r3 = ht.cumsum(a + b, 0)
+    return float(r1), float(r2), r3.numpy()
+
+
+def test_second_pass_zero_retraces():
+    a, b, c = _chain_inputs()
+    first = _sequence(a, b, c)  # may compile
+    dispatch.reset_stats()
+    second = _sequence(a, b, c)
+    stats = dispatch.cache_stats()
+    # (a) identical results
+    assert first[0] == second[0]
+    assert first[1] == second[1]
+    np.testing.assert_array_equal(first[2], second[2])
+    # (b) zero new trace/compile events on the second pass
+    assert stats["misses"] == 0, f"second pass recompiled: {stats}"
+    assert stats["hits"] > 0
+    assert stats["hit_rate"] == 1.0
+
+
+@needs_fusion
+def test_chain_fuses_to_single_dispatch():
+    a, b, c = _chain_inputs()
+    # warm the executable cache
+    float(((a * b + c) / 2.0 - b).sum())
+    dispatch.reset_stats()
+    r = ((a * b + c) / 2.0 - b).sum()  # 4 elementwise ops + reduction
+    val = float(r)
+    stats = dispatch.cache_stats()
+    # chain + masking + reduction ride ONE compiled dispatch
+    assert stats["dispatches"] == 1, stats
+    assert stats["fused_ops"] >= 5, stats
+    want = (((a.numpy() * b.numpy() + c.numpy()) / 2.0) - b.numpy()).sum()
+    assert abs(val - want) < 1e-4 * max(abs(want), 1.0)
+
+
+@needs_fusion
+def test_elementwise_result_is_pending():
+    a, b, c = _chain_inputs()
+    lazy = a * b + c
+    assert lazy._pending is not None
+    # metadata queries must not force materialization
+    assert lazy.shape == a.shape
+    assert lazy.split == a.split
+    assert lazy.dtype == ht.float32
+    assert lazy._pending is not None, "metadata access forced the chain"
+
+
+@needs_fusion
+def test_reduction_boundary_forces():
+    a, b, _ = _chain_inputs()
+    lazy = a * b
+    assert lazy._pending is not None
+    s = lazy.sum()  # reduction consumes the chain
+    np.testing.assert_allclose(
+        float(s), (a.numpy() * b.numpy()).sum(), rtol=1e-5
+    )
+
+
+@needs_fusion
+def test_print_boundary_forces():
+    a, b, _ = _chain_inputs(8)
+    lazy = a + b
+    assert lazy._pending is not None
+    text = repr(lazy)  # printing is a host read: must materialize
+    assert lazy._pending is None
+    assert "DNDarray" in text
+    np.testing.assert_allclose(lazy.numpy(), a.numpy() + b.numpy(), rtol=1e-6)
+
+
+@needs_fusion
+def test_index_boundary_forces():
+    a, b, _ = _chain_inputs(16)
+    lazy = a - b
+    assert lazy._pending is not None
+    v = float(lazy[3])
+    assert abs(v - (a.numpy()[3] - b.numpy()[3])) < 1e-5
+    # __getitem__ reads the dense view: the chain was forced
+    assert lazy._pending is None
+
+
+def test_host_read_boundary_forces():
+    a, b, _ = _chain_inputs(16)
+    lazy = a * b
+    np.testing.assert_allclose(lazy.numpy(), a.numpy() * b.numpy(), rtol=1e-6)
+    assert lazy._pending is None
+
+
+def test_chain_value_immune_to_operand_mutation():
+    """Leaves are captured as buffers at op time: mutating an operand
+    after building a chain must not change the chain's value."""
+    a, b, _ = _chain_inputs(16)
+    a_np = a.numpy().copy()
+    lazy = a + b
+    a += 100.0  # in-place mutation after the chain was built
+    np.testing.assert_allclose(lazy.numpy(), a_np + b.numpy(), rtol=1e-6)
+
+
+def test_depth_limit_bounds_chains():
+    a, _, _ = _chain_inputs(16)
+    x = a
+    for _ in range(dispatch.FUSION_DEPTH + 5):
+        x = x + 1.0
+    want = a.numpy() + (dispatch.FUSION_DEPTH + 5)
+    np.testing.assert_allclose(x.numpy(), want, rtol=1e-5)
+    if x._pending is not None:
+        assert x._pending is None or x._pending.depth <= dispatch.FUSION_DEPTH
+
+
+def test_masked_reduction_on_padded_array():
+    """Reductions across a padded split axis must mask the padding with
+    the neutral element inside the fused program."""
+    n = 13  # indivisible: padding present for comm.size > 1
+    x = ht.arange(n, split=0).astype(ht.float32)
+    y = x * 2.0 + 1.0
+    want = (np.arange(n) * 2.0 + 1.0)
+    np.testing.assert_allclose(float(y.sum()), want.sum(), rtol=1e-5)
+    np.testing.assert_allclose(float(y.max()), want.max(), rtol=1e-6)
+    np.testing.assert_allclose(
+        ht.cumsum(y, 0).numpy(), np.cumsum(want), rtol=1e-5
+    )
+
+
+def test_scalar_broadcast_fast_path():
+    x = ht.arange(10, split=0)  # int32
+    np.testing.assert_array_equal((x * 2).numpy(), np.arange(10) * 2)
+    assert (x * 2).dtype == ht.int32
+    r = x / 2
+    assert r.dtype == ht.float32
+    np.testing.assert_allclose(r.numpy(), np.arange(10) / 2, rtol=1e-6)
+    np.testing.assert_array_equal((2 - x).numpy(), 2 - np.arange(10))
+
+
+def test_kmeans_dispatches_bounded():
+    """The kmeans inner loop must issue a bounded number of dispatches,
+    INDEPENDENT of the Lloyd iteration count (the on-device while_loop
+    amortizes the whole fit into one launch)."""
+    ht.random.seed(7)
+    x = ht.random.randn(256, 4, split=0).astype(ht.float32)
+
+    def fit(iters):
+        km = ht.cluster.KMeans(n_clusters=3, init="random", max_iter=iters,
+                               tol=-1.0, random_state=0)
+        dispatch.reset_stats()
+        km.fit(x)
+        s = dispatch.cache_stats()
+        return s["dispatches"] + s["external_dispatches"]
+
+    d5 = fit(5)
+    d20 = fit(20)
+    assert d5 <= 8, f"kmeans fit issued {d5} dispatches for 5 iterations"
+    assert d20 == d5, (
+        f"dispatch count scales with iterations ({d5} -> {d20}): "
+        "the Lloyd loop is no longer amortized"
+    )
+
+
+def test_cache_stats_shape():
+    s = dispatch.cache_stats()
+    for k in ("hits", "misses", "dispatches", "fused_ops", "donations",
+              "external_dispatches", "hit_rate", "cache_size"):
+        assert k in s

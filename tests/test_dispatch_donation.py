@@ -1,0 +1,130 @@
+"""Buffer-donation tests (ISSUE 1 tentpole piece 2).
+
+In-place ops (``resplit_``, ``out=`` stores, ``__iadd__``-style dunders)
+donate the target's dead backing buffer to the compiled program so XLA
+can reuse the allocation.  Two properties are pinned here:
+
+* in-place paths do not GROW the live device-buffer population
+  (``jax.live_arrays()`` before/after on the CPU backend);
+* donation NEVER fires when the buffer is shared — another DNDarray,
+  a pending chain elsewhere, or a user-held ``larray_padded`` — and the
+  sharing holder stays readable afterwards.
+"""
+
+import gc
+
+import jax
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import dispatch
+
+
+def _live_count() -> int:
+    gc.collect()
+    return len(jax.live_arrays())
+
+
+def test_iadd_does_not_grow_live_buffers():
+    x = ht.arange(64, split=0).astype(ht.float32)
+    y = ht.ones(64, split=0)
+    x += y  # warm the executable
+    before = _live_count()
+    for _ in range(10):
+        x += y
+    after = _live_count()
+    assert after <= before, f"live buffers grew {before} -> {after}"
+    np.testing.assert_allclose(x.numpy(), np.arange(64) + 11.0, rtol=1e-6)
+
+
+def test_resplit_does_not_grow_live_buffers():
+    x = ht.arange(65, split=0).astype(ht.float32)  # indivisible: padded
+    want = x.numpy().copy()
+    x.resplit_(None)  # warm both directions
+    x.resplit_(0)
+    before = _live_count()
+    for _ in range(5):
+        x.resplit_(None)
+        x.resplit_(0)
+    after = _live_count()
+    assert after <= before, f"live buffers grew {before} -> {after}"
+    np.testing.assert_allclose(x.numpy(), want, rtol=1e-6)
+
+
+def test_out_store_does_not_grow_live_buffers():
+    a = ht.arange(64, split=0).astype(ht.float32)
+    b = ht.full((64,), 2.0, split=0)
+    out = ht.zeros(64, split=0)
+    ht.mul(a, b, out=out)  # warm
+    before = _live_count()
+    for _ in range(10):
+        ht.mul(a, b, out=out)
+        ht.add(a, b, out=out)
+    after = _live_count()
+    assert after <= before, f"live buffers grew {before} -> {after}"
+    np.testing.assert_allclose(out.numpy(), np.arange(64) + 2.0, rtol=1e-6)
+
+
+def test_iadd_donates_when_unshared():
+    x = ht.arange(64, split=0).astype(ht.float32)
+    x += 1.0  # warm
+    dispatch.reset_stats()
+    x += 1.0
+    if dispatch._DONATE_ENABLED:
+        assert dispatch.cache_stats()["donations"] >= 1
+    np.testing.assert_allclose(x.numpy(), np.arange(64) + 2.0, rtol=1e-6)
+
+
+def test_no_donation_when_chain_references_buffer():
+    """tmp = x + y keeps x's buffer as a chain leaf: x += tmp must NOT
+    donate, and tmp must stay readable afterwards."""
+    x = ht.arange(32, split=0).astype(ht.float32)
+    y = ht.ones(32, split=0)
+    tmp = x + y  # pending chain, leaf = x's buffer
+    dispatch.reset_stats()
+    x += tmp
+    if dispatch.fusion_enabled():
+        # with fusion off tmp is already concrete, so donating x's old
+        # buffer is safe and allowed — the refusal only applies to a
+        # LIVE chain that still references the buffer
+        assert dispatch.cache_stats()["donations"] == 0
+    np.testing.assert_allclose(tmp.numpy(), np.arange(32) + 1.0, rtol=1e-6)
+    np.testing.assert_allclose(x.numpy(), 2 * np.arange(32) + 1.0, rtol=1e-6)
+
+
+def test_no_donation_when_user_holds_buffer():
+    x = ht.arange(32, split=0).astype(ht.float32)
+    held = x.larray_padded
+    dispatch.reset_stats()
+    x += 1.0
+    assert dispatch.cache_stats()["donations"] == 0
+    assert float(np.asarray(held)[5]) == 5.0  # old buffer untouched
+
+
+def test_no_donation_when_backing_is_shared():
+    x = ht.arange(32, split=0).astype(ht.float32)
+    alias = x.resplit(0)  # same-axis resplit shares the backing buffer
+    dispatch.reset_stats()
+    x += 1.0
+    assert dispatch.cache_stats()["donations"] == 0
+    np.testing.assert_allclose(alias.numpy(), np.arange(32), rtol=1e-6)
+    np.testing.assert_allclose(x.numpy(), np.arange(32) + 1.0, rtol=1e-6)
+
+
+def test_no_donation_on_resplit_with_shared_backing():
+    x = ht.arange(32, split=0).astype(ht.float32)
+    alias = x.resplit(0)
+    dispatch.reset_stats()
+    x.resplit_(None)
+    assert dispatch.cache_stats()["donations"] == 0
+    np.testing.assert_allclose(alias.numpy(), np.arange(32), rtol=1e-6)
+
+
+def test_inplace_loop_values_stay_correct():
+    """The full ML-loop shape: repeated donating += with a warm cache."""
+    w = ht.zeros(128, split=0)
+    g = ht.ones(128, split=0)
+    for _ in range(25):
+        w += g * 0.5
+    np.testing.assert_allclose(w.numpy(), 12.5, rtol=1e-5)

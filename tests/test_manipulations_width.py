@@ -87,6 +87,38 @@ def test_pad_modes(m2d, split, mode, kw):
     np.testing.assert_array_equal(got.numpy(), np.pad(m2d, widths, mode=mode, **kw))
 
 
+@pytest.mark.parametrize(
+    "mode,kw",
+    [
+        ("reflect", {"reflect_type": "odd"}),
+        ("symmetric", {"reflect_type": "odd"}),
+        ("maximum", {"stat_length": 2}),
+        ("minimum", {"stat_length": ((2, 1), (1, 2))}),
+        ("mean", {"stat_length": 2}),
+        ("linear_ramp", {"end_values": 5.0}),
+        ("linear_ramp", {"end_values": ((1.0, 2.0), (3.0, 4.0))}),
+    ],
+)
+@pytest.mark.parametrize("split", [None, 0])
+def test_pad_mode_specific_kwargs_forwarded(m2d, split, mode, kw):
+    """Non-constant modes forward their mode-specific kwargs to jnp.pad
+    (ISSUE 1 satellite: they used to be dropped silently)."""
+    x = ht.array(m2d, split=split)
+    widths = ((2, 1), (0, 3))
+    got = ht.pad(x, widths, mode=mode, **kw)
+    np.testing.assert_allclose(
+        got.numpy(), np.pad(m2d, widths, mode=mode, **kw), rtol=1e-6
+    )
+
+
+def test_pad_kwargs_validated_against_mode(m2d):
+    x = ht.array(m2d)
+    with pytest.raises(ValueError, match="reflect_type"):
+        ht.pad(x, ((1, 1), (1, 1)), mode="edge", reflect_type="odd")
+    with pytest.raises(ValueError, match="stat_length"):
+        ht.pad(x, ((1, 1), (1, 1)), mode="constant", stat_length=2)
+
+
 @pytest.mark.parametrize("split", [None, 0])
 def test_insert_delete_append(split):
     a = np.arange(20, dtype=np.float32)
