@@ -1,7 +1,7 @@
 """Benchmark driver: the full BASELINE grid on the attached chip.
 
 Emits one JSON line per BASELINE config (smoke, KMeans, hSVD north star,
-DP-SGD, 3-D FFT, dispatch-amortization), then a final summary line whose top-level fields are the
+DP-SGD, 3-D FFT, dispatch-amortization, resilience counters), then a final summary line whose top-level fields are the
 hSVD north star (so single-metric consumers keep working) with the whole
 grid attached under ``"all"`` — BENCH_r{N}.json then records every config
 each round and rounds stay comparable (BASELINE.md targets table).
@@ -711,6 +711,79 @@ def bench_dispatch(ht, sync_floor, roofline=None):
     }
 
 
+def bench_resilience(ht, sync_floor, roofline=None):
+    """Config 7: resilience-layer counters + checkpoint overhead (ISSUE 2).
+
+    ``checkpoint_save_ms``/``checkpoint_restore_ms`` — wall time of one
+    filesystem-native Checkpointer save/restore of a representative
+    (1k x 256 f32 centers + scalars) fit state, the per-chunk overhead a
+    ``checkpoint_every=N`` fit pays; the perf gate watches these so a
+    checkpoint-layer regression (lost atomicity batching, sidecar
+    recomputation) is caught.  ``retries``/``faults_injected``/
+    ``faults_survived`` — counters from a scripted transient-fault save
+    (fault plan: one transient on ``io.write``), proving the retry path
+    is live in the shipped wheel, not just under pytest.  The headline
+    value is checkpoint_save_ms."""
+    import os
+    import shutil
+    import tempfile
+
+    from heat_tpu import resilience as rz
+    from heat_tpu.utils.checkpoint import Checkpointer
+
+    rz.reset_retry_stats()
+    rz.reset_fault_stats()
+    state = {
+        "state": np.random.default_rng(0).standard_normal((1024, 256)).astype(np.float32),
+        "n_iter": 17,
+        "shift": 1e-3,
+        "converged": False,
+    }
+    d = tempfile.mkdtemp(prefix="heat_tpu_bench_ck_")
+    try:
+        ck = Checkpointer(d)
+        save_s = float("inf")
+        for i in range(5):
+            t0 = time.perf_counter()
+            ck.save(i, state)
+            save_s = min(save_s, time.perf_counter() - t0)
+        restore_s = float("inf")
+        for i in range(5):
+            t0 = time.perf_counter()
+            out = ck.restore(i)
+            restore_s = min(restore_s, time.perf_counter() - t0)
+        assert out["n_iter"] == 17
+
+        # scripted transient save fault: one retry must absorb it
+        os.environ["HEAT_TPU_RETRY_NO_SLEEP"] = "1"
+        try:
+            with rz.fault_plan({"io.write": [0]}):
+                ht.save(
+                    ht.arange(1024, dtype=ht.float32),
+                    os.path.join(d, "fault_probe.npy"),
+                )
+        finally:
+            os.environ.pop("HEAT_TPU_RETRY_NO_SLEEP", None)
+        counters = rz.resilience_stats()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    return {
+        "metric": "resilience_checkpoint_save_ms",
+        "value": round(save_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "vs_baseline_kind": "self",
+        "checkpoint_save_ms": round(save_s * 1e3, 3),
+        "checkpoint_restore_ms": round(restore_s * 1e3, 3),
+        "checkpoint_state_mb": round(state["state"].nbytes / 2**20, 1),
+        "retries": counters["retries"],
+        "faults_injected": counters["faults_injected"],
+        "faults_survived": counters["faults_survived"],
+        "retry_gave_up": counters["gave_up"],
+    }
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -723,7 +796,8 @@ def main() -> None:
     except Exception as e:  # anchors are advisory; keep the grid going
         roofline = None
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
-    for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d, bench_dispatch):
+    for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
+                  bench_dispatch, bench_resilience):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

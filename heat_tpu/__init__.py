@@ -30,6 +30,7 @@ from . import preprocessing
 from . import regression
 from . import nn
 from . import optim
+from . import resilience
 from . import sparse
 from . import utils
 from . import datasets

@@ -48,7 +48,17 @@ def _kmeanspp_init(dense: jax.Array, first_idx: jax.Array, u_all: jax.Array, k: 
 
 
 class _KCluster(BaseEstimator, ClusteringMixin):
-    """Base class for k-statistics clustering (_kcluster.py:10)."""
+    """Base class for k-statistics clustering (_kcluster.py:10).
+
+    ``checkpoint_every=N`` + ``checkpoint_dir`` make the fit resumable:
+    every N iterations the centers are checkpointed through the
+    filesystem-native :class:`~heat_tpu.utils.checkpoint.Checkpointer`,
+    and ``resume_from=dir`` continues a killed fit from its last
+    checkpoint, reproducing the uninterrupted result exactly (the
+    chunked loop runs the identical iteration sequence).  The chunked
+    path also guards against NaN/Inf divergence
+    (:class:`~heat_tpu.resilience.DivergenceError` carrying the last
+    finite centers)."""
 
     def __init__(
         self,
@@ -58,18 +68,32 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         max_iter: int,
         tol: float,
         random_state: Optional[int],
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
+        from ..core.base import validate_resume_params
+
+        validate_resume_params(checkpoint_every, checkpoint_dir, resume_from)
         self.n_clusters = n_clusters
         self.init = init
         self.max_iter = max_iter
         self.tol = tol
         self.random_state = random_state
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_dir = checkpoint_dir
+        self.resume_from = resume_from
 
         self._metric = metric
         self._cluster_centers = None
         self._labels = None
         self._inertia = None
         self._n_iter = None
+
+    @property
+    def _resumable(self) -> bool:
+        """Whether the fit must take the chunked checkpoint/resume path."""
+        return self.checkpoint_every is not None or self.resume_from is not None
 
     @property
     def cluster_centers_(self) -> DNDarray:
@@ -140,6 +164,23 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             # stays a lazy 0-d value; inertia_ converts on first access
             self._inertia = arithmetics.sum(statistics.min(distances, axis=1) ** 2)._dense()
         return labels
+
+    def _run_resumable(self, run_chunk, init_centers, site: str):
+        """Chunked checkpoint/resume driver around the jitted fit loop
+        (see :func:`heat_tpu.core.base.resumable_fit_loop`)."""
+        from ..core.base import resumable_fit_loop
+
+        return resumable_fit_loop(
+            run_chunk,
+            init_centers,
+            self.max_iter,
+            float(self.tol),
+            checkpoint_every=self.checkpoint_every,
+            checkpoint_dir=self.checkpoint_dir,
+            resume_from=self.resume_from,
+            site=site,
+            what="cluster centers",
+        )
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
         raise NotImplementedError()

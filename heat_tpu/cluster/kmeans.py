@@ -111,6 +111,9 @@ class KMeans(_KCluster):
         max_iter: int = 300,
         tol: float = 1e-4,
         random_state: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         if max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {max_iter}")
@@ -123,6 +126,9 @@ class KMeans(_KCluster):
             max_iter=max_iter,
             tol=tol,
             random_state=random_state,
+            checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            resume_from=resume_from,
         )
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
@@ -177,11 +183,39 @@ class KMeans(_KCluster):
             raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
-        self._initialize_cluster_centers(x)
-
         xp = x.larray_padded
         if not types.heat_type_is_inexact(x.dtype):
             xp = xp.astype(jnp.float32)
+        if self._resumable:
+            # chunked checkpoint/resume path: the SAME `_lloyd_body`
+            # iteration sequence as the fast path, run checkpoint_every
+            # iterations per device program, centers checkpointed (and
+            # divergence-guarded) between chunks.  A killed fit resumed
+            # from its last checkpoint reproduces the uninterrupted
+            # result exactly.
+            dtype = xp.dtype
+
+            def run_chunk(centers, n):
+                dispatch.record_external_dispatch()
+                return _lloyd_loop(
+                    xp, jnp.asarray(centers, dtype), x.shape[0],
+                    self.n_clusters, n, float(self.tol),
+                )
+
+            def init_centers():
+                self._initialize_cluster_centers(x)
+                return self._cluster_centers._dense().astype(dtype)
+
+            centers, n_iter = self._run_resumable(run_chunk, init_centers, "kmeans.iter")
+            self._cluster_centers = DNDarray.from_dense(
+                jnp.asarray(centers, dtype), None, x.device, x.comm
+            )
+            self._n_iter = n_iter
+            labels, inertia = self._assign_padded(x)
+            self._inertia = inertia
+            self._labels = DNDarray.from_dense(labels[: x.shape[0]], x.split, x.device, x.comm)
+            return self
+        self._initialize_cluster_centers(x)
         centers = self._cluster_centers._dense().astype(xp.dtype)
         use_kernel = kernels.LLOYD_KERNEL and kernels.lloyd_supported(xp.shape[1], self.n_clusters)
         if use_kernel:

@@ -22,7 +22,9 @@ __all__ = ["KMedians"]
 @partial(jax.jit, static_argnames=("k", "max_iter", "tol"))
 def _kmedians_loop(dense: jax.Array, centers: jax.Array, k: int, max_iter: int, tol: float):
     """Whole KMedians fit as one on-device while_loop (one host sync
-    total instead of one per iteration)."""
+    total instead of one per iteration).  Returns (centers, n_iter,
+    last_shift) — the shift lets the chunked checkpoint/resume driver
+    distinguish convergence from a chunk-boundary stop."""
 
     def update(c):
         d = jnp.sum(jnp.abs(dense[:, None, :] - c[None, :, :]), axis=-1)
@@ -47,8 +49,8 @@ def _kmedians_loop(dense: jax.Array, centers: jax.Array, k: int, max_iter: int, 
         return new, i + 1, shift
 
     init = (centers, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
-    c, i, _ = jax.lax.while_loop(cond, body, init)
-    return c, i
+    c, i, shift = jax.lax.while_loop(cond, body, init)
+    return c, i, shift
 
 
 class KMedians(_KCluster):
@@ -61,6 +63,9 @@ class KMedians(_KCluster):
         max_iter: int = 300,
         tol: float = 1e-4,
         random_state: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         if init == "kmedians++":
             init = "probability_based"
@@ -71,6 +76,9 @@ class KMedians(_KCluster):
             max_iter=max_iter,
             tol=tol,
             random_state=random_state,
+            checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            resume_from=resume_from,
         )
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
@@ -98,15 +106,29 @@ class KMedians(_KCluster):
             raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
-        self._initialize_cluster_centers(x)
-
         dense = x._dense()
         if not types.heat_type_is_inexact(x.dtype):
             dense = dense.astype(jnp.float32)
-        centers = self._cluster_centers._dense().astype(dense.dtype)
-        new, n_iter = _kmedians_loop(
-            dense, centers, self.n_clusters, self.max_iter, float(self.tol)
-        )
+        if self._resumable:
+            dtype = dense.dtype
+
+            def run_chunk(centers, n):
+                return _kmedians_loop(
+                    dense, jnp.asarray(centers, dtype), self.n_clusters, n, float(self.tol)
+                )
+
+            def init_centers():
+                self._initialize_cluster_centers(x)
+                return self._cluster_centers._dense().astype(dtype)
+
+            new, n_iter = self._run_resumable(run_chunk, init_centers, "kmedians.iter")
+            new = jnp.asarray(new, dtype)
+        else:
+            self._initialize_cluster_centers(x)
+            centers = self._cluster_centers._dense().astype(dense.dtype)
+            new, n_iter, _ = _kmedians_loop(
+                dense, centers, self.n_clusters, self.max_iter, float(self.tol)
+            )
         self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
         self._n_iter = n_iter  # lazy host conversion in n_iter_
         self._labels = self._assign_to_cluster(x, eval_functional_value=True)

@@ -54,8 +54,8 @@ def _kmedoids_loop(dense: jax.Array, centers: jax.Array, k: int, max_iter: int):
         return new, i + 1, shift
 
     init = (centers, jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
-    c, i, _ = jax.lax.while_loop(cond, body, init)
-    return c, i
+    c, i, shift = jax.lax.while_loop(cond, body, init)
+    return c, i, shift
 
 
 class KMedoids(_KCluster):
@@ -67,6 +67,9 @@ class KMedoids(_KCluster):
         init: Union[str, DNDarray] = "random",
         max_iter: int = 300,
         random_state: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         if init == "kmedoids++":
             init = "probability_based"
@@ -77,6 +80,9 @@ class KMedoids(_KCluster):
             max_iter=max_iter,
             tol=0.0,
             random_state=random_state,
+            checkpoint_every=checkpoint_every,
+            checkpoint_dir=checkpoint_dir,
+            resume_from=resume_from,
         )
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
@@ -109,13 +115,25 @@ class KMedoids(_KCluster):
             raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
-        self._initialize_cluster_centers(x)
-
         dense = x._dense()
         if not types.heat_type_is_inexact(x.dtype):
             dense = dense.astype(jnp.float32)
-        centers = self._cluster_centers._dense().astype(dense.dtype)
-        new, n_iter = _kmedoids_loop(dense, centers, self.n_clusters, self.max_iter)
+        if self._resumable:
+            dtype = dense.dtype
+
+            def run_chunk(centers, n):
+                return _kmedoids_loop(dense, jnp.asarray(centers, dtype), self.n_clusters, n)
+
+            def init_centers():
+                self._initialize_cluster_centers(x)
+                return self._cluster_centers._dense().astype(dtype)
+
+            new, n_iter = self._run_resumable(run_chunk, init_centers, "kmedoids.iter")
+            new = jnp.asarray(new, dtype)
+        else:
+            self._initialize_cluster_centers(x)
+            centers = self._cluster_centers._dense().astype(dense.dtype)
+            new, n_iter, _ = _kmedoids_loop(dense, centers, self.n_clusters, self.max_iter)
         self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
         self._n_iter = n_iter  # lazy host conversion in n_iter_
         self._labels = self._assign_to_cluster(x, eval_functional_value=True)

@@ -1,9 +1,13 @@
-"""Estimator base classes, analog of heat/core/base.py (base.py:13-321)."""
+"""Estimator base classes, analog of heat/core/base.py (base.py:13-321),
+plus the shared resumable-fit machinery (checkpoint_every / resume_from)
+the iterative estimators build on."""
 
 from __future__ import annotations
 
 import inspect
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 __all__ = [
     "BaseEstimator",
@@ -17,7 +21,123 @@ __all__ = [
     "is_regressor",
     "is_transformer",
     "lazy_scalar_property",
+    "resumable_fit_loop",
+    "validate_resume_params",
 ]
+
+
+def validate_resume_params(
+    checkpoint_every: Optional[int],
+    checkpoint_dir: Optional[str],
+    resume_from: Optional[str],
+) -> None:
+    """Shared constructor validation for the resumable-fit parameters."""
+    if checkpoint_every is not None:
+        if not isinstance(checkpoint_every, int) or checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be a positive int, got {checkpoint_every!r}"
+            )
+        if checkpoint_dir is None and resume_from is None:
+            raise ValueError(
+                "checkpoint_every requires checkpoint_dir (or resume_from) "
+                "to name the checkpoint directory"
+            )
+
+
+def resumable_fit_loop(
+    run_chunk: Callable,
+    init_state: Callable,
+    max_iter: int,
+    tol: float,
+    checkpoint_every: Optional[int] = None,
+    checkpoint_dir: Optional[str] = None,
+    resume_from: Optional[str] = None,
+    site: str = "estimator.iter",
+    what: str = "iterate",
+    converged_when: Optional[Callable[[float, float], bool]] = None,
+) -> Tuple[object, int]:
+    """Drive an on-device fit loop in resumable, divergence-guarded chunks.
+
+    The fast paths of the iterative estimators run their whole fit as ONE
+    on-device ``lax.while_loop`` (zero host syncs).  With
+    ``checkpoint_every=N`` the same loop body runs in chunks of N
+    iterations — each chunk is still one device program — and between
+    chunks the iterate is (a) checked finite (:class:`DivergenceError`
+    carrying the last good iterate on NaN/Inf), (b) offered to the fault
+    injector (site ``<estimator>.iter`` — the hook kill-and-resume tests
+    script), and (c) checkpointed through the filesystem-native
+    :class:`~heat_tpu.utils.checkpoint.Checkpointer`.  The iteration
+    sequence is identical to the uninterrupted loop, so a killed fit
+    resumed from its last checkpoint reproduces the uninterrupted result
+    exactly.
+
+    ``run_chunk(state, n)`` runs at most ``n`` iterations from ``state``
+    and returns ``(new_state, iters_run, shift)`` (device values);
+    ``init_state()`` builds the initial iterate (only called when not
+    resuming, so RNG draws consumed by initialization are not replayed
+    on resume).  ``converged_when(shift, tol)`` must mirror the device
+    loop's own stop test (default ``shift <= tol``) so a chunk boundary
+    never stops the fit one iteration early or late relative to the
+    uninterrupted loop.  Returns ``(final_state, total_iterations)``.
+    """
+    from ..resilience.errors import DivergenceError  # lazy: avoid import cycles
+    from ..resilience.faults import inject
+    from ..resilience.guard import all_finite
+    from ..utils.checkpoint import Checkpointer
+
+    ckpt = None
+    directory = checkpoint_dir or resume_from
+    if directory is not None and checkpoint_every is not None:
+        ckpt = Checkpointer(directory)
+
+    state = None
+    total = 0
+    if resume_from is not None:
+        reader = ckpt if ckpt is not None else Checkpointer(resume_from)
+        step = reader.latest_step()
+        if step is not None:
+            saved = reader.restore(step)
+            state = saved["state"]
+            total = int(saved["n_iter"])
+            if saved.get("converged") or total >= max_iter:
+                return state, total
+    if state is None:
+        state = init_state()
+
+    chunk = checkpoint_every if checkpoint_every is not None else max_iter
+    last_good = (np.asarray(state), total)
+    while total < max_iter:
+        n = min(chunk, max_iter - total)
+        new_state, iters_dev, shift_dev = run_chunk(state, n)
+        iters = int(iters_dev)
+        shift = float(shift_dev)
+        total += iters
+        inject(site, iteration=total)
+        if not all_finite(new_state):
+            raise DivergenceError(
+                f"non-finite values in {what} at iteration {total} — the fit "
+                f"has diverged; last finite {what} is at iteration {last_good[1]}",
+                iteration=total,
+                last_good=last_good[0],
+                last_good_iteration=last_good[1],
+            )
+        state = new_state
+        stop_test = converged_when if converged_when is not None else (lambda s, t: s <= t)
+        converged = stop_test(shift, tol) or iters < n
+        if ckpt is not None:
+            ckpt.save(
+                total,
+                {
+                    "state": np.asarray(state),
+                    "n_iter": total,
+                    "shift": shift,
+                    "converged": bool(converged),
+                },
+            )
+        if converged:
+            break
+        last_good = (np.asarray(state), total)
+    return state, total
 
 
 def lazy_scalar_property(attr: str, kind: type = float, doc: Optional[str] = None) -> property:

@@ -61,6 +61,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience.faults import inject as _inject
+
 __all__ = [
     "PendingExpr",
     "cache_enabled",
@@ -104,7 +106,7 @@ def fusion_enabled() -> bool:
 # counters + cache
 # ----------------------------------------------------------------------
 _ZERO = dict(hits=0, misses=0, dispatches=0, fused_ops=0, donations=0,
-             external_dispatches=0)
+             external_dispatches=0, compile_fallbacks=0)
 _counters = dict(_ZERO)
 
 #: LRU of compiled executables.  Bounded because op callables created
@@ -127,8 +129,10 @@ def cache_stats() -> dict:
     ``donations`` the in-place launches that donated a dead buffer.
     ``external_dispatches`` are launches recorded by consumers with their
     own jitted programs (kmeans' Lloyd loop, lasso's CD loop,
-    ``fusion.jit``).  ``hit_rate`` is hits / (hits + misses), 0.0 before
-    any lookup."""
+    ``fusion.jit``).  ``compile_fallbacks`` counts compiled executions
+    that failed (trace/compile error, injected compile fault) and were
+    re-run eagerly instead of crashing the op.  ``hit_rate`` is
+    hits / (hits + misses), 0.0 before any lookup."""
     s = dict(_counters)
     total = s["hits"] + s["misses"]
     s["hit_rate"] = (s["hits"] / total) if total else 0.0
@@ -349,6 +353,7 @@ def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
         _note_lookup(True)
         return entry
     _note_lookup(False)
+    _inject("dispatch.compile")
     jit_kwargs: dict = {}
     if out_sharding is not None:
         jit_kwargs["out_shardings"] = out_sharding
@@ -374,6 +379,33 @@ def _run(compiled, leaves, n_ops: int, donated: bool = False):
     return compiled(*leaves)
 
 
+def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=None):
+    """Run through the executable cache; on a trace/compile/run failure
+    fall back to ONE eager execution instead of crashing the op.
+
+    The broken cache entry is dropped so the next call re-attempts a
+    compile (a transient compile failure — injected or an XLA hiccup —
+    heals itself); ``compile_fallbacks`` in :func:`cache_stats` counts
+    the events and a ``RuntimeWarning`` surfaces each one.  A genuine
+    error in the op (bad shapes, bad dtype) re-raises from the eager
+    run, so user-facing exceptions are unchanged.  Donating paths never
+    come through here: a partially-run donated program may have
+    consumed its input, making re-execution unsafe."""
+    try:
+        compiled = _get_compiled(key, builder, out_sharding=out_sharding)
+        return _run(compiled, leaves, n_ops)
+    except Exception as e:
+        _counters["compile_fallbacks"] += 1
+        _cache.pop(key, None)
+        warnings.warn(
+            f"dispatch: compiled execution failed ({type(e).__name__}: {e}); "
+            "falling back to eager execution for this call",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return eager_fn()
+
+
 # ----------------------------------------------------------------------
 # entry points
 # ----------------------------------------------------------------------
@@ -389,8 +421,10 @@ def materialize(expr: PendingExpr, out_sharding=None):
         key = _program_key("expr", nodes, leaves, (out_sharding,))
     except TypeError:
         return _eval_nodes(nodes, leaves)
-    compiled = _get_compiled(key, lambda: _build_program(nodes), out_sharding=out_sharding)
-    return _run(compiled, leaves, len(nodes))
+    return _compiled_or_fallback(
+        key, lambda: _build_program(nodes), leaves, len(nodes),
+        lambda: _eval_nodes(nodes, leaves), out_sharding=out_sharding,
+    )
 
 
 def eager_apply(op, args: Sequence, kwargs: Optional[dict] = None):
@@ -406,8 +440,10 @@ def eager_apply(op, args: Sequence, kwargs: Optional[dict] = None):
         hash(key)
     except TypeError:
         return op(*args, **kwargs)
-    compiled = _get_compiled(key, lambda: (lambda *a: op(*a, **kwargs)))
-    return _run(compiled, args, 1)
+    return _compiled_or_fallback(
+        key, lambda: (lambda *a: op(*a, **kwargs)), args, 1,
+        lambda: op(*args, **kwargs),
+    )
 
 
 def chain_apply(op, x, kwargs: Optional[dict] = None, mask=None):
@@ -439,8 +475,10 @@ def chain_apply(op, x, kwargs: Optional[dict] = None, mask=None):
         key = _program_key("chain", nodes, leaves)
     except TypeError:
         return _eval_nodes(nodes, leaves)
-    compiled = _get_compiled(key, lambda: _build_program(nodes))
-    return _run(compiled, leaves, len(nodes))
+    return _compiled_or_fallback(
+        key, lambda: _build_program(nodes), leaves, len(nodes),
+        lambda: _eval_nodes(nodes, leaves),
+    )
 
 
 # ----------------------------------------------------------------------
@@ -620,10 +658,13 @@ def repad(buf, old_slice, pad_widths, sharding, donate: bool = False):
         hash(key)
     except TypeError:
         return jax.device_put(build()(buf), sharding)
-    compiled = _get_compiled(
-        key, build, donate_argnums=(0,) if donate else None, out_sharding=sharding
-    )
-    return _run(compiled, (buf,), 1, donated=donate)
+    if not donate:
+        return _compiled_or_fallback(
+            key, build, (buf,), 1,
+            lambda: jax.device_put(build()(buf), sharding), out_sharding=sharding,
+        )
+    compiled = _get_compiled(key, build, donate_argnums=(0,), out_sharding=sharding)
+    return _run(compiled, (buf,), 1, donated=True)
 
 
 def cast_store(dst_buf, src, dtype, out_sharding=None):
@@ -701,9 +742,12 @@ def cast_store(dst_buf, src, dtype, out_sharding=None):
         )
     except TypeError:
         return _eval_nodes(nodes, leaves if not trailing_dst else leaves[:-1])
+    if donate_ix is None:
+        return _compiled_or_fallback(
+            key, build, leaves, len(nodes),
+            lambda: _eval_nodes(nodes, leaves), out_sharding=out_sharding,
+        )
     compiled = _get_compiled(
-        key, build,
-        donate_argnums=(donate_ix,) if donate_ix is not None else None,
-        out_sharding=out_sharding,
+        key, build, donate_argnums=(donate_ix,), out_sharding=out_sharding
     )
-    return _run(compiled, leaves, len(nodes), donated=donate_ix is not None)
+    return _run(compiled, leaves, len(nodes), donated=True)

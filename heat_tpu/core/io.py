@@ -12,14 +12,20 @@ io.py:36-47,463-485,1205).
 
 from __future__ import annotations
 
+import contextlib
 import csv as _csv
+import functools
 import os
+import shutil
 from typing import List, Optional, Tuple, Union
 
 import jax
 import numpy as np
 
 from ..parallel.comm import sanitize_comm
+from ..resilience import atomic as _ratomic
+from ..resilience.faults import inject as _inject
+from ..resilience.retry import default_io_policy as _io_policy
 from . import types
 from .devices import sanitize_device
 from .dndarray import DNDarray
@@ -85,6 +91,50 @@ except ImportError:  # pragma: no cover
     __PANDAS = False
 
 
+# ----------------------------------------------------------------------
+# resilience plumbing: every writer goes through atomic
+# write-temp-fsync-rename with a CRC32 sidecar (a torn write is never
+# visible; a corrupt file fails loudly on load), and every load/save
+# runs under the io retry policy (transient faults — injected or real —
+# are retried with bounded backoff).  HEAT_TPU_IO_CHECKSUM=0 disables
+# sidecar writing + verification.
+# ----------------------------------------------------------------------
+def _checksums_enabled() -> bool:
+    return os.environ.get("HEAT_TPU_IO_CHECKSUM", "1") != "0"
+
+
+def _retried(fn):
+    """Run the io function under the (env-tunable) default retry policy."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return _io_policy().call(fn, *args, **kwargs)
+
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+@contextlib.contextmanager
+def _atomic_out(path: str, preserve_existing: bool = False):
+    """Atomic-write scope for one destination file.
+
+    ``preserve_existing`` seeds the temp file with the current content —
+    the append/update modes (hdf5 ``'a'``, netCDF ``'a'``/``'r+'``,
+    variable updates) become copy-modify-rename, so even an in-place
+    update is all-or-nothing."""
+    with _ratomic.atomic_write(path, checksum=_checksums_enabled()) as tmp:
+        if preserve_existing and os.path.exists(path):
+            shutil.copyfile(path, tmp)
+        yield tmp
+
+
+def _checked_read(path: str) -> None:
+    """Load-side gate: fault-injection point + CRC32 verification."""
+    _inject("io.open", path=path)
+    if _checksums_enabled():
+        _ratomic.verify_checksum(path)
+
+
 def supports_hdf5() -> bool:
     """Whether HDF5 io is available (io.py:40)."""
     return __HDF5
@@ -140,9 +190,7 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
     if ext == ".csv":
         return save_csv(data, path, *args, **kwargs)
     if ext == ".npy":
-        if jax.process_index() == 0:
-            np.save(path, data.numpy())
-        return None
+        return _save_npy_file(data, path)
     if ext == ".npz":
         return savez(path, data, *args, **kwargs)
     if ext in (".txt", ".dat"):
@@ -153,6 +201,7 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
 # ----------------------------------------------------------------------
 # HDF5 (io.py:488-679)
 # ----------------------------------------------------------------------
+@_retried
 def load_hdf5(
     path: str,
     dataset: str,
@@ -175,6 +224,7 @@ def load_hdf5(
         raise TypeError(f"dataset must be str, not {type(dataset)}")
     if not isinstance(load_fraction, float) or not (0.0 < load_fraction <= 1.0):
         raise ValueError("load_fraction must be a float in (0., 1.]")
+    _checked_read(path)
     comm = sanitize_comm(comm)
     device = sanitize_device(device)
     dtype = types.canonical_heat_type(dtype)
@@ -243,15 +293,18 @@ def _iter_shard_slabs(data: DNDarray):
         yield start, block
 
 
+@_retried
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
     """Write a DNDarray to HDF5, streaming shard-by-shard (io.py:597).
 
     The dataset is created at the global shape and each device shard's true
     rows are written as a hyperslab — the global array is never gathered
     (the TPU-native analog of the reference's mpio / serialized rank
-    writes).  Multi-host: processes take turns appending their slabs (HDF5
-    without MPI-IO cannot write one file concurrently), synchronized via a
-    global device barrier."""
+    writes).  Single-host writes are atomic (temp + fsync + rename with a
+    CRC32 sidecar; ``mode='a'`` copies the existing file first, so the
+    append is all-or-nothing too).  Multi-host: processes take turns
+    appending their slabs (HDF5 without MPI-IO cannot write one file
+    concurrently), synchronized via a global device barrier."""
     if not __HDF5:
         raise RuntimeError("h5py is not available")
     if not isinstance(data, DNDarray):
@@ -276,8 +329,9 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 
     nproc = jax.process_count()
     if nproc == 1:
-        with h5py.File(path, mode) as handle:
-            write_slabs(handle, create=True)
+        with _atomic_out(path, preserve_existing=mode not in ("w", "w-", "x")) as tmp:
+            with h5py.File(tmp, mode) as handle:
+                write_slabs(handle, create=True)
         return
     # multi-host: serialized turns (reference io.py:648 rank-serialized path)
     from jax.experimental import multihost_utils  # pragma: no cover - multi-host only
@@ -298,6 +352,7 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 # ----------------------------------------------------------------------
 if __NETCDF:
 
+    @_retried
     def load_netcdf(path, variable, dtype=types.float32, split=None, device=None, comm=None, **kwargs):
         """Parallel netCDF read (io.py:75), netCDF4 or scipy-NetCDF3
         backed (``supports_netcdf``/``netcdf_backend``)."""
@@ -305,6 +360,7 @@ if __NETCDF:
             raise TypeError(f"path must be str, not {type(path)}")
         if not isinstance(variable, str):
             raise TypeError(f"variable must be str, not {type(variable)}")
+        _checked_read(path)
         comm = sanitize_comm(comm)
         device = sanitize_device(device)
         dtype = types.canonical_heat_type(dtype)
@@ -338,6 +394,7 @@ if __NETCDF:
             )
         return list(dimension_names)
 
+    @_retried
     def save_netcdf(
         data,
         path,
@@ -369,33 +426,39 @@ if __NETCDF:
             values = values.reshape(1)
         if jax.process_index() != 0:
             return
+        preserve = mode in ("a", "r+")
         if __NETCDF_BACKEND == "netcdf4":
-            with netCDF4.Dataset(path, mode) as handle:
-                if variable in handle.variables:
-                    handle.variables[variable][file_slices] = values
-                    return
-                for name, s in zip(dims, values.shape):
-                    if name not in handle.dimensions:
-                        handle.createDimension(name, None if is_unlimited else s)
-                var = handle.createVariable(variable, values.dtype, tuple(dims))
-                var[file_slices] = values
+            with _atomic_out(path, preserve_existing=preserve) as tmp:
+                with netCDF4.Dataset(tmp, mode) as handle:
+                    if variable in handle.variables:
+                        handle.variables[variable][file_slices] = values
+                    else:
+                        for name, s in zip(dims, values.shape):
+                            if name not in handle.dimensions:
+                                handle.createDimension(name, None if is_unlimited else s)
+                        var = handle.createVariable(variable, values.dtype, tuple(dims))
+                        var[file_slices] = values
             return
         sci_mode = "a" if mode == "r+" else mode
-        with _scipy_netcdf(path, sci_mode) as handle:
-            if variable in handle.variables:
-                handle.variables[variable][file_slices] = values
-                return
-            for i, (name, s) in enumerate(zip(dims, values.shape)):
-                if name not in handle.dimensions:
-                    # classic format: only the leading dim may be a record dim
-                    handle.createDimension(name, None if (is_unlimited and i == 0) else s)
-            var = handle.createVariable(variable, values.dtype, tuple(dims))
-            var[file_slices] = values
+        with _atomic_out(path, preserve_existing=preserve) as tmp:
+            with _scipy_netcdf(tmp, sci_mode) as handle:
+                if variable in handle.variables:
+                    handle.variables[variable][file_slices] = values
+                else:
+                    for i, (name, s) in enumerate(zip(dims, values.shape)):
+                        if name not in handle.dimensions:
+                            # classic format: only the leading dim may be a record dim
+                            handle.createDimension(
+                                name, None if (is_unlimited and i == 0) else s
+                            )
+                    var = handle.createVariable(variable, values.dtype, tuple(dims))
+                    var[file_slices] = values
 
 
 # ----------------------------------------------------------------------
 # CSV (io.py:731-1090)
 # ----------------------------------------------------------------------
+@_retried
 def load_csv(
     path: str,
     header_lines: int = 0,
@@ -416,6 +479,7 @@ def load_csv(
         raise TypeError(f"separator must be str, not {type(sep)}")
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, not {type(header_lines)}")
+    _checked_read(path)
     dtype = types.canonical_heat_type(dtype)
     np_dtype = np.dtype(dtype.jax_type())
     rows: List[List[float]] = []
@@ -431,6 +495,7 @@ def load_csv(
     )
 
 
+@_retried
 def save_csv(
     data: DNDarray,
     path: str,
@@ -440,7 +505,7 @@ def save_csv(
     encoding: str = "utf-8",
     **kwargs,
 ) -> None:
-    """Write a DNDarray to CSV (io.py:957)."""
+    """Write a DNDarray to CSV (io.py:957), atomically."""
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     if data.ndim > 2:
@@ -449,22 +514,25 @@ def save_csv(
     if arr.ndim == 1:
         arr = arr[:, None]
     if jax.process_index() == 0:
-        with open(path, "w", encoding=encoding, newline="") as f:
-            if header_lines:
-                for line in header_lines:
-                    f.write(line if line.endswith("\n") else line + "\n")
-            writer = _csv.writer(f, delimiter=sep)
-            for row in arr:
-                if decimals >= 0:
-                    writer.writerow([round(float(x), decimals) for x in row])
-                else:
-                    writer.writerow(row.tolist())
+        with _atomic_out(path) as tmp:
+            with open(tmp, "w", encoding=encoding, newline="") as f:
+                if header_lines:
+                    for line in header_lines:
+                        f.write(line if line.endswith("\n") else line + "\n")
+                writer = _csv.writer(f, delimiter=sep)
+                for row in arr:
+                    if decimals >= 0:
+                        writer.writerow([round(float(x), decimals) for x in row])
+                    else:
+                        writer.writerow(row.tolist())
 
 
 # ----------------------------------------------------------------------
 # npy shards (io.py:1145)
 # ----------------------------------------------------------------------
+@_retried
 def _load_npy_file(path: str, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    _checked_read(path)
     data = np.load(path)
     if dtype is not None:
         data = data.astype(np.dtype(types.canonical_heat_type(dtype).jax_type()))
@@ -473,11 +541,12 @@ def _load_npy_file(path: str, dtype=None, split=None, device=None, comm=None) ->
     )
 
 
+@_retried
 def load_npy_from_path(
     path: str, dtype=types.int32, split: int = 0, device=None, comm=None
 ) -> DNDarray:
     """Load a directory of per-rank .npy shards as one global array
-    (io.py:1145)."""
+    (io.py:1145); each shard verifies against its CRC32 sidecar."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
     if not isinstance(split, int) and split is not None:
@@ -485,7 +554,11 @@ def load_npy_from_path(
     files = sorted(f for f in os.listdir(path) if f.endswith(".npy"))
     if not files:
         raise ValueError(f"no .npy files found in {path}")
-    pieces = [np.load(os.path.join(path, f)) for f in files]
+    pieces = []
+    for f in files:
+        shard = os.path.join(path, f)
+        _checked_read(shard)
+        pieces.append(np.load(shard))
     dtype = types.canonical_heat_type(dtype)
     if split is None:
         data = pieces[0]
@@ -497,35 +570,51 @@ def load_npy_from_path(
     )
 
 
+@_retried
 def save_npy_from_path(data: DNDarray, path: str) -> None:
     """Write a DNDarray as a directory of per-shard ``.npy`` slab files.
 
     The sharded counterpart of ``np.save`` and the round-trip partner of
     :func:`load_npy_from_path` (reference io.py:1145): each device shard's
-    true rows stream to ``path/part_<offset>.npy`` one at a time, so the
-    global array is never materialized on any host.  Offsets are
-    zero-padded so a lexicographic listing is offset order.  Multi-host:
-    every process writes only its own shards — fully parallel, no
-    coordination needed (distinct files).
+    true rows stream to ``path/part_<offset>.npy`` one at a time (each an
+    atomic rename with a CRC32 sidecar), so the global array is never
+    materialized on any host.  Offsets are zero-padded so a lexicographic
+    listing is offset order.  Multi-host: every process writes only its
+    own shards — fully parallel, no coordination needed (distinct files).
     """
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     os.makedirs(path, exist_ok=True)
     if data.split is None:
-        if jax.process_index() == 0:
-            np.save(os.path.join(path, "part_000000000000.npy"), np.asarray(data.larray_padded))
-        return
-    for start, block in _iter_shard_slabs(data):
-        np.save(os.path.join(path, f"part_{start:012d}.npy"), block)
+        blocks = [(0, np.asarray(data.larray_padded))] if jax.process_index() == 0 else []
+    else:
+        blocks = _iter_shard_slabs(data)
+    for start, block in blocks:
+        shard = os.path.join(path, f"part_{start:012d}.npy")
+        with _atomic_out(shard) as tmp:
+            with open(tmp, "wb") as f:
+                np.save(f, block)
 
 
 # ----------------------------------------------------------------------
 # NumPy text/archive IO extensions beyond the reference's io surface
 # ----------------------------------------------------------------------
+@_retried
+def _save_npy_file(data: DNDarray, path: str) -> None:
+    """Atomic single-file ``np.save`` of the gathered global array."""
+    if jax.process_index() == 0:
+        arr = data.numpy() if isinstance(data, DNDarray) else np.asarray(data)
+        with _atomic_out(path) as tmp:
+            with open(tmp, "wb") as f:
+                np.save(f, arr)
+
+
+@_retried
 def loadtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
             skiprows: int = 0, usecols=None, split: Optional[int] = None,
             device=None, comm=None) -> DNDarray:
     """np.loadtxt analog; the parse happens per host, the wrap shards."""
+    _checked_read(path)
     arr = np.loadtxt(path, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()),
                      comments=comments, delimiter=delimiter, skiprows=skiprows, usecols=usecols)
     from . import factories
@@ -533,18 +622,22 @@ def loadtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 
+@_retried
 def savetxt(path: str, x: DNDarray, fmt: str = "%.18e", delimiter: str = " ",
             newline: str = "\n", header: str = "", footer: str = "", comments: str = "# ") -> None:
-    """np.savetxt analog (gathers, rank-0-writes)."""
+    """np.savetxt analog (gathers, rank-0-writes atomically)."""
     if jax.process_index() == 0:
-        np.savetxt(path, x.numpy(), fmt=fmt, delimiter=delimiter, newline=newline,
-                   header=header, footer=footer, comments=comments)
+        with _atomic_out(path) as tmp:
+            np.savetxt(tmp, x.numpy(), fmt=fmt, delimiter=delimiter, newline=newline,
+                       header=header, footer=footer, comments=comments)
 
 
+@_retried
 def genfromtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=None,
                skip_header: int = 0, filling_values=None, split: Optional[int] = None,
                device=None, comm=None) -> DNDarray:
     """np.genfromtxt analog (missing values filled, NaN by default)."""
+    _checked_read(path)
     arr = np.genfromtxt(path, dtype=np.dtype(types.canonical_heat_type(dtype).jax_type()),
                         comments=comments, delimiter=delimiter, skip_header=skip_header,
                         filling_values=filling_values)
@@ -553,23 +646,37 @@ def genfromtxt(path: str, dtype=types.float32, comments: str = "#", delimiter=No
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 
+def _npz_path(path: str) -> str:
+    # np.savez appends .npz to a bare str path; writing through a file
+    # object skips that, so normalize explicitly to keep the semantics
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+@_retried
 def savez(path: str, *args, **kwargs) -> None:
-    """np.savez analog over DNDarrays (gathered, rank-0-writes)."""
+    """np.savez analog over DNDarrays (gathered, rank-0-writes atomically)."""
     if jax.process_index() == 0:
-        np.savez(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
-                 **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
+        with _atomic_out(_npz_path(path)) as tmp:
+            with open(tmp, "wb") as f:
+                np.savez(f, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
+                         **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
 
 
+@_retried
 def savez_compressed(path: str, *args, **kwargs) -> None:
-    """np.savez_compressed analog over DNDarrays (rank-0-writes)."""
+    """np.savez_compressed analog over DNDarrays (rank-0-writes atomically)."""
     if jax.process_index() == 0:
-        np.savez_compressed(path, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
-                            **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
+        with _atomic_out(_npz_path(path)) as tmp:
+            with open(tmp, "wb") as f:
+                np.savez_compressed(f, *[a.numpy() if isinstance(a, DNDarray) else a for a in args],
+                                    **{k: (v.numpy() if isinstance(v, DNDarray) else v) for k, v in kwargs.items()})
 
 
+@_retried
 def fromfile(path: str, dtype=types.float32, count: int = -1, sep: str = "", offset: int = 0,
              split: Optional[int] = None, device=None, comm=None) -> DNDarray:
     """np.fromfile analog (binary or text mode)."""
+    _checked_read(path)
     npdt = np.dtype(types.canonical_heat_type(dtype).jax_type())
     arr = np.fromfile(path, dtype=npdt, count=count, sep=sep, offset=offset)
     from . import factories
@@ -577,14 +684,19 @@ def fromfile(path: str, dtype=types.float32, count: int = -1, sep: str = "", off
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 
+@_retried
 def tofile(x: DNDarray, path: str, sep: str = "", format: str = "%s") -> None:
-    """np.ndarray.tofile analog (gathers, rank-0-writes raw or text)."""
+    """np.ndarray.tofile analog (gathers, rank-0-writes raw or text,
+    atomically)."""
     if jax.process_index() == 0:
-        x.numpy().tofile(path, sep=sep, format=format)
+        with _atomic_out(path) as tmp:
+            x.numpy().tofile(tmp, sep=sep, format=format)
 
 
+@_retried
 def fromregex(path: str, regexp, dtype, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
     """np.fromregex analog (structured text extraction)."""
+    _checked_read(path)
     arr = np.fromregex(path, regexp, dtype)
     from . import factories
 
@@ -603,6 +715,8 @@ def memmap(path: str, dtype=types.float32, mode: str = "r", offset: int = 0, sha
     """np.memmap-backed ingestion: the file is memory-mapped on the host and
     transferred to device in one pass (pages stream through the map; one
     host-side densification happens during the device copy)."""
+    if mode in ("r", "r+", "c"):
+        _checked_read(path)
     npdt = np.dtype(types.canonical_heat_type(dtype).jax_type())
     mm = np.memmap(path, dtype=npdt, mode=mode, offset=offset, shape=shape)
     from . import factories
@@ -618,6 +732,8 @@ format = np.lib.format
 def open_memmap(path: str, mode: str = "r", dtype=None, shape=None,
                 split: Optional[int] = None, device=None, comm=None) -> DNDarray:
     """np.lib.format.open_memmap analog for .npy files."""
+    if mode in ("r", "r+", "c"):
+        _checked_read(path)
     mm = np.lib.format.open_memmap(path, mode=mode,
                                    dtype=None if dtype is None else np.dtype(types.canonical_heat_type(dtype).jax_type()),
                                    shape=shape)
@@ -642,11 +758,13 @@ class DataSource:
         return self._ds.open(path, mode=mode, encoding=encoding, newline=newline)
 
 
+@_retried
 def _load_npz_file(path: str, name: Optional[str] = None, split: Optional[int] = None,
                    device=None, comm=None) -> DNDarray:
     """Load one array from a .npz archive (first entry unless ``name``)."""
     from . import factories
 
+    _checked_read(path)
     with np.load(path) as z:
         key = name if name is not None else z.files[0]
         arr = z[key]

@@ -10,14 +10,19 @@ from __future__ import annotations
 from typing import Optional, Union
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import types
-from ..core.base import BaseEstimator, TransformMixin, lazy_scalar_property
+from ..core.base import BaseEstimator, TransformMixin, lazy_scalar_property, validate_resume_params
 from ..core.dndarray import DNDarray
 from ..core.linalg.svd import svd as _exact_svd
 from ..core.linalg import svdtools
 
 __all__ = ["PCA"]
+
+#: checkpoint step ids of the two fit stages (directory-per-step layout)
+_STAGE_MEAN = 0
+_STAGE_FITTED = 1
 
 
 class PCA(BaseEstimator, TransformMixin):
@@ -34,6 +39,9 @@ class PCA(BaseEstimator, TransformMixin):
         n_oversamples: int = 10,
         power_iteration_normalizer: str = "qr",
         random_state: Optional[int] = None,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
     ):
         if whiten:
             raise NotImplementedError("whitening is not yet supported (matching pca.py:135)")
@@ -41,6 +49,12 @@ class PCA(BaseEstimator, TransformMixin):
             raise ValueError(f"svd_solver must be 'full', 'hierarchical' or 'randomized', got {svd_solver!r}")
         if random_state is not None and not isinstance(random_state, int):
             raise ValueError(f"random_state must be None or int, got {type(random_state)}")
+        validate_resume_params(checkpoint_every, checkpoint_dir, resume_from)
+        # PCA's fit is staged (mean -> solver) rather than iterated;
+        # checkpoint_every acts as the enable flag for stage checkpoints
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_dir = checkpoint_dir
+        self.resume_from = resume_from
 
         self.n_components = n_components
         self.copy = copy
@@ -65,8 +79,48 @@ class PCA(BaseEstimator, TransformMixin):
     # conversion happens once on first access
     total_explained_variance_ratio_ = lazy_scalar_property("_tevr", float)
 
+    def _checkpointer(self, for_write: bool):
+        directory = self.checkpoint_dir or self.resume_from
+        if directory is None or (for_write and self.checkpoint_every is None):
+            return None
+        from ..utils.checkpoint import Checkpointer
+
+        return Checkpointer(directory)
+
+    def _restore_fitted(self, saved: dict, X: DNDarray) -> None:
+        as_dnd = lambda a: DNDarray.from_dense(jnp.asarray(a), None, X.device, X.comm)
+        self.mean_ = as_dnd(saved["mean"])
+        self.components_ = as_dnd(saved["components"])
+        self.singular_values_ = as_dnd(saved["singular_values"])
+        self.explained_variance_ = as_dnd(saved["explained_variance"])
+        self.explained_variance_ratio_ = as_dnd(saved["explained_variance_ratio"])
+        self._tevr = saved["tevr"]
+        self.n_components_ = saved["n_components"]
+
+    def _fitted_payload(self) -> dict:
+        as_np = lambda d: np.asarray(d._dense())
+        return {
+            "stage": "fitted",
+            "mean": as_np(self.mean_),
+            "components": as_np(self.components_),
+            "singular_values": as_np(self.singular_values_),
+            "explained_variance": as_np(self.explained_variance_),
+            "explained_variance_ratio": as_np(self.explained_variance_ratio_),
+            "tevr": float(self._tevr),
+            "n_components": int(self.n_components_),
+        }
+
     def fit(self, X: DNDarray, y=None) -> "PCA":
-        """Estimate principal components (pca.py:210)."""
+        """Estimate principal components (pca.py:210).
+
+        With ``checkpoint_every``/``checkpoint_dir`` set, the two fit
+        stages (column mean, SVD solve) each commit a checkpoint;
+        ``resume_from=dir`` skips every completed stage — a fit killed
+        between the stages resumes with only the solver left, and a
+        fully fitted checkpoint restores without touching the data.
+        The recomputed stages are deterministic functions of X and the
+        restored state, so a resumed fit reproduces the uninterrupted
+        result exactly."""
         if not isinstance(X, DNDarray):
             raise TypeError(f"X must be a DNDarray, got {type(X)}")
         if X.ndim != 2:
@@ -74,10 +128,31 @@ class PCA(BaseEstimator, TransformMixin):
         if y is not None:
             raise ValueError("PCA is an unsupervised transform; y must be None")
         from ..core import statistics
+        from ..resilience.faults import inject
+
+        writer = self._checkpointer(for_write=True)
+        restored_mean = None
+        if self.resume_from is not None:
+            reader = self._checkpointer(for_write=False)
+            step = reader.latest_step() if reader is not None else None
+            if step is not None:
+                saved = reader.restore(step)
+                if saved.get("stage") == "fitted":
+                    self._restore_fitted(saved, X)
+                    return self
+                restored_mean = saved["mean"]
 
         n, f = X.shape
-        mean = statistics.mean(X, axis=0)
-        self.mean_ = mean
+        if restored_mean is None:
+            inject("pca.stage", stage="mean")
+            mean = statistics.mean(X, axis=0)
+            self.mean_ = mean
+            if writer is not None:
+                writer.save(_STAGE_MEAN, {"stage": "mean", "mean": np.asarray(mean._dense())})
+        else:
+            mean = DNDarray.from_dense(jnp.asarray(restored_mean), None, X.device, X.comm)
+            self.mean_ = mean
+        inject("pca.stage", stage="solver")
         centered = X - mean
 
         if self.random_state is not None:
@@ -138,6 +213,8 @@ class PCA(BaseEstimator, TransformMixin):
             )
             self._tevr = jnp.sum(ev) / jnp.maximum(total_var, 1e-30)
             self.n_components_ = k
+        if writer is not None:
+            writer.save(_STAGE_FITTED, self._fitted_payload())
         return self
 
     def transform(self, X: DNDarray) -> DNDarray:

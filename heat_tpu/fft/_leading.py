@@ -59,7 +59,68 @@ def _precision():
     return _interleaved_precision()
 
 
-@functools.lru_cache(maxsize=64)
+# ----------------------------------------------------------------------
+# Byte-bounded weight cache.  The DFT weight matrices scale as n^2 (a
+# 1024-point f64 (cos, sin) pair is 16 MB; the (n, 2n) cat matrices and
+# their bf16 splits likewise), so a 64-ENTRY lru_cache over varied sizes
+# can pin ~1 GB of host RAM for the process lifetime.  All weight
+# builders share one LRU keyed by (builder, args) and bounded by BYTES
+# (HEAT_TPU_FFT_WEIGHT_CACHE_MB, default 256): inserts evict
+# least-recently-used entries until the total fits, so sweeping sizes
+# recomputes cold weights instead of growing without bound.
+# ----------------------------------------------------------------------
+_WEIGHT_CACHE_BUDGET = int(
+    float(os.environ.get("HEAT_TPU_FFT_WEIGHT_CACHE_MB", "256")) * (1 << 20)
+)
+_weight_cache: "dict" = {}  # insertion-ordered; move-to-end on hit
+_weight_cache_nbytes = 0
+
+
+def _entry_nbytes(val) -> int:
+    if isinstance(val, tuple):
+        return sum(_entry_nbytes(v) for v in val)
+    return int(getattr(val, "nbytes", 0))
+
+
+def _byte_lru(fn):
+    """lru_cache analog bounded by the shared byte budget."""
+    tag = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        global _weight_cache_nbytes
+        key = (tag, args)
+        if key in _weight_cache:
+            val = _weight_cache.pop(key)  # re-insert: most recently used
+            _weight_cache[key] = val
+            return val
+        val = fn(*args)
+        _weight_cache[key] = val
+        _weight_cache_nbytes += _entry_nbytes(val)
+        while _weight_cache_nbytes > _WEIGHT_CACHE_BUDGET and len(_weight_cache) > 1:
+            old = _weight_cache.pop(next(iter(_weight_cache)))
+            _weight_cache_nbytes -= _entry_nbytes(old)
+        return val
+
+    return wrapper
+
+
+def weight_cache_stats() -> dict:
+    """Size/budget snapshot of the shared weight cache (test surface)."""
+    return {
+        "entries": len(_weight_cache),
+        "nbytes": _weight_cache_nbytes,
+        "budget_nbytes": _WEIGHT_CACHE_BUDGET,
+    }
+
+
+def weight_cache_clear() -> None:
+    global _weight_cache_nbytes
+    _weight_cache.clear()
+    _weight_cache_nbytes = 0
+
+
+@_byte_lru
 def _cs(n: int, inverse: bool):
     """Host f64 (cos, sign*sin) planes of the n-point DFT matrix."""
     j = np.arange(n, dtype=np.float64)
@@ -69,7 +130,7 @@ def _cs(n: int, inverse: bool):
     return np.cos(ang), sign * np.sin(ang)
 
 
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _w_entry_half(n: int, m: int, dt: str, part: str):
     """(n, m) real-input entry matrix for bins 0..m-1 (axis-0 halving)."""
     c, s = _cs(n, False)
@@ -77,7 +138,7 @@ def _w_entry_half(n: int, m: int, dt: str, part: str):
     return np.asarray(w[:, :m], dt)
 
 
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _w_entry_cat(n: int, m: int, dt: str):
     """(n, 2m) ``[re-bins 0..m-1 | im-bins 0..m-1]`` entry matrix: one
     dot reads x once (the two-dot form reads it twice); the mid stage's
@@ -87,7 +148,7 @@ def _w_entry_cat(n: int, m: int, dt: str):
     return np.asarray(np.concatenate([c[:, :m], s[:, :m]], 1), dt)
 
 
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _w_cat(n: int, dt: str, inverse: bool, scale: float):
     """(n, 2n) ``[W_re | W_im] * scale`` stage matrix (scale folds the
     norm factor into the exit stage — no post-scaling pass)."""
@@ -143,7 +204,7 @@ def _stage(re, im, wcat, n: int, prec):
 # demands HIGHEST the XLA stage runs instead.  Measured at the 512^3 mid
 # stage: 4.44 ms vs 6.69 (the 4.2 ms bf16x3 MXU bound plus DMA overlap).
 # ----------------------------------------------------------------------
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _w_cat_bf(n: int, inverse: bool, scale: float):
     """(w_hi, w_lo) bf16 split of the (n, 2n) cat stage matrix."""
     w = np.asarray(_w_cat(n, "float32", inverse, scale))
@@ -454,7 +515,10 @@ def rfft3_leading(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
     alt = jnp.asarray(
         np.where(np.arange(n0) % 2 == 0, 1.0, -1.0).astype(dt)
     )
-    nyq = jnp.tensordot(alt, x, ((0,), (0,)))  # (n1, n2)
+    # precision=prec: without it this dot runs at the DEFAULT (bf16-pass)
+    # matmul policy on TPU, silently degrading the whole Nyquist plane
+    # below the engine's requested precision class
+    nyq = jnp.tensordot(alt, x, ((0,), (0,)), precision=prec)  # (n1, n2)
     a = _dg0(nyq, wc1, prec)  # (n2, 2n1)
     br = _dg0(a[:, :n1], wc2, prec)  # (n1, 2n2)
     bi = _dg0(a[:, n1:], wc2, prec)

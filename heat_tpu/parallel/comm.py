@@ -47,6 +47,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..resilience.faults import inject as _inject
+from ..resilience.retry import default_init_policy as _init_policy
+
 __all__ = [
     "Communication",
     "HierarchicalCommunication",
@@ -325,20 +328,28 @@ class Communication:
     # from shardings.  They exist for halo exchange, ring algorithms and
     # merge trees (TS-QR / hSVD), replacing the reference's hand-written
     # Send/Recv/Allreduce/... (communication.py:494-2186).
+    # Every entry evaluates the ``comm.collective`` fault-injection
+    # point (trace-time, so the compiled program itself is unaffected) —
+    # the hook a fault plan uses to script a lost-collective scenario.
     # ------------------------------------------------------------------
     def psum(self, x, axis_name: Optional[str] = None):
+        _inject("comm.collective", op="psum")
         return jax.lax.psum(x, axis_name or self.axis_name)
 
     def pmax(self, x, axis_name: Optional[str] = None):
+        _inject("comm.collective", op="pmax")
         return jax.lax.pmax(x, axis_name or self.axis_name)
 
     def pmin(self, x, axis_name: Optional[str] = None):
+        _inject("comm.collective", op="pmin")
         return jax.lax.pmin(x, axis_name or self.axis_name)
 
     def all_gather(self, x, axis: int = 0, axis_name: Optional[str] = None, tiled: bool = True):
+        _inject("comm.collective", op="all_gather")
         return jax.lax.all_gather(x, axis_name or self.axis_name, axis=axis, tiled=tiled)
 
     def all_to_all(self, x, split_axis: int, concat_axis: int, axis_name: Optional[str] = None):
+        _inject("comm.collective", op="all_to_all")
         return jax.lax.all_to_all(
             x, axis_name or self.axis_name, split_axis=split_axis,
             concat_axis=concat_axis, tiled=True,
@@ -348,6 +359,7 @@ class Communication:
         """Reduce-scatter: the sum lands shard-wise instead of replicated
         (the reference's Reduce_scatter, communication.py; the sparse
         SpMM meet-step uses it directly)."""
+        _inject("comm.collective", op="psum_scatter")
         return jax.lax.psum_scatter(
             x, axis_name or self.axis_name,
             scatter_dimension=scatter_dimension, tiled=True,
@@ -360,6 +372,7 @@ class Communication:
         additive identity, so no masking is needed.  The round count and
         rank range come from the NAMED axis (an override may address a
         sub-axis whose size differs from ``self.size``)."""
+        _inject("comm.collective", op="pscan")
         name = axis_name or self.axis_name
         n = int(dict(self.mesh.shape)[name]) if name != self.axis_name else self.size
         acc = x
@@ -382,11 +395,13 @@ class Communication:
         return self.pscan(x, axis_name, inclusive=False)
 
     def ppermute(self, x, perm, axis_name: Optional[str] = None):
+        _inject("comm.collective", op="ppermute")
         return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
 
     def ring_shift(self, x, shift: int = 1, axis_name: Optional[str] = None):
         """Cyclic shift by ``shift`` ranks (the ring primitive behind the
         reference's spatial ring in distance.py:209 and roll)."""
+        _inject("comm.collective", op="ring_shift")
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
         return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
@@ -525,6 +540,13 @@ def init(
     distributed runtime to exist before the backend is initialized).  On a
     single host with no coordinator this is a no-op, so programs written for
     multi-host run unchanged in single-controller mode.
+
+    The bootstrap runs under the init retry policy
+    (``resilience.default_init_policy``: bounded exponential backoff,
+    ``HEAT_TPU_INIT_RETRY_*`` env knobs) — at pod startup the
+    coordinator routinely comes up seconds after the workers, and a
+    connection race must not abort the whole program.  Configuration
+    errors (no cluster to detect, bad arguments) are not retried.
     """
     global _initialized
     if (
@@ -542,33 +564,42 @@ def init(
         # coordinator port, network failure) must fail LOUDLY — silently
         # degrading to independent single-process worlds would make every
         # collective return per-host partial results.
-        try:
-            jax.distributed.initialize()
-        except (ValueError, RuntimeError) as e:
-            msg = str(e).lower()
-            # no cluster detected (plain single host): harmless no-op
-            no_cluster = "coordinator" in msg and (
-                "defined" in msg or "detect" in msg or "none" in msg or "specif" in msg
-            )
-            # backend already up on a lone host: a defensive init() call
-            # after array work — also harmless.  On a real multi-process
-            # run either failure must propagate: silently degrading to
-            # independent single-process worlds corrupts every collective.
-            late_single_host = "before any jax" in msg and jax.process_count() == 1
-            if no_cluster or late_single_host:
-                _initialized = True
-                return
-            raise
+        def _bootstrap_auto() -> bool:
+            _inject("comm.init")
+            try:
+                jax.distributed.initialize()
+            except (ValueError, RuntimeError) as e:
+                msg = str(e).lower()
+                # no cluster detected (plain single host): harmless no-op
+                no_cluster = "coordinator" in msg and (
+                    "defined" in msg or "detect" in msg or "none" in msg or "specif" in msg
+                )
+                # backend already up on a lone host: a defensive init() call
+                # after array work — also harmless.  On a real multi-process
+                # run either failure must propagate: silently degrading to
+                # independent single-process worlds corrupts every collective.
+                late_single_host = "before any jax" in msg and jax.process_count() == 1
+                if no_cluster or late_single_host:
+                    return False  # benign no-op, nothing to re-resolve
+                raise  # real bootstrap failure: retried, then propagates
+            return True
+
+        if _init_policy().call(_bootstrap_auto):
+            _reset_defaults()
         _initialized = True
-        _reset_defaults()
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-        **kwargs,
-    )
+
+    def _bootstrap_explicit() -> None:
+        _inject("comm.init")
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            **kwargs,
+        )
+
+    _init_policy().call(_bootstrap_explicit)
     _initialized = True
     _reset_defaults()
 

@@ -23,13 +23,15 @@ def _soft_threshold_op(d, *, lam):
 
 
 @partial(jax.jit, static_argnames=("max_iter",))
-def _cd_loop(X, yd, col_sq, lam, tol, max_iter):
+def _cd_loop(X, yd, col_sq, lam, tol, max_iter, theta0):
     """Whole cyclic-coordinate-descent fit as one on-device while_loop.
 
     A host-side sweep loop costs a device->host sync per sweep (a full
     link RTT on a tunneled chip); lam/tol are traced so a regularization-
     path sweep (examples/lasso) reuses one compiled executable.
-    Returns (theta, sweeps_run).
+    ``theta0`` is the starting iterate (zeros for a fresh fit; a restored
+    checkpoint for the resumable path — the sweep sequence continues
+    exactly where it stopped).  Returns (theta, sweeps_run, last_delta).
     """
     m = X.shape[1]
     hp = jax.lax.Precision.HIGHEST
@@ -58,20 +60,41 @@ def _cd_loop(X, yd, col_sq, lam, tol, max_iter):
         delta = jnp.max(jnp.abs(new - th)).astype(jnp.float32)
         return new, it + 1, delta
 
-    init = (jnp.zeros((m,), X.dtype), jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
-    theta, it, _ = jax.lax.while_loop(cond, body, init)
-    return theta, it
+    init = (jnp.asarray(theta0, X.dtype), jnp.int32(0), jnp.asarray(jnp.inf, jnp.float32))
+    theta, it, delta = jax.lax.while_loop(cond, body, init)
+    return theta, it, delta
 
 __all__ = ["Lasso"]
 
 
 class Lasso(BaseEstimator, RegressionMixin):
-    """L1-regularized linear regression via coordinate descent (lasso.py:10)."""
+    """L1-regularized linear regression via coordinate descent (lasso.py:10).
 
-    def __init__(self, lam: float = 0.1, max_iter: int = 100, tol: float = 1e-6):
+    ``checkpoint_every=N`` + ``checkpoint_dir`` checkpoint ``theta``
+    every N sweeps through the filesystem-native Checkpointer;
+    ``resume_from=dir`` continues a killed fit from its last checkpoint
+    with the identical sweep sequence (the resumed result matches the
+    uninterrupted one exactly).  The chunked path raises
+    :class:`~heat_tpu.resilience.DivergenceError` on NaN/Inf."""
+
+    def __init__(
+        self,
+        lam: float = 0.1,
+        max_iter: int = 100,
+        tol: float = 1e-6,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        resume_from: Optional[str] = None,
+    ):
+        from ..core.base import validate_resume_params
+
+        validate_resume_params(checkpoint_every, checkpoint_dir, resume_from)
         self.__lam = lam
         self.max_iter = max_iter
         self.tol = tol
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_dir = checkpoint_dir
+        self.resume_from = resume_from
         self.__theta = None
         self._n_iter = None
 
@@ -131,17 +154,39 @@ class Lasso(BaseEstimator, RegressionMixin):
         X = jnp.concatenate([jnp.ones((n, 1), xd.dtype), xd], axis=1)
         col_sq = jnp.sum(X * X, axis=0)
 
-        # one launch for the whole coordinate-descent fit — the same
-        # dispatch-amortization shape as the kmeans Lloyd loop
-        dispatch.record_external_dispatch()
-        theta, it = _cd_loop(
-            X,
-            yd,
-            col_sq,
-            jnp.asarray(self.__lam, xd.dtype),
-            jnp.asarray(self.tol, jnp.float32),
-            self.max_iter,
-        )
+        lam = jnp.asarray(self.__lam, xd.dtype)
+        tol = jnp.asarray(self.tol, jnp.float32)
+        if self.checkpoint_every is not None or self.resume_from is not None:
+            # chunked checkpoint/resume path: same sweep sequence as the
+            # single-launch fit, theta checkpointed (and NaN-guarded)
+            # every checkpoint_every sweeps
+            from ..core.base import resumable_fit_loop
+
+            def run_chunk(theta, n_sweeps):
+                dispatch.record_external_dispatch()
+                return _cd_loop(X, yd, col_sq, lam, tol, n_sweeps, theta)
+
+            theta, it = resumable_fit_loop(
+                run_chunk,
+                lambda: jnp.zeros((X.shape[1],), X.dtype),
+                self.max_iter,
+                float(self.tol),
+                checkpoint_every=self.checkpoint_every,
+                checkpoint_dir=self.checkpoint_dir,
+                resume_from=self.resume_from,
+                site="lasso.iter",
+                what="theta",
+                converged_when=lambda s, t: s < t,  # cd cond: delta >= tol continues
+            )
+            theta = jnp.asarray(theta, X.dtype)
+        else:
+            # one launch for the whole coordinate-descent fit — the same
+            # dispatch-amortization shape as the kmeans Lloyd loop
+            dispatch.record_external_dispatch()
+            theta, it, _ = _cd_loop(
+                X, yd, col_sq, lam, tol, self.max_iter,
+                jnp.zeros((X.shape[1],), X.dtype),
+            )
         self._n_iter = it  # lazy: n_iter converts on first access
         self.__theta = DNDarray.from_dense(theta.reshape(-1, 1), None, x.device, x.comm)
         return self

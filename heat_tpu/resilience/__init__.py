@@ -1,0 +1,101 @@
+"""Resilience layer: deterministic fault injection, retrying atomic IO,
+and divergence guards.
+
+The reference framework assumes a perfectly healthy MPI world — a lost
+rank, a torn file or a failed compile aborts the whole SPMD program.
+This subsystem makes failure a first-class, deterministically testable
+scenario across four layers:
+
+* :mod:`~heat_tpu.resilience.faults` — seeded fault injector wired
+  through named injection points (``comm.collective``, ``comm.init``,
+  ``dispatch.compile``, ``io.open``/``io.write``,
+  ``checkpoint.save``/``checkpoint.restore``/``checkpoint.write``,
+  ``<estimator>.iter``, ``pca.stage``), scriptable per call index via a
+  plan dict or the ``HEAT_TPU_FAULT_PLAN`` env hook.
+* :mod:`~heat_tpu.resilience.retry` — :class:`RetryPolicy` (bounded
+  exponential backoff, deterministic no-sleep test mode, per-attempt
+  timeout, typed retryable filter) applied to ``parallel.init()``, io
+  loads/saves and checkpoint writes.
+* :mod:`~heat_tpu.resilience.atomic` — write-temp-fsync-rename with
+  CRC32 sidecars: torn writes are never visible, corrupt files fail
+  loudly (:class:`ChecksumError`).
+* :mod:`~heat_tpu.resilience.guard` — :func:`guard_finite` /
+  :class:`DivergenceError` for NaN/Inf divergence in iterative fits,
+  carrying the last finite iterate.
+
+Resumable estimator fits (``checkpoint_every=N`` / ``resume_from=dir``
+on the k-cluster family, Lasso and PCA) build on these plus the
+filesystem-native :class:`~heat_tpu.utils.checkpoint.Checkpointer`.
+See ``docs/resilience.md`` for recipes.
+"""
+
+from __future__ import annotations
+
+from .errors import (
+    ChecksumError,
+    DivergenceError,
+    PermanentFault,
+    ResilienceError,
+    TransientFault,
+)
+from .faults import (
+    FaultInjector,
+    active_injector,
+    fault_plan,
+    fault_stats,
+    inject,
+    refresh_env_plan,
+    reset_fault_stats,
+)
+from .retry import (
+    RetryPolicy,
+    RetryTimeout,
+    default_init_policy,
+    default_io_policy,
+    reset_retry_stats,
+    retry_stats,
+)
+from .atomic import (
+    atomic_write,
+    checksum_path,
+    crc32_file,
+    verify_checksum,
+    write_checksum,
+)
+from .guard import all_finite, guard_finite
+
+__all__ = [
+    "ChecksumError",
+    "DivergenceError",
+    "FaultInjector",
+    "PermanentFault",
+    "ResilienceError",
+    "RetryPolicy",
+    "RetryTimeout",
+    "TransientFault",
+    "active_injector",
+    "all_finite",
+    "atomic_write",
+    "checksum_path",
+    "crc32_file",
+    "default_init_policy",
+    "default_io_policy",
+    "fault_plan",
+    "fault_stats",
+    "guard_finite",
+    "inject",
+    "refresh_env_plan",
+    "reset_fault_stats",
+    "reset_retry_stats",
+    "retry_stats",
+    "verify_checksum",
+    "write_checksum",
+    "resilience_stats",
+]
+
+
+def resilience_stats() -> dict:
+    """One merged counter snapshot (faults + retries) for bench/CI."""
+    out = dict(fault_stats())
+    out.update(retry_stats())
+    return out

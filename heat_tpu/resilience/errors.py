@@ -1,0 +1,96 @@
+"""Typed failure taxonomy of the resilience layer.
+
+The reference framework has exactly one failure mode: any raised
+exception aborts the whole SPMD program.  This module splits failure
+into classes the rest of the layer can act on mechanically:
+
+* :class:`TransientFault` — a failure that a bounded retry is expected
+  to clear (flaky filesystem, preempted bootstrap, injected test
+  fault).  Subclasses ``OSError`` so the io retry filters treat real
+  POSIX errors and injected transients identically.
+* :class:`PermanentFault` — a failure retrying cannot fix.  The retry
+  machinery re-raises it immediately, whatever the policy's filter
+  says.
+* :class:`ChecksumError` — a file's content does not match its CRC32
+  sidecar: a torn or corrupted write that must fail loudly instead of
+  returning garbage.  Never retried (the bytes on disk will not
+  change).
+* :class:`DivergenceError` — an iterative fit produced non-finite
+  values.  Carries the last finite iterate and its iteration index so
+  a caller can degrade gracefully (restart from ``last_good``, shrink
+  the step, report a usable partial result).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = [
+    "ResilienceError",
+    "TransientFault",
+    "PermanentFault",
+    "ChecksumError",
+    "DivergenceError",
+]
+
+
+class ResilienceError(Exception):
+    """Base of every failure type the resilience layer raises."""
+
+
+class TransientFault(ResilienceError, OSError):
+    """A retryable failure (also raised by the fault injector for
+    ``kind='transient'`` plan entries)."""
+
+    def __init__(self, message: str = "transient fault", site: Optional[str] = None, index: Optional[int] = None):
+        super().__init__(message)
+        self.site = site
+        self.index = index
+
+
+class PermanentFault(ResilienceError, RuntimeError):
+    """A non-retryable failure: the retry machinery re-raises it
+    immediately (also raised for ``kind='permanent'`` plan entries)."""
+
+    def __init__(self, message: str = "permanent fault", site: Optional[str] = None, index: Optional[int] = None):
+        super().__init__(message)
+        self.site = site
+        self.index = index
+
+
+class ChecksumError(ResilienceError, OSError):
+    """File content disagrees with its CRC32 sidecar.  Excluded from
+    retry: re-reading corrupt bytes yields the same corrupt bytes."""
+
+    def __init__(self, path: str, expected: int, actual: int):
+        super().__init__(
+            f"checksum mismatch for {path!r}: sidecar records crc32 "
+            f"{expected:#010x} but the file hashes to {actual:#010x} — "
+            "the file is torn or corrupted; restore it from a replica "
+            "or delete the sidecar to force an unverified load"
+        )
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+
+
+class DivergenceError(ResilienceError, ArithmeticError):
+    """An iterative fit produced NaN/Inf.
+
+    ``iteration`` is the first iteration at which non-finite values were
+    observed; ``last_good`` is the most recent finite iterate (host
+    numpy/None), so callers can resume or report it instead of silently
+    converging to NaN.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        iteration: Optional[int] = None,
+        last_good: Any = None,
+        last_good_iteration: Optional[int] = None,
+    ):
+        super().__init__(message)
+        self.iteration = iteration
+        self.last_good = last_good
+        self.last_good_iteration = last_good_iteration

@@ -1,0 +1,250 @@
+"""Deterministic, seeded fault injection for failure-path testing.
+
+Long-running fits on preemptible TPU pods see transient IO errors, host
+preemption and compile failures; CPU CI sees none of them.  This module
+makes failure a *scriptable, reproducible* scenario: named injection
+points (``faults.inject("io.write", path=...)``) are wired through the
+communication, dispatch, io and checkpoint layers, and a **fault plan**
+decides, per site and per call index, whether a scripted fault fires.
+
+Plan format
+-----------
+A plan is a mapping from site pattern to a list of rules::
+
+    {
+        "io.write":          [0, 3],                    # transient at call 0 and 3
+        "dispatch.compile":  [{"at": 1, "kind": "transient"}],
+        "checkpoint.save":   [{"at": 2, "kind": "kill"}],
+        "comm.*":            [{"p": 0.01, "kind": "transient"}],
+    }
+
+* Site patterns match exactly or by :mod:`fnmatch` glob (``"io.*"``).
+* A bare int ``n`` is shorthand for ``{"at": n, "kind": "transient"}``.
+* ``at`` may be an int or list of ints — the per-site **call index** at
+  which the rule fires (each evaluated injection point increments the
+  site's counter).
+* ``p`` fires with probability ``p`` per call, driven by a
+  ``random.Random`` seeded from ``(seed, site)`` — the same plan + seed
+  + call sequence always injects the same faults.
+* ``kind``: ``"transient"`` (raises :class:`TransientFault`, retryable),
+  ``"permanent"`` (raises :class:`PermanentFault`, never retried) or
+  ``"kill"`` (``os._exit`` — simulated host preemption; exit code via
+  ``exit_code``, default 137).
+* ``times`` caps how often a ``p`` rule may fire (default unlimited;
+  ``at`` rules fire once per listed index).
+
+Activation
+----------
+* Context manager: ``with fault_plan({...}, seed=0) as inj: ...`` —
+  ``inj.hits``/``inj.injected`` hold per-site counters for assertions.
+* Environment: ``HEAT_TPU_FAULT_PLAN`` holds either inline JSON or a
+  path to a JSON file (``{"plan": {...}, "seed": 0}`` or just the plan
+  mapping).  This is how a *subprocess* under test gets its script —
+  e.g. "kill the fit at iteration k" for kill-and-resume tests.
+
+With no active plan, :func:`inject` is a counter-free no-op — the
+injection points cost one global read on production paths.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+from .errors import PermanentFault, TransientFault
+
+__all__ = [
+    "FaultInjector",
+    "fault_plan",
+    "inject",
+    "active_injector",
+    "fault_stats",
+    "reset_fault_stats",
+    "refresh_env_plan",
+]
+
+PLAN_ENV = "HEAT_TPU_FAULT_PLAN"
+
+#: process-lifetime totals (survive injector deactivation) — the bench
+#: resilience record reads these
+_TOTALS = {"sites_evaluated": 0, "faults_injected": 0}
+_TOTALS_LOCK = threading.Lock()
+
+
+def _normalize_rule(rule: Any) -> Dict:
+    if isinstance(rule, int):
+        rule = {"at": rule}
+    if not isinstance(rule, dict):
+        raise TypeError(f"fault rule must be an int or dict, got {type(rule)}")
+    out = dict(rule)
+    kind = out.setdefault("kind", "transient")
+    if kind not in ("transient", "permanent", "kill"):
+        raise ValueError(f"unknown fault kind {kind!r}")
+    if "at" in out:
+        at = out["at"]
+        out["at"] = frozenset([int(at)] if isinstance(at, int) else [int(i) for i in at])
+    elif "p" not in out:
+        raise ValueError("fault rule needs 'at' or 'p'")
+    if "p" in out:
+        p = float(out["p"])
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"fault probability must be in [0, 1], got {p}")
+        out["p"] = p
+    return out
+
+
+class FaultInjector:
+    """An activated fault plan with per-site hit accounting.
+
+    ``hits[site]`` counts every evaluation of the site's injection
+    point; ``injected[site]`` lists ``(call_index, kind)`` for each
+    fault actually raised — the assertion surface of failure tests.
+    """
+
+    def __init__(self, plan: Dict[str, Any], seed: int = 0):
+        self.seed = int(seed)
+        self.plan = {
+            site: [_normalize_rule(r) for r in (rules if isinstance(rules, list) else [rules])]
+            for site, rules in (plan or {}).items()
+        }
+        self.hits: Dict[str, int] = {}
+        self.injected: Dict[str, List] = {}
+        self._fired: Dict[int, int] = {}  # id(rule) -> times fired
+        self._rngs: Dict[str, random.Random] = {}
+        self._lock = threading.Lock()
+        self._prev: Optional["FaultInjector"] = None
+
+    # -- plan evaluation ------------------------------------------------
+    def _rules_for(self, site: str) -> List[Dict]:
+        rules = self.plan.get(site)
+        if rules is not None:
+            return rules
+        out: List[Dict] = []
+        for pattern, rs in self.plan.items():
+            if "*" in pattern or "?" in pattern or "[" in pattern:
+                if fnmatch.fnmatchcase(site, pattern):
+                    out.extend(rs)
+        return out
+
+    def check(self, site: str, info: Dict) -> None:
+        """Record one evaluation of ``site`` and raise if the plan says so."""
+        with self._lock:
+            index = self.hits.get(site, 0)
+            self.hits[site] = index + 1
+            with _TOTALS_LOCK:
+                _TOTALS["sites_evaluated"] += 1
+            fire_kind = None
+            for rule in self._rules_for(site):
+                fired = self._fired.get(id(rule), 0)
+                times = rule.get("times")
+                if times is not None and fired >= times:
+                    continue
+                hit = False
+                if "at" in rule and index in rule["at"]:
+                    hit = True
+                elif "p" in rule:
+                    rng = self._rngs.get(site)
+                    if rng is None:
+                        rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
+                    hit = rng.random() < rule["p"]
+                if hit:
+                    self._fired[id(rule)] = fired + 1
+                    fire_kind = rule["kind"]
+                    break
+            if fire_kind is None:
+                return
+            self.injected.setdefault(site, []).append((index, fire_kind))
+            with _TOTALS_LOCK:
+                _TOTALS["faults_injected"] += 1
+        if fire_kind == "kill":
+            os._exit(int(rule.get("exit_code", 137)))
+        msg = rule.get(
+            "message", f"injected {fire_kind} fault at {site!r} call {index}"
+        )
+        if fire_kind == "permanent":
+            raise PermanentFault(msg, site=site, index=index)
+        raise TransientFault(msg, site=site, index=index)
+
+    # -- activation -----------------------------------------------------
+    def __enter__(self) -> "FaultInjector":
+        global _ACTIVE
+        self._prev = _ACTIVE
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev
+        self._prev = None
+
+
+_ACTIVE: Optional[FaultInjector] = None
+_ENV_CHECKED = False
+
+
+def fault_plan(plan: Dict[str, Any], seed: int = 0) -> FaultInjector:
+    """Build a :class:`FaultInjector`; use as a context manager to
+    activate it for the enclosed block."""
+    return FaultInjector(plan, seed=seed)
+
+
+def _load_env_plan() -> Optional[FaultInjector]:
+    raw = os.environ.get(PLAN_ENV)
+    if not raw:
+        return None
+    raw = raw.strip()
+    if not raw.startswith("{") and os.path.exists(raw):
+        with open(raw) as f:
+            raw = f.read()
+    spec = json.loads(raw)
+    if "plan" in spec and isinstance(spec["plan"], dict):
+        return FaultInjector(spec["plan"], seed=int(spec.get("seed", 0)))
+    return FaultInjector(spec)
+
+
+def refresh_env_plan() -> Optional[FaultInjector]:
+    """(Re-)read ``HEAT_TPU_FAULT_PLAN`` and activate it process-wide.
+
+    Called lazily by the first :func:`inject`; call explicitly after
+    changing the env var mid-process (tests)."""
+    global _ACTIVE, _ENV_CHECKED
+    _ENV_CHECKED = True
+    inj = _load_env_plan()
+    if inj is not None:
+        _ACTIVE = inj
+    return inj
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The currently active injector, or None."""
+    return _ACTIVE
+
+
+def inject(site: str, **info) -> None:
+    """Evaluate the injection point ``site``.
+
+    No-op (one global read) without an active plan; with one, records
+    the hit and raises the scripted fault when the plan triggers."""
+    global _ENV_CHECKED
+    if _ACTIVE is None:
+        if _ENV_CHECKED:
+            return
+        refresh_env_plan()
+        if _ACTIVE is None:
+            return
+    _ACTIVE.check(site, info)
+
+
+def fault_stats() -> Dict[str, int]:
+    """Process-lifetime injection totals (bench counters)."""
+    with _TOTALS_LOCK:
+        return dict(_TOTALS)
+
+
+def reset_fault_stats() -> None:
+    with _TOTALS_LOCK:
+        _TOTALS.update({"sites_evaluated": 0, "faults_injected": 0})

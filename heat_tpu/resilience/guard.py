@@ -1,0 +1,71 @@
+"""Non-finite guards for iterative fits.
+
+An iterative solver that walks into NaN keeps "converging" — the shift
+``sum((new - old)**2)`` of two NaN iterates is NaN, every comparison
+with the tolerance is False, and the loop runs to ``max_iter`` before
+handing the caller NaN centroids with a clean exit code.
+:func:`guard_finite` turns that into a structured
+:class:`DivergenceError` carrying the last finite iterate, so callers
+can restart from it instead of discovering the NaNs three pipeline
+stages later.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .errors import DivergenceError
+
+__all__ = ["guard_finite", "all_finite"]
+
+
+def _as_array(x):
+    # DNDarray duck-type: anything carrying _dense() reads its global array
+    dense = getattr(x, "_dense", None)
+    if callable(dense):
+        return dense()
+    return x
+
+
+def all_finite(x) -> bool:
+    """Host bool: every element of ``x`` (array / DNDarray / pytree leaf
+    list) is finite.  Forces a device sync — call at checkpoint cadence,
+    not per iteration."""
+    arr = _as_array(x)
+    if not hasattr(arr, "dtype"):
+        arr = np.asarray(arr)
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        return True
+    return bool(jnp.all(jnp.isfinite(arr)))
+
+
+def guard_finite(
+    x,
+    what: str = "iterate",
+    iteration: Optional[int] = None,
+    last_good: Any = None,
+    last_good_iteration: Optional[int] = None,
+):
+    """Raise :class:`DivergenceError` if ``x`` contains NaN/Inf.
+
+    ``x`` passes through unchanged when finite, so the guard drops into
+    an update chain: ``centers = guard_finite(step(centers), ...)``.
+    ``last_good``/``last_good_iteration`` ride the raised error — the
+    most recent finite iterate a caller can degrade to."""
+    if not all_finite(x):
+        where = f" at iteration {iteration}" if iteration is not None else ""
+        hint = (
+            f"; last finite iterate was iteration {last_good_iteration}"
+            if last_good_iteration is not None
+            else ""
+        )
+        raise DivergenceError(
+            f"non-finite values in {what}{where} — the fit has diverged{hint}",
+            iteration=iteration,
+            last_good=last_good,
+            last_good_iteration=last_good_iteration,
+        )
+    return x

@@ -3,24 +3,46 @@
 The reference has no dedicated checkpoint subsystem (SURVEY.md §5):
 persistence is the io layer writing global arrays, plus
 ``DetectMetricPlateau.get_state/set_state`` for optimizer state
-(optim/utils.py:72-108).  The TPU-native equivalent is orbax-backed
-checkpointing of sharded jax arrays — each host writes its own shards,
-restore re-places them on the mesh — exposed here for DNDarrays, pytrees
-(model params / optax state), and DASO's state dicts.
+(optim/utils.py:72-108).  This module provides a directory-per-step
+:class:`Checkpointer` with two backends:
+
+* ``"native"`` (default) — a filesystem-only format with **no optional
+  dependencies**: the pytree structure goes to ``state.json``, the array
+  leaves to ``arrays.npz``, both written through the resilience layer's
+  atomic write-temp-fsync-rename with CRC32 sidecars, and the whole step
+  committed by a single atomic directory rename.  A step directory
+  either exists completely or not at all — a fit killed mid-save resumes
+  from the previous step, never from a torn one.  Saves run under the io
+  retry policy, so transient filesystem faults (injected or real) are
+  absorbed.  This is the backend the resumable estimator fits
+  (``checkpoint_every=N`` / ``resume_from=dir``) use.
+* ``"orbax"`` — the orbax-backed sharded-array path for multi-host jax
+  pytrees (each host writes its own shards).  Orbax is now optional: it
+  is imported only when this backend is requested.
+
+Both backends share the step/metadata API, so callers switch with one
+constructor argument.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+import shutil
+import uuid
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ..core.dndarray import DNDarray
+from ..resilience import atomic as _ratomic
+from ..resilience.faults import inject as _inject
+from ..resilience.retry import default_io_policy as _io_policy
 
 __all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
+
+_STEP_PREFIX = "step_"
 
 
 def _orbax():
@@ -29,47 +51,52 @@ def _orbax():
     return ocp
 
 
-class Checkpointer:
-    """Directory-per-step checkpoint manager over orbax."""
+# ----------------------------------------------------------------------
+# native pytree codec: JSON structure + npz leaves.  Lossless for the
+# state estimators and optimizers actually save — nested dict/list/tuple
+# of arrays (np/jax/DNDarray) and python scalars.
+# ----------------------------------------------------------------------
+def _encode(obj: Any, leaves: List[np.ndarray]):
+    if isinstance(obj, DNDarray):
+        leaves.append(np.asarray(obj._dense()))
+        return {"t": "arr", "i": len(leaves) - 1}
+    if isinstance(obj, (np.ndarray, np.generic, jax.Array)):
+        leaves.append(np.asarray(obj))
+        return {"t": "arr", "i": len(leaves) - 1}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"t": "py", "v": obj}
+    if isinstance(obj, complex):
+        return {"t": "complex", "re": obj.real, "im": obj.imag}
+    if isinstance(obj, (list, tuple)):
+        return {
+            "t": "tuple" if isinstance(obj, tuple) else "list",
+            "v": [_encode(x, leaves) for x in obj],
+        }
+    if isinstance(obj, dict):
+        if not all(isinstance(k, str) for k in obj):
+            raise TypeError("native checkpoints require str dict keys")
+        return {"t": "dict", "v": {k: _encode(v, leaves) for k, v in obj.items()}}
+    raise TypeError(
+        f"cannot checkpoint object of type {type(obj)!r} natively; "
+        "use arrays, python scalars, lists/tuples/dicts — or the orbax backend"
+    )
 
-    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
-        ocp = _orbax()
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
-        self._mngr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
-        )
 
-    def save(self, step: int, state: Any, extra_metadata: Optional[Dict] = None) -> None:
-        """Save a pytree (params/opt state/DNDarray-free metadata)."""
-        ocp = _orbax()
-        state = _strip_dndarrays(state)
-        self._mngr.save(step, args=ocp.args.StandardSave(state))
-        self._mngr.wait_until_finished()
-        if extra_metadata is not None:
-            with open(os.path.join(self.directory, f"meta_{step}.json"), "w") as f:
-                json.dump(extra_metadata, f)
-
-    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
-        ocp = _orbax()
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {self.directory}")
-        if template is not None:
-            template = _strip_dndarrays(template)
-            return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
-        return self._mngr.restore(step)
-
-    def latest_step(self) -> Optional[int]:
-        return self._mngr.latest_step()
-
-    def metadata(self, step: int) -> Optional[Dict]:
-        path = os.path.join(self.directory, f"meta_{step}.json")
-        if os.path.exists(path):
-            with open(path) as f:
-                return json.load(f)
-        return None
+def _decode(node: Dict, leaves) -> Any:
+    t = node["t"]
+    if t == "arr":
+        return leaves[f"a{node['i']}"]
+    if t == "py":
+        return node["v"]
+    if t == "complex":
+        return complex(node["re"], node["im"])
+    if t == "list":
+        return [_decode(x, leaves) for x in node["v"]]
+    if t == "tuple":
+        return tuple(_decode(x, leaves) for x in node["v"])
+    if t == "dict":
+        return {k: _decode(v, leaves) for k, v in node["v"].items()}
+    raise ValueError(f"unknown checkpoint node type {t!r}")
 
 
 def _strip_dndarrays(tree: Any) -> Any:
@@ -80,6 +107,154 @@ def _strip_dndarrays(tree: Any) -> Any:
         tree,
         is_leaf=lambda x: isinstance(x, DNDarray),
     )
+
+
+class Checkpointer:
+    """Directory-per-step checkpoint manager.
+
+    ``backend='native'`` (default) needs nothing beyond the filesystem;
+    ``backend='orbax'`` delegates to orbax for multi-host sharded
+    writes.  Step directories (``step_<k>``) are committed atomically;
+    ``latest_step`` only ever sees complete checkpoints.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: Optional[int] = None,
+        backend: str = "native",
+    ):
+        if backend not in ("native", "orbax"):
+            raise ValueError(f"backend must be 'native' or 'orbax', got {backend!r}")
+        self.directory = os.path.abspath(directory)
+        self.backend = backend
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+        if backend == "orbax":
+            ocp = _orbax()
+            self._mngr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            )
+
+    # -- step bookkeeping ----------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{int(step)}")
+
+    def all_steps(self) -> List[int]:
+        """Committed steps, ascending."""
+        if self.backend == "orbax":
+            return sorted(self._mngr.all_steps())
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        if self.backend == "orbax":
+            return self._mngr.latest_step()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save / restore -------------------------------------------------
+    def save(self, step: int, state: Any, extra_metadata: Optional[Dict] = None) -> None:
+        """Save a pytree (params/opt state/DNDarray-carrying metadata).
+
+        Native: runs under the io retry policy; the step directory is
+        staged under a temp name and committed with one atomic rename,
+        so a crash mid-save leaves no partial step behind."""
+        if self.backend == "orbax":
+            ocp = _orbax()
+            stripped = _strip_dndarrays(state)
+            self._mngr.save(step, args=ocp.args.StandardSave(stripped))
+            self._mngr.wait_until_finished()
+        else:
+            _io_policy().call(self._native_save, int(step), state)
+        if extra_metadata is not None:
+            self._write_metadata(int(step), extra_metadata)
+
+    def _native_save(self, step: int, state: Any) -> None:
+        _inject("checkpoint.save", step=step)
+        leaves: List[np.ndarray] = []
+        tree = _encode(state, leaves)
+        staging = os.path.join(
+            self.directory, f".tmp-{_STEP_PREFIX}{step}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
+        os.makedirs(staging)
+        try:
+            with _ratomic.atomic_write(os.path.join(staging, "state.json"), fault_site="checkpoint.write") as tmp:
+                with open(tmp, "w") as f:
+                    json.dump({"version": 1, "step": step, "tree": tree}, f)
+            with _ratomic.atomic_write(os.path.join(staging, "arrays.npz"), fault_site="checkpoint.write") as tmp:
+                with open(tmp, "wb") as f:
+                    np.savez(f, **{f"a{i}": a for i, a in enumerate(leaves)})
+            final = self._step_dir(step)
+            if os.path.isdir(final):
+                # re-save of an existing step: replace it (tiny window
+                # where the step is absent; the previous step still is)
+                shutil.rmtree(final)
+            os.rename(staging, final)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        self._prune()
+
+    def _prune(self) -> None:
+        if not self.max_to_keep:
+            return
+        steps = self.all_steps()
+        for s in steps[: max(0, len(steps) - self.max_to_keep)]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+        """Restore a step (latest by default).
+
+        Native: both files verify against their CRC32 sidecars before
+        decoding — a corrupt checkpoint raises ``ChecksumError`` instead
+        of returning garbage.  ``template`` is only consulted by the
+        orbax backend (the native codec is structure-lossless)."""
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if self.backend == "orbax":
+            ocp = _orbax()
+            if template is not None:
+                template = _strip_dndarrays(template)
+                return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
+            return self._mngr.restore(step)
+        return self._native_restore(step)
+
+    def _native_restore(self, step: int) -> Any:
+        _inject("checkpoint.restore", step=step)
+        d = self._step_dir(step)
+        state_path = os.path.join(d, "state.json")
+        arrays_path = os.path.join(d, "arrays.npz")
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"no checkpoint for step {step} in {self.directory}")
+        _ratomic.verify_checksum(state_path)
+        _ratomic.verify_checksum(arrays_path)
+        with open(state_path) as f:
+            doc = json.load(f)
+        with np.load(arrays_path) as leaves:
+            return _decode(doc["tree"], leaves)
+
+    # -- metadata -------------------------------------------------------
+    def _write_metadata(self, step: int, meta: Dict) -> None:
+        path = os.path.join(self.directory, f"meta_{step}.json")
+        with _ratomic.atomic_write(path, fault_site="checkpoint.write") as tmp:
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+
+    def metadata(self, step: int) -> Optional[Dict]:
+        path = os.path.join(self.directory, f"meta_{step}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                return json.load(f)
+        return None
 
 
 def save_checkpoint(path: str, state: Any, step: int = 0) -> None:

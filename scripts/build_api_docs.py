@@ -26,6 +26,7 @@ jax.config.update("jax_platforms", "cpu")
 MODULES = [
     ("Top level", "heat_tpu", "factories, arithmetics, manipulations and the rest of the numpy-style surface"),
     ("Dispatch", "heat_tpu.core.dispatch", "cached-executable dispatch, chain fusion, buffer donation (docs/dispatch.md)"),
+    ("Resilience", "heat_tpu.resilience", "fault injection, retry policies, atomic IO, divergence guards (docs/resilience.md)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),
     ("Linear algebra", "heat_tpu.core.linalg.basics", None),
     ("QR / SVD / solvers", "heat_tpu.core.linalg.qr", None),

@@ -135,6 +135,37 @@ def main():
     per, spd = _timeit(lambda: sa @ xd, lambda r: float(r[0, 0]), n_iter=2)
     record("sparse_spmm_ring", per, spd, 8.0 * 4096 * 64, anchor_bw)
 
+    # checkpoint save+restore roundtrip (stream-anchored on the state
+    # bytes; catches resilience-layer overhead regressions — a lost
+    # atomic-rename batching or a doubled checksum pass shows up here)
+    import shutil
+    import tempfile
+
+    from heat_tpu.utils.checkpoint import Checkpointer
+
+    ck_state = {
+        "state": np.random.default_rng(0).standard_normal((512, 256)).astype(np.float32),
+        "n_iter": 1,
+        "shift": 0.5,
+        "converged": False,
+    }
+    ck_dir = tempfile.mkdtemp(prefix="heat_tpu_ci_ck_")
+    try:
+        ck = Checkpointer(ck_dir)
+        step_box = {"i": 0}
+
+        def ck_roundtrip():
+            step_box["i"] += 1
+            ck.save(step_box["i"], ck_state)
+            return ck.restore(step_box["i"])
+
+        per, spd = _timeit(
+            ck_roundtrip, lambda r: float(r["state"][0, 0]), n_iter=2, windows=3
+        )
+        record("checkpoint_roundtrip", per, spd, 2.0 * ck_state["state"].nbytes, anchor_bw)
+    finally:
+        shutil.rmtree(ck_dir, ignore_errors=True)
+
     print(json.dumps(results, indent=1))
 
 

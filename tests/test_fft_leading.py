@@ -178,3 +178,54 @@ def test_rfft3_leading_fused_ext_path(monkeypatch):
     ref = np.fft.fftn(x.astype(np.float64))
     got = np.asarray(re) + 1j * np.asarray(im)
     assert _rel(got, ref) < 5e-4
+
+
+# ----------------------------------------------------------------------
+# byte-bounded weight cache (ISSUE 2 satellite): the DFT weight builders
+# share one LRU bounded by BYTES, not entry count, so sweeping sizes
+# cannot pin ~1 GB of host RAM for the process lifetime.
+# ----------------------------------------------------------------------
+def test_weight_cache_stays_under_byte_budget(monkeypatch):
+    monkeypatch.setattr(_leading, "_WEIGHT_CACHE_BUDGET", 4 << 20)  # 4 MB
+    _leading.weight_cache_clear()
+    try:
+        for n in (64, 96, 128, 192, 256, 320, 384):
+            _leading._w_cat(n, "float32", False, 1.0)
+            _leading._w_cat_bf(n, False, 1.0)
+            _leading._w_entry_cat(n, n // 2, "float32")
+        s = _leading.weight_cache_stats()
+        assert s["nbytes"] <= s["budget_nbytes"] or s["entries"] == 1
+        assert s["entries"] < 21  # some of the 21 inserts were evicted
+    finally:
+        _leading.weight_cache_clear()
+
+
+def test_weight_cache_hit_returns_same_object_and_recomputes_after_eviction():
+    _leading.weight_cache_clear()
+    try:
+        a = _leading._w_cat(32, "float32", False, 1.0)
+        assert _leading._w_cat(32, "float32", False, 1.0) is a  # LRU hit
+        _leading.weight_cache_clear()
+        b = _leading._w_cat(32, "float32", False, 1.0)  # cold: recomputed
+        assert b is not a
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    finally:
+        _leading.weight_cache_clear()
+
+
+def test_weight_cache_values_unchanged_by_eviction(monkeypatch):
+    """Evicted-and-recomputed weights are bitwise identical — the cache
+    is a pure memoization layer, never a source of drift."""
+    monkeypatch.setattr(_leading, "_WEIGHT_CACHE_BUDGET", 1 << 20)  # tiny: thrash
+    _leading.weight_cache_clear()
+    try:
+        first = {n: np.asarray(_leading._w_cat(n, "float32", False, 1.0)).copy()
+                 for n in (64, 128, 192)}
+        for n in (256, 320, 384):  # push the earlier entries out
+            _leading._w_cat(n, "float32", False, 1.0)
+        for n, want in first.items():
+            np.testing.assert_array_equal(
+                np.asarray(_leading._w_cat(n, "float32", False, 1.0)), want
+            )
+    finally:
+        _leading.weight_cache_clear()
