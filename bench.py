@@ -1,7 +1,8 @@
 """Benchmark driver: the full BASELINE grid on the attached chip.
 
 Emits one JSON line per BASELINE config (smoke, KMeans, hSVD north star,
-DP-SGD, 3-D FFT, dispatch-amortization, resilience counters), then a final summary line whose top-level fields are the
+DP-SGD, 3-D FFT, dispatch-amortization, resilience counters, overlap-layer
+stall/prefetch/bucket metrics), then a final summary line whose top-level fields are the
 hSVD north star (so single-metric consumers keep working) with the whole
 grid attached under ``"all"`` — BENCH_r{N}.json then records every config
 each round and rounds stay comparable (BASELINE.md targets table).
@@ -784,6 +785,107 @@ def bench_resilience(ht, sync_floor, roofline=None):
     }
 
 
+def bench_overlap(ht, sync_floor, roofline=None):
+    """Config 8: overlap-layer metrics (ISSUE 3).
+
+    ``ckpt_stall_ms`` — wall time the caller spends inside an async
+    ``AsyncCheckpointer.save`` (snapshot + enqueue) for the
+    representative 1024x256 f32 fit state, i.e. the per-chunk stall a
+    ``checkpoint_every=N`` fit now pays, vs ``checkpoint_save_ms`` — the
+    full synchronous write the fit used to pay; ``stall_vs_sync`` is
+    their ratio (the acceptance gate wants < 0.3).  ``prefetch_hit_rate``
+    — fraction of batches staged on device ahead of the consumer by
+    ``prefetch_to_device`` over a synthetic windowed stream.
+    ``grad_buckets`` — collective buckets a bucketed-schedule
+    DataParallel step issues for a small MLP.  The headline value is the
+    async stall."""
+    import os
+    import shutil
+    import tempfile
+
+    import optax
+
+    from heat_tpu.utils import overlap as ov
+    from heat_tpu.utils.checkpoint import Checkpointer
+    from heat_tpu.utils.data import prefetch_to_device
+
+    ov.reset_overlap_stats()
+    state = {
+        "state": np.random.default_rng(0).standard_normal((1024, 256)).astype(np.float32),
+        "n_iter": 17,
+        "shift": 1e-3,
+        "converged": False,
+    }
+    d = tempfile.mkdtemp(prefix="heat_tpu_bench_ov_")
+    try:
+        ck = Checkpointer(os.path.join(d, "sync"))
+        sync_s = float("inf")
+        for i in range(5):
+            t0 = time.perf_counter()
+            ck.save(i, state)
+            sync_s = min(sync_s, time.perf_counter() - t0)
+
+        ack = Checkpointer(os.path.join(d, "async")).as_async()
+        stall_s = float("inf")
+        for i in range(5):
+            t0 = time.perf_counter()
+            ack.save(i, state)  # snapshot + enqueue: the loop-visible cost
+            stall_s = min(stall_s, time.perf_counter() - t0)
+            ack.wait()  # drain outside the stall window (the fit's chunk
+            # compute covers this in production)
+        ack.close()
+
+        # prefetch hit rate over a synthetic windowed stream with a
+        # small device op standing in for the consuming train step
+        windows = (np.full((256, 8), i, np.float32) for i in range(32))
+        consume = jax.jit(lambda b: b.sum())
+        for b in prefetch_to_device(windows, size=2):
+            consume(b)
+        stats = ov.overlap_stats()
+
+        # bucketed-schedule DataParallel step on a small MLP
+        rng = np.random.default_rng(1)
+        params = {
+            "w1": jnp.asarray(rng.normal(size=(64, 128)) * 0.1, jnp.float32),
+            "b1": jnp.zeros((128,), jnp.float32),
+            "w2": jnp.asarray(rng.normal(size=(128, 8)) * 0.1, jnp.float32),
+            "b2": jnp.zeros((8,), jnp.float32),
+        }
+        apply = lambda p, xb: jnp.tanh(xb @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+        loss_fn = lambda pred, tgt: jnp.mean((pred - tgt) ** 2)
+        xb = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+        yb = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+        os.environ["HEAT_TPU_GRAD_BUCKET_MB"] = "0.01"  # visible bucketing at toy scale
+        try:
+            dp = ht.nn.DataParallel(
+                apply, optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1))
+            )
+            dp.set_params(params)
+            dp.step(loss_fn, xb, yb)
+        finally:
+            os.environ.pop("HEAT_TPU_GRAD_BUCKET_MB", None)
+        grad_buckets = ov.overlap_stats()["grad_buckets"]
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    total = stats["prefetch_hits"] + stats["prefetch_misses"]
+    return {
+        "metric": "overlap_ckpt_stall_ms",
+        "value": round(stall_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(sync_s / stall_s, 2) if stall_s else 0.0,
+        "vs_baseline_kind": "sync_checkpoint_save_same_process",
+        "ckpt_stall_ms": round(stall_s * 1e3, 3),
+        "checkpoint_save_ms": round(sync_s * 1e3, 3),
+        "stall_vs_sync": round(stall_s / sync_s, 3) if sync_s else 0.0,
+        "async_saves": stats["async_saves"],
+        "prefetch_hits": stats["prefetch_hits"],
+        "prefetch_misses": stats["prefetch_misses"],
+        "prefetch_hit_rate": round(stats["prefetch_hits"] / total, 3) if total else 0.0,
+        "grad_buckets": grad_buckets,
+    }
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -797,7 +899,7 @@ def main() -> None:
         roofline = None
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
-                  bench_dispatch, bench_resilience):
+                  bench_dispatch, bench_resilience, bench_overlap):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

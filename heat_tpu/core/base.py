@@ -79,16 +79,30 @@ def resumable_fit_loop(
     loop's own stop test (default ``shift <= tol``) so a chunk boundary
     never stops the fit one iteration early or late relative to the
     uninterrupted loop.  Returns ``(final_state, total_iterations)``.
+
+    Checkpoint writes are **asynchronous** by default (overlap layer,
+    docs/overlap.md): chunk *k*'s atomic write runs on a background
+    writer while chunk *k+1* computes on device, and the loop drains it
+    (``wait()``) before evaluating the next chunk boundary — so the
+    fault/kill semantics are unchanged (a kill at boundary *k+1* always
+    finds chunk *k* durable, exactly like the synchronous loop) and the
+    loop never returns before its final checkpoint is committed.
+    ``HEAT_TPU_ASYNC_CKPT=0`` restores fully synchronous saves.
     """
+    import sys as _sys
+
     from ..resilience.errors import DivergenceError  # lazy: avoid import cycles
     from ..resilience.faults import inject
     from ..resilience.guard import all_finite
     from ..utils.checkpoint import Checkpointer
+    from ..utils.overlap import async_checkpoint_enabled
 
     ckpt = None
     directory = checkpoint_dir or resume_from
     if directory is not None and checkpoint_every is not None:
         ckpt = Checkpointer(directory)
+        if async_checkpoint_enabled():
+            ckpt = ckpt.as_async()
 
     state = None
     total = 0
@@ -105,38 +119,55 @@ def resumable_fit_loop(
         state = init_state()
 
     chunk = checkpoint_every if checkpoint_every is not None else max_iter
-    last_good = (np.asarray(state), total)
-    while total < max_iter:
-        n = min(chunk, max_iter - total)
-        new_state, iters_dev, shift_dev = run_chunk(state, n)
-        iters = int(iters_dev)
-        shift = float(shift_dev)
-        total += iters
-        inject(site, iteration=total)
-        if not all_finite(new_state):
-            raise DivergenceError(
-                f"non-finite values in {what} at iteration {total} — the fit "
-                f"has diverged; last finite {what} is at iteration {last_good[1]}",
-                iteration=total,
-                last_good=last_good[0],
-                last_good_iteration=last_good[1],
-            )
-        state = new_state
-        stop_test = converged_when if converged_when is not None else (lambda s, t: s <= t)
-        converged = stop_test(shift, tol) or iters < n
+    # device references, not host copies: the last-good iterate only
+    # converts to a host array if a DivergenceError actually needs it
+    last_good = (state, total)
+    try:
+        while total < max_iter:
+            n = min(chunk, max_iter - total)
+            new_state, iters_dev, shift_dev = run_chunk(state, n)
+            iters = int(iters_dev)
+            shift = float(shift_dev)
+            total += iters
+            if ckpt is not None:
+                # the previous chunk's async write overlapped this
+                # chunk's compute; drain it before the boundary so a
+                # scripted kill/fault here sees it durable (sync: no-op)
+                ckpt.wait()
+            inject(site, iteration=total)
+            if not all_finite(new_state):
+                raise DivergenceError(
+                    f"non-finite values in {what} at iteration {total} — the fit "
+                    f"has diverged; last finite {what} is at iteration {last_good[1]}",
+                    iteration=total,
+                    last_good=np.asarray(last_good[0]),
+                    last_good_iteration=last_good[1],
+                )
+            state = new_state
+            stop_test = converged_when if converged_when is not None else (lambda s, t: s <= t)
+            converged = stop_test(shift, tol) or iters < n
+            if ckpt is not None:
+                ckpt.save(
+                    total,
+                    {
+                        "state": state,
+                        "n_iter": total,
+                        "shift": shift,
+                        "converged": bool(converged),
+                    },
+                )
+            if converged:
+                break
+            last_good = (state, total)
+    finally:
         if ckpt is not None:
-            ckpt.save(
-                total,
-                {
-                    "state": np.asarray(state),
-                    "n_iter": total,
-                    "shift": shift,
-                    "converged": bool(converged),
-                },
-            )
-        if converged:
-            break
-        last_good = (np.asarray(state), total)
+            if _sys.exc_info()[0] is None:
+                ckpt.close()  # final write durable before the fit returns
+            else:
+                try:  # body exception wins over a late writer error
+                    ckpt.close()
+                except BaseException:
+                    pass
     return state, total
 
 

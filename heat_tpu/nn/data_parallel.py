@@ -13,20 +13,146 @@ backward pass.  The blocking/non-blocking distinction, the per-layer hook
 ordering, and the identical-initialization dance all disappear: one jit'd
 train step is the whole protocol.  Any flax ``linen.Module`` (or a bare
 ``apply(params, x)`` function) can be wrapped.
+
+Explicit gradient-reduction schedules (overlap layer, docs/overlap.md):
+the implicit schedule above leaves the collective placement entirely to
+XLA.  :func:`reduce_gradients` is the explicit alternative — local
+per-device gradients reduced by hand-placed psums inside a
+``shard_map`` body, in **byte-bounded buckets issued in reverse layer
+order** (``HEAT_TPU_GRAD_BUCKET_MB``, default 4) so the collective for
+the last layers' gradients — ready first in the backward pass — is in
+flight while the first layers' backward still computes: the TPU-native
+transcription of the reference's ``_nonblocking_hook`` per-layer
+``Iallreduce`` pipeline (data_parallel.py:240).  On a hierarchical mesh
+each bucket reduces in two stages — ICI ``'node'`` psum, then DCN
+``'global'`` psum — through
+:class:`~heat_tpu.parallel.HierarchicalCommunication`.
+``blocking=True`` selects the single fused psum of the whole flat
+gradient (the reference's ``_blocking_hook``, :220); both schedules sum
+the same elements across the same participants and produce identical
+updates.  :class:`DataParallel` selects a schedule per instance — pass a
+:class:`~heat_tpu.optim.DataParallelOptimizer` (its ``blocking`` flag
+routes fused-vs-bucketed) or ``grad_reduction=`` directly; a bare optax
+transform keeps the implicit schedule.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.dndarray import DNDarray
-from ..parallel.comm import Communication, sanitize_comm
+from ..parallel.comm import Communication, HierarchicalCommunication, sanitize_comm
 
-__all__ = ["DataParallel", "DataParallelMultiGPU"]
+__all__ = [
+    "DataParallel",
+    "DataParallelMultiGPU",
+    "bucket_partition",
+    "reduce_gradients",
+]
+
+#: default collective bucket size for the bucketed schedule, MiB
+DEFAULT_GRAD_BUCKET_MB = 4.0
+
+
+def _grad_bucket_bytes() -> int:
+    return int(
+        float(os.environ.get("HEAT_TPU_GRAD_BUCKET_MB", str(DEFAULT_GRAD_BUCKET_MB)))
+        * 2**20
+    )
+
+
+def bucket_partition(
+    leaves: Sequence, bucket_bytes: Optional[int]
+) -> List[List[int]]:
+    """Partition gradient leaves into collective buckets.
+
+    Returns lists of leaf indices in **reverse layer order** (the order
+    gradients become ready in the backward pass), each bucket bounded by
+    ``bucket_bytes`` (``None`` = unbounded, i.e. the fused schedule) and
+    containing a single dtype (buckets are concatenated into one buffer
+    per collective, which cannot mix dtypes).  A leaf larger than the
+    bound gets its own bucket — leaves are never split."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i in reversed(range(len(leaves))):
+        leaf = leaves[i]
+        nbytes = int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        over = bucket_bytes is not None and cur_bytes + nbytes > bucket_bytes
+        if cur and (over or leaf.dtype != cur_dtype):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+        cur_dtype = leaf.dtype
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def reduce_gradients(
+    grads: Any,
+    comm: Optional[Communication] = None,
+    blocking: bool = False,
+    bucket_bytes: Optional[int] = None,
+):
+    """Cross-device mean of a local-gradient pytree — call INSIDE a
+    ``shard_map`` body (it issues named-axis psums).
+
+    ``blocking=False`` (default): one psum per byte-bounded bucket in
+    reverse layer order, so XLA can overlap each bucket's collective
+    with the remaining backward compute.  ``blocking=True``: a single
+    fused psum of the whole flattened gradient (per dtype).  On a
+    :class:`HierarchicalCommunication` each bucket reduces in two
+    stages: psum over the ``'node'`` (ICI) axis, then over the
+    ``'global'`` (DCN) axis.  Both schedules sum identical elements
+    across identical participants, so the averaged gradients — and the
+    optimizer updates they produce — are identical.
+
+    The number of buckets issued is added to the shared overlap-stats
+    counter ``grad_buckets`` at trace time."""
+    from ..utils.overlap import _bump
+
+    comm = sanitize_comm(comm)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+    if blocking:
+        buckets = bucket_partition(leaves, None)
+    else:
+        buckets = bucket_partition(
+            leaves, _grad_bucket_bytes() if bucket_bytes is None else bucket_bytes
+        )
+    _bump("grad_buckets", len(buckets))
+    hier = isinstance(comm, HierarchicalCommunication)
+    inv = 1.0 / comm.size
+    sizes = [int(l.size) for l in leaves]
+    out: List[Any] = [None] * len(leaves)
+    for bucket in buckets:
+        flat = [jnp.ravel(leaves[i]) for i in bucket]
+        buf = flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+        if hier:
+            # two-stage: node-local reduce rides ICI, then one smaller
+            # cross-node reduce rides DCN (the reference's DDP-then-MPI
+            # hierarchy, heat/optim/dp_optimizer.py:450)
+            buf = comm.psum(buf, comm.node_axis)
+            buf = comm.psum(buf, comm.global_axis)
+        else:
+            buf = comm.psum(buf)
+        buf = buf * jnp.asarray(inv, buf.dtype)
+        offset = 0
+        for i in bucket:
+            out[i] = jax.lax.slice(buf, (offset,), (offset + sizes[i],)).reshape(
+                leaves[i].shape
+            )
+            offset += sizes[i]
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 class DataParallel:
@@ -39,11 +165,23 @@ class DataParallel:
     comm : Communication, optional
         Mesh over which the batch is sharded (default: world).
     optimizer : optional
-        An optax gradient transformation; enables :meth:`step`.
+        An optax gradient transformation, or a
+        :class:`~heat_tpu.optim.DataParallelOptimizer` wrapping one — the
+        wrapper's ``blocking`` flag then selects the explicit gradient
+        schedule (``True`` -> fused single psum, ``False`` -> bucketed
+        overlapped psums).  Enables :meth:`step`.
     blocking_parameter_updates : bool
-        Accepted for API parity; both modes compile to the same overlapped
-        psum schedule under XLA (the reference's :240 non-blocking pipeline
-        is the compiler's default here).
+        ``True`` selects the explicit single fused gradient psum (the
+        reference's ``_blocking_hook``, :220).  ``False`` (default)
+        keeps the implicit schedule, where XLA places and overlaps the
+        reduction itself (the compiler-native analog of the :240
+        non-blocking pipeline).
+    grad_reduction : str, optional
+        Explicit schedule override: ``"implicit"`` (XLA-placed),
+        ``"bucketed"`` (reverse-order byte-bounded psums, see
+        :func:`reduce_gradients`) or ``"fused"`` (one flat psum).
+        Unknown values raise.  Default: derived from ``optimizer`` /
+        ``blocking_parameter_updates`` as above.
     """
 
     def __init__(
@@ -52,15 +190,31 @@ class DataParallel:
         comm: Optional[Communication] = None,
         optimizer: Any = None,
         blocking_parameter_updates: bool = False,
+        grad_reduction: Optional[str] = None,
     ):
+        from ..optim.dp_optimizer import DataParallelOptimizer
+
         self.module = module
         self.comm = sanitize_comm(comm)
         self.blocking_parameter_updates = blocking_parameter_updates
+        if isinstance(optimizer, DataParallelOptimizer):
+            if grad_reduction is None:
+                grad_reduction = optimizer.schedule
+            optimizer = optimizer.optimizer
+        if grad_reduction is None:
+            grad_reduction = "fused" if blocking_parameter_updates else "implicit"
+        if grad_reduction not in ("implicit", "bucketed", "fused"):
+            raise ValueError(
+                "grad_reduction must be 'implicit', 'bucketed' or 'fused', "
+                f"got {grad_reduction!r}"
+            )
+        self.grad_reduction = grad_reduction
         self._optimizer = optimizer
         self._opt_state = None
         self.params = None
         self._apply = module.apply if hasattr(module, "apply") else module
         self._train_step = None
+        self._train_step_explicit = None
         self._epoch_fn = None
         self._programs = {}
 
@@ -83,6 +237,7 @@ class DataParallel:
         if self._optimizer is not None:
             self._opt_state = jax.device_put(self._optimizer.init(self.params), rep)
         self._train_step = None
+        self._train_step_explicit = None
         self._epoch_fn = None
         self._programs = {}
 
@@ -191,6 +346,8 @@ class DataParallel:
         def build():
             apply = self._apply
             optimizer = self._optimizer
+            comm = self.comm
+            schedule = self.grad_reduction
             import optax
 
             def body(params, opt_state, xb, yb):
@@ -200,6 +357,39 @@ class DataParallel:
                 loss, grads = jax.value_and_grad(total_loss)(params)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
                 return loss, optax.apply_updates(params, updates), opt_state
+
+            body_explicit = None
+            if schedule in ("bucketed", "fused"):
+                # explicit schedule: per-device local gradients inside a
+                # shard_map, reduced by hand-placed psums (bucketed
+                # reverse-order or one fused collective) — the loss mean
+                # over equal shards equals the global batch mean, so the
+                # update matches the implicit schedule mathematically
+                from jax.experimental.shard_map import shard_map
+
+                spec = P(comm.axis_name)
+                blocking = schedule == "fused"
+
+                def local_step(params, xl, yl):
+                    def local_loss(p):
+                        return loss_fn(apply(p, xl), yl)
+
+                    loss, grads = jax.value_and_grad(local_loss)(params)
+                    grads = reduce_gradients(grads, comm, blocking=blocking)
+                    loss = comm.psum(loss) / comm.size
+                    return loss, grads
+
+                def explicit_body(params, opt_state, xb, yb):
+                    loss, grads = shard_map(
+                        local_step,
+                        mesh=comm.mesh,
+                        in_specs=(P(), spec, spec),
+                        out_specs=(P(), P()),
+                    )(params, xb, yb)
+                    updates, opt_state = optimizer.update(grads, opt_state, params)
+                    return loss, optax.apply_updates(params, updates), opt_state
+
+                body_explicit = jax.jit(explicit_body)
 
             @jax.jit
             def epoch(params, opt_state, xs, ys):
@@ -218,10 +408,10 @@ class DataParallel:
             self._stack_sharding = NamedSharding(
                 self.comm.mesh, P(None, self.comm.axis_name)
             )
-            return jax.jit(body), epoch
+            return jax.jit(body), epoch, body_explicit
 
-        self._train_step, self._epoch_fn = self._cached_program(
-            self._programs, loss_fn, build
+        self._train_step, self._epoch_fn, self._train_step_explicit = (
+            self._cached_program(self._programs, loss_fn, build)
         )
 
     def step(self, loss_fn: Callable, x, y) -> float:
@@ -234,10 +424,18 @@ class DataParallel:
 
         xd = x._dense() if isinstance(x, DNDarray) else jnp.asarray(x)
         yd = y._dense() if isinstance(y, DNDarray) else jnp.asarray(y)
-        if xd.shape[0] % self.comm.size == 0:
+        divisible = xd.shape[0] % self.comm.size == 0
+        if divisible:
             xd = jax.device_put(xd, self._batch_sharding)
             yd = jax.device_put(yd, self._batch_sharding)
-        loss, self.params, self._opt_state = self._train_step(self.params, self._opt_state, xd, yd)
+        # explicit schedules run as a shard_map, which needs the batch to
+        # tile the mesh; ragged batches fall back to the implicit body
+        step_fn = (
+            self._train_step_explicit
+            if (self._train_step_explicit is not None and divisible)
+            else self._train_step
+        )
+        loss, self.params, self._opt_state = step_fn(self.params, self._opt_state, xd, yd)
         return float(loss)
 
     def train_steps(self, loss_fn: Callable, xs, ys) -> jnp.ndarray:
@@ -256,6 +454,11 @@ class DataParallel:
 
         Returns the per-step losses (a device-resident ``(n_steps,)``
         array; fetch at epoch boundaries, not per step).
+
+        The scanned epoch always uses the implicit gradient schedule —
+        inside one compiled scan XLA already owns collective placement
+        end to end; explicit bucketed/fused schedules apply to
+        :meth:`step`.
         """
         if self._optimizer is None:
             raise RuntimeError("construct DataParallel with an optimizer to use train_steps()")

@@ -42,19 +42,41 @@ __all__ = ["DataParallelOptimizer", "DASO"]
 
 class DataParallelOptimizer:
     """Stateful wrapper binding an optax transform to the DP cycle
-    (dp_optimizer.py:851)."""
+    (dp_optimizer.py:851).
+
+    ``blocking`` selects the gradient-reduction schedule a
+    :class:`~heat_tpu.nn.DataParallel` built on this optimizer uses
+    (the reference's ``_blocking_hook`` vs ``_nonblocking_hook``
+    distinction, data_parallel.py:220/:240): ``True`` -> one fused psum
+    of the whole flat gradient, ``False`` (default) -> byte-bounded
+    buckets psum'd in reverse layer order so collectives overlap the
+    remaining backward compute
+    (:func:`heat_tpu.nn.data_parallel.reduce_gradients`).  Both
+    schedules produce identical updates; only the collective/compute
+    overlap differs."""
 
     def __init__(self, optimizer: Any, blocking: bool = False):
         import optax
 
         if not hasattr(optimizer, "update"):
             raise TypeError("optimizer must be an optax gradient transformation")
+        if not isinstance(blocking, bool):
+            raise ValueError(
+                "blocking must be True (single fused psum) or False "
+                f"(bucketed overlapped psums), got {blocking!r}"
+            )
         self.optimizer = optimizer
         self.blocking = blocking
         self.opt_state = None
         self._apply = jax.jit(
             lambda params, grads, opt_state: _apply_updates(self.optimizer, params, grads, opt_state)
         )
+
+    @property
+    def schedule(self) -> str:
+        """Gradient-reduction schedule this optimizer selects
+        (``'fused'`` when blocking, else ``'bucketed'``)."""
+        return "fused" if self.blocking else "bucketed"
 
     def init(self, params) -> None:
         self.opt_state = self.optimizer.init(params)

@@ -10,6 +10,8 @@ scenario across four layers:
   through named injection points (``comm.collective``, ``comm.init``,
   ``dispatch.compile``, ``io.open``/``io.write``,
   ``checkpoint.save``/``checkpoint.restore``/``checkpoint.write``,
+  ``checkpoint.async_write`` (evaluated on the overlap layer's
+  background writer thread, before the staged atomic write),
   ``<estimator>.iter``, ``pca.stage``), scriptable per call index via a
   plan dict or the ``HEAT_TPU_FAULT_PLAN`` env hook.
 * :mod:`~heat_tpu.resilience.retry` — :class:`RetryPolicy` (bounded

@@ -7,6 +7,13 @@ points (``faults.inject("io.write", path=...)``) are wired through the
 communication, dispatch, io and checkpoint layers, and a **fault plan**
 decides, per site and per call index, whether a scripted fault fires.
 
+Sites may be evaluated from *any* thread — the overlap layer's
+``checkpoint.async_write`` (and the ``checkpoint.save``/
+``checkpoint.write`` sites under an async save) fire on the background
+writer thread, which is how kill-mid-async-write scenarios are
+scripted; the injector is lock-protected, so per-site call indices stay
+deterministic across threads as long as the call *sequence* is.
+
 Plan format
 -----------
 A plan is a mapping from site pattern to a list of rules::

@@ -3,7 +3,8 @@ checkpoint/resume and profiling, SURVEY.md §5)."""
 
 from . import checkpoint
 from . import data
+from . import overlap
 from . import profiling
 from . import vision_transforms
 
-__all__ = ["checkpoint", "data", "profiling", "vision_transforms"]
+__all__ = ["checkpoint", "data", "overlap", "profiling", "vision_transforms"]

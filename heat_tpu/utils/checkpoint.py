@@ -22,6 +22,13 @@ persistence is the io layer writing global arrays, plus
 
 Both backends share the step/metadata API, so callers switch with one
 constructor argument.
+
+Saves can also run *asynchronously* — overlapped with the caller's next
+on-device chunk — through :class:`~heat_tpu.utils.overlap.AsyncCheckpointer`
+(``Checkpointer(...).as_async()``, or ``save(step, state, async_=True)``
+which routes through a lazily created internal async front end).  The
+write path is identical (retry + staged dir + atomic rename), only the
+calling thread changes; see ``docs/overlap.md``.
 """
 
 from __future__ import annotations
@@ -142,7 +149,9 @@ class Checkpointer:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{int(step)}")
 
     def all_steps(self) -> List[int]:
-        """Committed steps, ascending."""
+        """Committed steps, ascending (drains any in-flight async save
+        first, so a caller never misses the step it just enqueued)."""
+        self.close()
         if self.backend == "orbax":
             return sorted(self._mngr.all_steps())
         steps = []
@@ -160,13 +169,49 @@ class Checkpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    # -- async front end ------------------------------------------------
+    def as_async(self) -> "AsyncCheckpointer":
+        """An :class:`~heat_tpu.utils.overlap.AsyncCheckpointer` over this
+        checkpointer (bounded 1-in-flight background writes)."""
+        from .overlap import AsyncCheckpointer
+
+        return AsyncCheckpointer(self)
+
+    def wait(self) -> None:
+        """No-op (synchronous saves are durable on return); lets callers
+        drive sync and async checkpointers through one protocol."""
+
+    def close(self) -> None:
+        """Drain the internal async front end, if ``save(async_=True)``
+        ever created one (no-op otherwise)."""
+        inner = getattr(self, "_async", None)
+        if inner is not None:
+            inner.close()
+
     # -- save / restore -------------------------------------------------
-    def save(self, step: int, state: Any, extra_metadata: Optional[Dict] = None) -> None:
+    def save(
+        self,
+        step: int,
+        state: Any,
+        extra_metadata: Optional[Dict] = None,
+        async_: bool = False,
+    ) -> None:
         """Save a pytree (params/opt state/DNDarray-carrying metadata).
 
         Native: runs under the io retry policy; the step directory is
         staged under a temp name and committed with one atomic rename,
-        so a crash mid-save leaves no partial step behind."""
+        so a crash mid-save leaves no partial step behind.
+
+        ``async_=True`` snapshots the (device) state non-blockingly and
+        runs the same atomic write on a bounded background writer (at
+        most one in flight; errors re-raise at the next ``save``/
+        ``close``) — call :meth:`close` before relying on durability."""
+        if async_:
+            inner = getattr(self, "_async", None)
+            if inner is None:
+                inner = self._async = self.as_async()
+            inner.save(step, state, extra_metadata)
+            return
         if self.backend == "orbax":
             ocp = _orbax()
             stripped = _strip_dndarrays(state)
@@ -217,6 +262,7 @@ class Checkpointer:
         decoding — a corrupt checkpoint raises ``ChecksumError`` instead
         of returning garbage.  ``template`` is only consulted by the
         orbax backend (the native codec is structure-lossless)."""
+        self.close()
         step = self.latest_step() if step is None else int(step)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")

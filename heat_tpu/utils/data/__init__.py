@@ -4,6 +4,7 @@ from . import matrixgallery
 from .datatools import DataLoader, Dataset, dataset_irecv, dataset_ishuffle, dataset_shuffle
 from .mnist import MNISTDataset, synthetic_mnist
 from .partial_dataset import PartialH5DataLoaderIter, PartialH5Dataset
+from .prefetch import prefetch_to_device, sharding_for_batch
 from .spherical import create_clusters, create_spherical_dataset
 
 __all__ = [
@@ -18,5 +19,7 @@ __all__ = [
     "dataset_ishuffle",
     "dataset_shuffle",
     "matrixgallery",
+    "prefetch_to_device",
+    "sharding_for_batch",
     "synthetic_mnist",
 ]

@@ -59,7 +59,14 @@ class Dataset:
 
 
 class DataLoader:
-    """Minibatch iterator over a Dataset (datatools.py:16)."""
+    """Minibatch iterator over a Dataset (datatools.py:16).
+
+    ``prefetch=N`` (overlap layer, docs/overlap.md) wraps the epoch in
+    :func:`~heat_tpu.utils.data.prefetch.prefetch_to_device`: the next
+    ``N`` batches are gathered and staged on device while the current one
+    computes, so per-batch gather/dispatch latency hides behind the step
+    instead of preceding it.  ``0`` (default) keeps the fully lazy
+    iterator."""
 
     def __init__(
         self,
@@ -68,6 +75,7 @@ class DataLoader:
         shuffle: bool = True,
         drop_last: bool = False,
         ishuffle: bool = False,
+        prefetch: int = 0,
     ):
         if isinstance(dataset, DNDarray):
             dataset = Dataset(dataset)
@@ -76,6 +84,7 @@ class DataLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.ishuffle = ishuffle
+        self.prefetch = int(prefetch)
         self._epoch = 0
 
     def __len__(self) -> int:
@@ -85,6 +94,13 @@ class DataLoader:
         return -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator:
+        if self.prefetch > 0:
+            from .prefetch import prefetch_to_device
+
+            return prefetch_to_device(self._batches(), size=self.prefetch)
+        return self._batches()
+
+    def _batches(self) -> Iterator:
         if self.ishuffle or getattr(self.dataset, "ishuffle", False):
             # complete the shuffle started at the end of the previous epoch
             # (the reference's DataLoader does the same Irecv-then-Ishuffle

@@ -5,19 +5,28 @@ chunk reads and overlaps load/convert with training via a custom loader
 iterator (:224) fed by daemon threads running :func:`queue_thread`
 (partial_dataset.py:20).  Here the same structure holds — a loader thread
 reads the next HDF5 slab while the device executes the previous batch —
-and JAX's asynchronous dispatch overlaps the host→device copy as well.
+and the staging step is now *shard-aware* (overlap layer, docs/overlap.md):
+each window is ``jax.device_put`` with the canonical split
+``NamedSharding`` from the dataset's communication, so the host->device
+copy AND the resharding ride behind compute instead of inside the
+consuming step.  Windows handed out that were already staged when the
+consumer asked count as ``prefetch_hits`` on the shared overlap stats
+surface; underruns count as ``prefetch_misses``.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ...core.dndarray import DNDarray
+from ..overlap import _bump
 
 __all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
 
@@ -46,7 +55,16 @@ def queue_thread(q: "queue.Queue") -> None:
 
 
 class PartialH5Dataset:
-    """Stream a large HDF5 dataset in windows (partial_dataset.py:32)."""
+    """Stream a large HDF5 dataset in windows (partial_dataset.py:32).
+
+    ``comm`` names the mesh the staged windows are sharded over (default:
+    the process-wide communication); divisible windows land with the
+    canonical split-0 ``NamedSharding``, ragged ones on the default
+    device.  Subclasses that override :meth:`read_window` (and set
+    ``length``/``load_length``/``transforms``/``dataset_names``/``comm``)
+    can feed the loader iterator from any source — the tests drive it
+    from in-memory arrays without h5py.
+    """
 
     def __init__(
         self,
@@ -63,12 +81,19 @@ class PartialH5Dataset:
         if not _H5:
             raise RuntimeError("h5py is not available")
         self.file = file
+        self.comm = comm
         self.dataset_names = dataset_names or ["data"]
         self.initial_load = initial_load
         self.load_length = load_length
         self.transforms = transforms
         with h5py.File(file, "r") as f:
             self.length = f[self.dataset_names[0]].shape[0]
+
+    def read_window(self, start: int, stop: int) -> List[np.ndarray]:
+        """Read one ``[start, stop)`` slab of every named dataset from the
+        backing store (runs on the loader thread)."""
+        with h5py.File(self.file, "r") as f:
+            return [np.asarray(f[name][start:stop]) for name in self.dataset_names]
 
     def __len__(self) -> int:
         return self.length
@@ -81,12 +106,22 @@ class PartialH5DataLoaderIter:
     """Windowed loader iterator (partial_dataset.py:224).
 
     A daemon thread running :func:`queue_thread` reads window ``i+1`` from
-    the HDF5 file while window ``i`` is being consumed, so disk latency
-    hides behind compute the way the reference's loader/convert threads do.
+    the backing store while window ``i`` is being consumed, so disk latency
+    hides behind compute the way the reference's loader/convert threads do;
+    the thread also stages each window on device with the canonical split
+    sharding, so the transfer overlaps too.
     """
 
+    #: close() drain deadline — a loader thread wedged in a backing-store
+    #: read beyond this is abandoned (daemon threads die with the process)
+    _CLOSE_TIMEOUT_S = 10.0
+
     def __init__(self, dataset: PartialH5Dataset):
+        from ...parallel.comm import sanitize_comm
+
         self._ds = dataset
+        self._comm = sanitize_comm(getattr(dataset, "comm", None))
+        self._split_sharding = self._comm.sharding(0)
         self._pos = 0
         self._work: "queue.Queue" = queue.Queue()
         self._ready: "queue.Queue" = queue.Queue(maxsize=2)
@@ -95,16 +130,22 @@ class PartialH5DataLoaderIter:
         self._windows_queued = 0
         self._queue_next_read()  # prime the pipeline
 
+    def _stage(self, chunk: np.ndarray):
+        """Start the host->device copy of one window, sharded over the
+        canonical split when the extent tiles the mesh (non-blocking:
+        JAX async dispatch owns the transfer)."""
+        if chunk.ndim >= 1 and chunk.shape[0] % self._comm.size == 0:
+            return jax.device_put(chunk, self._split_sharding)
+        return jnp.asarray(chunk)
+
     def _read_window(self, start: int, stop: int) -> None:
         try:
             out = []
-            with h5py.File(self._ds.file, "r") as f:
-                for name in self._ds.dataset_names:
-                    chunk = np.asarray(f[name][start:stop])
-                    arr = jnp.asarray(chunk)
-                    if self._ds.transforms is not None and callable(self._ds.transforms):
-                        arr = self._ds.transforms(arr)
-                    out.append(arr)
+            for chunk in self._ds.read_window(start, stop):
+                arr = self._stage(chunk)
+                if self._ds.transforms is not None and callable(self._ds.transforms):
+                    arr = self._ds.transforms(arr)
+                out.append(arr)
             self._ready.put(out[0] if len(out) == 1 else tuple(out))
         except BaseException as e:  # surface loader errors on the consumer side
             self._ready.put(e)
@@ -118,10 +159,25 @@ class PartialH5DataLoaderIter:
         self._windows_queued += 1
 
     def close(self) -> None:
-        """Retire the worker thread (safe to call more than once)."""
-        if self._thread is not None:
-            self._work.put(None)
-            self._thread = None
+        """Retire the worker thread (safe to call more than once).
+
+        The loader thread may be blocked in ``_ready.put`` with the ready
+        queue full (two staged windows nobody consumed); the sentinel
+        alone would never reach it.  Drain pending windows until the
+        thread consumes the sentinel and exits, bounded by a deadline for
+        a thread wedged inside a backing-store read."""
+        t, self._thread = self._thread, None
+        if t is None:
+            return
+        self._work.put(None)
+        deadline = time.monotonic() + self._CLOSE_TIMEOUT_S
+        while t.is_alive() and time.monotonic() < deadline:
+            try:
+                self._ready.get_nowait()  # unblock a full-queue put
+            except queue.Empty:
+                pass
+            t.join(timeout=0.02)
+        self._windows_queued = 0
 
     def __del__(self):
         self.close()
@@ -133,6 +189,7 @@ class PartialH5DataLoaderIter:
         if self._windows_queued == 0 or self._thread is None:
             self.close()
             raise StopIteration
+        _bump("prefetch_misses" if self._ready.empty() else "prefetch_hits")
         batch = self._ready.get()
         self._windows_queued -= 1
         if isinstance(batch, BaseException):

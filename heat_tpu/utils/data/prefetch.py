@@ -1,0 +1,120 @@
+"""Shard-aware device prefetch (overlap layer, docs/overlap.md).
+
+The loaders in this package already overlap *disk* reads with compute
+(:class:`~heat_tpu.utils.data.PartialH5DataLoaderIter`'s loader thread),
+but batches still landed on the default device unsharded — the
+host->device copy and any resharding were paid inside the consuming
+train step.  :func:`prefetch_to_device` closes that gap: a
+double-buffered iterator adapter that stages ``jax.device_put`` of batch
+*i+1* — with the canonical split :class:`~jax.sharding.NamedSharding`
+when one is given — while batch *i* computes.  Because JAX dispatch is
+asynchronous, ``device_put`` on the staged batch returns immediately and
+the transfer rides the device's copy engine behind the running step (the
+same overlap the reference wins by handing converted batches to daemon
+threads in ``heat/utils/data/partial_dataset.py``).
+
+Counters: every batch handed out that was staged *ahead* of the consumer
+counts a ``prefetch_hit``; a batch staged synchronously on demand (an
+underrun) counts a ``prefetch_miss`` (shared overlap stats surface,
+:func:`heat_tpu.utils.overlap.overlap_stats`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..overlap import _bump
+
+__all__ = ["prefetch_to_device", "sharding_for_batch"]
+
+
+def sharding_for_batch(batch_extent: int, comm=None, split: int = 0):
+    """The canonical split sharding for a batch of ``batch_extent`` rows,
+    or ``None`` when the extent does not tile the mesh (``device_put``
+    would reject a ragged split; callers fall back to the default
+    placement, exactly like the train-step staging paths)."""
+    from ...parallel.comm import sanitize_comm
+
+    comm = sanitize_comm(comm)
+    if comm.size > 0 and batch_extent % comm.size == 0:
+        return comm.sharding(split)
+    return None
+
+
+def _stage(batch: Any, sharding) -> Any:
+    """Start the host->device copy of every array leaf of ``batch``
+    (non-blocking: JAX async dispatch owns the transfer)."""
+
+    def one(x):
+        if not hasattr(x, "shape") and not hasattr(x, "dtype"):
+            return x  # non-array payloads ride along untouched
+        if sharding is not None:
+            return jax.device_put(x, sharding)
+        return jnp.asarray(x)
+
+    return jax.tree_util.tree_map(one, batch)
+
+
+class _DevicePrefetcher:
+    """Bounded look-ahead buffer of device-staged batches."""
+
+    def __init__(self, it: Iterator, size: int, sharding):
+        self._it: Optional[Iterator] = iter(it)
+        self._size = size
+        self._sharding = sharding
+        self._buf: "deque" = deque()
+        self._fill()  # prime: batches 0..size-1 staged before first use
+
+    def _fill(self) -> None:
+        while self._it is not None and len(self._buf) < self._size:
+            try:
+                nxt = next(self._it)
+            except StopIteration:
+                self._it = None
+                return
+            self._buf.append(_stage(nxt, self._sharding))
+
+    def __iter__(self) -> "_DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        if self._buf:
+            _bump("prefetch_hits")
+            out = self._buf.popleft()
+        elif self._it is None:
+            raise StopIteration
+        else:  # underrun: stage synchronously (still correct, not overlapped)
+            out = _stage(next(self._it), self._sharding)
+            _bump("prefetch_misses")
+        self._fill()  # restart the look-ahead immediately
+        return out
+
+
+def prefetch_to_device(it: Iterable, size: int = 2, sharding=None) -> Iterator:
+    """Wrap ``it`` so batches are staged on device ``size`` steps ahead.
+
+    Parameters
+    ----------
+    it : iterable of batches
+        Each batch is a pytree whose array leaves (numpy or jax) are
+        staged; non-array leaves pass through.
+    size : int
+        Look-ahead depth (default 2 — classic double buffering: one
+        batch computing, one in flight).
+    sharding : jax.sharding.Sharding, optional
+        Placement for the staged leaves (e.g. the canonical split
+        ``NamedSharding`` from :meth:`Communication.sharding`, or
+        :func:`sharding_for_batch`).  ``None`` stages to the default
+        device.  The caller guarantees the sharding tiles every staged
+        leaf (``sharding_for_batch`` returns ``None`` otherwise).
+
+    Ordering is preserved exactly; ``StopIteration`` propagates after
+    the last buffered batch is handed out.
+    """
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    return _DevicePrefetcher(it, size, sharding)

@@ -1,0 +1,266 @@
+"""Overlap layer: hide host-side latency behind device compute.
+
+PR 1 made single-op dispatch cheap and PR 2 made long fits resumable,
+but three host-side latencies were still paid *serially* on the device
+timeline:
+
+* ``Checkpointer.save`` blocked the fit loop for the full atomic write
+  (~22 ms per ``checkpoint_every`` chunk on the CI grid);
+* data loaders landed batches unsharded on the default device, paying
+  the host->device copy inside the consuming step;
+* the DP training path reduced gradients as one monolithic collective
+  with no way to overlap transport with the remaining backward pass.
+
+This module is the shared surface of the overlap layer that removes
+them (the same latency-hiding pattern the reference implements with
+per-layer ``Iallreduce`` hooks in its non-blocking DASO pipeline,
+``heat/optim/dp_optimizer.py`` ``_nonblocking_hook``):
+
+* :class:`AsyncCheckpointer` — snapshot device state non-blockingly and
+  run the existing atomic-rename+CRC32 write (retry policy included) on
+  a bounded background writer.  At most **one** save is in flight;
+  overrun back-pressures; writer errors re-raise at the next
+  ``save()``/``wait()``/``close()``.  The write itself stays the
+  resilience layer's staged-dir-plus-atomic-rename commit, so a kill
+  mid-async-write never leaves a partial step visible.  Fault site:
+  ``checkpoint.async_write`` (evaluated on the writer thread, after the
+  device snapshot is ready and before the filesystem write).
+* the **overlap counters** (:func:`overlap_stats`): ``async_saves`` /
+  ``sync_saves`` / ``ckpt_stall_ms`` from the checkpoint path,
+  ``prefetch_hits`` / ``prefetch_misses`` from the device-prefetch
+  iterators (:mod:`heat_tpu.utils.data.prefetch`,
+  :class:`~heat_tpu.utils.data.PartialH5DataLoaderIter`), and
+  ``grad_buckets`` from the bucketed gradient reduction
+  (:func:`heat_tpu.nn.data_parallel.reduce_gradients`).  ``bench.py``'s
+  ``bench_overlap`` config and ``scripts/perf_ci.py`` publish them.
+
+``HEAT_TPU_ASYNC_CKPT=0`` disables the async path globally (resumable
+fits fall back to fully synchronous saves).  See ``docs/overlap.md``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..resilience.faults import inject as _inject
+
+__all__ = [
+    "AsyncCheckpointer",
+    "async_checkpoint_enabled",
+    "overlap_stats",
+    "reset_overlap_stats",
+    "snapshot_state",
+]
+
+
+def async_checkpoint_enabled() -> bool:
+    """Whether resumable fits use the async checkpoint path (default on;
+    ``HEAT_TPU_ASYNC_CKPT=0`` selects the PR 2 synchronous saves)."""
+    v = os.environ.get("HEAT_TPU_ASYNC_CKPT")
+    if v is None:
+        return True
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+# ----------------------------------------------------------------------
+# shared overlap counters
+# ----------------------------------------------------------------------
+_ZERO = dict(
+    async_saves=0,
+    sync_saves=0,
+    ckpt_stall_ms=0.0,
+    prefetch_hits=0,
+    prefetch_misses=0,
+    grad_buckets=0,
+)
+_STATS = dict(_ZERO)
+_STATS_LOCK = threading.Lock()
+
+
+def _bump(name: str, amount=1) -> None:
+    with _STATS_LOCK:
+        _STATS[name] += amount
+
+
+def overlap_stats() -> Dict[str, Any]:
+    """Snapshot of the overlap counters.
+
+    ``async_saves``/``sync_saves`` count checkpoint writes by schedule;
+    ``ckpt_stall_ms`` is the cumulative wall time the *caller* spent
+    blocked inside async ``save()``/``wait()`` — the part of the write
+    the device timeline actually saw (a fully hidden write contributes
+    ~0).  ``prefetch_hits``/``prefetch_misses`` count batches that were
+    staged on device ahead of the consumer vs. staged synchronously on
+    demand (``prefetch_hit_rate`` derives from them).  ``grad_buckets``
+    counts collective buckets issued by the bucketed gradient-reduction
+    schedule at trace time."""
+    with _STATS_LOCK:
+        s = dict(_STATS)
+    total = s["prefetch_hits"] + s["prefetch_misses"]
+    s["prefetch_hit_rate"] = (s["prefetch_hits"] / total) if total else 0.0
+    return s
+
+
+def reset_overlap_stats() -> None:
+    """Zero all overlap counters."""
+    with _STATS_LOCK:
+        _STATS.update(_ZERO)
+
+
+# ----------------------------------------------------------------------
+# async checkpointing
+# ----------------------------------------------------------------------
+def snapshot_state(state: Any) -> Any:
+    """Cheap consistent snapshot of a checkpoint payload.
+
+    JAX arrays are immutable, so holding the reference *is* the snapshot
+    — no host transfer happens here; ``block_until_ready`` +
+    device-to-host conversion run on the writer thread.  DNDarrays
+    snapshot as their (lazily forced) dense global array for the same
+    reason.  NumPy leaves are mutable and are copied (a host memcpy,
+    orders of magnitude cheaper than the encode+CRC+fsync write).
+    Scalars/strings pass through."""
+    from ..core.dndarray import DNDarray  # lazy: avoid import cycle
+
+    def one(x):
+        if isinstance(x, DNDarray):
+            return x._dense()
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
+        return x
+
+    return jax.tree_util.tree_map(
+        one, state, is_leaf=lambda x: isinstance(x, DNDarray)
+    )
+
+
+class AsyncCheckpointer:
+    """Non-blocking front end over a :class:`~heat_tpu.utils.checkpoint.Checkpointer`.
+
+    ``save(step, state)`` snapshots the (device) state without blocking
+    on it and hands the atomic write to a background writer thread, so a
+    fit loop overlaps the write with its next on-device chunk.  The
+    write path is unchanged from the synchronous checkpointer — io retry
+    policy, staged temp dir, CRC32 sidecars, one atomic directory rename
+    — so every atomicity/bitwise-resume guarantee carries over; the only
+    new failure surface is *when* an error is seen:
+
+    * at most one save is in flight; a second ``save()`` during a write
+      back-pressures (blocks) until the first completes;
+    * a writer error is stored and re-raised at the next ``save()``,
+      ``wait()`` or ``close()`` — never swallowed;
+    * ``close()`` (or context-manager exit) drains the writer, so a
+      caller returning from a fit knows its last checkpoint is durable.
+
+    Read-side methods (``restore``/``latest_step``/``all_steps``/
+    ``metadata``) first wait for any in-flight write, so a reader never
+    misses the step it just saved.
+    """
+
+    def __init__(self, checkpointer, max_pending: int = 1):
+        from .checkpoint import Checkpointer  # lazy: avoid import cycle
+
+        if isinstance(checkpointer, str):
+            checkpointer = Checkpointer(checkpointer)
+        self.checkpointer = checkpointer
+        if max_pending != 1:
+            raise ValueError(
+                f"AsyncCheckpointer is bounded at exactly 1 in-flight save, "
+                f"got max_pending={max_pending!r}"
+            )
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+
+    # -- write side -----------------------------------------------------
+    def save(self, step: int, state: Any, extra_metadata=None, async_: bool = True) -> None:
+        """Enqueue one checkpoint write (or run it synchronously with
+        ``async_=False``).  Blocks only for the snapshot and for any
+        previous in-flight write (back-pressure); re-raises a pending
+        writer error before accepting new work."""
+        t0 = time.perf_counter()
+        self.wait()  # back-pressure (<=1 in flight) + error surface
+        if not async_:
+            self.checkpointer.save(step, state, extra_metadata)
+            _bump("sync_saves")
+            return
+        snap = snapshot_state(state)
+
+        def _write():
+            try:
+                jax.block_until_ready(snap)  # device->writer handoff point
+                _inject("checkpoint.async_write", step=step)
+                self.checkpointer.save(step, snap, extra_metadata)
+            except BaseException as e:  # surfaced at the next save/wait/close
+                with self._error_lock:
+                    self._error = e
+
+        t = threading.Thread(
+            target=_write, name=f"heat-tpu-async-ckpt-{step}", daemon=True
+        )
+        self._thread = t
+        t.start()
+        _bump("async_saves")
+        _bump("ckpt_stall_ms", (time.perf_counter() - t0) * 1e3)
+
+    def wait(self) -> None:
+        """Block until no write is in flight; re-raise any writer error."""
+        t0 = time.perf_counter()
+        t = self._thread
+        if t is threading.current_thread():
+            # re-entrant call from the writer itself (the write path's
+            # pruning walks the step list, which drains-by-contract):
+            # the in-flight save is this very call — nothing to wait for
+            return
+        if t is not None:
+            t.join()
+            self._thread = None
+            _bump("ckpt_stall_ms", (time.perf_counter() - t0) * 1e3)
+        with self._error_lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def close(self) -> None:
+        """Drain the writer (idempotent); re-raises a pending error."""
+        self.wait()
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            # don't mask the in-flight body exception with a writer error
+            try:
+                self.close()
+            except BaseException:
+                pass
+
+    # -- read side (sees in-flight writes through) ----------------------
+    def restore(self, step=None, template=None):
+        self.wait()
+        return self.checkpointer.restore(step, template)
+
+    def latest_step(self):
+        self.wait()
+        return self.checkpointer.latest_step()
+
+    def all_steps(self) -> List[int]:
+        self.wait()
+        return self.checkpointer.all_steps()
+
+    def metadata(self, step: int):
+        self.wait()
+        return self.checkpointer.metadata(step)
+
+    @property
+    def directory(self) -> str:
+        return self.checkpointer.directory

@@ -47,6 +47,34 @@ def _timeit(fn, fetch, windows=5, n_iter=3):
     return best, round(spread, 1)
 
 
+def _timeit_interleaved(specs, rounds=8):
+    """Interleaved min-of-k for noise-prone metrics (the r5 KMeans bench
+    method): one window of each metric per round, rounds alternating, so
+    a monotone runner drift (CI neighbors waking up mid-job) degrades
+    every metric's sample set equally instead of landing on whichever
+    metric ran last — the committed kmeans_lloyd (22.5%) and
+    checkpoint_roundtrip (17.6%) spreads were exactly that artifact.
+    ``specs`` is ``[(fn, fetch, n_iter), ...]``; returns one
+    ``(best, spread_pct)`` per spec from the min over all its rounds."""
+    for fn, fetch, _ in specs:
+        fetch(fn())  # compile/warm outside the sample set
+    samples = [[] for _ in specs]
+    for _ in range(rounds):
+        for j, (fn, fetch, n_iter) in enumerate(specs):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n_iter):
+                out = fn()
+            fetch(out)
+            samples[j].append((time.perf_counter() - t0) / n_iter)
+    results = []
+    for s in samples:
+        best = min(s)
+        med = float(np.median(s))
+        results.append((best, round(100.0 * (med - best) / best if best else 0.0, 1)))
+    return results
+
+
 def main():
     import heat_tpu as ht
 
@@ -75,7 +103,19 @@ def main():
             "spread_pct": spread,
         }
 
-    # kmeans lloyd iteration (stream-anchored: reads the point set)
+    def guarded(name, fn):
+        """Run one kernel's measurement; a kernel broken in THIS runner
+        (e.g. a jax API the installed version lacks) records an explicit
+        error entry — with no ``rel_to_anchor``, the gate skips it —
+        instead of killing the whole grid."""
+        try:
+            fn()
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:160]}
+
+    # kmeans lloyd iteration (stream-anchored: reads the point set).
+    # Measured below, interleaved with the checkpoint roundtrip — the two
+    # flakiest gate metrics share one drift-resistant sample schedule.
     nk, f, k = 1 << 16, 16, 8
     ht.random.seed(0)
     x = ht.random.randn(nk, f, split=0).astype(ht.float32)
@@ -86,58 +126,65 @@ def main():
         km.fit(x)
         return km
 
-    per, sp = _timeit(fit, lambda km: float(km.cluster_centers_.sum()), n_iter=1)
-    record("kmeans_lloyd", per / 10, sp, nk * f * 4.0, anchor_bw)
-
     # hsvd (matmul-anchored)
-    nh, fh = 1 << 16, 64
-    xh = ht.random.randn(nh, fh, split=0).astype(ht.float32)
-    float(xh.sum())
+    def bench_hsvd():
+        nh, fh = 1 << 16, 64
+        xh = ht.random.randn(nh, fh, split=0).astype(ht.float32)
+        float(xh.sum())
+        per, sp = _timeit(lambda: ht.linalg.hsvd_rank(xh, 10, compute_sv=False)[0],
+                          lambda u: float(u.sum()), n_iter=1)
+        record("hsvd", per, sp, 2.0 * nh * fh * fh, anchor_flops)
 
-    def fact():
-        u, s, verr = ht.linalg.hsvd_rank(xh, 10, compute_sv=False)
-        return s if hasattr(s, "sum") else u
-
-    per, sp = _timeit(lambda: ht.linalg.hsvd_rank(xh, 10, compute_sv=False)[0],
-                      lambda u: float(u.sum()), n_iter=1)
-    record("hsvd", per, sp, 2.0 * nh * fh * fh, anchor_flops)
+    guarded("hsvd", bench_hsvd)
 
     # fft3d 64^3 planar (stream-anchored, minimal 48B/el model)
-    os.environ["HEAT_TPU_PLANAR"] = "1"
-    s3 = 64
-    xf = ht.random.randn(s3, s3, s3, split=0).astype(ht.float32)
-    float(xf.sum())
+    def bench_fft():
+        os.environ["HEAT_TPU_PLANAR"] = "1"
+        s3 = 64
+        xf = ht.random.randn(s3, s3, s3, split=0).astype(ht.float32)
+        float(xf.sum())
 
-    def fft():
-        return ht.fft.fftn(xf)
+        def fft():
+            return ht.fft.fftn(xf)
 
-    def fetch_fft(r):
-        re, im = r._planar
-        return float(re[0, 0, 0])
+        def fetch_fft(r):
+            re, im = r._planar
+            return float(re[0, 0, 0])
 
-    per, sp = _timeit(fft, fetch_fft, n_iter=2)
-    record("fft3d_64", per, sp, 48.0 * s3**3, anchor_bw)
+        per, sp = _timeit(fft, fetch_fft, n_iter=2)
+        record("fft3d_64", per, sp, 48.0 * s3**3, anchor_bw)
+
+    guarded("fft3d_64", bench_fft)
 
     # distributed sort (stream-anchored; 2^18 keeps the CI job under a
     # minute — the PSRS program is the same shape at any extent)
-    xs = ht.random.randn(1 << 18, split=0).astype(ht.float32)
-    float(xs.sum())
-    per, sp = _timeit(lambda: ht.sort(xs)[0], lambda r: float(r[0]), n_iter=1, windows=3)
-    record("sort_psrs", per, sp, 4.0 * (1 << 18), anchor_bw)
+    def bench_sort():
+        xs = ht.random.randn(1 << 18, split=0).astype(ht.float32)
+        float(xs.sum())
+        per, sp = _timeit(lambda: ht.sort(xs)[0], lambda r: float(r[0]), n_iter=1, windows=3)
+        record("sort_psrs", per, sp, 4.0 * (1 << 18), anchor_bw)
+
+    guarded("sort_psrs", bench_sort)
 
     # sparse CSR ring SpMM (stream-anchored on the dense operand)
-    import scipy.sparse as sp_m
+    def bench_sparse():
+        import scipy.sparse as sp_m
 
-    A = sp_m.random(4096, 4096, density=0.01, random_state=0, format="csr", dtype=np.float64)
-    sa = ht.sparse.sparse_csr_matrix(A, split=0)
-    xd = ht.random.randn(4096, 64, split=0).astype(ht.float64)
-    float(xd.sum())
-    per, spd = _timeit(lambda: sa @ xd, lambda r: float(r[0, 0]), n_iter=2)
-    record("sparse_spmm_ring", per, spd, 8.0 * 4096 * 64, anchor_bw)
+        A = sp_m.random(4096, 4096, density=0.01, random_state=0, format="csr", dtype=np.float64)
+        sa = ht.sparse.sparse_csr_matrix(A, split=0)
+        xd = ht.random.randn(4096, 64, split=0).astype(ht.float64)
+        float(xd.sum())
+        per, spd = _timeit(lambda: sa @ xd, lambda r: float(r[0, 0]), n_iter=2)
+        record("sparse_spmm_ring", per, spd, 8.0 * 4096 * 64, anchor_bw)
+
+    guarded("sparse_spmm_ring", bench_sparse)
 
     # checkpoint save+restore roundtrip (stream-anchored on the state
     # bytes; catches resilience-layer overhead regressions — a lost
-    # atomic-rename batching or a doubled checksum pass shows up here)
+    # atomic-rename batching or a doubled checksum pass shows up here),
+    # measured INTERLEAVED with the kmeans lloyd iteration: the two gate
+    # metrics with the worst committed spreads take one window each per
+    # round so runner drift cancels instead of accumulating on one of them
     import shutil
     import tempfile
 
@@ -151,7 +198,7 @@ def main():
     }
     ck_dir = tempfile.mkdtemp(prefix="heat_tpu_ci_ck_")
     try:
-        ck = Checkpointer(ck_dir)
+        ck = Checkpointer(os.path.join(ck_dir, "sync"))
         step_box = {"i": 0}
 
         def ck_roundtrip():
@@ -159,10 +206,41 @@ def main():
             ck.save(step_box["i"], ck_state)
             return ck.restore(step_box["i"])
 
-        per, spd = _timeit(
-            ck_roundtrip, lambda r: float(r["state"][0, 0]), n_iter=2, windows=3
+        (km_per, km_sp), (ck_per, ck_sp) = _timeit_interleaved(
+            [
+                (fit, lambda km: float(km.cluster_centers_.sum()), 1),
+                (ck_roundtrip, lambda r: float(r["state"][0, 0]), 2),
+            ],
+            rounds=8,
         )
-        record("checkpoint_roundtrip", per, spd, 2.0 * ck_state["state"].nbytes, anchor_bw)
+        record("kmeans_lloyd", km_per / 10, km_sp, nk * f * 4.0, anchor_bw)
+        record("checkpoint_roundtrip", ck_per, ck_sp, 2.0 * ck_state["state"].nbytes, anchor_bw)
+
+        # async checkpoint stall (overlap layer): the caller-visible cost
+        # of one AsyncCheckpointer.save — snapshot + enqueue — for the
+        # same state; the write itself is drained outside the window.  A
+        # regression here (a snapshot that started copying device buffers
+        # synchronously, a lost back-pressure bound) erases the overlap
+        # win even while checkpoint_roundtrip stays healthy.
+        ack = Checkpointer(os.path.join(ck_dir, "async")).as_async()
+        ack.save(0, ck_state)
+        ack.wait()  # warm (directory creation, first staging)
+        stalls = []
+        for i in range(1, 11):
+            t0 = time.perf_counter()
+            ack.save(i, ck_state)
+            stalls.append(time.perf_counter() - t0)
+            ack.wait()
+        ack.close()
+        best = min(stalls)
+        med = float(np.median(stalls))
+        record(
+            "checkpoint_async_stall",
+            best,
+            round(100.0 * (med - best) / best if best else 0.0, 1),
+            ck_state["state"].nbytes,
+            anchor_bw,
+        )
     finally:
         shutil.rmtree(ck_dir, ignore_errors=True)
 

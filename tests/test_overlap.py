@@ -1,0 +1,585 @@
+"""Overlap-layer tests (ISSUE 3 tentpole).
+
+The contract under test (docs/overlap.md):
+
+* AsyncCheckpointer writes asynchronously with at most one save in
+  flight (back-pressure on overrun), re-raises writer errors at the next
+  save()/wait()/close(), and keeps every atomicity guarantee of the
+  synchronous checkpointer — a subprocess killed mid-async-write leaves
+  no partial step and resumes to the uninterrupted result bitwise;
+* resumable fits overlap checkpoint writes with the next on-device
+  chunk and still match the uninterrupted fit bitwise (sync fallback
+  via HEAT_TPU_ASYNC_CKPT=0 included);
+* prefetch_to_device preserves order, stages with the requested
+  sharding, propagates StopIteration, and feeds the shared
+  prefetch_hits/misses counters;
+* the windowed loader iterator works without h5py through the
+  read_window hook (tuple windows, transforms, error propagation via
+  the BaseException put path) and close() retires the worker thread
+  even when the ready queue is full (the PR 2 leak);
+* bucketed and fused gradient-reduction schedules produce identical
+  parameter updates (flat and hierarchical two-stage meshes), and
+  DataParallelOptimizer.blocking routes schedule selection.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.utils import overlap as ov
+from heat_tpu.utils.checkpoint import Checkpointer
+from heat_tpu.utils.data import prefetch_to_device, sharding_for_batch
+from heat_tpu.utils.data.partial_dataset import PartialH5DataLoaderIter
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_RETRY_NO_SLEEP", "1")
+
+
+# ----------------------------------------------------------------------
+# async checkpointing
+# ----------------------------------------------------------------------
+class TestAsyncCheckpointer:
+    def test_roundtrip_and_counters(self, tmp_path):
+        ov.reset_overlap_stats()
+        ack = Checkpointer(str(tmp_path / "ck")).as_async()
+        state = {"state": np.arange(32, dtype=np.float32), "n_iter": 3}
+        ack.save(3, state)
+        ack.save(7, {"state": np.arange(32, dtype=np.float32) * 2, "n_iter": 7})
+        assert ack.all_steps() == [3, 7]
+        got = ack.restore(7)
+        np.testing.assert_array_equal(got["state"], np.arange(32, dtype=np.float32) * 2)
+        ack.close()
+        s = ov.overlap_stats()
+        assert s["async_saves"] == 2
+
+    def test_snapshot_isolated_from_caller_mutation(self, tmp_path):
+        """The snapshot is consistent even if the caller mutates its numpy
+        state right after save() returns (the fit-loop contract)."""
+        ack = Checkpointer(str(tmp_path / "ck")).as_async()
+        arr = np.arange(16, dtype=np.float32)
+        ack.save(0, {"state": arr})
+        arr[:] = -1.0  # mutate while the write may still be in flight
+        ack.close()
+        np.testing.assert_array_equal(
+            ack.restore(0)["state"], np.arange(16, dtype=np.float32)
+        )
+
+    def test_device_state_snapshots_nonblocking(self, tmp_path):
+        ack = Checkpointer(str(tmp_path / "ck")).as_async()
+        dev = jnp.arange(64, dtype=jnp.float32) * 3
+        ack.save(1, {"state": dev, "n_iter": 1})
+        ack.close()
+        np.testing.assert_array_equal(ack.restore(1)["state"], np.asarray(dev))
+
+    def test_at_most_one_in_flight_backpressure(self, tmp_path, monkeypatch):
+        """A second save() during a slow write blocks until the first
+        completes — saves are never reordered or dropped."""
+        ck = Checkpointer(str(tmp_path / "ck"))
+        gate = threading.Event()
+        orig = ck.save
+        order = []
+
+        def slow_save(step, state, extra_metadata=None, async_=False):
+            gate.wait(timeout=10)
+            order.append(step)
+            return orig(step, state, extra_metadata)
+
+        monkeypatch.setattr(ck, "save", slow_save)
+        ack = ov.AsyncCheckpointer(ck)
+        ack.save(0, {"v": np.arange(4)})  # writer now blocked on the gate
+        t0 = time.perf_counter()
+        release = threading.Timer(0.2, gate.set)
+        release.start()
+        ack.save(1, {"v": np.arange(4)})  # must back-pressure on save 0
+        waited = time.perf_counter() - t0
+        ack.close()
+        release.cancel()
+        assert order == [0, 1]
+        assert waited >= 0.15  # blocked until the gate released save 0
+
+    def test_writer_error_reraised_at_next_call(self, tmp_path):
+        ack = Checkpointer(str(tmp_path / "ck")).as_async()
+        with rz.fault_plan({"checkpoint.async_write": [{"at": 0, "kind": "permanent"}]}) as inj:
+            ack.save(0, {"v": np.arange(4)})
+            with pytest.raises(rz.PermanentFault):
+                ack.wait()
+        assert inj.injected["checkpoint.async_write"] == [(0, "permanent")]
+        # the error was consumed; the checkpointer is usable again
+        ack.save(1, {"v": np.arange(4)})
+        ack.close()
+        assert ack.all_steps() == [1]
+
+    def test_writer_error_surfaces_at_next_save_and_close(self, tmp_path):
+        ack = Checkpointer(str(tmp_path / "ck")).as_async()
+        with rz.fault_plan({"checkpoint.async_write": [0, 1]}) as inj:
+            # transient faults are NOT retried across the async boundary
+            # transparently swallowed — they surface to the caller
+            ack.save(0, {"v": np.arange(4)})
+            with pytest.raises(rz.TransientFault):
+                ack.save(1, {"v": np.arange(4)})
+            ack.wait()  # save 1's write was never enqueued; nothing pending
+        assert inj.injected["checkpoint.async_write"] == [(0, "transient")]
+
+    def test_save_async_param_on_checkpointer(self, tmp_path):
+        ck = Checkpointer(str(tmp_path / "ck"))
+        ck.save(2, {"v": np.arange(6)}, async_=True)
+        # read side drains the internal async front end
+        assert ck.latest_step() == 2
+        np.testing.assert_array_equal(ck.restore(2)["v"], np.arange(6))
+        ck.close()
+
+    def test_transient_fault_in_write_path_still_retried(self, tmp_path):
+        """The writer thread runs the same io retry policy: a transient
+        checkpoint.save fault is absorbed, not surfaced."""
+        ack = Checkpointer(str(tmp_path / "ck")).as_async()
+        with rz.fault_plan({"checkpoint.save": [0]}) as inj:
+            ack.save(4, {"v": np.arange(3)})
+            ack.wait()  # no raise: retry absorbed the transient
+        assert inj.injected["checkpoint.save"] == [(0, "transient")]
+        assert ack.all_steps() == [4]
+
+    def test_context_manager(self, tmp_path):
+        with Checkpointer(str(tmp_path / "ck")).as_async() as ack:
+            ack.save(0, {"v": np.arange(2)})
+        assert Checkpointer(str(tmp_path / "ck")).all_steps() == [0]
+
+
+# ----------------------------------------------------------------------
+# async resumable fits
+# ----------------------------------------------------------------------
+def _data(n=240, f=6, seed=13):
+    ht.random.seed(seed)
+    return ht.random.randn(n, f, split=0).astype(ht.float32)
+
+
+class TestAsyncResumableFits:
+    def test_chunked_fit_uses_async_saves_and_matches_plain(self, tmp_path):
+        ov.reset_overlap_stats()
+        x = _data()
+        kw = dict(n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3)
+        plain = ht.cluster.KMeans(**kw).fit(x)
+        ck = ht.cluster.KMeans(**kw, checkpoint_every=5, checkpoint_dir=str(tmp_path)).fit(x)
+        assert np.array_equal(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(ck.cluster_centers_._dense()),
+        )
+        assert Checkpointer(str(tmp_path)).latest_step() == ck.n_iter_
+        assert ov.overlap_stats()["async_saves"] > 0  # the overlap path ran
+
+    def test_sync_fallback_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_ASYNC_CKPT", "0")
+        ov.reset_overlap_stats()
+        x = _data()
+        kw = dict(n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3)
+        plain = ht.cluster.KMeans(**kw).fit(x)
+        ck = ht.cluster.KMeans(**kw, checkpoint_every=5, checkpoint_dir=str(tmp_path)).fit(x)
+        assert np.array_equal(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(ck.cluster_centers_._dense()),
+        )
+        assert ov.overlap_stats()["async_saves"] == 0
+
+    def test_async_write_fault_surfaces_from_fit(self, tmp_path):
+        x = _data()
+        with rz.fault_plan({"checkpoint.async_write": [{"at": 0, "kind": "permanent"}]}):
+            with pytest.raises(rz.PermanentFault):
+                ht.cluster.KMeans(
+                    n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3,
+                    checkpoint_every=2, checkpoint_dir=str(tmp_path),
+                ).fit(x)
+
+    def test_subprocess_kill_mid_async_write_no_partial_step(self, tmp_path):
+        """Real preemption DURING an overlapped write: the env fault plan
+        os._exit-kills the child on the background writer thread inside
+        the second checkpoint's staged write (`checkpoint.write` fires
+        per file; index 2 is step 4's state.json).  No partial step may
+        be visible, and resuming must reproduce the uninterrupted fit
+        bitwise — extends the PR 2 kill test to the async path."""
+        d = str(tmp_path / "ck")
+        child = (
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"  # mirror conftest
+            "import heat_tpu as ht\n"
+            "ht.random.seed(13)\n"
+            "x = ht.random.randn(240, 6, split=0).astype(ht.float32)\n"
+            f"ht.cluster.KMeans(n_clusters=4, init='random', max_iter=40, tol=1e-4,\n"
+            f"                  random_state=3, checkpoint_every=2, checkpoint_dir={d!r}).fit(x)\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("HEAT_TPU_ASYNC_CKPT", None)  # async on (the default)
+        env["HEAT_TPU_FAULT_PLAN"] = json.dumps(
+            {"plan": {"checkpoint.write": [{"at": 2, "kind": "kill", "exit_code": 137}]}}
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=env, capture_output=True, timeout=300
+        )
+        assert proc.returncode == 137, proc.stderr.decode()[-2000:]
+        # the interrupted write left no torn step directory behind
+        steps = Checkpointer(d).all_steps()
+        assert steps == [2], steps
+        x = _data()
+        plain = ht.cluster.KMeans(
+            n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3
+        ).fit(x)
+        resumed = ht.cluster.KMeans(
+            n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3,
+            checkpoint_every=2, resume_from=d,
+        ).fit(x)
+        assert np.array_equal(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(resumed.cluster_centers_._dense()),
+        )
+
+    def test_pca_stage_writes_drained_on_fault(self, tmp_path):
+        """PCA's mean-stage write runs on the async writer; a solver-stage
+        fault must still leave the mean checkpoint durable (the fit
+        drains the writer on every exit path)."""
+        x = _data(64, 12, seed=11)
+        kw = dict(n_components=4, svd_solver="hierarchical", random_state=5)
+        d = str(tmp_path / "ck")
+        with rz.fault_plan({"pca.stage": [{"at": 1, "kind": "permanent"}]}):
+            with pytest.raises(rz.PermanentFault):
+                ht.decomposition.PCA(**kw, checkpoint_every=1, checkpoint_dir=d).fit(x)
+        assert Checkpointer(d).all_steps() == [0]
+        plain = ht.decomposition.PCA(**kw).fit(x)
+        resumed = ht.decomposition.PCA(**kw, checkpoint_every=1, resume_from=d).fit(x)
+        assert np.array_equal(
+            np.asarray(plain.components_._dense()),
+            np.asarray(resumed.components_._dense()),
+        )
+
+
+# ----------------------------------------------------------------------
+# device prefetch
+# ----------------------------------------------------------------------
+class TestPrefetchToDevice:
+    def test_order_and_stop_iteration(self):
+        src = (np.full((8, 2), i, np.float32) for i in range(7))
+        it = prefetch_to_device(src, size=2)
+        got = [float(b[0, 0]) for b in it]
+        assert got == [float(i) for i in range(7)]
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_sharding_applied(self):
+        comm = ht.get_comm()
+        sh = sharding_for_batch(comm.size * 2, comm)
+        assert sh is not None
+        out = list(prefetch_to_device(
+            (np.ones((comm.size * 2, 3), np.float32) for _ in range(3)),
+            size=2, sharding=sh,
+        ))
+        assert all(b.sharding == sh for b in out)
+
+    def test_ragged_batch_has_no_canonical_sharding(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("every extent tiles a single-device mesh")
+        assert sharding_for_batch(comm.size + 1, comm) is None
+
+    def test_counters_and_pytree_batches(self):
+        ov.reset_overlap_stats()
+        src = ({"x": np.full((4,), i, np.float32), "label": i} for i in range(5))
+        out = list(prefetch_to_device(src, size=2))
+        assert [b["label"] for b in out] == list(range(5))
+        assert float(out[2]["x"][0]) == 2.0
+        s = ov.overlap_stats()
+        assert s["prefetch_hits"] == 5  # all staged ahead by the look-ahead
+        assert s["prefetch_hit_rate"] == 1.0
+
+    def test_empty_iterator_and_bad_size(self):
+        assert list(prefetch_to_device(iter([]), size=2)) == []
+        with pytest.raises(ValueError):
+            prefetch_to_device(iter([]), size=0)
+
+    def test_dataloader_prefetch_wiring(self):
+        x = ht.arange(40, dtype=ht.float32, split=0).reshape((20, 2))
+        loader = ht.utils.data.DataLoader(x, batch_size=4, shuffle=False, prefetch=2)
+        seen = [np.asarray(b)[:, 0].tolist() for b in loader]
+        flat = [v for b in seen for v in b]
+        assert flat == [float(v) for v in range(0, 40, 2)]
+
+
+# ----------------------------------------------------------------------
+# windowed loader without h5py (synthetic read_window backend)
+# ----------------------------------------------------------------------
+class _SyntheticWindowed:
+    """PartialH5Dataset stand-in: the loader-iterator protocol (length /
+    load_length / transforms / dataset_names / comm / read_window)
+    backed by in-memory arrays — no h5py anywhere."""
+
+    def __init__(self, arrays, load_length=4, transforms=None, comm=None,
+                 fail_at_window=None, fail_with=None):
+        self.arrays = list(arrays)
+        self.dataset_names = [f"d{i}" for i in range(len(self.arrays))]
+        self.length = self.arrays[0].shape[0]
+        self.load_length = load_length
+        self.transforms = transforms
+        self.comm = comm
+        self.fail_at_window = fail_at_window
+        self.fail_with = fail_with or RuntimeError("backing store exploded")
+        self.reads = []
+
+    def read_window(self, start, stop):
+        self.reads.append((start, stop))
+        if self.fail_at_window is not None and start >= self.fail_at_window * self.load_length:
+            raise self.fail_with
+        return [np.asarray(a[start:stop]) for a in self.arrays]
+
+    def __iter__(self):
+        return PartialH5DataLoaderIter(self)
+
+
+class TestSyntheticWindowedLoader:
+    def test_single_dataset_windows_in_order(self):
+        data = np.arange(20, dtype=np.float32).reshape(10, 2)
+        ds = _SyntheticWindowed([data], load_length=4)
+        out = [np.asarray(w) for w in ds]
+        assert [w.shape[0] for w in out] == [4, 4, 2]
+        np.testing.assert_array_equal(np.concatenate(out), data)
+
+    def test_multi_dataset_tuple_windows_and_transforms(self):
+        xa = np.arange(12, dtype=np.float32).reshape(6, 2)
+        ya = np.arange(6, dtype=np.float32)
+        ds = _SyntheticWindowed([xa, ya], load_length=3, transforms=lambda a: a * 2)
+        wins = list(ds)
+        assert all(isinstance(w, tuple) and len(w) == 2 for w in wins)
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(w[0]) for w in wins]), xa * 2
+        )
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(w[1]) for w in wins]), ya * 2
+        )
+
+    def test_windows_staged_with_split_sharding(self):
+        comm = ht.get_comm()
+        data = np.ones((comm.size * 4, 3), np.float32)
+        ds = _SyntheticWindowed([data], load_length=comm.size * 2, comm=comm)
+        wins = list(ds)
+        assert all(w.sharding == comm.sharding(0) for w in wins)
+
+    def test_loader_error_propagates_to_consumer(self):
+        data = np.zeros((12, 2), np.float32)
+        ds = _SyntheticWindowed([data], load_length=4, fail_at_window=1)
+        it = iter(ds)
+        assert np.asarray(next(it)).shape == (4, 2)
+        with pytest.raises(RuntimeError, match="backing store exploded"):
+            for _ in it:
+                pass
+        assert it._thread is None  # errored iterator retired its worker
+
+    def test_base_exception_path(self):
+        """Even a KeyboardInterrupt on the loader thread surfaces at the
+        consumer instead of dying silently on the daemon thread."""
+        data = np.zeros((8, 2), np.float32)
+        ds = _SyntheticWindowed(
+            [data], load_length=4, fail_at_window=0, fail_with=KeyboardInterrupt()
+        )
+        with pytest.raises(KeyboardInterrupt):
+            next(iter(ds))
+
+    def test_close_with_full_ready_queue_retires_thread(self):
+        """PR 2 leak regression: with the ready queue full (maxsize=2)
+        the loader thread blocks in _ready.put and can never consume the
+        bare None sentinel; close() must drain pending windows until the
+        worker exits."""
+        data = np.zeros((64, 2), np.float32)
+        ds = _SyntheticWindowed([data], load_length=4)
+        it = iter(ds)  # window 0 read is queued on the worker
+        # fill both ready slots so the worker's put blocks (the state a
+        # stalled consumer reaches with staged windows it never takes)
+        it._ready.put(np.zeros((4, 2), np.float32))
+        it._ready.put(np.zeros((4, 2), np.float32))
+        deadline = time.monotonic() + 5
+        while not ds.reads and time.monotonic() < deadline:
+            time.sleep(0.01)  # worker picked up the read, heading for put
+        worker = it._thread
+        assert worker is not None and worker.is_alive()
+        it.close()
+        worker.join(timeout=5)
+        assert not worker.is_alive()
+        # idempotent + iteration after close terminates cleanly
+        it.close()
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_close_unconsumed_iterator(self):
+        data = np.zeros((40, 2), np.float32)
+        ds = _SyntheticWindowed([data], load_length=4)
+        it = iter(ds)  # primed, never consumed
+        worker = it._thread
+        it.close()
+        worker.join(timeout=5)
+        assert not worker.is_alive()
+
+
+# ----------------------------------------------------------------------
+# bucketed / fused gradient reduction
+# ----------------------------------------------------------------------
+def _mlp_setup(seed=0):
+    rng = np.random.default_rng(seed)
+    params = {
+        "w1": jnp.asarray(rng.normal(size=(8, 16)) * 0.1, jnp.float32),
+        "b1": jnp.zeros((16,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(size=(16, 2)) * 0.1, jnp.float32),
+        "b2": jnp.zeros((2,), jnp.float32),
+    }
+    x = jnp.asarray(rng.normal(size=(32, 8)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(32, 2)), jnp.float32)
+
+    def apply(p, xb):
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def loss_fn(pred, target):
+        return jnp.mean((pred - target) ** 2)
+
+    return params, x, y, apply, loss_fn
+
+
+class TestBucketedGradReduction:
+    def test_bucket_partition_reverse_order_byte_bound_and_dtype(self):
+        from heat_tpu.nn.data_parallel import bucket_partition
+
+        leaves = [
+            np.zeros((64,), np.float32),   # 256 B
+            np.zeros((8,), np.float32),    # 32 B
+            np.zeros((8,), np.float64),    # 64 B, different dtype
+            np.zeros((4,), np.float32),    # 16 B
+        ]
+        buckets = bucket_partition(leaves, 128)
+        # reverse order; dtype change splits; byte bound splits
+        assert buckets == [[3], [2], [1], [0]] or buckets[0][0] == 3
+        flat = [i for b in buckets for i in b]
+        assert flat == [3, 2, 1, 0]
+        for b in buckets:
+            assert len({str(leaves[i].dtype) for i in b}) == 1
+            assert sum(leaves[i].nbytes for i in b) <= 128 or len(b) == 1
+        # fused: unbounded, still dtype-pure
+        fused = bucket_partition(leaves, None)
+        assert [i for b in fused for i in b] == [3, 2, 1, 0]
+        assert all(len({str(leaves[i].dtype) for i in b}) == 1 for b in fused)
+
+    def test_bucketed_equals_fused_bitwise(self, monkeypatch):
+        import optax
+
+        monkeypatch.setenv("HEAT_TPU_GRAD_BUCKET_MB", "0.0001")  # force many buckets
+        params, x, y, apply, loss_fn = _mlp_setup()
+
+        def run(schedule):
+            dp = ht.nn.DataParallel(apply, optimizer=optax.sgd(0.1), grad_reduction=schedule)
+            dp.set_params(jax.tree_util.tree_map(lambda a: a.copy(), params))
+            losses = [dp.step(loss_fn, x, y) for _ in range(3)]
+            return losses, dp.params
+
+        ov.reset_overlap_stats()
+        loss_b, p_b = run("bucketed")
+        assert ov.overlap_stats()["grad_buckets"] > 1  # really bucketed
+        loss_f, p_f = run("fused")
+        assert loss_b == loss_f
+        for k in params:
+            assert np.array_equal(np.asarray(p_b[k]), np.asarray(p_f[k])), k
+
+    def test_explicit_matches_implicit_numerically(self):
+        import optax
+
+        params, x, y, apply, loss_fn = _mlp_setup()
+
+        def run(**kw):
+            dp = ht.nn.DataParallel(apply, optimizer=optax.sgd(0.1), **kw)
+            dp.set_params(jax.tree_util.tree_map(lambda a: a.copy(), params))
+            dp.step(loss_fn, x, y)
+            return dp.params
+
+        p_i, p_b = run(), run(grad_reduction="bucketed")
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p_i[k]), np.asarray(p_b[k]), rtol=2e-5, atol=1e-7
+            )
+
+    def test_hierarchical_two_stage_schedules_match(self):
+        import optax
+
+        comm = ht.parallel.HierarchicalCommunication()
+        if comm.num_nodes * comm.node_size < 2:
+            pytest.skip("needs a multi-device mesh")
+        params, x, y, apply, loss_fn = _mlp_setup()
+
+        def run(schedule):
+            dp = ht.nn.DataParallel(
+                apply, comm=comm, optimizer=optax.sgd(0.1), grad_reduction=schedule
+            )
+            dp.set_params(jax.tree_util.tree_map(lambda a: a.copy(), params))
+            dp.step(loss_fn, x, y)
+            return dp.params
+
+        p_b, p_f = run("bucketed"), run("fused")
+        for k in params:
+            assert np.array_equal(np.asarray(p_b[k]), np.asarray(p_f[k])), k
+
+    def test_dp_optimizer_blocking_routes_schedule(self):
+        import optax
+
+        apply = lambda p, xb: xb @ p["w"]
+        fused = ht.nn.DataParallel(
+            apply, optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1), blocking=True)
+        )
+        assert fused.grad_reduction == "fused"
+        bucketed = ht.nn.DataParallel(
+            apply, optimizer=ht.optim.DataParallelOptimizer(optax.sgd(0.1))
+        )
+        assert bucketed.grad_reduction == "bucketed"
+        # plain optax transform keeps the implicit schedule
+        assert ht.nn.DataParallel(apply, optimizer=optax.sgd(0.1)).grad_reduction == "implicit"
+        # blocking_parameter_updates maps to the fused explicit schedule
+        assert ht.nn.DataParallel(
+            apply, optimizer=optax.sgd(0.1), blocking_parameter_updates=True
+        ).grad_reduction == "fused"
+
+    def test_unknown_values_rejected(self):
+        import optax
+
+        with pytest.raises(ValueError):
+            ht.optim.DataParallelOptimizer(optax.sgd(0.1), blocking="yes")
+        with pytest.raises(ValueError):
+            ht.nn.DataParallel(lambda p, x: x, optimizer=optax.sgd(0.1), grad_reduction="wat")
+
+    def test_ragged_batch_falls_back_to_implicit_body(self):
+        import optax
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("every batch tiles a single-device mesh")
+        params, _, _, apply, loss_fn = _mlp_setup()
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(comm.size + 1, 8)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(comm.size + 1, 2)), jnp.float32)
+        dp = ht.nn.DataParallel(apply, optimizer=optax.sgd(0.1), grad_reduction="bucketed")
+        dp.set_params(params)
+        loss = dp.step(loss_fn, x, y)  # must not crash in shard_map
+        assert np.isfinite(loss)
+
+
+class TestOverlapStats:
+    def test_reset_and_derived_rate(self):
+        ov.reset_overlap_stats()
+        s = ov.overlap_stats()
+        assert s["async_saves"] == 0 and s["prefetch_hit_rate"] == 0.0
+        list(prefetch_to_device(iter([np.zeros(2)]), size=1))
+        assert ov.overlap_stats()["prefetch_hits"] == 1
+        ov.reset_overlap_stats()
+        assert ov.overlap_stats()["prefetch_hits"] == 0
