@@ -2,10 +2,13 @@
 
 Emits one JSON line per BASELINE config (smoke, KMeans, hSVD north star,
 DP-SGD, 3-D FFT, dispatch-amortization, resilience counters, overlap-layer
-stall/prefetch/bucket metrics), then a final summary line whose top-level fields are the
+stall/prefetch/bucket metrics, telemetry self-cost), then a final summary
+line whose top-level fields are the
 hSVD north star (so single-metric consumers keep working) with the whole
 grid attached under ``"all"`` — BENCH_r{N}.json then records every config
-each round and rounds stay comparable (BASELINE.md targets table).
+each round and rounds stay comparable (BASELINE.md targets table).  Every
+config record embeds the telemetry registry snapshot at its end
+(``"telemetry"`` key, docs/observability.md).
 
 Timing methodology (tunneled-chip aware): every measurement enqueues
 ``n_iter`` programs and fetches one scalar at the end — the device
@@ -886,6 +889,55 @@ def bench_overlap(ht, sync_floor, roofline=None):
     }
 
 
+def bench_telemetry(ht, sync_floor, roofline=None):
+    """Config 9: telemetry-layer self-cost (ISSUE 4).
+
+    ``span_ns_enabled``/``span_ns_disabled`` — per-span wall cost of the
+    host-side tracer with recording on vs off (disabled must be ~two
+    attribute reads; enabled buys a ring append + TraceAnnotation).
+    ``snapshot_us`` — cost of one full-registry ``telemetry.snapshot()``
+    with every domain registered, the price a heartbeat scraper pays.
+    The headline value is the enabled span cost — the number that bounds
+    how densely the stack can afford to be instrumented."""
+    from heat_tpu import telemetry
+
+    def span_ns(n: int = 50_000) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with telemetry.span("bench.telemetry.probe"):
+                pass
+        return (time.perf_counter() - t0) / n * 1e9
+
+    prev = telemetry.set_tracing(True)
+    try:
+        span_ns(2_000)  # warm
+        enabled_ns = min(span_ns() for _ in range(3))
+        telemetry.set_tracing(False)
+        disabled_ns = min(span_ns() for _ in range(3))
+    finally:
+        telemetry.set_tracing(prev)
+        telemetry.clear_spans()
+
+    n_snap = 500
+    telemetry.snapshot()  # warm
+    t0 = time.perf_counter()
+    for _ in range(n_snap):
+        telemetry.snapshot()
+    snapshot_us = (time.perf_counter() - t0) / n_snap * 1e6
+
+    return {
+        "metric": "telemetry_span_ns",
+        "value": round(enabled_ns, 1),
+        "unit": "ns",
+        "vs_baseline": round(disabled_ns / enabled_ns, 4) if enabled_ns else 0.0,
+        "vs_baseline_kind": "tracing_disabled_same_process",
+        "span_ns_enabled": round(enabled_ns, 1),
+        "span_ns_disabled": round(disabled_ns, 1),
+        "snapshot_us": round(snapshot_us, 2),
+        "metrics_registered": len(telemetry.REGISTRY.names()),
+    }
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -899,7 +951,7 @@ def main() -> None:
         roofline = None
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
-                  bench_dispatch, bench_resilience, bench_overlap):
+                  bench_dispatch, bench_resilience, bench_overlap, bench_telemetry):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)
@@ -911,6 +963,10 @@ def main() -> None:
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}"[:200],
             }
+        # every config embeds the registry state at its end: the bench
+        # artifact doubles as a telemetry regression record (comm bytes,
+        # compile time, cache traffic per config)
+        r["telemetry"] = ht.telemetry.snapshot(include_zero=False)
         results.append(r)
         print(json.dumps(r), flush=True)
 

@@ -10,6 +10,7 @@ it on any mesh size — single TPU chip, a pod slice, or a virtual CPU mesh:
 
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
 
@@ -33,7 +34,9 @@ def main() -> None:
         ("KMedians", ht.cluster.KMedians(n_clusters=4, init="kmeans++", random_state=7)),
         ("KMedoids", ht.cluster.KMedoids(n_clusters=4, init="kmeans++", random_state=7)),
     ):
+        t0 = time.perf_counter()
         labels = estimator.fit_predict(data)
+        fit_s = time.perf_counter() - t0
         centers = estimator.cluster_centers_.numpy()
         # match each estimated center to its nearest generating center
         d = np.linalg.norm(centers[:, None, :] - reference_centers[None, :, :], axis=2)
@@ -42,6 +45,9 @@ def main() -> None:
         print(f"  centers:\n{np.round(centers, 2)}")
         counts = np.bincount(labels.numpy().astype(int).ravel(), minlength=4)
         print(f"  cluster sizes: {counts.tolist()}")
+        # one-line observability summary: cumulative collective traffic,
+        # XLA compile wall time, and this fit's iteration rate
+        print(f"  {ht.telemetry.summary_line(estimator.n_iter_ / fit_s)}")
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ matplotlib is available.
 
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
 
@@ -29,13 +30,19 @@ def main() -> None:
 
     lambdas = np.logspace(0, 4, 10) / 10
     theta_path = []
+    total_iters = 0
+    t0 = time.perf_counter()
     for lam in lambdas:
         estimator = Lasso(lam=float(lam), max_iter=100)
         estimator.fit(X, y)
+        total_iters += int(estimator.n_iter or 0)
         theta = estimator.theta.numpy().ravel()
         theta_path.append(theta)
         nnz = int((np.abs(theta[1:]) > 1e-10).sum())
         print(f"lambda={lam:8.2f}: {nnz:2d} active features, |theta|_1={np.abs(theta[1:]).sum():.3f}")
+    sweep_s = time.perf_counter() - t0
+    # one-line observability summary over the whole path sweep
+    print(ht.telemetry.summary_line(total_iters / sweep_s if sweep_s > 0 else None))
 
     # drop the intercept row, features x lambdas
     theta_lasso = np.stack(theta_path).T[1:, :]

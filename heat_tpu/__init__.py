@@ -32,6 +32,7 @@ from . import nn
 from . import optim
 from . import resilience
 from . import sparse
+from . import telemetry
 from . import utils
 from . import datasets
 

@@ -7,6 +7,7 @@ segment-sum expression on the sharded global array; XLA emits the psum.
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 from typing import Optional, Union
 
@@ -131,6 +132,19 @@ class KMeans(_KCluster):
             resume_from=resume_from,
         )
 
+    def _account_lloyd_psum(self, x: DNDarray, xp):
+        """Telemetry model of the GSPMD psum behind one launched Lloyd
+        program: the per-cluster partial sums (k, f) plus counts (k,)
+        reduced across the sample-split shards (`sums = oh.T @ xp` —
+        XLA inserts the collective; this layer never issues it, so the
+        comm accounting happens here at launch).  Returns a ``comm.psum``
+        span to wrap the launch with; a no-op for replicated input."""
+        if x.split is None or x.comm.size <= 1:
+            return contextlib.nullcontext()
+        k = self.n_clusters
+        nbytes = (k * int(xp.shape[1]) + k) * xp.dtype.itemsize
+        return x.comm.account_implicit("psum", nbytes, site="kmeans.lloyd")
+
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
         """New centers = per-cluster mean (kmeans.py:80-120)."""
         dense = x._dense()
@@ -160,10 +174,11 @@ class KMeans(_KCluster):
             xp = xp.astype(jnp.float32)
         centers = self._cluster_centers._dense().astype(xp.dtype)
         dispatch.record_external_dispatch()  # one launch per Lloyd step
-        if kernels.LLOYD_KERNEL and kernels.lloyd_supported(xp.shape[1], self.n_clusters):
-            new, shift, _ = kernels.lloyd_update(x, centers)
-        else:
-            new, shift = _lloyd_update(xp, centers, x.shape[0], self.n_clusters)
+        with self._account_lloyd_psum(x, xp):
+            if kernels.LLOYD_KERNEL and kernels.lloyd_supported(xp.shape[1], self.n_clusters):
+                new, shift, _ = kernels.lloyd_update(x, centers)
+            else:
+                new, shift = _lloyd_update(xp, centers, x.shape[0], self.n_clusters)
         self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
         return shift
 
@@ -174,7 +189,8 @@ class KMeans(_KCluster):
             xp = xp.astype(jnp.float32)
         centers = self._cluster_centers._dense().astype(xp.dtype)
         dispatch.record_external_dispatch()
-        labels, _, _, inertia = _lloyd_step(xp, centers, x.shape[0], self.n_clusters)
+        with self._account_lloyd_psum(x, xp):
+            labels, _, _, inertia = _lloyd_step(xp, centers, x.shape[0], self.n_clusters)
         return labels, inertia
 
     def fit(self, x: DNDarray) -> "KMeans":
@@ -197,10 +213,11 @@ class KMeans(_KCluster):
 
             def run_chunk(centers, n):
                 dispatch.record_external_dispatch()
-                return _lloyd_loop(
-                    xp, jnp.asarray(centers, dtype), x.shape[0],
-                    self.n_clusters, n, float(self.tol),
-                )
+                with self._account_lloyd_psum(x, xp):
+                    return _lloyd_loop(
+                        xp, jnp.asarray(centers, dtype), x.shape[0],
+                        self.n_clusters, n, float(self.tol),
+                    )
 
             def init_centers():
                 self._initialize_cluster_centers(x)
@@ -233,9 +250,10 @@ class KMeans(_KCluster):
             # dispatch for the whole fit, however many Lloyd iterations —
             # the dispatch-amortization invariant the micro-test pins.
             dispatch.record_external_dispatch()
-            new, n_iter_dev, _ = _lloyd_loop(
-                xp, centers, x.shape[0], self.n_clusters, self.max_iter, float(self.tol)
-            )
+            with self._account_lloyd_psum(x, xp):
+                new, n_iter_dev, _ = _lloyd_loop(
+                    xp, centers, x.shape[0], self.n_clusters, self.max_iter, float(self.tol)
+                )
             self._cluster_centers = DNDarray.from_dense(new, None, x.device, x.comm)
             n_iter = n_iter_dev
 

@@ -90,12 +90,21 @@ def resumable_fit_loop(
     ``HEAT_TPU_ASYNC_CKPT=0`` restores fully synchronous saves.
     """
     import sys as _sys
+    import time as _time
 
     from ..resilience.errors import DivergenceError  # lazy: avoid import cycles
     from ..resilience.faults import inject
     from ..resilience.guard import all_finite
+    from ..telemetry import metrics as _tm
+    from ..telemetry.spans import span as _span
     from ..utils.checkpoint import Checkpointer
     from ..utils.overlap import async_checkpoint_enabled
+
+    # fit heartbeat: iterations/s of the most recent chunk and its
+    # convergence delta, refreshed at every chunk boundary so a stalled
+    # or diverging long fit is visible from telemetry.snapshot()
+    iter_rate_g = _tm.gauge("fit.iter_rate", "iterations/s of the last fit chunk")
+    shift_g = _tm.gauge("fit.shift", "convergence delta of the last fit chunk")
 
     ckpt = None
     directory = checkpoint_dir or resume_from
@@ -125,9 +134,17 @@ def resumable_fit_loop(
     try:
         while total < max_iter:
             n = min(chunk, max_iter - total)
-            new_state, iters_dev, shift_dev = run_chunk(state, n)
-            iters = int(iters_dev)
-            shift = float(shift_dev)
+            t0 = _time.perf_counter()
+            # heartbeat span: one per chunk, attrs filled in once the
+            # chunk's device values are known
+            with _span("fit.chunk", site=site) as sp:
+                new_state, iters_dev, shift_dev = run_chunk(state, n)
+                iters = int(iters_dev)
+                shift = float(shift_dev)
+            elapsed = _time.perf_counter() - t0
+            sp.attrs.update(iters=iters, shift=shift, total=total + iters)
+            iter_rate_g.set(iters / elapsed if elapsed > 0 else 0.0)
+            shift_g.set(shift)
             total += iters
             if ckpt is not None:
                 # the previous chunk's async write overlapped this

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 import warnings
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
@@ -62,6 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..resilience.faults import inject as _inject
+from ..telemetry import metrics as _tm
+from ..telemetry.spans import span as _span
 
 __all__ = [
     "PendingExpr",
@@ -103,16 +106,34 @@ def fusion_enabled() -> bool:
 
 
 # ----------------------------------------------------------------------
-# counters + cache
+# counters + cache.  The counters live in the shared telemetry registry
+# (``telemetry.snapshot()`` reports them as ``dispatch.*`` alongside the
+# resilience/overlap/comm domains); :func:`cache_stats` is a thin
+# byte-compatible view over them.
 # ----------------------------------------------------------------------
-_ZERO = dict(hits=0, misses=0, dispatches=0, fused_ops=0, donations=0,
-             external_dispatches=0, compile_fallbacks=0)
-_counters = dict(_ZERO)
+_COUNTER_NAMES = ("hits", "misses", "dispatches", "fused_ops", "donations",
+                  "external_dispatches", "compile_fallbacks")
+_C = {n: _tm.counter(f"dispatch.{n}") for n in _COUNTER_NAMES}
+
+#: per-compile wall time (jit trace + XLA compile + first execution of a
+#: fresh cache entry), milliseconds
+_COMPILE_MS = _tm.histogram(
+    "dispatch.compile_ms", "wall time of compile+first-run per cache miss"
+)
 
 #: LRU of compiled executables.  Bounded because op callables created
 #: inline (lambdas/partials) key by object identity and would otherwise
 #: accumulate one dead entry per call.
 _cache: "OrderedDict[Any, Callable]" = OrderedDict()
+
+_tm.gauge("dispatch.cache_size", "live compiled-executable cache entries",
+          fn=lambda: len(_cache))
+_tm.gauge(
+    "dispatch.hit_rate", "hits / (hits + misses), 0.0 before any lookup",
+    fn=lambda: (
+        _C["hits"].value / t if (t := _C["hits"].value + _C["misses"].value) else 0.0
+    ),
+)
 
 #: (op, arg avals, kwargs) -> ShapeDtypeStruct; jax.eval_shape costs
 #: ~1 ms per call, far too slow to pay per dispatch.
@@ -132,8 +153,12 @@ def cache_stats() -> dict:
     ``fusion.jit``).  ``compile_fallbacks`` counts compiled executions
     that failed (trace/compile error, injected compile fault) and were
     re-run eagerly instead of crashing the op.  ``hit_rate`` is
-    hits / (hits + misses), 0.0 before any lookup."""
-    s = dict(_counters)
+    hits / (hits + misses), 0.0 before any lookup.
+
+    A thin view over the shared telemetry registry (the counters live
+    there as ``dispatch.*``); ``telemetry.snapshot()`` reports the same
+    values alongside every other domain."""
+    s = {n: _C[n].value for n in _COUNTER_NAMES}
     total = s["hits"] + s["misses"]
     s["hit_rate"] = (s["hits"] / total) if total else 0.0
     s["cache_size"] = len(_cache)
@@ -141,8 +166,11 @@ def cache_stats() -> dict:
 
 
 def reset_stats() -> None:
-    """Zero all counters (the compiled cache itself is kept)."""
-    _counters.update(_ZERO)
+    """Zero all dispatch counters (the compiled cache itself is kept);
+    delegates to ``telemetry.reset_all("dispatch")``."""
+    from ..telemetry import reset_all
+
+    reset_all("dispatch")
 
 
 def clear_cache() -> None:
@@ -155,11 +183,11 @@ def clear_cache() -> None:
 def record_external_dispatch(n: int = 1) -> None:
     """Count ``n`` executable launches made outside this layer (consumers
     with their own jitted programs: kmeans/lasso loops, ``fusion.jit``)."""
-    _counters["external_dispatches"] += n
+    _C["external_dispatches"].inc(n)
 
 
 def _note_lookup(hit: bool) -> None:
-    _counters["hits" if hit else "misses"] += 1
+    _C["hits" if hit else "misses"].inc()
 
 
 # ----------------------------------------------------------------------
@@ -347,11 +375,15 @@ def _eval_nodes(nodes, leaves):
 
 
 def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
+    """Cached jitted executable for ``key``; returns ``(entry, fresh)``
+    where ``fresh`` marks a miss — the first execution of a fresh entry
+    pays trace+compile, which :func:`_run` times into the
+    ``dispatch.compile_ms`` histogram."""
     entry = _cache.get(key)
     if entry is not None:
         _cache.move_to_end(key)
         _note_lookup(True)
-        return entry
+        return entry, False
     _note_lookup(False)
     _inject("dispatch.compile")
     jit_kwargs: dict = {}
@@ -363,20 +395,33 @@ def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
     _cache[key] = entry
     while len(_cache) > _CACHE_MAXSIZE:
         _cache.popitem(last=False)
-    return entry
+    return entry, True
 
 
-def _run(compiled, leaves, n_ops: int, donated: bool = False):
-    _counters["dispatches"] += 1
-    _counters["fused_ops"] += n_ops
+def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = False):
+    _C["dispatches"].inc()
+    _C["fused_ops"].inc(n_ops)
     if donated:
-        _counters["donations"] += 1
-        with warnings.catch_warnings():
-            # XLA may decline an unusable donation (layout mismatch);
-            # that is a perf note, not a user-facing condition
-            warnings.filterwarnings("ignore", message=".*[Dd]onat")
-            return compiled(*leaves)
-    return compiled(*leaves)
+        _C["donations"].inc()
+
+    def call():
+        if donated:
+            with warnings.catch_warnings():
+                # XLA may decline an unusable donation (layout mismatch);
+                # that is a perf note, not a user-facing condition
+                warnings.filterwarnings("ignore", message=".*[Dd]onat")
+                return compiled(*leaves)
+        return compiled(*leaves)
+
+    if not fresh:
+        return call()
+    # cache miss: the first call traces + compiles; record the wall time
+    # so ``where did the compile time go?`` is answerable from telemetry
+    t0 = time.perf_counter()
+    with _span("dispatch.compile", ops=n_ops):
+        out = call()
+    _COMPILE_MS.observe((time.perf_counter() - t0) * 1e3)
+    return out
 
 
 def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=None):
@@ -392,10 +437,10 @@ def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=No
     come through here: a partially-run donated program may have
     consumed its input, making re-execution unsafe."""
     try:
-        compiled = _get_compiled(key, builder, out_sharding=out_sharding)
-        return _run(compiled, leaves, n_ops)
+        compiled, fresh = _get_compiled(key, builder, out_sharding=out_sharding)
+        return _run(compiled, leaves, n_ops, fresh=fresh)
     except Exception as e:
-        _counters["compile_fallbacks"] += 1
+        _C["compile_fallbacks"].inc()
         _cache.pop(key, None)
         warnings.warn(
             f"dispatch: compiled execution failed ({type(e).__name__}: {e}); "
@@ -663,8 +708,8 @@ def repad(buf, old_slice, pad_widths, sharding, donate: bool = False):
             key, build, (buf,), 1,
             lambda: jax.device_put(build()(buf), sharding), out_sharding=sharding,
         )
-    compiled = _get_compiled(key, build, donate_argnums=(0,), out_sharding=sharding)
-    return _run(compiled, (buf,), 1, donated=True)
+    compiled, fresh = _get_compiled(key, build, donate_argnums=(0,), out_sharding=sharding)
+    return _run(compiled, (buf,), 1, donated=True, fresh=fresh)
 
 
 def cast_store(dst_buf, src, dtype, out_sharding=None):
@@ -747,7 +792,7 @@ def cast_store(dst_buf, src, dtype, out_sharding=None):
             key, build, leaves, len(nodes),
             lambda: _eval_nodes(nodes, leaves), out_sharding=out_sharding,
         )
-    compiled = _get_compiled(
+    compiled, fresh = _get_compiled(
         key, build, donate_argnums=(donate_ix,), out_sharding=out_sharding
     )
-    return _run(compiled, leaves, len(nodes), donated=True)
+    return _run(compiled, leaves, len(nodes), donated=True, fresh=fresh)

@@ -18,6 +18,7 @@ from ..core.base import BaseEstimator, TransformMixin, lazy_scalar_property, val
 from ..core.dndarray import DNDarray
 from ..core.linalg.svd import svd as _exact_svd
 from ..core.linalg import svdtools
+from ..telemetry.spans import span as _span
 
 __all__ = ["PCA"]
 
@@ -154,11 +155,13 @@ class PCA(BaseEstimator, TransformMixin):
         # async stage writes are drained on every exit path, so a
         # caller (or a test) listing the checkpoint directory right
         # after fit() raises/returns sees a deterministic step set
+        solver_span = None
         try:
             n, f = X.shape
             if restored_mean is None:
                 inject("pca.stage", stage="mean")
-                mean = statistics.mean(X, axis=0)
+                with _span("pca.stage", stage="mean"):
+                    mean = statistics.mean(X, axis=0)
                 self.mean_ = mean
                 if writer is not None:
                     # device reference, not a host copy: the snapshot is free and
@@ -168,6 +171,10 @@ class PCA(BaseEstimator, TransformMixin):
                 mean = DNDarray.from_dense(jnp.asarray(restored_mean), None, X.device, X.comm)
                 self.mean_ = mean
             inject("pca.stage", stage="solver")
+            # stage heartbeat; closed in the finally so an aborted solve
+            # still records its span (and never leaks nesting depth)
+            solver_span = _span("pca.stage", stage="solver", solver=self.svd_solver)
+            solver_span.__enter__()
             centered = X - mean
 
             if self.random_state is not None:
@@ -232,6 +239,8 @@ class PCA(BaseEstimator, TransformMixin):
                 writer.save(_STAGE_FITTED, self._fitted_payload())
             return self
         finally:
+            if solver_span is not None:
+                solver_span.__exit__(*sys.exc_info())
             if writer is not None:
                 if sys.exc_info()[0] is None:
                     writer.close()

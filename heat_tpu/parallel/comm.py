@@ -49,6 +49,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import default_init_policy as _init_policy
+from ..telemetry import metrics as _tm
+from ..telemetry.spans import span as _span
 
 __all__ = [
     "Communication",
@@ -71,6 +73,40 @@ SPLIT_AXIS_NAME = "split"
 #: (DCN in a multi-slice pod), 'node' spans the devices within one node (ICI).
 GLOBAL_AXIS_NAME = "global"
 NODE_AXIS_NAME = "node"
+
+# ----------------------------------------------------------------------
+# collective volume accounting (telemetry).  Collectives are invoked at
+# TRACE time (inside shard_map bodies under jit), so the counts are a
+# static model of the compiled program's communication — payload bytes
+# x participants per issued collective, not a wire measurement.  A
+# program traced once and re-executed from the jit cache accounts its
+# collectives exactly once, which is what makes the counts
+# deterministic and comparable across runs.
+# ----------------------------------------------------------------------
+_COMM_COUNTERS: dict = {}
+
+
+def _comm_counters(op: str):
+    pair = _COMM_COUNTERS.get(op)
+    if pair is None:
+        pair = _COMM_COUNTERS[op] = (
+            _tm.counter(f"comm.calls.{op}", f"{op} collectives issued (trace time)"),
+            _tm.counter(
+                f"comm.bytes.{op}", f"{op} payload bytes x participants (trace time)"
+            ),
+        )
+    return pair
+
+
+def _payload_nbytes(x) -> int:
+    """Total payload bytes of a (possibly traced) array or pytree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        try:
+            total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        except Exception:
+            pass
+    return total
 
 
 class Communication:
@@ -330,40 +366,90 @@ class Communication:
     # Send/Recv/Allreduce/... (communication.py:494-2186).
     # Every entry evaluates the ``comm.collective`` fault-injection
     # point (trace-time, so the compiled program itself is unaffected) —
-    # the hook a fault plan uses to script a lost-collective scenario.
+    # the hook a fault plan uses to script a lost-collective scenario —
+    # and accounts its payload into the telemetry registry
+    # (``comm.bytes.{op}`` / ``comm.calls.{op}``, see the module-level
+    # accounting note) while running under a ``comm.{op}`` span.
     # ------------------------------------------------------------------
+    def _axis_size(self, axis_name) -> int:
+        """Participant count along ``axis_name`` (axis-name tuples — the
+        hierarchical default — multiply out)."""
+        names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+        try:
+            shape = dict(self.mesh.shape)
+            n = 1
+            for nm in names:
+                n *= int(shape.get(nm, 1))
+            return n
+        except Exception:
+            return self.size
+
+    def _account(self, op: str, x, axis_name):
+        """Record one issued collective; returns a ``comm.{op}`` span
+        (trace-time wall clock) carrying the byte model as attrs."""
+        _inject("comm.collective", op=op)
+        participants = self._axis_size(axis_name)
+        nbytes = _payload_nbytes(x) * participants
+        calls, byts = _comm_counters(op)
+        calls.inc()
+        byts.inc(nbytes)
+        return _span(f"comm.{op}", bytes=nbytes, participants=participants)
+
+    def account_implicit(self, op: str, nbytes: int, axis_name=None, **attrs):
+        """Account a GSPMD-*inferred* collective this layer never issues
+        explicitly — e.g. the psum XLA inserts behind a segment sum over
+        the split axis in the kmeans centroid update.  Same counters and
+        ``comm.{op}`` span as the explicit collectives (the span attrs
+        carry ``implicit=True``); ``nbytes`` is the per-participant
+        payload, scaled by the participant count like the explicit
+        model.  Returns the span as a context manager — wrap the
+        launching call so the trace attributes the program to it."""
+        participants = self._axis_size(axis_name or self.axis_name)
+        total = int(nbytes) * participants
+        calls, byts = _comm_counters(op)
+        calls.inc()
+        byts.inc(total)
+        return _span(
+            f"comm.{op}", bytes=total, participants=participants,
+            implicit=True, **attrs,
+        )
+
     def psum(self, x, axis_name: Optional[str] = None):
-        _inject("comm.collective", op="psum")
-        return jax.lax.psum(x, axis_name or self.axis_name)
+        name = axis_name or self.axis_name
+        with self._account("psum", x, name):
+            return jax.lax.psum(x, name)
 
     def pmax(self, x, axis_name: Optional[str] = None):
-        _inject("comm.collective", op="pmax")
-        return jax.lax.pmax(x, axis_name or self.axis_name)
+        name = axis_name or self.axis_name
+        with self._account("pmax", x, name):
+            return jax.lax.pmax(x, name)
 
     def pmin(self, x, axis_name: Optional[str] = None):
-        _inject("comm.collective", op="pmin")
-        return jax.lax.pmin(x, axis_name or self.axis_name)
+        name = axis_name or self.axis_name
+        with self._account("pmin", x, name):
+            return jax.lax.pmin(x, name)
 
     def all_gather(self, x, axis: int = 0, axis_name: Optional[str] = None, tiled: bool = True):
-        _inject("comm.collective", op="all_gather")
-        return jax.lax.all_gather(x, axis_name or self.axis_name, axis=axis, tiled=tiled)
+        name = axis_name or self.axis_name
+        with self._account("all_gather", x, name):
+            return jax.lax.all_gather(x, name, axis=axis, tiled=tiled)
 
     def all_to_all(self, x, split_axis: int, concat_axis: int, axis_name: Optional[str] = None):
-        _inject("comm.collective", op="all_to_all")
-        return jax.lax.all_to_all(
-            x, axis_name or self.axis_name, split_axis=split_axis,
-            concat_axis=concat_axis, tiled=True,
-        )
+        name = axis_name or self.axis_name
+        with self._account("all_to_all", x, name):
+            return jax.lax.all_to_all(
+                x, name, split_axis=split_axis, concat_axis=concat_axis, tiled=True,
+            )
 
     def psum_scatter(self, x, axis_name: Optional[str] = None, scatter_dimension: int = 0):
         """Reduce-scatter: the sum lands shard-wise instead of replicated
         (the reference's Reduce_scatter, communication.py; the sparse
         SpMM meet-step uses it directly)."""
-        _inject("comm.collective", op="psum_scatter")
-        return jax.lax.psum_scatter(
-            x, axis_name or self.axis_name,
-            scatter_dimension=scatter_dimension, tiled=True,
-        )
+        name = axis_name or self.axis_name
+        with self._account("psum_scatter", x, name):
+            return jax.lax.psum_scatter(
+                x, name, scatter_dimension=scatter_dimension, tiled=True,
+            )
 
     def pscan(self, x, axis_name: Optional[str] = None, inclusive: bool = True):
         """Prefix sum over mesh ranks (the reference's Scan / Exscan,
@@ -372,39 +458,45 @@ class Communication:
         additive identity, so no masking is needed.  The round count and
         rank range come from the NAMED axis (an override may address a
         sub-axis whose size differs from ``self.size``)."""
-        _inject("comm.collective", op="pscan")
         name = axis_name or self.axis_name
         n = int(dict(self.mesh.shape)[name]) if name != self.axis_name else self.size
-        acc = x
-        shift = 1
-        while shift < n:
-            prev = jax.lax.ppermute(
-                acc, name, [(i, i + shift) for i in range(n - shift)]
-            )
-            acc = acc + prev
-            shift *= 2
-        if inclusive:
-            return acc
-        # exclusive scan: the inclusive result of the previous rank
-        # (rank 0 receives the zero fill — MPI's Exscan leaves rank 0
-        # undefined; zero is this layer's defined value)
-        return jax.lax.ppermute(acc, name, [(i, i + 1) for i in range(n - 1)])
+        # one account entry covers the whole log2(n)-round ladder (plus
+        # the shift round of an exclusive scan): bytes scale by rounds
+        rounds = max(n - 1, 0).bit_length() + (0 if inclusive else 1)
+        op = "pscan" if inclusive else "exscan"
+        with self._account(op, [x] * rounds, name):
+            acc = x
+            shift = 1
+            while shift < n:
+                prev = jax.lax.ppermute(
+                    acc, name, [(i, i + shift) for i in range(n - shift)]
+                )
+                acc = acc + prev
+                shift *= 2
+            if inclusive:
+                return acc
+            # exclusive scan: the inclusive result of the previous rank
+            # (rank 0 receives the zero fill — MPI's Exscan leaves rank 0
+            # undefined; zero is this layer's defined value)
+            return jax.lax.ppermute(acc, name, [(i, i + 1) for i in range(n - 1)])
 
     def exscan(self, x, axis_name: Optional[str] = None):
         """Exclusive prefix sum (zero at rank 0)."""
         return self.pscan(x, axis_name, inclusive=False)
 
     def ppermute(self, x, perm, axis_name: Optional[str] = None):
-        _inject("comm.collective", op="ppermute")
-        return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
+        name = axis_name or self.axis_name
+        with self._account("ppermute", x, name):
+            return jax.lax.ppermute(x, name, perm=perm)
 
     def ring_shift(self, x, shift: int = 1, axis_name: Optional[str] = None):
         """Cyclic shift by ``shift`` ranks (the ring primitive behind the
         reference's spatial ring in distance.py:209 and roll)."""
-        _inject("comm.collective", op="ring_shift")
+        name = axis_name or self.axis_name
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
-        return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
+        with self._account("ring_shift", x, name):
+            return jax.lax.ppermute(x, name, perm=perm)
 
     def axis_index(self, axis_name: Optional[str] = None):
         return jax.lax.axis_index(axis_name or self.axis_name)

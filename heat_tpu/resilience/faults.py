@@ -63,6 +63,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from .errors import PermanentFault, TransientFault
+from ..telemetry import metrics as _tm
 
 __all__ = [
     "FaultInjector",
@@ -76,10 +77,11 @@ __all__ = [
 
 PLAN_ENV = "HEAT_TPU_FAULT_PLAN"
 
-#: process-lifetime totals (survive injector deactivation) — the bench
-#: resilience record reads these
-_TOTALS = {"sites_evaluated": 0, "faults_injected": 0}
-_TOTALS_LOCK = threading.Lock()
+#: process-lifetime totals (survive injector deactivation) — registered
+#: in the shared telemetry registry as ``fault.*``; the bench resilience
+#: record and ``telemetry.snapshot()`` both read them
+_SITES_EVALUATED = _tm.counter("fault.sites_evaluated")
+_FAULTS_INJECTED = _tm.counter("fault.faults_injected")
 
 
 def _normalize_rule(rule: Any) -> Dict:
@@ -142,8 +144,7 @@ class FaultInjector:
         with self._lock:
             index = self.hits.get(site, 0)
             self.hits[site] = index + 1
-            with _TOTALS_LOCK:
-                _TOTALS["sites_evaluated"] += 1
+            _SITES_EVALUATED.inc()
             fire_kind = None
             for rule in self._rules_for(site):
                 fired = self._fired.get(id(rule), 0)
@@ -165,8 +166,7 @@ class FaultInjector:
             if fire_kind is None:
                 return
             self.injected.setdefault(site, []).append((index, fire_kind))
-            with _TOTALS_LOCK:
-                _TOTALS["faults_injected"] += 1
+            _FAULTS_INJECTED.inc()
         if fire_kind == "kill":
             os._exit(int(rule.get("exit_code", 137)))
         msg = rule.get(
@@ -247,11 +247,17 @@ def inject(site: str, **info) -> None:
 
 
 def fault_stats() -> Dict[str, int]:
-    """Process-lifetime injection totals (bench counters)."""
-    with _TOTALS_LOCK:
-        return dict(_TOTALS)
+    """Process-lifetime injection totals (bench counters) — a thin view
+    over the shared telemetry registry (``fault.*``)."""
+    return {
+        "sites_evaluated": _SITES_EVALUATED.value,
+        "faults_injected": _FAULTS_INJECTED.value,
+    }
 
 
 def reset_fault_stats() -> None:
-    with _TOTALS_LOCK:
-        _TOTALS.update({"sites_evaluated": 0, "faults_injected": 0})
+    """Zero the injection totals; delegates to
+    ``telemetry.reset_all("faults")``."""
+    from ..telemetry import reset_all
+
+    reset_all("faults")

@@ -25,11 +25,11 @@ from __future__ import annotations
 
 import functools
 import os
-import threading
 import time
 from typing import Callable, List, Optional, Tuple, Type
 
 from .errors import ChecksumError, DivergenceError, PermanentFault, TransientFault
+from ..telemetry import metrics as _tm
 
 __all__ = [
     "RetryPolicy",
@@ -40,31 +40,28 @@ __all__ = [
     "default_init_policy",
 ]
 
-_STATS = {
-    "calls": 0,
-    "retries": 0,
-    "gave_up": 0,
-    "succeeded_after_retry": 0,
-    "faults_survived": 0,
-}
-_STATS_LOCK = threading.Lock()
+#: aggregate retry counters across every policy in the process —
+#: registered in the shared telemetry registry as ``retry.*``
+_STAT_NAMES = ("calls", "retries", "gave_up", "succeeded_after_retry", "faults_survived")
+_STATS = {k: _tm.counter(f"retry.{k}") for k in _STAT_NAMES}
 
 
 def _bump(key: str, n: int = 1) -> None:
-    with _STATS_LOCK:
-        _STATS[key] += n
+    _STATS[key].inc(n)
 
 
 def retry_stats() -> dict:
-    """Aggregate retry counters across every policy in the process."""
-    with _STATS_LOCK:
-        return dict(_STATS)
+    """Aggregate retry counters across every policy in the process — a
+    thin view over the shared telemetry registry (``retry.*``)."""
+    return {k: _STATS[k].value for k in _STAT_NAMES}
 
 
 def reset_retry_stats() -> None:
-    with _STATS_LOCK:
-        for k in _STATS:
-            _STATS[k] = 0
+    """Zero the retry counters; delegates to
+    ``telemetry.reset_all("retry")``."""
+    from ..telemetry import reset_all
+
+    reset_all("retry")
 
 
 class RetryTimeout(TransientFault):

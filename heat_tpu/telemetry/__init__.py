@@ -1,0 +1,174 @@
+"""Unified observability: metrics registry, structured spans, device traces.
+
+The framework's answer to three production questions the reference
+(instrumented only from the outside by ``perun``, SURVEY.md §5) cannot
+ask: *how many bytes crossed ICI/DCN this fit, how long did we spend in
+XLA compiles, and where did the wall-clock go?*
+
+* :mod:`~heat_tpu.telemetry.metrics` — process-global named counters,
+  gauges and bounded histograms.  The four legacy counter islands
+  (``core.dispatch``, ``resilience``, ``utils.overlap``,
+  ``nn.data_parallel``) register into it; their ``*_stats()`` functions
+  are thin views; :func:`snapshot` returns everything in one document
+  and :func:`expose` emits Prometheus text for scrape-based
+  deployments.
+* :mod:`~heat_tpu.telemetry.spans` — nestable host-side spans in a
+  bounded ring buffer (``HEAT_TPU_TRACE=0`` disables), each doubling as
+  a ``jax.profiler.TraceAnnotation`` so Xprof/perfetto device timelines
+  attribute ops to framework operations;
+  :func:`export_chrome_trace` writes ``chrome://tracing``-loadable JSON
+  with zero extra deps.
+* :mod:`~heat_tpu.telemetry.profiling` — ``start_trace``/``stop_trace``
+  /``monitor`` device-trace hooks (moved from ``utils.profiling``,
+  which re-exports them).
+
+Instrumentation wired through the stack: ``parallel.comm`` collectives
+account trace-time payload bytes x participants into
+``comm.bytes.{op}`` / ``comm.calls.{op}``; ``core.dispatch`` records
+per-compile wall time into the ``dispatch.compile_ms`` histogram;
+``core.base.resumable_fit_loop`` emits heartbeat spans and the
+``fit.iter_rate`` gauge; checkpoint save/restore and the async writer
+drain are spanned so ``overlap.ckpt_stall_ms`` is attributable.
+
+``HEAT_TPU_METRICS_DUMP=<path>`` writes the final snapshot as JSON at
+process exit (CI scraping).  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Any, Dict, Optional
+
+from . import metrics
+from . import spans
+from . import profiling
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    dump_json,
+    expose,
+    gauge,
+    histogram,
+    snapshot,
+)
+from .spans import (
+    SpanRecord,
+    clear_spans,
+    export_chrome_trace,
+    get_spans,
+    set_tracing,
+    span,
+    tracing_enabled,
+)
+from .profiling import annotate, monitor, start_trace, stop_trace, trace
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "SpanRecord",
+    "annotate",
+    "clear_spans",
+    "counter",
+    "dump_json",
+    "expose",
+    "export_chrome_trace",
+    "gauge",
+    "get_spans",
+    "histogram",
+    "monitor",
+    "reset_all",
+    "set_tracing",
+    "snapshot",
+    "span",
+    "start_trace",
+    "stop_trace",
+    "summary_line",
+    "trace",
+    "tracing_enabled",
+]
+
+#: legacy per-domain reset functions delegate here with these names;
+#: a domain maps to the registry prefixes it owns
+_DOMAIN_PREFIXES = {
+    "dispatch": ("dispatch.",),
+    "faults": ("fault.",),
+    "retry": ("retry.",),
+    "resilience": ("fault.", "retry."),
+    "overlap": ("overlap.",),
+    "comm": ("comm.",),
+    "fit": ("fit.",),
+    "spans": ("spans.",),
+    "telemetry": ("spans.", "fit."),
+}
+
+
+def reset_all(domain: Optional[str] = None) -> None:
+    """Zero telemetry state in one call.
+
+    With no argument: every registered metric (dispatch, resilience,
+    overlap, comm, fit, ...) AND the span ring buffer — the single
+    replacement for the four legacy reset conventions.  With a domain
+    name (``"dispatch"``, ``"resilience"``, ``"overlap"``, ``"comm"``,
+    ...), only that island's metrics; the legacy ``reset_stats`` /
+    ``reset_fault_stats`` / ``reset_retry_stats`` /
+    ``reset_overlap_stats`` functions delegate here per-domain."""
+    if domain is None:
+        metrics.reset(None)
+        spans.clear_spans()
+        return
+    prefixes = _DOMAIN_PREFIXES.get(domain)
+    if prefixes is None:
+        raise ValueError(
+            f"unknown telemetry domain {domain!r}; known: {sorted(_DOMAIN_PREFIXES)}"
+        )
+    for p in prefixes:
+        metrics.reset(p)
+    if domain in ("spans", "telemetry"):
+        spans.clear_spans()
+
+
+def summary_line(iter_rate: Optional[float] = None) -> str:
+    """One-line human summary of the headline metrics — the string the
+    example scripts print after a fit: cumulative collective traffic
+    (trace-time model, bytes x participants), total XLA compile wall
+    time, and the last fit iteration rate (``fit.iter_rate`` gauge, or
+    the explicit ``iter_rate`` argument for fast-path fits that never
+    touch the gauge)."""
+    snap = metrics.snapshot()
+    comm_bytes = sum(
+        v for k, v in snap.items()
+        if k.startswith("comm.bytes.") and isinstance(v, (int, float))
+    )
+    compile_doc = snap.get("dispatch.compile_ms") or {}
+    compile_ms = float(compile_doc.get("sum") or 0.0)
+    if iter_rate is None:
+        rate = snap.get("fit.iter_rate") or 0.0
+    else:
+        rate = iter_rate
+    rate_s = f"{rate:.1f} iter/s" if rate else "n/a"
+    return (
+        f"telemetry: comm {comm_bytes / 2**30:.4f} GiB · "
+        f"compile {compile_ms:.0f} ms · iter rate {rate_s}"
+    )
+
+
+@atexit.register
+def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
+    """``HEAT_TPU_METRICS_DUMP=<path>``: write the final metrics snapshot
+    as JSON at interpreter exit (checked at exit time, so setting the
+    variable after import still works)."""
+    path = os.environ.get("HEAT_TPU_METRICS_DUMP")
+    if not path:
+        return
+    try:
+        metrics.dump_json(path)
+    except Exception:
+        pass

@@ -1,0 +1,376 @@
+"""Process-global metrics registry: counters, gauges, bounded histograms.
+
+Before this module the framework had four *disjoint* counter islands —
+``core.dispatch.cache_stats()``, ``resilience_stats()``,
+``overlap_stats()`` and the ``grad_buckets`` counter inside
+``nn.data_parallel`` — each with its own snapshot/reset convention and
+none visible in one place.  The reference framework has it worse: zero
+in-library observability, with benchmarks instrumented from the outside
+by the external ``perun`` monitor (benchmarks/cb/linalg.py:4,7).
+
+This registry is the single home for every named metric in the process:
+
+* :class:`Counter` — monotonically increasing int/float totals
+  (``comm.bytes.psum``, ``dispatch.hits``).
+* :class:`Gauge` — last-written values (``fit.iter_rate``) or live
+  callbacks (``dispatch.cache_size`` reads ``len(_cache)`` on demand).
+* :class:`Histogram` — bounded geometric-bucket distributions: p50/p90/
+  p99 estimates **without storing samples** (fixed ~12%-wide log-spaced
+  buckets; memory is O(buckets touched), never O(observations)), used
+  for ``dispatch.compile_ms``.
+
+Every island re-registers its counters here, so one
+:func:`snapshot` / :func:`reset` / :func:`dump_json` /
+:func:`expose` surface covers the whole stack; the islands' public
+``*_stats()`` functions are now thin views over this registry.
+
+All operations are thread-safe (per-metric locks; the overlap layer's
+background checkpoint writer and data-loader workers bump counters from
+their own threads).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "reset",
+    "dump_json",
+    "expose",
+]
+
+Number = Union[int, float]
+
+#: histogram bucket upper bounds: 10**(e/20) for e in [-120, 240] — a
+#: geometric ladder from 1e-6 to 1e12 in ~12% steps.  Quantile estimates
+#: interpolate inside one bucket, so the worst-case relative error of a
+#: reported p50/p90/p99 is half a bucket (~6%) — plenty for wall-time
+#: distributions, at a fixed worst-case memory of 361 ints.
+_BOUNDS: List[float] = [10.0 ** (e / 20.0) for e in range(-120, 241)]
+
+
+class Counter:
+    """Monotonic named total (int or float increments)."""
+
+    __slots__ = ("name", "doc", "_value", "_lock")
+
+    def __init__(self, name: str, doc: str = ""):
+        self.name = name
+        self.doc = doc
+        self._value: Number = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Number = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-written value, or a live callback evaluated at read time."""
+
+    __slots__ = ("name", "doc", "fn", "_value", "_lock")
+
+    def __init__(self, name: str, doc: str = "", fn: Optional[Callable[[], Number]] = None):
+        self.name = name
+        self.doc = doc
+        self.fn = fn
+        self._value: Number = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: Number) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self) -> Number:
+        if self.fn is not None:
+            try:
+                return self.fn()
+            except Exception:
+                return 0.0
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram:
+    """Bounded distribution: geometric buckets, exact count/sum/min/max.
+
+    ``observe(v)`` is O(log buckets); quantiles come from a cumulative
+    walk over the (sparse) bucket counts with geometric interpolation
+    inside the crossing bucket, clamped to the exact observed [min, max].
+    Non-positive observations land in a dedicated low bucket valued at
+    the observed minimum (durations are the intended payload; zeros
+    happen on sub-resolution clocks)."""
+
+    __slots__ = ("name", "doc", "_buckets", "_low", "_count", "_sum", "_min", "_max", "_lock")
+
+    def __init__(self, name: str, doc: str = ""):
+        self.name = name
+        self.doc = doc
+        self._buckets: Dict[int, int] = {}
+        self._low = 0  # observations <= 0 (or under the first bound)
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, v: Number) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if v <= _BOUNDS[0]:
+                self._low += 1
+            else:
+                ix = bisect.bisect_left(_BOUNDS, v)
+                self._buckets[ix] = self._buckets.get(ix, 0) + 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def min(self) -> Optional[float]:
+        with self._lock:
+            return self._min if self._count else None
+
+    @property
+    def max(self) -> Optional[float]:
+        with self._lock:
+            return self._max if self._count else None
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile (q in [0, 1]); None when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            if not self._count:
+                return None
+            target = q * self._count
+            seen = self._low
+            if seen >= target:
+                return self._min
+            val = self._max
+            for ix in sorted(self._buckets):
+                seen += self._buckets[ix]
+                if seen >= target:
+                    lo = _BOUNDS[ix - 1] if ix > 0 else _BOUNDS[0]
+                    hi = _BOUNDS[ix]
+                    val = (lo * hi) ** 0.5  # geometric bucket midpoint
+                    break
+            return min(max(val, self._min), self._max)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.5),
+            "p90": self.quantile(0.9),
+            "p99": self.quantile(0.99),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+            self._low = 0
+            self._count = 0
+            self._sum = 0.0
+            self._min = float("inf")
+            self._max = float("-inf")
+
+
+class MetricsRegistry:
+    """Name -> metric map with one snapshot/reset/export surface.
+
+    Dotted names form domains (``dispatch.hits``, ``comm.bytes.psum``);
+    :meth:`reset` takes a prefix so an island's legacy reset function
+    can clear exactly its own metrics."""
+
+    def __init__(self):
+        self._metrics: "Dict[str, Union[Counter, Gauge, Histogram]]" = {}
+        self._lock = threading.Lock()
+
+    def _get_or_make(self, name: str, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kwargs)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {type(m).__name__}, "
+                    f"not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, doc: str = "") -> Counter:
+        return self._get_or_make(name, Counter, doc=doc)
+
+    def gauge(self, name: str, doc: str = "", fn: Optional[Callable[[], Number]] = None) -> Gauge:
+        g = self._get_or_make(name, Gauge, doc=doc)
+        if fn is not None:
+            g.fn = fn
+        return g
+
+    def histogram(self, name: str, doc: str = "") -> Histogram:
+        return self._get_or_make(name, Histogram, doc=doc)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self, include_zero: bool = True) -> Dict[str, Any]:
+        """One document of every metric's current value.
+
+        Counters/gauges report their numeric value; histograms report a
+        ``{count, sum, min, max, p50, p90, p99}`` sub-document.
+        ``include_zero=False`` drops zero counters and empty histograms
+        (compact per-config embedding for bench artifacts)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for name, m in items:
+            if isinstance(m, Histogram):
+                if not include_zero and m.count == 0:
+                    continue
+                out[name] = m.snapshot()
+            else:
+                v = m.value
+                if not include_zero and not v:
+                    continue
+                out[name] = v
+        return out
+
+    def reset(self, prefix: Optional[str] = None) -> None:
+        """Zero every metric (or only names under ``prefix``).  Callback
+        gauges are left alone — their value is derived live."""
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if prefix is not None and not name.startswith(prefix):
+                continue
+            if isinstance(m, Gauge) and m.fn is not None:
+                continue
+            m.reset()
+
+    def dump_json(self, path: str) -> None:
+        """Write the full snapshot as JSON (atomic tmp + rename), the
+        artifact the ``HEAT_TPU_METRICS_DUMP`` atexit hook produces for
+        CI scraping."""
+        doc = {"timestamp": time.time(), "pid": os.getpid(), "metrics": self.snapshot()}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, path)
+
+    def expose(self) -> str:
+        """Prometheus text exposition of every metric.
+
+        Counters/gauges emit one sample; histograms emit a summary
+        (quantile-labeled samples plus ``_sum``/``_count``).  Metric
+        names are sanitized to the Prometheus charset with a
+        ``heat_tpu_`` namespace prefix."""
+        lines: List[str] = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            pname = "heat_tpu_" + "".join(
+                c if (c.isalnum() or c == "_") else "_" for c in name
+            )
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {m.value}")
+            else:
+                lines.append(f"# TYPE {pname} summary")
+                for q in (0.5, 0.9, 0.99):
+                    v = m.quantile(q)
+                    if v is not None:
+                        lines.append(f'{pname}{{quantile="{q}"}} {v}')
+                lines.append(f"{pname}_sum {m.sum}")
+                lines.append(f"{pname}_count {m.count}")
+        return "\n".join(lines) + "\n"
+
+
+#: the process-global registry every subsystem registers into
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, doc: str = "") -> Counter:
+    """Get-or-create a counter in the global registry."""
+    return REGISTRY.counter(name, doc)
+
+
+def gauge(name: str, doc: str = "", fn: Optional[Callable[[], Number]] = None) -> Gauge:
+    """Get-or-create a gauge (optionally callback-backed) in the global registry."""
+    return REGISTRY.gauge(name, doc, fn)
+
+
+def histogram(name: str, doc: str = "") -> Histogram:
+    """Get-or-create a bounded histogram in the global registry."""
+    return REGISTRY.histogram(name, doc)
+
+
+def snapshot(include_zero: bool = True) -> Dict[str, Any]:
+    """Snapshot of every registered metric (see :meth:`MetricsRegistry.snapshot`)."""
+    return REGISTRY.snapshot(include_zero)
+
+
+def reset(prefix: Optional[str] = None) -> None:
+    """Zero every registered metric, or only names under ``prefix``."""
+    REGISTRY.reset(prefix)
+
+
+def dump_json(path: str) -> None:
+    """Write the global registry's snapshot as JSON."""
+    REGISTRY.dump_json(path)
+
+
+def expose() -> str:
+    """Prometheus text exposition of the global registry."""
+    return REGISTRY.expose()

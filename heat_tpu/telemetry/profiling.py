@@ -1,0 +1,86 @@
+"""Device-trace hooks (Xprof/perfetto) — the telemetry layer's bridge to
+``jax.profiler``.
+
+The reference instruments benchmarks with the external ``perun``
+runtime/energy monitor (``@monitor()`` decorators, benchmarks/cb/
+linalg.py:4,7); the library itself has no tracing (SURVEY.md §5).  The
+TPU-native equivalent is jax.profiler: Xprof/perfetto traces with named
+regions so collectives show up attributed to framework ops.  Host-side
+structured spans live in :mod:`heat_tpu.telemetry.spans`; this module
+starts/stops the *device* trace those spans annotate.
+
+Previously ``heat_tpu.utils.profiling`` (still importable there as a
+backward-compatible alias).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["annotate", "monitor", "start_trace", "stop_trace", "trace"]
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin an Xprof/perfetto trace (analog of starting a perun run)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None):
+    """Context manager tracing the enclosed region."""
+    if log_dir is None:
+        yield
+        return
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region; nests into the XLA timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def monitor(name: Optional[str] = None):
+    """Decorator measuring wall time of a benchmark function — the drop-in
+    analog of perun's ``@monitor()`` (benchmarks/cb/linalg.py:7).  Blocks on
+    the function's jax outputs so async dispatch doesn't hide device time.
+    ``last_runtime`` is set even when the wrapped function raises (the
+    elapsed time up to the raise), so a failed call can never leave a
+    stale measurement from the previous call behind.
+    """
+
+    def deco(fn: Callable):
+        label = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                with jax.profiler.TraceAnnotation(label):
+                    out = fn(*args, **kwargs)
+                    out = jax.block_until_ready(out) if _is_jax_tree(out) else out
+                return out
+            finally:
+                wrapped.last_runtime = time.perf_counter() - t0
+
+        wrapped.last_runtime = None
+        return wrapped
+
+    return deco
+
+
+def _is_jax_tree(x) -> bool:
+    leaves = jax.tree_util.tree_leaves(x)
+    return any(isinstance(l, jax.Array) for l in leaves)

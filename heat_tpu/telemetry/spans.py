@@ -1,0 +1,215 @@
+"""Structured host-side span tracer with Chrome-trace export.
+
+``span("name", **attrs)`` is a nestable context manager (and decorator)
+recording wall-time spans into a bounded ring buffer — monotonic clocks,
+thread-safe, ~no-op when disabled (``HEAT_TPU_TRACE=0``).  Each span
+also opens a :class:`jax.profiler.TraceAnnotation`, so framework
+operations show up *attributed* in Xprof/perfetto device timelines
+(start a device trace with :func:`heat_tpu.telemetry.start_trace`) —
+the answer to the reference's external-only ``perun`` instrumentation.
+
+:func:`export_chrome_trace` writes the ring buffer in Chrome
+trace-event format — one JSON file viewable in ``chrome://tracing`` or
+https://ui.perfetto.dev with **zero extra dependencies**.
+
+Environment knobs:
+
+* ``HEAT_TPU_TRACE=0`` — disable recording (span() costs one attribute
+  read and records nothing: no ring write, no registry write).
+* ``HEAT_TPU_TRACE_RING`` — ring capacity in spans (default 4096); the
+  newest spans win, so a long fit keeps its tail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque, namedtuple
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = [
+    "SpanRecord",
+    "span",
+    "tracing_enabled",
+    "set_tracing",
+    "get_spans",
+    "clear_spans",
+    "export_chrome_trace",
+]
+
+
+def _env_on(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+_ENABLED = _env_on("HEAT_TPU_TRACE", True)
+_RING_SIZE = int(os.environ.get("HEAT_TPU_TRACE_RING", "4096"))
+_RING: "deque[SpanRecord]" = deque(maxlen=max(1, _RING_SIZE))
+_TLS = threading.local()
+
+#: completed-span counter in the shared registry; the ONLY registry
+#: write the tracer makes, so disabled mode provably writes nothing
+_RECORDED = _metrics.counter(
+    "spans.recorded", "host-side spans recorded into the ring buffer"
+)
+
+try:  # TraceAnnotation attributes spans in Xprof/perfetto device traces
+    import jax
+
+    _ANNOTATION = jax.profiler.TraceAnnotation
+except Exception:  # pragma: no cover - jax always present in this repo
+    _ANNOTATION = None
+
+#: one completed span: monotonic start, duration, owning thread, nesting
+#: depth at entry, and the user attrs (payload bytes, step ids, ...)
+SpanRecord = namedtuple(
+    "SpanRecord", ["name", "start_ns", "duration_ns", "thread_id", "depth", "attrs"]
+)
+
+
+def tracing_enabled() -> bool:
+    """Whether spans are being recorded."""
+    return _ENABLED
+
+
+def set_tracing(enabled: bool) -> bool:
+    """Enable/disable span recording at runtime (overrides the env var);
+    returns the previous state."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(enabled)
+    return prev
+
+
+def refresh_env() -> bool:
+    """Re-read ``HEAT_TPU_TRACE`` (tests that flip the env mid-process)."""
+    global _ENABLED
+    _ENABLED = _env_on("HEAT_TPU_TRACE", True)
+    return _ENABLED
+
+
+def get_spans() -> List[SpanRecord]:
+    """Completed spans currently in the ring buffer, oldest first."""
+    return list(_RING)
+
+
+def clear_spans() -> None:
+    """Drop every recorded span."""
+    _RING.clear()
+
+
+class span:
+    """Record one named wall-time span; context manager and decorator.
+
+    ::
+
+        with span("checkpoint.save", step=7):
+            ...
+        @span("fit.chunk")
+        def run_chunk(...): ...
+
+    Nesting is tracked per thread (``depth`` in the record); the
+    enclosed region also runs under a ``jax.profiler.TraceAnnotation``
+    of the same name, so an active device trace attributes its ops to
+    this span.  When tracing is disabled the whole protocol is two
+    attribute reads — nothing is recorded anywhere.
+    """
+
+    __slots__ = ("name", "attrs", "_t0", "_depth", "_ann", "_live")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._live = False
+
+    def __enter__(self) -> "span":
+        if not _ENABLED:
+            return self
+        self._live = True
+        depth = getattr(_TLS, "depth", 0)
+        _TLS.depth = depth + 1
+        self._depth = depth
+        if _ANNOTATION is not None:
+            self._ann = _ANNOTATION(self.name)
+            self._ann.__enter__()
+        else:  # pragma: no cover
+            self._ann = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if not self._live:
+            return False
+        dur = time.perf_counter_ns() - self._t0
+        self._live = False
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        _TLS.depth = self._depth
+        _RING.append(
+            SpanRecord(
+                self.name,
+                self._t0,
+                dur,
+                threading.get_ident(),
+                self._depth,
+                self.attrs,
+            )
+        )
+        _RECORDED.inc()
+        return False
+
+    def __call__(self, fn: Callable) -> Callable:
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with span(self.name, **self.attrs):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+def export_chrome_trace(path: str, clear: bool = False) -> int:
+    """Write the ring buffer as Chrome trace-event JSON; returns the
+    number of events written.
+
+    The format is the ``traceEvents`` list of complete ("ph": "X")
+    events — microsecond timestamps relative to the process's monotonic
+    clock — that ``chrome://tracing`` and Perfetto load directly.  Span
+    attrs land in each event's ``args``."""
+    events: List[Dict[str, Any]] = []
+    pid = os.getpid()
+    for rec in list(_RING):
+        events.append(
+            {
+                "name": rec.name,
+                "ph": "X",
+                "ts": rec.start_ns / 1e3,
+                "dur": rec.duration_ns / 1e3,
+                "pid": pid,
+                "tid": rec.thread_id,
+                "args": {k: _json_safe(v) for k, v in rec.attrs.items()},
+            }
+        )
+    events.sort(key=lambda e: e["ts"])
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = f"{path}.tmp.{pid}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    if clear:
+        clear_spans()
+    return len(events)

@@ -46,6 +46,7 @@ from ..core.dndarray import DNDarray
 from ..resilience import atomic as _ratomic
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import default_io_policy as _io_policy
+from ..telemetry.spans import span as _span
 
 __all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
 
@@ -222,6 +223,7 @@ class Checkpointer:
         if extra_metadata is not None:
             self._write_metadata(int(step), extra_metadata)
 
+    @_span("checkpoint.write")
     def _native_save(self, step: int, state: Any) -> None:
         _inject("checkpoint.save", step=step)
         leaves: List[np.ndarray] = []
@@ -274,6 +276,7 @@ class Checkpointer:
             return self._mngr.restore(step)
         return self._native_restore(step)
 
+    @_span("checkpoint.read")
     def _native_restore(self, step: int) -> Any:
         _inject("checkpoint.restore", step=step)
         d = self._step_dir(step)

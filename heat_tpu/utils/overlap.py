@@ -49,6 +49,8 @@ import jax
 import numpy as np
 
 from ..resilience.faults import inject as _inject
+from ..telemetry import metrics as _tm
+from ..telemetry.spans import span as _span
 
 __all__ = [
     "AsyncCheckpointer",
@@ -69,23 +71,24 @@ def async_checkpoint_enabled() -> bool:
 
 
 # ----------------------------------------------------------------------
-# shared overlap counters
+# shared overlap counters.  They live in the shared telemetry registry
+# as ``overlap.*`` (``telemetry.snapshot()`` reports them alongside the
+# dispatch/resilience/comm domains); :func:`overlap_stats` is a thin
+# byte-compatible view.
 # ----------------------------------------------------------------------
-_ZERO = dict(
-    async_saves=0,
-    sync_saves=0,
-    ckpt_stall_ms=0.0,
-    prefetch_hits=0,
-    prefetch_misses=0,
-    grad_buckets=0,
+_COUNTER_NAMES = (
+    "async_saves",
+    "sync_saves",
+    "ckpt_stall_ms",
+    "prefetch_hits",
+    "prefetch_misses",
+    "grad_buckets",
 )
-_STATS = dict(_ZERO)
-_STATS_LOCK = threading.Lock()
+_STATS = {n: _tm.counter(f"overlap.{n}") for n in _COUNTER_NAMES}
 
 
 def _bump(name: str, amount=1) -> None:
-    with _STATS_LOCK:
-        _STATS[name] += amount
+    _STATS[name].inc(amount)
 
 
 def overlap_stats() -> Dict[str, Any]:
@@ -99,18 +102,23 @@ def overlap_stats() -> Dict[str, Any]:
     staged on device ahead of the consumer vs. staged synchronously on
     demand (``prefetch_hit_rate`` derives from them).  ``grad_buckets``
     counts collective buckets issued by the bucketed gradient-reduction
-    schedule at trace time."""
-    with _STATS_LOCK:
-        s = dict(_STATS)
+    schedule at trace time.
+
+    A thin view over the shared telemetry registry (the counters live
+    there as ``overlap.*``)."""
+    s: Dict[str, Any] = {n: _STATS[n].value for n in _COUNTER_NAMES}
+    s["ckpt_stall_ms"] = float(s["ckpt_stall_ms"])
     total = s["prefetch_hits"] + s["prefetch_misses"]
     s["prefetch_hit_rate"] = (s["prefetch_hits"] / total) if total else 0.0
     return s
 
 
 def reset_overlap_stats() -> None:
-    """Zero all overlap counters."""
-    with _STATS_LOCK:
-        _STATS.update(_ZERO)
+    """Zero all overlap counters; delegates to
+    ``telemetry.reset_all("overlap")``."""
+    from ..telemetry import reset_all
+
+    reset_all("overlap")
 
 
 # ----------------------------------------------------------------------
@@ -187,19 +195,22 @@ class AsyncCheckpointer:
         t0 = time.perf_counter()
         self.wait()  # back-pressure (<=1 in flight) + error surface
         if not async_:
-            self.checkpointer.save(step, state, extra_metadata)
+            with _span("checkpoint.save", step=step, mode="sync"):
+                self.checkpointer.save(step, state, extra_metadata)
             _bump("sync_saves")
             return
-        snap = snapshot_state(state)
+        with _span("checkpoint.save", step=step, mode="async"):
+            snap = snapshot_state(state)
 
-        def _write():
-            try:
-                jax.block_until_ready(snap)  # device->writer handoff point
-                _inject("checkpoint.async_write", step=step)
-                self.checkpointer.save(step, snap, extra_metadata)
-            except BaseException as e:  # surfaced at the next save/wait/close
-                with self._error_lock:
-                    self._error = e
+            def _write():
+                try:
+                    with _span("checkpoint.async_write", step=step):
+                        jax.block_until_ready(snap)  # device->writer handoff point
+                        _inject("checkpoint.async_write", step=step)
+                        self.checkpointer.save(step, snap, extra_metadata)
+                except BaseException as e:  # surfaced at the next save/wait/close
+                    with self._error_lock:
+                        self._error = e
 
         t = threading.Thread(
             target=_write, name=f"heat-tpu-async-ckpt-{step}", daemon=True
@@ -219,7 +230,8 @@ class AsyncCheckpointer:
             # the in-flight save is this very call — nothing to wait for
             return
         if t is not None:
-            t.join()
+            with _span("checkpoint.drain"):
+                t.join()
             self._thread = None
             _bump("ckpt_stall_ms", (time.perf_counter() - t0) * 1e3)
         with self._error_lock:
@@ -247,7 +259,8 @@ class AsyncCheckpointer:
     # -- read side (sees in-flight writes through) ----------------------
     def restore(self, step=None, template=None):
         self.wait()
-        return self.checkpointer.restore(step, template)
+        with _span("checkpoint.restore", step=step if step is not None else -1):
+            return self.checkpointer.restore(step, template)
 
     def latest_step(self):
         self.wait()

@@ -1,75 +1,19 @@
-"""Profiling/tracing hooks.
+"""Backward-compatible alias of :mod:`heat_tpu.telemetry.profiling`.
 
-The reference instruments benchmarks with the external ``perun``
-runtime/energy monitor (``@monitor()`` decorators, benchmarks/cb/
-linalg.py:4,7); the library itself has no tracing (SURVEY.md §5).  The
-TPU-native equivalent is jax.profiler: Xprof/perfetto traces with named
-regions so collectives show up attributed to framework ops.
+The profiling hooks moved into the unified telemetry layer
+(``heat_tpu/telemetry/``, docs/observability.md); every public name is
+re-exported here so existing ``heat_tpu.utils.profiling`` imports keep
+working unchanged.
 """
 
 from __future__ import annotations
 
-import contextlib
-import functools
-import time
-from typing import Callable, Optional
-
-import jax
+from ..telemetry.profiling import (  # noqa: F401
+    annotate,
+    monitor,
+    start_trace,
+    stop_trace,
+    trace,
+)
 
 __all__ = ["annotate", "monitor", "start_trace", "stop_trace", "trace"]
-
-
-def start_trace(log_dir: str) -> None:
-    """Begin an Xprof/perfetto trace (analog of starting a perun run)."""
-    jax.profiler.start_trace(log_dir)
-
-
-def stop_trace() -> None:
-    jax.profiler.stop_trace()
-
-
-@contextlib.contextmanager
-def trace(log_dir: Optional[str] = None):
-    """Context manager tracing the enclosed region."""
-    if log_dir is None:
-        yield
-        return
-    start_trace(log_dir)
-    try:
-        yield
-    finally:
-        stop_trace()
-
-
-def annotate(name: str):
-    """Named trace region; nests into the XLA timeline."""
-    return jax.profiler.TraceAnnotation(name)
-
-
-def monitor(name: Optional[str] = None):
-    """Decorator measuring wall time of a benchmark function — the drop-in
-    analog of perun's ``@monitor()`` (benchmarks/cb/linalg.py:7).  Blocks on
-    the function's jax outputs so async dispatch doesn't hide device time.
-    """
-
-    def deco(fn: Callable):
-        label = name or fn.__name__
-
-        @functools.wraps(fn)
-        def wrapped(*args, **kwargs):
-            t0 = time.perf_counter()
-            with jax.profiler.TraceAnnotation(label):
-                out = fn(*args, **kwargs)
-                out = jax.block_until_ready(out) if _is_jax_tree(out) else out
-            wrapped.last_runtime = time.perf_counter() - t0
-            return out
-
-        wrapped.last_runtime = None
-        return wrapped
-
-    return deco
-
-
-def _is_jax_tree(x) -> bool:
-    leaves = jax.tree_util.tree_leaves(x)
-    return any(isinstance(l, jax.Array) for l in leaves)

@@ -28,6 +28,7 @@ MODULES = [
     ("Dispatch", "heat_tpu.core.dispatch", "cached-executable dispatch, chain fusion, buffer donation (docs/dispatch.md)"),
     ("Resilience", "heat_tpu.resilience", "fault injection, retry policies, atomic IO, divergence guards (docs/resilience.md)"),
     ("Overlap", "heat_tpu.utils.overlap", "async checkpointing, device prefetch + bucketed gradient-reduction counters (docs/overlap.md)"),
+    ("Observability", "heat_tpu.telemetry", "unified metrics registry, structured spans, comm-volume accounting (docs/observability.md)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),
     ("Linear algebra", "heat_tpu.core.linalg.basics", None),
     ("QR / SVD / solvers", "heat_tpu.core.linalg.qr", None),

@@ -244,6 +244,43 @@ def main():
     finally:
         shutil.rmtree(ck_dir, ignore_errors=True)
 
+    # telemetry overhead: the SAME kmeans lloyd kernel with span tracing
+    # enabled vs disabled, interleaved min-of-k so runner drift cancels.
+    # Gated as a hard cap (``max_overhead_pct``) rather than an anchored
+    # ratio: the acceptance bound is absolute — instrumentation must stay
+    # under 3% of the kernel it instruments.
+    def bench_telemetry_overhead():
+        from heat_tpu import telemetry
+
+        prev = telemetry.tracing_enabled()
+
+        def fit_traced():
+            telemetry.set_tracing(True)
+            return fit()
+
+        def fit_untraced():
+            telemetry.set_tracing(False)
+            return fit()
+
+        try:
+            fetch = lambda km: float(km.cluster_centers_.sum())
+            (en_per, en_sp), (dis_per, dis_sp) = _timeit_interleaved(
+                [(fit_traced, fetch, 1), (fit_untraced, fetch, 1)], rounds=8
+            )
+        finally:
+            telemetry.set_tracing(prev)
+            telemetry.clear_spans()
+        overhead_pct = 100.0 * (en_per - dis_per) / dis_per if dis_per else 0.0
+        results["telemetry_overhead"] = {
+            "overhead_pct": round(overhead_pct, 2),
+            "max_overhead_pct": 3.0,
+            "enabled_s": round(en_per, 5),
+            "disabled_s": round(dis_per, 5),
+            "spread_pct": max(en_sp, dis_sp),
+        }
+
+    guarded("telemetry_overhead", bench_telemetry_overhead)
+
     print(json.dumps(results, indent=1))
 
 

@@ -24,7 +24,25 @@ import sys
 def gate(committed: dict, current: dict, margin_pct: float) -> int:
     failures = []
     for name, rec in committed.items():
-        if not isinstance(rec, dict) or "rel_to_anchor" not in rec:
+        if not isinstance(rec, dict):
+            continue
+        # hard-cap metrics (``max_overhead_pct``): absolute bound, no
+        # anchor or slack — e.g. telemetry tracing overhead must stay
+        # under its cap regardless of runner speed
+        if "max_overhead_pct" in rec:
+            cur = current.get(name)
+            if cur is None or "overhead_pct" not in cur:
+                failures.append(f"{name}: missing from current run")
+                continue
+            cap = float(rec["max_overhead_pct"])
+            got = float(cur["overhead_pct"])
+            failed = got > cap
+            status = "FAIL" if failed else "ok"
+            print(f"{name}: current {got:.2f}% cap {cap:.2f}% [{status}]")
+            if failed:
+                failures.append(f"{name}: {got:.2f}% > cap {cap:.2f}%")
+            continue
+        if "rel_to_anchor" not in rec:
             continue
         cur = current.get(name)
         if cur is None or "rel_to_anchor" not in cur:
