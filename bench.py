@@ -938,6 +938,75 @@ def bench_telemetry(ht, sync_floor, roofline=None):
     }
 
 
+def bench_analysis(ht, sync_floor, roofline=None):
+    """Config 10: SPMD program-analyzer self-cost (ISSUE 5).
+
+    ``analyze_off_miss_us``/``analyze_off_hit_ns`` — per-dispatch cost of
+    the compile-path hook with ``HEAT_TPU_ANALYZE=0`` (the default): the
+    off-mode hook is one lazy-import lookup + a string compare per cache
+    MISS and provably nothing per hit (the ``if fresh`` guard), so both
+    numbers track the plain dispatch floor.
+    ``analyze_on_miss_ms`` — full analyzer cost per fresh compile in warn
+    mode (re-lower + re-compile + HLO walk), the price a CI job pays to
+    see J101-J105 diagnostics.  Headline value is the off-mode hit cost —
+    the number that bounds what production dispatch pays for having the
+    analyzer wired in at all."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from heat_tpu import analysis
+    from heat_tpu.analysis import diagnostics
+    from heat_tpu.core import dispatch
+
+    buf = jnp.ones((256,), jnp.float32)
+
+    def miss_us(n=64):
+        """Mean per-call cost of n distinct-key misses (fresh scalars)."""
+        dispatch.clear_cache()
+        ops = [(lambda v: (lambda a, b: a + b * v))(i) for i in range(n)]
+        t0 = time.perf_counter()
+        for op in ops:
+            dispatch.eager_apply(op, (buf, buf))
+        return (time.perf_counter() - t0) / n * 1e6
+
+    def hit_ns(n=20_000):
+        dispatch.eager_apply(jnp.add, (buf, buf))  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            dispatch.eager_apply(jnp.add, (buf, buf))
+        return (time.perf_counter() - t0) / n * 1e9
+
+    prev = diagnostics.set_analysis_mode("0")
+    try:
+        off_miss = min(miss_us() for _ in range(3))
+        off_hit = min(hit_ns() for _ in range(3))
+        diagnostics.set_analysis_mode("warn")
+        analysis.clear_diagnostics()
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            on_miss = min(miss_us() for _ in range(2))
+        diags = len(analysis.recent_diagnostics())
+    finally:
+        diagnostics.set_analysis_mode(prev)
+        analysis.clear_diagnostics()
+        dispatch.clear_cache()
+
+    return {
+        "metric": "analysis_off_hit_ns",
+        "value": round(off_hit, 1),
+        "unit": "ns",
+        "vs_baseline": round(on_miss / off_miss, 2) if off_miss else 0.0,
+        "vs_baseline_kind": "warn_mode_miss_vs_off_mode_miss",
+        "analyze_off_hit_ns": round(off_hit, 1),
+        "analyze_off_miss_us": round(off_miss, 2),
+        "analyze_on_miss_ms": round(on_miss / 1e3, 3),
+        "warn_mode_diags": diags,
+        "analyzer_mode_default": diagnostics.analysis_mode(),
+    }
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -951,7 +1020,8 @@ def main() -> None:
         roofline = None
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
-                  bench_dispatch, bench_resilience, bench_overlap, bench_telemetry):
+                  bench_dispatch, bench_resilience, bench_overlap, bench_telemetry,
+                  bench_analysis):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

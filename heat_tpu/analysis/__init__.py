@@ -1,0 +1,53 @@
+"""Static analysis: SPMD program lint + framework-invariant AST lint.
+
+Two cooperating analyzers (docs/static_analysis.md):
+
+* :mod:`~heat_tpu.analysis.program_lint` — walks the jaxpr and compiled
+  (post-GSPMD) HLO of a program for SPMD hazards the type system cannot
+  see: implicit unaccounted collectives (J101), accidental full gathers
+  of the split axis (J102), weak-type / python-scalar recompile hazards
+  (J103), donation misses (J104) and silent dtype promotion (J105).
+  Hooked into the ``core/dispatch.py`` compile path
+  (``HEAT_TPU_ANALYZE=0/1/raise`` — off/warn/error) and callable
+  standalone via :func:`analyze`.  Diagnostics flow into the telemetry
+  registry (``analysis.diags.{rule}`` counters) and a bounded ring
+  (:func:`recent_diagnostics`).
+* :mod:`~heat_tpu.analysis.ast_lint` — custom AST visitors enforcing
+  the repo's own invariants with stable rule IDs (H101 raw writes, H201
+  unregistered env knobs, H301 unaccounted collectives, H302
+  unregistered fault sites, H401 host syncs in chunk bodies, H501
+  fault-swallowing broad excepts, H601 host-entropy seeding).  Run as
+  ``python -m heat_tpu.analysis <paths>``; ``scripts/lint_gate.py``
+  gates CI against ``scripts/lint_baseline.json``.
+"""
+
+from __future__ import annotations
+
+from .ast_lint import RULES, Violation, lint_file, lint_paths
+from .diagnostics import (
+    AnalysisWarning,
+    Diagnostic,
+    ProgramLintError,
+    analysis_mode,
+    clear_diagnostics,
+    recent_diagnostics,
+    set_analysis_mode,
+)
+from .program_lint import analyze, analyze_compiled_text, analyze_jaxpr
+
+__all__ = [
+    "AnalysisWarning",
+    "Diagnostic",
+    "ProgramLintError",
+    "RULES",
+    "Violation",
+    "analysis_mode",
+    "analyze",
+    "analyze_compiled_text",
+    "analyze_jaxpr",
+    "clear_diagnostics",
+    "lint_file",
+    "lint_paths",
+    "recent_diagnostics",
+    "set_analysis_mode",
+]

@@ -1,0 +1,95 @@
+"""CLI for the AST-level framework-invariant linter.
+
+    python -m heat_tpu.analysis heat_tpu/ [more paths...]
+        [--baseline scripts/lint_baseline.json] [--no-baseline]
+        [--format text|json] [--list-rules]
+
+Exit status: 0 when every violation is covered by the baseline (or none
+exist), 1 when new violations are present.  With no ``--baseline``
+argument the checked-in ``scripts/lint_baseline.json`` next to the repo
+root is used when it exists — so ``python -m heat_tpu.analysis
+heat_tpu/`` run from a checkout gates exactly like CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .ast_lint import (
+    RULES,
+    lint_paths,
+    violations_to_json,
+    _find_repo_root,
+)
+
+
+def _load_baseline(path: str):
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc["violations"] if isinstance(doc, dict) else doc
+    return {(e["rule"], e["file"], e["line"]) for e in entries}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m heat_tpu.analysis",
+        description="heat_tpu framework-invariant AST linter",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint (default: heat_tpu/)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of accepted violations "
+                         "(default: <repo>/scripts/lint_baseline.json if present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignoring any baseline")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    paths = args.paths
+    repo_root = _find_repo_root(paths[0] if paths else os.getcwd())
+    if not paths:
+        paths = [os.path.join(repo_root, "heat_tpu")]
+
+    violations = lint_paths(paths, repo_root=repo_root)
+
+    baseline = set()
+    if not args.no_baseline:
+        bpath = args.baseline
+        if bpath is None:
+            cand = os.path.join(repo_root, "scripts", "lint_baseline.json")
+            bpath = cand if os.path.exists(cand) else None
+        if bpath is not None:
+            baseline = _load_baseline(bpath)
+
+    new = [v for v in violations if v.key() not in baseline]
+    accepted = len(violations) - len(new)
+
+    if args.format == "json":
+        print(json.dumps({
+            "violations": violations_to_json(new),
+            "accepted_baseline": accepted,
+            "total": len(violations),
+        }, indent=1))
+    else:
+        for v in new:
+            print(v)
+        note = f" ({accepted} accepted by baseline)" if accepted else ""
+        print(
+            f"lint: {len(new)} new violation(s), {len(violations)} total{note}",
+            file=sys.stderr,
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

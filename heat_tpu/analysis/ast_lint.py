@@ -1,0 +1,487 @@
+"""AST-level framework-invariant linter with stable rule IDs.
+
+Four PRs of layered infrastructure gave the codebase conventions nothing
+enforced: every writer goes through the atomic-rename layer, every
+``HEAT_TPU_*`` knob is registered, every collective is accounted, every
+fault site is named in the registry, resumable chunk bodies stay on
+device, and broad exception handlers must not swallow the resilience
+layer's non-retryable errors.  This module turns each convention into a
+machine-checked rule over the Python AST of the sources:
+
+==========  ==========================================================
+H101        raw ``open(..., "w"/"wb"/"a"/...)`` write outside
+            ``resilience/atomic.py`` and the two sanctioned telemetry
+            dump paths, and not inside an ``atomic_write``/
+            ``_atomic_out`` block — bypasses write-temp-fsync-rename +
+            CRC32 (docs/resilience.md)
+H201        ``os.environ`` / ``os.getenv`` read of a ``HEAT_TPU_*``
+            name that is not registered in the central knob table
+            (``core/_env.py KNOBS``) — typo'd or undocumented knob
+H301        ``jax.lax`` collective in ``parallel/comm.py`` not
+            lexically inside a ``_account(...)`` span — the comm-volume
+            model would under-report
+H302        fault-injection site name (``inject("...")`` /
+            ``fault_site=...`` / ``site=...``) not registered in
+            ``resilience/faults.py KNOWN_SITES`` — a fault plan
+            targeting it could never be validated
+H401        host-sync call (``.item()``, ``np.asarray``,
+            ``jax.device_get``) inside a ``resumable_fit_loop`` chunk
+            body — a device->host round trip per chunk iteration
+H501        ``except Exception:`` / ``except BaseException:`` / bare
+            ``except:`` whose body never re-raises — can swallow
+            ``PermanentFault`` / ``ChecksumError``
+H601        host-entropy seeding (``time.time`` inside a ``seed``
+            function) — collision-prone across hosts; use
+            ``heat_tpu.core.random.default_seed`` (os.urandom)
+==========  ==========================================================
+
+Suppressions: append ``# lint: allow H501(<reason>)`` to the flagged
+line (rule ID must match; the reason is free text).  Accepted legacy
+violations live in ``scripts/lint_baseline.json``; ``scripts/
+lint_gate.py`` fails CI on any violation not in the baseline.
+
+Run as ``python -m heat_tpu.analysis <paths...>``.  The linter is pure
+stdlib (``ast`` + a static parse of the knob/site registries) — it
+never imports the modules it checks.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "RULES",
+    "Violation",
+    "lint_file",
+    "lint_paths",
+    "load_registered_knobs",
+    "load_registered_sites",
+]
+
+#: rule ID -> one-line description (the catalogue docs and the CLI share)
+RULES = {
+    "H101": "raw write-mode open() outside the atomic-write layer",
+    "H201": "unregistered HEAT_TPU_* env knob (core/_env.py KNOBS)",
+    "H301": "collective in parallel/comm.py without an accounting span",
+    "H302": "fault-injection site not registered in resilience/faults.py",
+    "H401": "host-sync call inside a resumable_fit_loop chunk body",
+    "H501": "broad except that can swallow PermanentFault/ChecksumError",
+    "H601": "host-entropy seeding; use core.random.default_seed",
+}
+
+#: repo-relative files whose raw writes are the sanctioned implementation
+#: (the atomic layer itself + the two telemetry dump paths, which use
+#: their own tmp+os.replace protocol documented in docs/observability.md)
+H101_SANCTIONED_FILES = (
+    "heat_tpu/resilience/atomic.py",
+    "heat_tpu/telemetry/metrics.py",
+    "heat_tpu/telemetry/spans.py",
+)
+
+_WRITE_MODES = re.compile(r"[wax]")
+
+_SUPPRESS = re.compile(r"#\s*lint:\s*allow\s+(H\d{3})\b")
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One lint finding, stable across runs: (rule, file, line) is the
+    identity the baseline gate compares."""
+
+    rule: str
+    file: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.rule, self.file, self.line)
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# ----------------------------------------------------------------------
+# registry loading (static — ast.literal_eval, no imports)
+# ----------------------------------------------------------------------
+def _literal_assignment(path: str, name: str):
+    """The literal value assigned to module-level ``name`` in ``path``."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        if name in targets and node.value is not None:
+            return ast.literal_eval(node.value)
+    raise LookupError(f"no literal assignment of {name!r} in {path}")
+
+
+def load_registered_knobs(repo_root: str) -> Set[str]:
+    """Knob names from ``core/_env.py KNOBS`` (static parse)."""
+    path = os.path.join(repo_root, "heat_tpu", "core", "_env.py")
+    return set(_literal_assignment(path, "KNOBS"))
+
+
+def load_registered_sites(repo_root: str) -> Set[str]:
+    """Fault-site names from ``resilience/faults.py KNOWN_SITES``."""
+    path = os.path.join(repo_root, "heat_tpu", "resilience", "faults.py")
+    return set(_literal_assignment(path, "KNOWN_SITES"))
+
+
+def _find_repo_root(start: str) -> str:
+    """Walk up from ``start`` to the directory containing ``heat_tpu/``."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        if os.path.isdir(os.path.join(d, "heat_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise FileNotFoundError(
+                f"cannot locate the repo root (heat_tpu/) above {start!r}"
+            )
+        d = parent
+
+
+# ----------------------------------------------------------------------
+# the visitor
+# ----------------------------------------------------------------------
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.lax.psum', 'open')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+_HOST_SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+
+_COMM_COLLECTIVES = {
+    "psum", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter",
+}
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel_path: str, source: str, knobs: Set[str], sites: Set[str]):
+        self.rel = rel_path
+        self.lines = source.splitlines()
+        self.knobs = knobs
+        self.sites = sites
+        self.violations: List[Violation] = []
+        # lexical context stacks
+        self._with_atomic = 0       # inside atomic_write/_atomic_out block
+        self._with_account = 0      # inside *_account(...) span block
+        self._func_stack: List[str] = []
+        self._chunk_depth = 0       # inside a resumable chunk body
+        self._chunk_fn_names: Set[str] = set()
+        self._is_comm = rel_path.replace(os.sep, "/").endswith("parallel/comm.py")
+        self._h101_sanctioned = any(
+            self.rel.replace(os.sep, "/").endswith(p) for p in H101_SANCTIONED_FILES
+        )
+
+    # -- plumbing -------------------------------------------------------
+    def _add(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if 1 <= line <= len(self.lines):
+            m = _SUPPRESS.search(self.lines[line - 1])
+            if m and m.group(1) == rule:
+                return
+        self.violations.append(Violation(
+            rule=rule, file=self.rel, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+        ))
+
+    # -- pre-pass: which local functions are resumable chunk bodies -----
+    def collect_chunk_fns(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if not name.endswith(("resumable_fit_loop", "_run_resumable")):
+                continue
+            cand = None
+            if node.args:
+                cand = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "run_chunk":
+                    cand = kw.value
+            if isinstance(cand, ast.Name):
+                self._chunk_fn_names.add(cand.id)
+        self._chunk_fn_names.add("run_chunk")  # the estimator convention
+
+    # -- with blocks ----------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        atomic = account = False
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                name = _dotted(ctx.func)
+                tail = name.rsplit(".", 1)[-1]
+                if tail in ("atomic_write", "_atomic_out"):
+                    atomic = True
+                if tail.endswith("_account") or tail == "account_implicit":
+                    account = True
+        self._with_atomic += atomic
+        self._with_account += account
+        self.generic_visit(node)
+        self._with_atomic -= atomic
+        self._with_account -= account
+
+    # -- function context (H401, H601) ----------------------------------
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node.name)
+        is_chunk = node.name in self._chunk_fn_names
+        self._chunk_depth += is_chunk
+        for default in list(getattr(node.args, "defaults", ())) + list(
+            getattr(node.args, "kw_defaults", ())
+        ):
+            self._check_site_default(node, default)
+        self.generic_visit(node)
+        self._chunk_depth -= is_chunk
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _check_site_default(self, fn_node, default) -> None:
+        # FunctionDef defaults for parameters named site/fault_site
+        if not isinstance(default, ast.Constant) or not isinstance(default.value, str):
+            return
+        defaults = list(getattr(fn_node.args, "defaults", ()))
+        kw_defaults = list(getattr(fn_node.args, "kw_defaults", ()))
+        pos_args = list(getattr(fn_node.args, "args", ()))
+        pairs = list(zip(pos_args[len(pos_args) - len(defaults):], defaults))
+        pairs += [
+            (a, d) for a, d in zip(getattr(fn_node.args, "kwonlyargs", ()), kw_defaults)
+            if d is not None
+        ]
+        for arg, d in pairs:
+            if d is default and arg.arg in ("site", "fault_site"):
+                self._check_site_literal(default)
+
+    def _check_site_literal(self, node: ast.Constant) -> None:
+        site = node.value
+        if site not in self.sites:
+            self._add(
+                "H302", node,
+                f"fault site {site!r} is not registered in "
+                "resilience/faults.py KNOWN_SITES — register it so fault "
+                "plans targeting it can be validated",
+            )
+
+    # -- calls: H101, H201, H301, H302, H401, H601 ----------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        tail = name.rsplit(".", 1)[-1]
+
+        # H101: write-mode open()
+        if name == "open" and not self._h101_sanctioned and not self._with_atomic:
+            mode = None
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and _WRITE_MODES.search(mode):
+                self._add(
+                    "H101", node,
+                    f"raw open(..., {mode!r}) bypasses the atomic "
+                    "write-temp-fsync-rename + CRC32 layer; write through "
+                    "resilience.atomic.atomic_write",
+                )
+
+        # H201: env reads of HEAT_TPU_* literals
+        if name in ("os.getenv", "os.environ.get", "environ.get",
+                    "os.environ.setdefault", "os.environ.pop"):
+            if node.args and isinstance(node.args[0], ast.Constant):
+                self._check_knob(node.args[0])
+
+        # H301: unaccounted collective in parallel/comm.py
+        if (
+            self._is_comm
+            and name.startswith("jax.lax.")
+            and tail in _COMM_COLLECTIVES
+            and not self._with_account
+        ):
+            self._add(
+                "H301", node,
+                f"jax.lax.{tail} in parallel/comm.py outside an "
+                "_account(...) span — the collective would be invisible to "
+                "the comm-volume model (docs/observability.md)",
+            )
+
+        # H302: inject("...") / fault_site="..." / site=... literals on the
+        # fault-plumbing calls (a `site=` span attr elsewhere is not a
+        # fault site)
+        if tail in ("inject", "_inject") and node.args:
+            if isinstance(node.args[0], ast.Constant) and isinstance(
+                node.args[0].value, str
+            ):
+                self._check_site_literal(node.args[0])
+        if tail in ("inject", "_inject", "atomic_write", "_atomic_out",
+                    "resumable_fit_loop", "_run_resumable"):
+            for kw in node.keywords:
+                if (
+                    kw.arg in ("fault_site", "site")
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    self._check_site_literal(kw.value)
+        if tail in ("resumable_fit_loop", "_run_resumable"):
+            # positional site argument of the estimator helpers
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                        and arg.value.endswith((".iter", ".stage")):
+                    self._check_site_literal(arg)
+
+        # H401: host syncs inside chunk bodies
+        if self._chunk_depth > 0:
+            if name in _HOST_SYNC_CALLS or (
+                tail == "item" and isinstance(node.func, ast.Attribute)
+                and not node.args
+            ):
+                self._add(
+                    "H401", node,
+                    f"host-sync call {name or tail}() inside a "
+                    "resumable_fit_loop chunk body — one device->host round "
+                    "trip per chunk; keep the chunk on-device and sync only "
+                    "at chunk boundaries",
+                )
+
+        # H601: host-entropy seeding
+        if name in ("time.time", "time.time_ns") and any(
+            "seed" in f.lower() for f in self._func_stack
+        ):
+            self._add(
+                "H601", node,
+                "seeding from time.time() collides across hosts launched "
+                "in the same tick; derive the default seed from "
+                "heat_tpu.core.random.default_seed() (os.urandom-backed)",
+            )
+
+        self.generic_visit(node)
+
+    # -- subscript env reads: os.environ["HEAT_TPU_X"] -------------------
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if _dotted(node.value) in ("os.environ", "environ"):
+            sl = node.slice
+            if isinstance(sl, ast.Constant):
+                self._check_knob(sl)
+        self.generic_visit(node)
+
+    def _check_knob(self, node: ast.Constant) -> None:
+        name = node.value
+        if isinstance(name, str) and name.startswith("HEAT_TPU_") \
+                and name not in self.knobs:
+            self._add(
+                "H201", node,
+                f"env knob {name!r} is not registered in core/_env.py "
+                "KNOBS — register it (name, type, default, doc) so "
+                "docs/env_vars.md and the typed accessors stay truthful",
+            )
+
+    # -- H501: broad except without re-raise -----------------------------
+    def visit_Try(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if self._is_broad(handler.type) and not self._reraises(handler):
+                self._add(
+                    "H501", handler,
+                    "broad except without re-raise can swallow "
+                    "PermanentFault/ChecksumError — narrow the exception "
+                    "type, re-raise the non-retryables, or annotate a "
+                    "deliberate catch-all with `# lint: allow H501(reason)`",
+                )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_broad(type_node) -> bool:
+        if type_node is None:
+            return True  # bare except
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [_dotted(t) for t in type_node.elts]
+        else:
+            names = [_dotted(type_node)]
+        return any(n.rsplit(".", 1)[-1] in ("Exception", "BaseException")
+                   for n in names)
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+# ----------------------------------------------------------------------
+# driver
+# ----------------------------------------------------------------------
+def lint_file(
+    path: str,
+    repo_root: Optional[str] = None,
+    knobs: Optional[Set[str]] = None,
+    sites: Optional[Set[str]] = None,
+    source: Optional[str] = None,
+    rel_path: Optional[str] = None,
+) -> List[Violation]:
+    """Lint one Python file; returns its violations (suppressions
+    applied).  ``source``/``rel_path`` let tests lint embedded fixture
+    code without touching the filesystem."""
+    if repo_root is None:
+        repo_root = _find_repo_root(path)
+    if knobs is None:
+        knobs = load_registered_knobs(repo_root)
+    if sites is None:
+        sites = load_registered_sites(repo_root)
+    if source is None:
+        with open(path) as f:
+            source = f.read()
+    if rel_path is None:
+        rel_path = os.path.relpath(os.path.abspath(path), repo_root)
+    tree = ast.parse(source, filename=rel_path)
+    linter = _Linter(rel_path, source, knobs, sites)
+    linter.collect_chunk_fns(tree)
+    linter.visit(tree)
+    return sorted(linter.violations, key=lambda v: (v.file, v.line, v.rule))
+
+
+def lint_paths(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> List[Violation]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    if repo_root is None:
+        repo_root = _find_repo_root(paths[0] if paths else os.getcwd())
+    knobs = load_registered_knobs(repo_root)
+    sites = load_registered_sites(repo_root)
+    out: List[Violation] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files = [p]
+        else:
+            files = sorted(
+                os.path.join(dp, f)
+                for dp, _dirs, fns in os.walk(p)
+                for f in fns
+                if f.endswith(".py")
+            )
+        for f in files:
+            out.extend(lint_file(f, repo_root, knobs, sites))
+    return sorted(out, key=lambda v: (v.file, v.line, v.rule))
+
+
+def violations_to_json(violations: Sequence[Violation]) -> List[Dict]:
+    """JSON-serializable form (the baseline file format)."""
+    return [
+        {"rule": v.rule, "file": v.file, "line": v.line, "message": v.message}
+        for v in violations
+    ]

@@ -1,0 +1,156 @@
+"""Diagnostic records, modes, and the recent-diagnostics ring.
+
+Both analyzers — the jaxpr/HLO-level SPMD program lint
+(:mod:`~heat_tpu.analysis.program_lint`) and the AST-level framework
+invariant lint (:mod:`~heat_tpu.analysis.ast_lint`) — report through one
+structured record type.  Program-lint diagnostics additionally flow into
+the shared telemetry registry (``analysis.diags.{rule}`` counters) and a
+bounded ring of recent records, so a long-running fit's hazards are
+visible from ``telemetry.snapshot()`` exactly like its comm volume or
+compile time.
+
+``HEAT_TPU_ANALYZE`` selects the runtime mode of the dispatch-path
+analyzer: ``0`` (off — the production default, one module-global read
+per compile), ``1`` (warn — each diagnostic raises a
+:class:`AnalysisWarning`), ``raise`` (error — the first diagnostic
+raises :class:`ProgramLintError`, for CI jobs that must not merge a
+hazard).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..core import _env
+from ..telemetry import metrics as _tm
+
+__all__ = [
+    "AnalysisWarning",
+    "Diagnostic",
+    "ProgramLintError",
+    "analysis_mode",
+    "clear_diagnostics",
+    "emit",
+    "recent_diagnostics",
+    "refresh_env",
+    "set_analysis_mode",
+]
+
+MODE_OFF = "off"
+MODE_WARN = "warn"
+MODE_RAISE = "raise"
+
+_MODE_ALIASES = {
+    "0": MODE_OFF, "off": MODE_OFF, "false": MODE_OFF, "no": MODE_OFF,
+    "1": MODE_WARN, "on": MODE_WARN, "warn": MODE_WARN, "true": MODE_WARN,
+    "raise": MODE_RAISE, "error": MODE_RAISE, "2": MODE_RAISE,
+}
+
+
+class AnalysisWarning(UserWarning):
+    """A program-lint diagnostic surfaced in warn mode."""
+
+
+class ProgramLintError(RuntimeError):
+    """A program-lint diagnostic surfaced in raise mode."""
+
+    def __init__(self, diagnostic: "Diagnostic"):
+        super().__init__(str(diagnostic))
+        self.diagnostic = diagnostic
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding of either analyzer.
+
+    ``rule`` is the stable rule ID (``J1xx`` for the jaxpr/HLO program
+    lint, ``H1xx``-``H6xx`` for the AST lint); ``location`` is a
+    ``file:line`` string for AST findings and a program label (op name /
+    cache-key tag) for program findings; ``details`` carries the
+    machine-readable evidence (collective kinds, shapes, argnums)."""
+
+    rule: str
+    message: str
+    location: Optional[str] = None
+    source: str = "program"  # "program" | "dispatch" | "ast"
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    def __str__(self) -> str:
+        loc = f" [{self.location}]" if self.location else ""
+        return f"{self.rule}{loc}: {self.message}"
+
+
+def _parse_mode(raw: Optional[str]) -> str:
+    if raw is None:
+        raw = _env.knob_default("HEAT_TPU_ANALYZE")
+    mode = _MODE_ALIASES.get(str(raw).strip().lower())
+    if mode is None:
+        raise ValueError(
+            f"HEAT_TPU_ANALYZE={raw!r}: expected one of 0/1/raise"
+        )
+    return mode
+
+
+_MODE = _parse_mode(os.environ.get("HEAT_TPU_ANALYZE"))
+_RING_SIZE = _env.env_int("HEAT_TPU_ANALYZE_RING")
+_RING: "deque[Diagnostic]" = deque(maxlen=max(1, _RING_SIZE))
+_LOCK = threading.Lock()
+
+
+def analysis_mode() -> str:
+    """Current analyzer mode: ``"off"``, ``"warn"`` or ``"raise"``."""
+    return _MODE
+
+
+def set_analysis_mode(mode: str) -> str:
+    """Set the analyzer mode at runtime (overrides the env var); accepts
+    the env spellings (``0/1/raise``) or the mode names; returns the
+    previous mode."""
+    global _MODE
+    prev = _MODE
+    _MODE = _parse_mode(mode)
+    return prev
+
+
+def refresh_env() -> str:
+    """Re-read ``HEAT_TPU_ANALYZE`` (tests that flip the env var
+    mid-process); returns the new mode."""
+    global _MODE
+    _MODE = _parse_mode(os.environ.get("HEAT_TPU_ANALYZE"))
+    return _MODE
+
+
+def recent_diagnostics() -> List[Diagnostic]:
+    """Recent program-lint diagnostics, oldest first (bounded ring,
+    ``HEAT_TPU_ANALYZE_RING`` capacity)."""
+    with _LOCK:
+        return list(_RING)
+
+
+def clear_diagnostics() -> None:
+    """Drop every recorded diagnostic."""
+    with _LOCK:
+        _RING.clear()
+
+
+def emit(diag: Diagnostic, mode: Optional[str] = None) -> None:
+    """Record one diagnostic: bump ``analysis.diags.{rule}`` in the
+    telemetry registry, append to the ring, and surface it according to
+    ``mode`` (default: the global analyzer mode) — a warning in warn
+    mode, :class:`ProgramLintError` in raise mode."""
+    _tm.counter(
+        f"analysis.diags.{diag.rule}",
+        f"program-lint diagnostics of rule {diag.rule}",
+    ).inc()
+    with _LOCK:
+        _RING.append(diag)
+    mode = _MODE if mode is None else mode
+    if mode == MODE_RAISE:
+        raise ProgramLintError(diag)
+    if mode == MODE_WARN:
+        warnings.warn(str(diag), AnalysisWarning, stacklevel=3)

@@ -1,12 +1,161 @@
-"""Shared environment-knob parsing (single source for the precision
-tables that the FFT and hsvd layers both expose)."""
+"""Central environment-knob registry and shared env parsing.
+
+Every ``HEAT_TPU_*`` tuning knob the framework reads is declared ONCE in
+the :data:`KNOBS` table below — name, type, default, and a one-line doc.
+The table is the machine-checked source of truth three consumers share:
+
+* the typed accessors in this module (:func:`env_flag`, :func:`env_int`,
+  :func:`env_float`, :func:`env_str`) refuse unregistered names, so a
+  typo'd knob read fails loudly at import instead of silently returning
+  its default forever;
+* ``scripts/build_api_docs.py`` generates ``docs/env_vars.md`` from it,
+  so the docs can never drift from the code;
+* the AST linter's **H201** rule (``heat_tpu/analysis/ast_lint.py``)
+  cross-checks every ``os.environ`` read of a ``HEAT_TPU_*`` literal in
+  the sources against this table and flags unregistered names — new
+  knobs must be registered here before they can merge.
+
+The table is a **pure literal** (no computed values) so the linter can
+read it with ``ast.literal_eval`` without importing jax.
+
+Also hosts the shared precision tables the FFT and hsvd layers both
+expose (``precision_from_env``).
+"""
 
 from __future__ import annotations
 
 import os
+from typing import Any, Dict, Optional
 
 import jax
 
+__all__ = [
+    "KNOBS",
+    "env_flag",
+    "env_float",
+    "env_int",
+    "env_str",
+    "knob_default",
+    "precision_from_env",
+    "precision_name_from_env",
+    "registered_knobs",
+]
+
+#: Every HEAT_TPU_* knob: name -> (type, default, doc).  ``type`` is one
+#: of "bool" (0/false/no/off = off), "int", "float", "str", "path" or
+#: "choice"; ``default`` is the value used when the variable is unset
+#: (as a string, "" meaning "unset / auto-detect").  PURE LITERAL — the
+#: AST linter parses this assignment statically (ast.literal_eval).
+KNOBS = {
+    # -- dispatch (core/dispatch.py, docs/dispatch.md) ------------------
+    "HEAT_TPU_DISPATCH_CACHE": ("bool", "1", "executable cache under the generic op wrappers (0 = plain eager jnp calls, fusion off too)"),
+    "HEAT_TPU_FUSION": ("bool", "1", "lazy elementwise chain fusion (0 = every op materializes immediately)"),
+    "HEAT_TPU_FUSION_DEPTH": ("int", "16", "max pending-chain depth before a subchain is materialized"),
+    "HEAT_TPU_DONATE": ("bool", "1", "refcount-proven buffer donation on in-place paths"),
+    "HEAT_TPU_DISPATCH_CACHE_SIZE": ("int", "1024", "LRU capacity of the compiled-executable cache"),
+    # -- static analysis (heat_tpu/analysis, docs/static_analysis.md) ---
+    "HEAT_TPU_ANALYZE": ("choice", "0", "SPMD program analyzer on the dispatch compile path: 0 = off, 1 = warn, raise = error on any diagnostic"),
+    "HEAT_TPU_ANALYZE_RING": ("int", "256", "capacity of the recent-diagnostics ring buffer"),
+    # -- telemetry (heat_tpu/telemetry, docs/observability.md) ----------
+    "HEAT_TPU_TRACE": ("bool", "1", "host-side span recording (0 = span() costs two attribute reads and records nothing)"),
+    "HEAT_TPU_TRACE_RING": ("int", "4096", "span ring-buffer capacity (newest spans win)"),
+    "HEAT_TPU_METRICS_DUMP": ("path", "", "write the final metrics snapshot as JSON to this path at process exit"),
+    # -- resilience (heat_tpu/resilience, docs/resilience.md) -----------
+    "HEAT_TPU_FAULT_PLAN": ("str", "", "fault-injection plan: inline JSON or a path to a JSON file"),
+    "HEAT_TPU_RETRY_NO_SLEEP": ("bool", "0", "record retry backoff delays without sleeping (deterministic failure tests)"),
+    "HEAT_TPU_IO_RETRY_ATTEMPTS": ("int", "3", "max attempts of the io load/save retry policy"),
+    "HEAT_TPU_IO_RETRY_BASE_DELAY": ("float", "0.05", "first backoff delay (s) of the io retry policy"),
+    "HEAT_TPU_IO_RETRY_MAX_DELAY": ("float", "2.0", "backoff delay cap (s) of the io retry policy"),
+    "HEAT_TPU_INIT_RETRY_ATTEMPTS": ("int", "3", "max attempts of the parallel.init() bootstrap retry policy"),
+    "HEAT_TPU_INIT_RETRY_BASE_DELAY": ("float", "0.5", "first backoff delay (s) of the init retry policy"),
+    "HEAT_TPU_INIT_RETRY_MAX_DELAY": ("float", "10.0", "backoff delay cap (s) of the init retry policy"),
+    "HEAT_TPU_IO_CHECKSUM": ("bool", "1", "CRC32 sidecar writing + load-side verification on every io path"),
+    # -- overlap / nn (docs/overlap.md) ---------------------------------
+    "HEAT_TPU_ASYNC_CKPT": ("bool", "1", "asynchronous checkpoint writes in resumable fits (0 = fully synchronous saves)"),
+    "HEAT_TPU_GRAD_BUCKET_MB": ("float", "4", "byte bound (MiB) of one bucketed gradient-reduction psum"),
+    "HEAT_TPU_FLASH": ("bool", "1", "flash-attention kernel for local attention on TPU (0 = einsum path)"),
+    # -- kernels / linalg -----------------------------------------------
+    "HEAT_TPU_LLOYD_KERNEL": ("bool", "0", "opt-in fused Pallas Lloyd iteration (VPU-bound on v5e; see core/kernels.py)"),
+    "HEAT_TPU_HSVD_PRECISION": ("choice", "high", "hsvd Gram-pass matmul precision: default | high | highest"),
+    "HEAT_TPU_HSVD_SYRK": ("bool", "1", "one-HBM-read syrk kernel for hsvd Gram passes when supported"),
+    "HEAT_TPU_COMPLEX": ("bool", "", "override the complex-on-TPU support probe (unset = probe per device kind)"),
+    # -- fft (docs/fft_roofline.md) -------------------------------------
+    "HEAT_TPU_PLANAR": ("bool", "", "planar (re, im) complex representation (unset = auto: TPU without complex support)"),
+    "HEAT_TPU_FFT_PRECISION": ("choice", "highest", "FFT matmul precision: default | high | highest"),
+    "HEAT_TPU_FFT_CUTOFF": ("int", "64", "extent cutoff below which planar FFT uses the direct DFT matmul"),
+    "HEAT_TPU_FFT_DIRECT_CAP": ("int", "1024", "largest extent the direct DFT path may handle"),
+    "HEAT_TPU_FFT_PALLAS": ("bool", "0", "opt-in Pallas planar-FFT stage kernel"),
+    "HEAT_TPU_FFT_INTERLEAVED": ("bool", "1", "interleaved pencil decomposition of multi-axis FFTs"),
+    "HEAT_TPU_FFT_WEIGHT_CACHE_MB": ("float", "256", "byte bound (MiB) of the shared FFT twiddle/weight LRU cache"),
+    "HEAT_TPU_FFT_STAGE_PALLAS": ("bool", "1", "Pallas four-step stage kernel of the leading-axis FFT"),
+    "HEAT_TPU_FFT_EXT_PALLAS": ("bool", "1", "Pallas extension kernel of the leading-axis FFT"),
+    "HEAT_TPU_FFT_LEADING": ("bool", "1", "leading-axis (split-axis) FFT path"),
+    # -- test / CI harness ----------------------------------------------
+    "HEAT_TPU_TEST_DEVICES": ("int", "8", "virtual CPU mesh size the test suite forces (tests/conftest.py)"),
+    "HEAT_TPU_COMPILE_CACHE": ("path", "tests/.jax_cache", "persistent XLA compilation cache directory for the test suite (0 = off)"),
+}
+
+_FALSE_WORDS = ("0", "false", "no", "off")
+
+
+def registered_knobs() -> Dict[str, tuple]:
+    """Copy of the knob table (name -> (type, default, doc))."""
+    return dict(KNOBS)
+
+
+def _lookup(name: str) -> tuple:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a registered HEAT_TPU knob; add it to "
+            "heat_tpu.core._env.KNOBS (name, type, default, doc) — the "
+            "H201 lint rule enforces the same registry on direct "
+            "os.environ reads"
+        ) from None
+
+
+def knob_default(name: str) -> str:
+    """The registered default (string form) of ``name``."""
+    return _lookup(name)[1]
+
+
+def env_str(name: str, default: Optional[str] = None) -> str:
+    """Raw string value of a registered knob (default from the table)."""
+    d = _lookup(name)[1] if default is None else default
+    return os.environ.get(name, d)
+
+
+def env_flag(name: str, default: Optional[bool] = None) -> bool:
+    """Boolean knob: unset -> registered default; ``0/false/no/off``
+    (any case) -> False; anything else -> True."""
+    v = os.environ.get(name)
+    if v is None:
+        if default is not None:
+            return default
+        v = _lookup(name)[1]
+    return str(v).strip().lower() not in _FALSE_WORDS
+
+
+def env_int(name: str, default: Optional[int] = None) -> int:
+    """Integer knob (registered default when unset)."""
+    v = os.environ.get(name)
+    if v is None:
+        return int(_lookup(name)[1]) if default is None else default
+    return int(v)
+
+
+def env_float(name: str, default: Optional[float] = None) -> float:
+    """Float knob (registered default when unset)."""
+    v = os.environ.get(name)
+    if v is None:
+        return float(_lookup(name)[1]) if default is None else default
+    return float(v)
+
+
+# ----------------------------------------------------------------------
+# shared precision tables (FFT + hsvd)
+# ----------------------------------------------------------------------
 _PRECISION_TABLE = {
     "default": jax.lax.Precision.DEFAULT,
     "high": jax.lax.Precision.HIGH,

@@ -173,7 +173,7 @@ def _try_scalar_fast(operation, t1, t2, fn_kwargs) -> Optional[DNDarray]:
         return None
     try:
         leaf = dispatch.scalar_leaf(scalar, types.heat_type_of(scalar).jax_type())
-    except Exception:
+    except Exception:  # lint: allow H501(scalar outside canonical dtype range -> no fusion)
         return None  # e.g. int out of the canonical dtype's range
     src = arr._fusion_source
     args = (leaf, src) if scalar_first else (src, leaf)

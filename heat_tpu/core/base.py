@@ -183,7 +183,7 @@ def resumable_fit_loop(
             else:
                 try:  # body exception wins over a late writer error
                     ckpt.close()
-                except BaseException:
+                except BaseException:  # lint: allow H501(body exception wins over a late writer error)
                     pass
     return state, total
 

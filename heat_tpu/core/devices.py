@@ -71,7 +71,7 @@ def _ensure_registry() -> Device:
     if __default_device is None:
         try:  # pragma: no cover - depends on runtime platform
             platform = jax.default_backend()
-        except Exception:  # pragma: no cover
+        except Exception:  # lint: allow H501(backend probe falls back to cpu)
             platform = "cpu"
         if platform not in __registry:
             accel = Device(platform)

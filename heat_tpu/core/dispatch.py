@@ -44,14 +44,19 @@ Environment knobs (all default-on):
 * ``HEAT_TPU_FUSION_DEPTH`` — max pending-chain depth before a subchain
   is materialized (default 16).
 * ``HEAT_TPU_DONATE=0`` — disable buffer donation.
+* ``HEAT_TPU_ANALYZE=1`` (or ``raise``) — run the SPMD program analyzer
+  (``heat_tpu/analysis/program_lint.py``) over every freshly compiled
+  executable: unaccounted implicit collectives, accidental full
+  gathers, scalar-dtype recompile churn and donation misses surface as
+  structured diagnostics (default ``0`` = off, free).
 
 See ``docs/dispatch.md`` for the cache-key, donation, and
-fusion-boundary semantics.
+fusion-boundary semantics, and ``docs/static_analysis.md`` for the
+analyzer.
 """
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 import warnings
@@ -62,9 +67,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience.errors import ChecksumError as _ChecksumError
+from ..resilience.errors import PermanentFault as _PermanentFault
 from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
+from . import _env as _env
 
 __all__ = [
     "PendingExpr",
@@ -81,18 +89,13 @@ __all__ = [
 ]
 
 
-def _env_flag(name: str, default: bool = True) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() not in ("0", "false", "no", "off")
-
-
-_CACHE_ENABLED = _env_flag("HEAT_TPU_DISPATCH_CACHE", True)
-_FUSION_ENABLED = _env_flag("HEAT_TPU_FUSION", True)
-_DONATE_ENABLED = _env_flag("HEAT_TPU_DONATE", True)
-FUSION_DEPTH = int(os.environ.get("HEAT_TPU_FUSION_DEPTH", "16"))
-_CACHE_MAXSIZE = int(os.environ.get("HEAT_TPU_DISPATCH_CACHE_SIZE", "1024"))
+# knob reads go through the central registry (core/_env.py KNOBS) —
+# the H201 lint rule enforces the same table on direct os.environ reads
+_CACHE_ENABLED = _env.env_flag("HEAT_TPU_DISPATCH_CACHE")
+_FUSION_ENABLED = _env.env_flag("HEAT_TPU_FUSION")
+_DONATE_ENABLED = _env.env_flag("HEAT_TPU_DONATE")
+FUSION_DEPTH = _env.env_int("HEAT_TPU_FUSION_DEPTH")
+_CACHE_MAXSIZE = _env.env_int("HEAT_TPU_DISPATCH_CACHE_SIZE")
 
 
 def cache_enabled() -> bool:
@@ -268,7 +271,7 @@ def make_node(op, args: Sequence, kwargs: Optional[dict] = None) -> Optional[Pen
             arg_avals.append((tuple(a.shape), a.dtype))
     try:
         aval = _abstract_eval(op, tuple(arg_avals), kw_key, kwargs)
-    except Exception:
+    except Exception:  # lint: allow H501(unfusable node -> eager path, no fault sites inside)
         return None
     if not isinstance(aval, jax.ShapeDtypeStruct):
         return None  # multi-output ops don't fuse
@@ -374,6 +377,24 @@ def _eval_nodes(nodes, leaves):
     return _build_program(nodes)(*leaves)
 
 
+def _maybe_analyze(entry, leaves, key, donate_argnums=()) -> None:
+    """SPMD program-lint hook on the compile path (docs/static_analysis.md).
+
+    Off mode (``HEAT_TPU_ANALYZE=0``, the default) costs one lazy-import
+    dict lookup and a string compare per cache MISS — nothing per hit.
+    Warn/raise mode re-lowers the fresh entry and walks its compiled
+    module for unaccounted collectives, full gathers and donation misses
+    (roughly one extra trace+compile per miss)."""
+    from ..analysis.diagnostics import analysis_mode
+
+    if analysis_mode() == "off":
+        return
+    from ..analysis.program_lint import note_dispatch_key, on_dispatch_compile
+
+    note_dispatch_key(key)
+    on_dispatch_compile(entry, leaves, key, donate_argnums=donate_argnums)
+
+
 def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
     """Cached jitted executable for ``key``; returns ``(entry, fresh)``
     where ``fresh`` marks a miss — the first execution of a fresh entry
@@ -438,8 +459,21 @@ def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=No
     consumed its input, making re-execution unsafe."""
     try:
         compiled, fresh = _get_compiled(key, builder, out_sharding=out_sharding)
+        if fresh:
+            _maybe_analyze(compiled, leaves, key)
         return _run(compiled, leaves, n_ops, fresh=fresh)
-    except Exception as e:
+    except (_PermanentFault, _ChecksumError):
+        # non-retryable resilience faults must propagate — an eager
+        # fallback here would SWALLOW a permanent failure the caller's
+        # recovery logic (and the H501 lint rule) depends on seeing
+        raise
+    except Exception as e:  # lint: allow H501(compile fallback; non-retryables re-raised above)
+        if type(e).__name__ == "ProgramLintError":
+            # raise-mode analyzer diagnostics are verdicts, not transient
+            # compile failures — an eager fallback would hide exactly the
+            # hazard HEAT_TPU_ANALYZE=raise exists to stop on (lazy name
+            # check: importing analysis here would cycle through core)
+            raise
         _C["compile_fallbacks"].inc()
         _cache.pop(key, None)
         warnings.warn(
@@ -591,7 +625,7 @@ def _refcount_at_most(buf, extra: int = 0) -> bool:
         return False
     try:
         return sys.getrefcount(buf) <= _RC_BASE + extra
-    except Exception:  # pragma: no cover - non-CPython
+    except Exception:  # lint: allow H501(non-CPython refcount probe -> donation off)
         return False
 
 
@@ -651,7 +685,7 @@ def _expr_private(root: PendingExpr, leaf_buf) -> bool:
         try:
             if sys.getrefcount(n) > allowed:
                 return False
-        except Exception:  # pragma: no cover - non-CPython
+        except Exception:  # lint: allow H501(non-CPython refcount probe -> donation off)
             return False
     return True
 
@@ -664,7 +698,7 @@ def _refcount_leaf_at_most(buf, slots: int) -> bool:
         return False
     try:
         return sys.getrefcount(buf) <= _RC_LEAF_BASE + (slots - 1)
-    except Exception:  # pragma: no cover - non-CPython
+    except Exception:  # lint: allow H501(non-CPython refcount probe -> donation off)
         return False
 
 
@@ -709,6 +743,8 @@ def repad(buf, old_slice, pad_widths, sharding, donate: bool = False):
             lambda: jax.device_put(build()(buf), sharding), out_sharding=sharding,
         )
     compiled, fresh = _get_compiled(key, build, donate_argnums=(0,), out_sharding=sharding)
+    if fresh:
+        _maybe_analyze(compiled, (buf,), key, donate_argnums=(0,))
     return _run(compiled, (buf,), 1, donated=True, fresh=fresh)
 
 
@@ -795,4 +831,6 @@ def cast_store(dst_buf, src, dtype, out_sharding=None):
     compiled, fresh = _get_compiled(
         key, build, donate_argnums=(donate_ix,), out_sharding=out_sharding
     )
+    if fresh:
+        _maybe_analyze(compiled, leaves, key, donate_argnums=(donate_ix,))
     return _run(compiled, leaves, len(nodes), donated=True, fresh=fresh)

@@ -1640,7 +1640,7 @@ def _tpu_complex_ok() -> bool:
         # backend-init branch above), and a persisted "0" would demote
         # complex to the host forever on capable hardware
         ok, conclusive = False, False
-    except Exception:
+    except Exception:  # lint: allow H501(complex-support probe; inconclusive stays unpersisted)
         ok, conclusive = True, False
     _TPU_COMPLEX_OK = ok
     if conclusive:

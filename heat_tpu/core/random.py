@@ -15,7 +15,7 @@ the reference's two guarantees, for free.
 
 from __future__ import annotations
 
-import time
+import os
 
 builtins_bytes = bytes
 from typing import Optional, Tuple, Union
@@ -33,6 +33,7 @@ from .stride_tricks import sanitize_axis, sanitize_shape
 __all__ = [
     "bytes",
     "choice",
+    "default_seed",
     "get_state",
     "normal",
     "permutation",
@@ -57,11 +58,26 @@ __seed: int = 0
 __counter: int = 0
 
 
+def default_seed() -> int:
+    """A fresh 31-bit seed from OS entropy (``os.urandom``).
+
+    The sanctioned source for "no seed given" seeding: the previous
+    millisecond-clock fallback (``int(time.time() * 1000)``) collides
+    across hosts launched in the same millisecond — exactly the pod
+    bring-up case, where every worker would then draw identical
+    "random" streams.  The AST linter's H601 rule points clock-based
+    seeding here."""
+    return int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF
+
+
 def seed(new_seed: Optional[int] = None) -> None:
-    """Seed the generator (random.py:885)."""
+    """Seed the generator (random.py:885).  With no argument the seed
+    comes from :func:`default_seed` (OS entropy — collision-free across
+    hosts, unlike a millisecond clock); an explicit seed is used as
+    given, so seeded runs stay bit-deterministic."""
     global __seed, __counter
     if new_seed is None:
-        new_seed = int(time.time() * 1000) & 0x7FFFFFFF
+        new_seed = default_seed()
     __seed = int(new_seed)
     __counter = 0
 

@@ -247,7 +247,7 @@ class PCA(BaseEstimator, TransformMixin):
                 else:
                     try:  # the body exception wins over a writer error
                         writer.close()
-                    except BaseException:
+                    except BaseException:  # lint: allow H501(body exception wins over a writer error)
                         pass
 
     def transform(self, X: DNDarray) -> DNDarray:

@@ -688,7 +688,7 @@ def _host_fftn(arr, s, axes, norm, last_kind: str = None):
         a = a.astype(np.complex64 if arr.dtype in (jnp.complex64, jnp.float32) else np.complex128)
         try:
             return jnp.asarray(a)
-        except Exception:  # complex host->device also unimplemented: split
+        except Exception:  # lint: allow H501(complex transfer unimplemented -> planar split)
             return jax.lax.complex(jnp.asarray(a.real.copy()), jnp.asarray(a.imag.copy()))
     return jnp.asarray(a.astype(np.float32 if arr.dtype in (jnp.complex64, jnp.float32) else np.float64))
 

@@ -67,6 +67,7 @@ from ..telemetry import metrics as _tm
 
 __all__ = [
     "FaultInjector",
+    "KNOWN_SITES",
     "fault_plan",
     "inject",
     "active_injector",
@@ -76,6 +77,30 @@ __all__ = [
 ]
 
 PLAN_ENV = "HEAT_TPU_FAULT_PLAN"
+
+#: Registry of every named injection point wired through the stack.  A
+#: fault plan targeting a site not listed here can never fire; the AST
+#: linter's H302 rule (heat_tpu/analysis/ast_lint.py) statically checks
+#: each ``inject("...")`` / ``fault_site=...`` literal in the sources
+#: against this table, so the registry and the wiring cannot drift.
+#: PURE LITERAL — the linter parses this assignment without importing.
+KNOWN_SITES = (
+    "comm.init",
+    "comm.collective",
+    "dispatch.compile",
+    "io.open",
+    "io.write",
+    "checkpoint.save",
+    "checkpoint.restore",
+    "checkpoint.write",
+    "checkpoint.async_write",
+    "estimator.iter",
+    "kmeans.iter",
+    "kmedians.iter",
+    "kmedoids.iter",
+    "lasso.iter",
+    "pca.stage",
+)
 
 #: process-lifetime totals (survive injector deactivation) — registered
 #: in the shared telemetry registry as ``fault.*``; the bench resilience

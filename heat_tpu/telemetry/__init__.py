@@ -170,5 +170,5 @@ def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
         return
     try:
         metrics.dump_json(path)
-    except Exception:
+    except Exception:  # lint: allow H501(best-effort metrics dump at interpreter exit)
         pass

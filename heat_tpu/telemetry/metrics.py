@@ -109,7 +109,7 @@ class Gauge:
         if self.fn is not None:
             try:
                 return self.fn()
-            except Exception:
+            except Exception:  # lint: allow H501(gauge callback isolation, value degrades to 0)
                 return 0.0
         with self._lock:
             return self._value

@@ -64,7 +64,7 @@ try:  # TraceAnnotation attributes spans in Xprof/perfetto device traces
     import jax
 
     _ANNOTATION = jax.profiler.TraceAnnotation
-except Exception:  # pragma: no cover - jax always present in this repo
+except Exception:  # lint: allow H501(optional jax profiler import guard)
     _ANNOTATION = None
 
 #: one completed span: monotonic start, duration, owning thread, nesting

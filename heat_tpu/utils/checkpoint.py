@@ -245,7 +245,7 @@ class Checkpointer:
                 # where the step is absent; the previous step still is)
                 shutil.rmtree(final)
             os.rename(staging, final)
-        except BaseException:
+        except BaseException:  # lint: allow H501(staging cleanup re-raises)
             shutil.rmtree(staging, ignore_errors=True)
             raise
         self._prune()

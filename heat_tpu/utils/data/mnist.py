@@ -22,7 +22,7 @@ try:  # pragma: no cover - optional dependency
     from torchvision import datasets as _tv_datasets
 
     _TORCHVISION = True
-except Exception:
+except Exception:  # lint: allow H501(optional torchvision import guard)
     _TORCHVISION = False
 
 

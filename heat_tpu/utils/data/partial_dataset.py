@@ -147,7 +147,7 @@ class PartialH5DataLoaderIter:
                     arr = self._ds.transforms(arr)
                 out.append(arr)
             self._ready.put(out[0] if len(out) == 1 else tuple(out))
-        except BaseException as e:  # surface loader errors on the consumer side
+        except BaseException as e:  # lint: allow H501(loader error surfaced on the consumer side)
             self._ready.put(e)
 
     def _queue_next_read(self) -> None:

@@ -208,7 +208,7 @@ class AsyncCheckpointer:
                         jax.block_until_ready(snap)  # device->writer handoff point
                         _inject("checkpoint.async_write", step=step)
                         self.checkpointer.save(step, snap, extra_metadata)
-                except BaseException as e:  # surfaced at the next save/wait/close
+                except BaseException as e:  # lint: allow H501(writer error surfaced at next save/wait/close)
                     with self._error_lock:
                         self._error = e
 
@@ -253,7 +253,7 @@ class AsyncCheckpointer:
             # don't mask the in-flight body exception with a writer error
             try:
                 self.close()
-            except BaseException:
+            except BaseException:  # lint: allow H501(body exception wins over a writer error)
                 pass
 
     # -- read side (sees in-flight writes through) ----------------------

@@ -29,6 +29,7 @@ MODULES = [
     ("Resilience", "heat_tpu.resilience", "fault injection, retry policies, atomic IO, divergence guards (docs/resilience.md)"),
     ("Overlap", "heat_tpu.utils.overlap", "async checkpointing, device prefetch + bucketed gradient-reduction counters (docs/overlap.md)"),
     ("Observability", "heat_tpu.telemetry", "unified metrics registry, structured spans, comm-volume accounting (docs/observability.md)"),
+    ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601) (docs/static_analysis.md)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),
     ("Linear algebra", "heat_tpu.core.linalg.basics", None),
     ("QR / SVD / solvers", "heat_tpu.core.linalg.qr", None),
@@ -99,10 +100,57 @@ def document_module(modpath: str):
     return rows
 
 
+def build_env_vars(out_path: str) -> int:
+    """Generate ``docs/env_vars.md`` from the central knob registry
+    (``heat_tpu.core._env.KNOBS``) — the same table the typed accessors
+    and the H201 lint rule enforce, so the docs cannot drift from the
+    code.  Returns the number of documented knobs."""
+    from heat_tpu.core._env import KNOBS
+
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated from the central knob registry (`heat_tpu/core/_env.py"
+        " KNOBS`) by `scripts/build_api_docs.py` — do not edit.",
+        "",
+        "Every `HEAT_TPU_*` knob the framework reads is registered in that"
+        " one table (name, type, default, doc); the typed accessors"
+        " (`env_flag`/`env_int`/`env_float`/`env_str`) refuse unregistered"
+        " names and the AST linter's [H201 rule](static_analysis.md) flags"
+        " any direct `os.environ` read of an unregistered `HEAT_TPU_*`"
+        " literal — so this page is complete by construction.",
+        "",
+        "Boolean knobs treat `0/false/no/off` (any case) as off and"
+        " anything else as on.  An empty default means *unset* (the"
+        " consumer auto-detects).",
+        "",
+        "| variable | type | default | effect |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(KNOBS):
+        typ, default, doc = KNOBS[name]
+        shown = f"`{default}`" if default != "" else "*(unset)*"
+        lines.append(f"| `{name}` | {typ} | {shown} | {doc} |")
+    lines += [
+        "",
+        "See also: [static analysis](static_analysis.md),"
+        " [dispatch layer](dispatch.md), [resilience](resilience.md),"
+        " [overlap layer](overlap.md), [observability](observability.md).",
+        "",
+    ]
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    return len(KNOBS)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "docs", "api_reference.md"))
+    ap.add_argument("--env-out", default=os.path.join(REPO, "docs", "env_vars.md"))
     args = ap.parse_args()
+
+    n_knobs = build_env_vars(args.env_out)
+    print(f"env vars: {n_knobs} knobs -> {args.env_out}")
 
     parts = [
         "# API reference",

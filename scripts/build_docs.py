@@ -42,6 +42,14 @@ NAV = [
         ("2. Distributed data", "tutorials/hpc/02_distributed_data.md"),
         ("3. Training at scale", "tutorials/hpc/03_training_at_scale.md"),
     ]),
+    ("Internals", [
+        ("Dispatch layer", "docs/dispatch.md"),
+        ("Resilience", "docs/resilience.md"),
+        ("Overlap layer", "docs/overlap.md"),
+        ("Observability", "docs/observability.md"),
+        ("Static analysis", "docs/static_analysis.md"),
+        ("Environment variables", "docs/env_vars.md"),
+    ]),
     ("Reference", [
         ("API reference", "docs/api_reference.md"),
         ("API coverage", "coverage_tables.md"),
@@ -132,9 +140,11 @@ def build(out_dir: str, skip_notebooks: bool) -> int:
         entries = entries + [("Notebooks", NOTEBOOKS)]
 
     api_md = os.path.join(REPO, "docs", "api_reference.md")
-    if not os.path.exists(api_md):
-        # the API reference is a generated artifact: produce it on demand
-        # so the documented one-command invocation works on a fresh clone
+    env_md = os.path.join(REPO, "docs", "env_vars.md")
+    if not (os.path.exists(api_md) and os.path.exists(env_md)):
+        # the API reference and env-var pages are generated artifacts:
+        # produce them on demand so the documented one-command invocation
+        # works on a fresh clone
         import subprocess
 
         subprocess.run(

@@ -1,0 +1,102 @@
+"""Framework-invariant lint gate: fail CI on any NEW violation.
+
+Same gate pattern as ``perf_gate.py``: a committed record of the
+accepted state (``scripts/lint_baseline.json`` — legacy violations that
+predate their rule) is compared against a fresh run of the AST linter
+(``heat_tpu/analysis/ast_lint.py``); any violation not in the baseline
+fails the gate with its rule ID and ``file:line``, so new code cannot
+re-introduce a class of bug the rules exist to prevent.  Violations
+*fixed* since the baseline are reported as stale entries (the gate still
+passes — run with ``--update`` to shrink the baseline).
+
+    python scripts/lint_gate.py [--baseline scripts/lint_baseline.json]
+                                [--paths heat_tpu/] [--update]
+
+Exit status: 0 = no new violations, 1 = new violations (printed).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "scripts", "lint_baseline.json")
+
+
+def run_gate(paths=None, baseline_path=DEFAULT_BASELINE, update=False, quiet=False):
+    """Run the linter and compare to the baseline; returns a result dict
+    (``new``/``fixed``/``total``/``baseline``) for embedding in CI
+    summaries (``perf_ci.py`` reports it next to the perf metrics)."""
+    from heat_tpu.analysis.ast_lint import lint_paths, violations_to_json
+
+    paths = paths or [os.path.join(REPO, "heat_tpu")]
+    violations = lint_paths(paths, repo_root=REPO)
+
+    baseline = []
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            doc = json.load(f)
+        baseline = doc["violations"] if isinstance(doc, dict) else doc
+    baseline_keys = {(e["rule"], e["file"], e["line"]) for e in baseline}
+    current_keys = {v.key() for v in violations}
+
+    new = [v for v in violations if v.key() not in baseline_keys]
+    fixed = sorted(k for k in baseline_keys if k not in current_keys)
+
+    if update:
+        with open(baseline_path, "w") as f:
+            json.dump(
+                {
+                    "comment": "accepted legacy lint violations; regenerate "
+                               "with: python scripts/lint_gate.py --update",
+                    "violations": violations_to_json(violations),
+                },
+                f, indent=1,
+            )
+            f.write("\n")
+        if not quiet:
+            print(f"baseline updated: {len(violations)} accepted violation(s)")
+
+    return {
+        "total": len(violations),
+        "baseline": len(baseline),
+        "new": violations_to_json(new),
+        "new_count": len(new),
+        "fixed": [{"rule": r, "file": f_, "line": l} for r, f_, l in fixed],
+        "fixed_count": len(fixed),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--paths", nargs="*", default=None)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline to the current violation set")
+    args = ap.parse_args()
+
+    res = run_gate(paths=args.paths, baseline_path=args.baseline,
+                   update=args.update)
+
+    for e in res["fixed"]:
+        print(f"stale baseline entry (fixed): {e['file']}:{e['line']} {e['rule']}")
+    if args.update:
+        # the freshly written baseline covers the current set by definition
+        sys.exit(0)
+    if res["new"]:
+        print("\nLINT GATE FAILED — new violation(s):")
+        for e in res["new"]:
+            print(f"  - {e['file']}:{e['line']}: {e['rule']} {e['message']}")
+        sys.exit(1)
+    print(
+        f"lint gate passed: {res['total']} violation(s), all accepted by "
+        f"baseline ({res['fixed_count']} stale baseline entr{'y' if res['fixed_count'] == 1 else 'ies'})"
+    )
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -281,6 +281,28 @@ def main():
 
     guarded("telemetry_overhead", bench_telemetry_overhead)
 
+    # framework-invariant lint gate (scripts/lint_gate.py): violations
+    # are reported alongside the perf metrics and gated as a hard-cap
+    # count — ANY new violation (not in scripts/lint_baseline.json)
+    # fails the same perf_gate run that guards the kernels
+    def bench_lint_gate():
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from lint_gate import run_gate
+
+        res = run_gate(quiet=True)
+        results["lint_new_violations"] = {
+            "count": res["new_count"],
+            "max_count": 0,
+            "total_violations": res["total"],
+            "baseline_violations": res["baseline"],
+            "stale_baseline": res["fixed_count"],
+            "items": [
+                f"{e['file']}:{e['line']} {e['rule']}" for e in res["new"]
+            ],
+        }
+
+    guarded("lint_new_violations", bench_lint_gate)
+
     print(json.dumps(results, indent=1))
 
 

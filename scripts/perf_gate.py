@@ -26,6 +26,23 @@ def gate(committed: dict, current: dict, margin_pct: float) -> int:
     for name, rec in committed.items():
         if not isinstance(rec, dict):
             continue
+        # hard-cap count metrics (``max_count``): absolute integer bound,
+        # e.g. the lint gate's new-violation count must stay at 0
+        if "max_count" in rec:
+            cur = current.get(name)
+            if cur is None or "count" not in cur:
+                failures.append(f"{name}: missing from current run")
+                continue
+            cap = int(rec["max_count"])
+            got = int(cur["count"])
+            failed = got > cap
+            status = "FAIL" if failed else "ok"
+            print(f"{name}: current {got} cap {cap} [{status}]")
+            if failed:
+                failures.append(f"{name}: {got} > cap {cap}")
+                for item in cur.get("items", [])[:20]:
+                    failures.append(f"{name}:   {item}")
+            continue
         # hard-cap metrics (``max_overhead_pct``): absolute bound, no
         # anchor or slack — e.g. telemetry tracing overhead must stay
         # under its cap regardless of runner speed
