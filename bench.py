@@ -890,16 +890,31 @@ def bench_overlap(ht, sync_floor, roofline=None):
 
 
 def bench_telemetry(ht, sync_floor, roofline=None):
-    """Config 9: telemetry-layer self-cost (ISSUE 4).
+    """Config 9: telemetry-layer self-cost (ISSUE 4 + ISSUE 6).
 
     ``span_ns_enabled``/``span_ns_disabled`` — per-span wall cost of the
     host-side tracer with recording on vs off (disabled must be ~two
     attribute reads; enabled buys a ring append + TraceAnnotation).
     ``snapshot_us`` — cost of one full-registry ``telemetry.snapshot()``
     with every domain registered, the price a heartbeat scraper pays.
+    Introspection-layer additions (ISSUE 6): ``scrape_metrics_us`` /
+    ``scrape_varz_us`` — one full HTTP GET against the live endpoint on
+    an ephemeral port (socket + handler + serialization, the cost ONE
+    Prometheus scrape imposes on the process); ``recorder_overhead_ns``
+    — per-span cost with the crash flight recorder ARMED vs not (the
+    recorder is a passive excepthook, so this must be ~1.0x);
+    ``cost_accounting_miss_us`` — per-miss dispatch cost with
+    ``HEAT_TPU_COST_ANALYSIS`` on vs off, plus the recorded flops.
     The headline value is the enabled span cost — the number that bounds
     how densely the stack can afford to be instrumented."""
+    import shutil
+    import tempfile
+    import urllib.request
+
     from heat_tpu import telemetry
+    from heat_tpu.core import dispatch
+    from heat_tpu.telemetry import flight_recorder
+    from heat_tpu.telemetry import server as tserver
 
     def span_ns(n: int = 50_000) -> float:
         t0 = time.perf_counter()
@@ -914,6 +929,16 @@ def bench_telemetry(ht, sync_floor, roofline=None):
         enabled_ns = min(span_ns() for _ in range(3))
         telemetry.set_tracing(False)
         disabled_ns = min(span_ns() for _ in range(3))
+        # flight recorder armed vs not: the recorder is an excepthook +
+        # bundle dir, so the steady-state delta must be noise (~1.0x)
+        telemetry.set_tracing(True)
+        d = tempfile.mkdtemp(prefix="heat_tpu_bench_fr_")
+        try:
+            flight_recorder.install(d)
+            recorder_ns = min(span_ns() for _ in range(3))
+        finally:
+            flight_recorder.uninstall()
+            shutil.rmtree(d, ignore_errors=True)
     finally:
         telemetry.set_tracing(prev)
         telemetry.clear_spans()
@@ -925,6 +950,46 @@ def bench_telemetry(ht, sync_floor, roofline=None):
         telemetry.snapshot()
     snapshot_us = (time.perf_counter() - t0) / n_snap * 1e6
 
+    # live-endpoint scrape cost: ephemeral port, same-process HTTP GET
+    srv = tserver.start_server(0)
+    try:
+        def scrape_us(route: str, n: int = 50) -> float:
+            urllib.request.urlopen(f"{srv.url}{route}", timeout=10).read()  # warm
+            t0 = time.perf_counter()
+            for _ in range(n):
+                urllib.request.urlopen(f"{srv.url}{route}", timeout=10).read()
+            return (time.perf_counter() - t0) / n * 1e6
+
+        scrape_metrics_us = min(scrape_us("/metrics") for _ in range(3))
+        scrape_varz_us = min(scrape_us("/varz") for _ in range(3))
+    finally:
+        tserver.stop_server()
+
+    # per-executable cost accounting: dispatch-miss cost with the
+    # analysis on vs off, and the flops it records
+    import jax.numpy as jnp
+
+    buf = jnp.ones((256,), jnp.float32)
+
+    def miss_us(n: int = 32) -> float:
+        dispatch.clear_cache()
+        ops = [(lambda v: (lambda a, b: a + b * v))(i) for i in range(n)]
+        t0 = time.perf_counter()
+        for op in ops:
+            dispatch.eager_apply(op, (buf, buf))
+        return (time.perf_counter() - t0) / n * 1e6
+
+    prev_cost = dispatch.set_cost_accounting(False)
+    try:
+        cost_off_us = min(miss_us() for _ in range(2))
+        dispatch.set_cost_accounting(True)
+        cost_on_us = min(miss_us() for _ in range(2))
+        cost = dispatch.cost_summary()
+        flops_recorded = cost["flops_total"]
+    finally:
+        dispatch.set_cost_accounting(prev_cost)
+        dispatch.clear_cache()
+
     return {
         "metric": "telemetry_span_ns",
         "value": round(enabled_ns, 1),
@@ -935,6 +1000,12 @@ def bench_telemetry(ht, sync_floor, roofline=None):
         "span_ns_disabled": round(disabled_ns, 1),
         "snapshot_us": round(snapshot_us, 2),
         "metrics_registered": len(telemetry.REGISTRY.names()),
+        "scrape_metrics_us": round(scrape_metrics_us, 1),
+        "scrape_varz_us": round(scrape_varz_us, 1),
+        "recorder_overhead_x": round(recorder_ns / enabled_ns, 3) if enabled_ns else 0.0,
+        "cost_accounting_miss_us": round(cost_on_us, 2),
+        "cost_accounting_off_miss_us": round(cost_off_us, 2),
+        "cost_accounting_flops_recorded": flops_recorded,
     }
 
 

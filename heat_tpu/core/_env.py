@@ -60,6 +60,10 @@ KNOBS = {
     "HEAT_TPU_TRACE": ("bool", "1", "host-side span recording (0 = span() costs two attribute reads and records nothing)"),
     "HEAT_TPU_TRACE_RING": ("int", "4096", "span ring-buffer capacity (newest spans win)"),
     "HEAT_TPU_METRICS_DUMP": ("path", "", "write the final metrics snapshot as JSON to this path at process exit"),
+    "HEAT_TPU_HTTP_PORT": ("int", "0", "serve the runtime-introspection HTTP endpoint (/metrics /varz /healthz /trace /statusz) on this port (0 = off)"),
+    "HEAT_TPU_HEALTH_MAX_AGE_S": ("float", "0", "/healthz flips unhealthy when the fit heartbeat is older than this many seconds (0 = staleness check off)"),
+    "HEAT_TPU_FLIGHT_RECORDER": ("path", "", "crash flight recorder: write atomic crash bundles into this directory on unhandled exceptions (empty = off)"),
+    "HEAT_TPU_COST_ANALYSIS": ("bool", "0", "record per-executable XLA cost/memory analysis at dispatch compile time (/statusz cost accounting)"),
     # -- resilience (heat_tpu/resilience, docs/resilience.md) -----------
     "HEAT_TPU_FAULT_PLAN": ("str", "", "fault-injection plan: inline JSON or a path to a JSON file"),
     "HEAT_TPU_RETRY_NO_SLEEP": ("bool", "0", "record retry backoff delays without sleeping (deterministic failure tests)"),

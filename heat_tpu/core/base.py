@@ -102,9 +102,14 @@ def resumable_fit_loop(
 
     # fit heartbeat: iterations/s of the most recent chunk and its
     # convergence delta, refreshed at every chunk boundary so a stalled
-    # or diverging long fit is visible from telemetry.snapshot()
+    # or diverging long fit is visible from telemetry.snapshot();
+    # fit.heartbeat_ts is the liveness signal /healthz judges staleness
+    # against (HEAT_TPU_HEALTH_MAX_AGE_S, telemetry/server.py)
     iter_rate_g = _tm.gauge("fit.iter_rate", "iterations/s of the last fit chunk")
     shift_g = _tm.gauge("fit.shift", "convergence delta of the last fit chunk")
+    heartbeat_g = _tm.gauge(
+        "fit.heartbeat_ts", "unix time of the last resumable-fit chunk boundary"
+    )
 
     ckpt = None
     directory = checkpoint_dir or resume_from
@@ -134,6 +139,7 @@ def resumable_fit_loop(
     try:
         while total < max_iter:
             n = min(chunk, max_iter - total)
+            heartbeat_g.set(_time.time())  # entering a chunk counts as alive
             t0 = _time.perf_counter()
             # heartbeat span: one per chunk, attrs filled in once the
             # chunk's device values are known
@@ -143,6 +149,7 @@ def resumable_fit_loop(
                 shift = float(shift_dev)
             elapsed = _time.perf_counter() - t0
             sp.attrs.update(iters=iters, shift=shift, total=total + iters)
+            heartbeat_g.set(_time.time())
             iter_rate_g.set(iters / elapsed if elapsed > 0 else 0.0)
             shift_g.set(shift)
             total += iters

@@ -49,6 +49,10 @@ Environment knobs (all default-on):
   executable: unaccounted implicit collectives, accidental full
   gathers, scalar-dtype recompile churn and donation misses surface as
   structured diagnostics (default ``0`` = off, free).
+* ``HEAT_TPU_COST_ANALYSIS=1`` — record XLA's per-executable cost/memory
+  analysis on every cache miss (``dispatch.flops_total``,
+  :func:`cost_summary`; surfaced by the introspection server's
+  ``/statusz`` page and the crash flight recorder).  Default off.
 
 See ``docs/dispatch.md`` for the cache-key, donation, and
 fusion-boundary semantics, and ``docs/static_analysis.md`` for the
@@ -77,15 +81,19 @@ from . import _env as _env
 __all__ = [
     "PendingExpr",
     "cache_enabled",
+    "cache_keys",
     "cache_stats",
     "chain_apply",
     "clear_cache",
+    "cost_accounting_enabled",
+    "cost_summary",
     "eager_apply",
     "fusion_enabled",
     "make_node",
     "materialize",
     "record_external_dispatch",
     "reset_stats",
+    "set_cost_accounting",
 ]
 
 
@@ -96,6 +104,7 @@ _FUSION_ENABLED = _env.env_flag("HEAT_TPU_FUSION")
 _DONATE_ENABLED = _env.env_flag("HEAT_TPU_DONATE")
 FUSION_DEPTH = _env.env_int("HEAT_TPU_FUSION_DEPTH")
 _CACHE_MAXSIZE = _env.env_int("HEAT_TPU_DISPATCH_CACHE_SIZE")
+_COST_ENABLED = _env.env_flag("HEAT_TPU_COST_ANALYSIS")
 
 
 def cache_enabled() -> bool:
@@ -177,9 +186,11 @@ def reset_stats() -> None:
 
 
 def clear_cache() -> None:
-    """Drop every compiled executable and zero the counters."""
+    """Drop every compiled executable (and its cost records) and zero
+    the counters."""
     _cache.clear()
     _aval_cache.clear()
+    _cost_records.clear()
     reset_stats()
 
 
@@ -187,6 +198,123 @@ def record_external_dispatch(n: int = 1) -> None:
     """Count ``n`` executable launches made outside this layer (consumers
     with their own jitted programs: kmeans/lasso loops, ``fusion.jit``)."""
     _C["external_dispatches"].inc(n)
+
+
+# ----------------------------------------------------------------------
+# per-executable cost accounting (docs/observability.md).  Opt-in
+# (``HEAT_TPU_COST_ANALYSIS=1``): on every cache miss the fresh entry is
+# re-lowered and XLA's own cost/memory analysis recorded per cache key —
+# the static FLOP and byte footprint of every compiled program in the
+# process, the inventory ``/statusz`` and the flight recorder expose.
+# Off by default because the extra trace+lower per miss is measurable in
+# compile-bound workloads (the analysis itself is version-guarded: any
+# jax without Lowered.cost_analysis just records nothing).
+# ----------------------------------------------------------------------
+_FLOPS_TOTAL = _tm.counter(
+    "dispatch.flops_total", "XLA cost-analysis flops summed over compiled executables"
+)
+_COST_BYTES_TOTAL = _tm.counter(
+    "dispatch.cost_bytes_total",
+    "XLA cost-analysis bytes-accessed summed over compiled executables",
+)
+
+#: cache key -> cost record for every analyzed executable (bounded like
+#: the executable cache itself)
+_cost_records: "OrderedDict[Any, dict]" = OrderedDict()
+
+
+def cost_accounting_enabled() -> bool:
+    """Whether per-executable cost accounting is active."""
+    return _COST_ENABLED
+
+
+def set_cost_accounting(enabled: bool) -> bool:
+    """Enable/disable cost accounting at runtime (overrides the env
+    knob); returns the previous state.  Bench/test hook."""
+    global _COST_ENABLED
+    prev = _COST_ENABLED
+    _COST_ENABLED = bool(enabled)
+    return prev
+
+
+def _fmt_key_part(obj, depth: int = 0) -> str:
+    if callable(obj):
+        return getattr(obj, "__name__", type(obj).__name__)
+    if isinstance(obj, (tuple, list)):
+        if depth > 3:
+            return "(...)"
+        return "(" + ", ".join(_fmt_key_part(o, depth + 1) for o in obj) + ")"
+    return str(obj)
+
+
+def _key_repr(key, limit: int = 200) -> str:
+    """Compact human-readable form of a cache key (op names, shapes,
+    dtypes; shardings stringify) for /statusz and crash bundles."""
+    s = _fmt_key_part(key)
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def cache_keys() -> list:
+    """Readable reprs of every live executable-cache key (insertion
+    order: oldest first, like the LRU itself)."""
+    return [_key_repr(k) for k in list(_cache)]
+
+
+def cost_summary() -> dict:
+    """Cost-accounting view: totals plus the per-executable records.
+
+    ``{"enabled", "executables", "flops_total", "bytes_total",
+    "per_key": {key_repr: {flops, bytes_accessed, ...}}}`` — totals are
+    the ``dispatch.flops_total`` / ``dispatch.cost_bytes_total``
+    registry counters, so they survive record eviction."""
+    return {
+        "enabled": _COST_ENABLED,
+        "executables": len(_cost_records),
+        "flops_total": _FLOPS_TOTAL.value,
+        "bytes_total": _COST_BYTES_TOTAL.value,
+        "per_key": {_key_repr(k): dict(v) for k, v in _cost_records.items()},
+    }
+
+
+def _record_cost(key, entry, leaves) -> None:
+    """Record XLA's cost/memory analysis for a freshly compiled entry.
+
+    Version-guarded throughout: ``Lowered.cost_analysis`` /
+    ``Compiled.memory_analysis`` vary across jax releases (dict vs
+    [dict], missing attributes) — any probe failure records nothing and
+    costs nothing downstream."""
+    try:
+        lowered = entry.lower(*leaves)
+        cost = lowered.cost_analysis()
+    except Exception:  # lint: allow H501(version-guarded probe; accounting is best-effort)
+        return
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return
+    rec = {
+        "flops": float(cost.get("flops", 0.0) or 0.0),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+        "transcendentals": float(cost.get("transcendentals", 0.0) or 0.0),
+    }
+    try:
+        mem = lowered.compile().memory_analysis()
+        for attr in (
+            "generated_code_size_in_bytes",
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "temp_size_in_bytes",
+        ):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                rec[attr] = int(v)
+    except Exception:  # lint: allow H501(memory analysis missing on this jax/backend; flops still recorded)
+        pass
+    _FLOPS_TOTAL.inc(rec["flops"])
+    _COST_BYTES_TOTAL.inc(rec["bytes_accessed"])
+    _cost_records[key] = rec
+    while len(_cost_records) > _CACHE_MAXSIZE:
+        _cost_records.popitem(last=False)
 
 
 def _note_lookup(hit: bool) -> None:
@@ -419,7 +547,8 @@ def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
     return entry, True
 
 
-def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = False):
+def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = False,
+         key=None):
     _C["dispatches"].inc()
     _C["fused_ops"].inc(n_ops)
     if donated:
@@ -442,6 +571,12 @@ def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = Fals
     with _span("dispatch.compile", ops=n_ops):
         out = call()
     _COMPILE_MS.observe((time.perf_counter() - t0) * 1e3)
+    if _COST_ENABLED and key is not None:
+        # outside the timed window: the accounting re-lower must not
+        # inflate the compile_ms histogram it sits next to
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*[Dd]onat")
+            _record_cost(key, compiled, leaves)
     return out
 
 
@@ -461,7 +596,7 @@ def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=No
         compiled, fresh = _get_compiled(key, builder, out_sharding=out_sharding)
         if fresh:
             _maybe_analyze(compiled, leaves, key)
-        return _run(compiled, leaves, n_ops, fresh=fresh)
+        return _run(compiled, leaves, n_ops, fresh=fresh, key=key)
     except (_PermanentFault, _ChecksumError):
         # non-retryable resilience faults must propagate — an eager
         # fallback here would SWALLOW a permanent failure the caller's
@@ -745,7 +880,7 @@ def repad(buf, old_slice, pad_widths, sharding, donate: bool = False):
     compiled, fresh = _get_compiled(key, build, donate_argnums=(0,), out_sharding=sharding)
     if fresh:
         _maybe_analyze(compiled, (buf,), key, donate_argnums=(0,))
-    return _run(compiled, (buf,), 1, donated=True, fresh=fresh)
+    return _run(compiled, (buf,), 1, donated=True, fresh=fresh, key=key)
 
 
 def cast_store(dst_buf, src, dtype, out_sharding=None):
@@ -833,4 +968,4 @@ def cast_store(dst_buf, src, dtype, out_sharding=None):
     )
     if fresh:
         _maybe_analyze(compiled, leaves, key, donate_argnums=(donate_ix,))
-    return _run(compiled, leaves, len(nodes), donated=True, fresh=fresh)
+    return _run(compiled, leaves, len(nodes), donated=True, fresh=fresh, key=key)

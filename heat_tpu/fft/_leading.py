@@ -38,7 +38,6 @@ constants), so every norm mode ships at the default-path cost.
 
 from __future__ import annotations
 
-import functools
 import os
 from typing import Optional, Tuple
 
@@ -60,64 +59,14 @@ def _precision():
 
 
 # ----------------------------------------------------------------------
-# Byte-bounded weight cache.  The DFT weight matrices scale as n^2 (a
-# 1024-point f64 (cos, sin) pair is 16 MB; the (n, 2n) cat matrices and
-# their bf16 splits likewise), so a 64-ENTRY lru_cache over varied sizes
-# can pin ~1 GB of host RAM for the process lifetime.  All weight
-# builders share one LRU keyed by (builder, args) and bounded by BYTES
-# (HEAT_TPU_FFT_WEIGHT_CACHE_MB, default 256): inserts evict
-# least-recently-used entries until the total fits, so sweeping sizes
-# recomputes cold weights instead of growing without bound.
+# Byte-bounded weight cache — shared with _planar.py via _weight_cache
+# (one LRU keyed by (builder, args), bounded by BYTES under
+# HEAT_TPU_FFT_WEIGHT_CACHE_MB, eviction counter in the telemetry
+# registry; see heat_tpu/fft/_weight_cache.py).  The legacy names are
+# re-exported here because this module introduced the surface.
 # ----------------------------------------------------------------------
-_WEIGHT_CACHE_BUDGET = int(
-    float(os.environ.get("HEAT_TPU_FFT_WEIGHT_CACHE_MB", "256")) * (1 << 20)
-)
-_weight_cache: "dict" = {}  # insertion-ordered; move-to-end on hit
-_weight_cache_nbytes = 0
-
-
-def _entry_nbytes(val) -> int:
-    if isinstance(val, tuple):
-        return sum(_entry_nbytes(v) for v in val)
-    return int(getattr(val, "nbytes", 0))
-
-
-def _byte_lru(fn):
-    """lru_cache analog bounded by the shared byte budget."""
-    tag = fn.__name__
-
-    @functools.wraps(fn)
-    def wrapper(*args):
-        global _weight_cache_nbytes
-        key = (tag, args)
-        if key in _weight_cache:
-            val = _weight_cache.pop(key)  # re-insert: most recently used
-            _weight_cache[key] = val
-            return val
-        val = fn(*args)
-        _weight_cache[key] = val
-        _weight_cache_nbytes += _entry_nbytes(val)
-        while _weight_cache_nbytes > _WEIGHT_CACHE_BUDGET and len(_weight_cache) > 1:
-            old = _weight_cache.pop(next(iter(_weight_cache)))
-            _weight_cache_nbytes -= _entry_nbytes(old)
-        return val
-
-    return wrapper
-
-
-def weight_cache_stats() -> dict:
-    """Size/budget snapshot of the shared weight cache (test surface)."""
-    return {
-        "entries": len(_weight_cache),
-        "nbytes": _weight_cache_nbytes,
-        "budget_nbytes": _WEIGHT_CACHE_BUDGET,
-    }
-
-
-def weight_cache_clear() -> None:
-    global _weight_cache_nbytes
-    _weight_cache.clear()
-    _weight_cache_nbytes = 0
+from ._weight_cache import byte_lru as _byte_lru
+from ._weight_cache import weight_cache_clear, weight_cache_stats
 
 
 @_byte_lru
@@ -156,7 +105,7 @@ def _w_cat(n: int, dt: str, inverse: bool, scale: float):
     return np.asarray(np.concatenate([c, s], 1) * scale, dt)
 
 
-@functools.lru_cache(maxsize=16)
+@_byte_lru
 def _perm_bf(n: int):
     """Exact-in-bf16 rev-roll permutation: P[a, b] = 1 iff a = (n-b) % n.
 

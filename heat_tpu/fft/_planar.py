@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._weight_cache import byte_lru as _byte_lru
+
 __all__ = [
     "fft_planes",
     "fftn_planes",
@@ -78,7 +80,7 @@ def _mm(a: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.matmul(a, w, precision=_precision())
 
 
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _dft_w(n: int, inverse: bool, dtype: str):
     """(W_re, W_im, W_re+W_im) for the symmetric n-point DFT matrix."""
     j = np.arange(n, dtype=np.float64)
@@ -99,7 +101,7 @@ def _dft_w(n: int, inverse: bool, dtype: str):
     )
 
 
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _dft_w2(n: int, inverse: bool, dtype: str):
     """(W_re, W_im) only — the direct-dot branch never needs the
     Karatsuba wsum plane, and at the 1024-point cap each cached wsum
@@ -111,7 +113,7 @@ def _dft_w2(n: int, inverse: bool, dtype: str):
     return np.asarray(np.cos(ang), dtype), np.asarray(sign * np.sin(ang), dtype)
 
 
-@functools.lru_cache(maxsize=64)
+@_byte_lru
 def _twiddle(n1: int, n2: int, n: int, inverse: bool, dtype: str):
     """T[j1, k2] = exp(sign * 2*pi*i * j1*k2 / n) for the four-step."""
     j1 = np.arange(n1, dtype=np.float64)
@@ -259,7 +261,7 @@ def _fft_last(re, im, inverse: bool) -> Tuple[jax.Array, jax.Array]:
     return re, im
 
 
-@functools.lru_cache(maxsize=32)
+@_byte_lru
 def _bluestein_consts(n: int, inverse: bool, dtype: str):
     """Chirp and the precomputed spectrum of the chirp filter."""
     m = _next_pow2(2 * n - 1)
@@ -371,7 +373,7 @@ def fftn_planes(
 # doubles MXU time for accuracy below the truncation any consumer of a
 # single-precision transform already accepts.
 # ----------------------------------------------------------------------
-@functools.lru_cache(maxsize=32)
+@_byte_lru
 def _w2_full(n: int, inverse: bool, dtype: str):
     """(2n, 2n) interleaved real form of the complex DFT matrix."""
     wre, wim = _dft_w(n, inverse, "float64")[:2]
@@ -383,7 +385,7 @@ def _w2_full(n: int, inverse: bool, dtype: str):
     return np.asarray(W.reshape(2 * n, 2 * n), dtype)
 
 
-@functools.lru_cache(maxsize=32)
+@_byte_lru
 def _w2_real_in(n: int, m: int, dtype: str):
     """(n, 2m) real-input DFT matrix truncated at the Nyquist bin."""
     wre, wim = _dft_w(n, False, "float64")[:2]
@@ -391,7 +393,7 @@ def _w2_real_in(n: int, m: int, dtype: str):
     return np.asarray(W.reshape(n, 2 * m), dtype)
 
 
-@functools.lru_cache(maxsize=32)
+@_byte_lru
 def _w2_split(n: int, dtype: str, inverse: bool = False):
     """(2n, n) re and im column blocks of the full interleaved matrix."""
     W = _w2_full(n, inverse, dtype)
@@ -401,7 +403,7 @@ def _w2_split(n: int, dtype: str, inverse: bool = False):
     )
 
 
-@functools.lru_cache(maxsize=32)
+@_byte_lru
 def _w2_row_split(n: int, dtype: str, inverse: bool = False):
     """(n, 2n) row blocks applying the DFT to a SEPARATE re / im plane:
     out_interleaved = re @ rows_re + im @ rows_im — the plane pair enters
@@ -517,7 +519,7 @@ def rfft3_half_interleaved(x: jax.Array, norm) -> Tuple[jax.Array, jax.Array]:
     return _rfft3_half(x, norm)
 
 
-@functools.lru_cache(maxsize=32)
+@_byte_lru
 def _w_irfft_exit(m_used: int, n_out: int, dtype: str):
     """(2*m_used, n_out) c2r exit matrix: the Hermitian extension IS the
     matrix.  out[x] = sum_k w_k (re_k cos(2pi k x / n) - im_k sin(...))

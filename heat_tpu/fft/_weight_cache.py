@@ -1,0 +1,94 @@
+"""Shared byte-bounded LRU for the FFT weight/twiddle matrices.
+
+The DFT weight matrices scale as n^2 (a 1024-point f64 (cos, sin) pair
+is 16 MB; the (n, 2n) cat matrices and their bf16 splits likewise), so
+an entry-count-bounded ``lru_cache`` over varied transform sizes can pin
+hundreds of MB to ~1 GB of host RAM for the process lifetime (ADVICE
+round 5).  Every weight builder in ``_leading.py`` **and**
+``_planar.py`` therefore shares ONE insertion-ordered LRU keyed by
+``(builder name, args)`` and bounded by BYTES
+(``HEAT_TPU_FFT_WEIGHT_CACHE_MB``, default 256): inserts evict
+least-recently-used entries until the total fits, so sweeping sizes
+recomputes cold weights instead of growing without bound.
+
+Evictions are counted into the telemetry registry
+(``fft.weight_cache.evictions``) and the live byte total is a callback
+gauge (``fft.weight_cache.nbytes``), so a workload thrashing the weight
+cache is visible from ``telemetry.snapshot()`` / the ``/varz`` endpoint
+instead of only as mysterious recompute time.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+from ..telemetry import metrics as _tm
+
+__all__ = [
+    "byte_lru",
+    "weight_cache_clear",
+    "weight_cache_stats",
+]
+
+_WEIGHT_CACHE_BUDGET = int(
+    float(os.environ.get("HEAT_TPU_FFT_WEIGHT_CACHE_MB", "256")) * (1 << 20)
+)
+_weight_cache: "dict" = {}  # insertion-ordered; move-to-end on hit
+_weight_cache_nbytes = 0
+
+_EVICTIONS = _tm.counter(
+    "fft.weight_cache.evictions",
+    "FFT weight-cache entries evicted by the shared byte budget",
+)
+_tm.gauge(
+    "fft.weight_cache.nbytes",
+    "live bytes held by the shared FFT weight cache",
+    fn=lambda: _weight_cache_nbytes,
+)
+
+
+def _entry_nbytes(val) -> int:
+    if isinstance(val, tuple):
+        return sum(_entry_nbytes(v) for v in val)
+    return int(getattr(val, "nbytes", 0))
+
+
+def byte_lru(fn):
+    """lru_cache analog bounded by the shared byte budget."""
+    tag = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        global _weight_cache_nbytes
+        key = (tag, args)
+        if key in _weight_cache:
+            val = _weight_cache.pop(key)  # re-insert: most recently used
+            _weight_cache[key] = val
+            return val
+        val = fn(*args)
+        _weight_cache[key] = val
+        _weight_cache_nbytes += _entry_nbytes(val)
+        while _weight_cache_nbytes > _WEIGHT_CACHE_BUDGET and len(_weight_cache) > 1:
+            old = _weight_cache.pop(next(iter(_weight_cache)))
+            _weight_cache_nbytes -= _entry_nbytes(old)
+            _EVICTIONS.inc()
+        return val
+
+    return wrapper
+
+
+def weight_cache_stats() -> dict:
+    """Size/budget snapshot of the shared weight cache (test surface)."""
+    return {
+        "entries": len(_weight_cache),
+        "nbytes": _weight_cache_nbytes,
+        "budget_nbytes": _WEIGHT_CACHE_BUDGET,
+        "evictions": _EVICTIONS.value,
+    }
+
+
+def weight_cache_clear() -> None:
+    global _weight_cache_nbytes
+    _weight_cache.clear()
+    _weight_cache_nbytes = 0

@@ -21,6 +21,16 @@ XLA compiles, and where did the wall-clock go?*
 * :mod:`~heat_tpu.telemetry.profiling` — ``start_trace``/``stop_trace``
   /``monitor`` device-trace hooks (moved from ``utils.profiling``,
   which re-exports them).
+* :mod:`~heat_tpu.telemetry.server` — runtime-introspection HTTP
+  endpoint (``HEAT_TPU_HTTP_PORT``; ``/metrics`` ``/varz`` ``/healthz``
+  ``/trace`` ``/statusz`` on a daemon thread, off by default).
+* :mod:`~heat_tpu.telemetry.aggregate` — cross-worker snapshot
+  tagging/merging with straggler/skew gauges
+  (``telemetry.straggler_score``).
+* :mod:`~heat_tpu.telemetry.flight_recorder` — crash flight recorder
+  (``HEAT_TPU_FLIGHT_RECORDER``): atomic CRC32-checksummed forensic
+  bundles on unhandled exceptions, rendered by
+  ``python -m heat_tpu.telemetry.inspect``.
 
 Instrumentation wired through the stack: ``parallel.comm`` collectives
 account trace-time payload bytes x participants into
@@ -43,6 +53,9 @@ from typing import Any, Dict, Optional
 from . import metrics
 from . import spans
 from . import profiling
+from . import aggregate
+from . import flight_recorder
+from . import server
 from .metrics import (
     Counter,
     Gauge,
@@ -58,6 +71,7 @@ from .metrics import (
 )
 from .spans import (
     SpanRecord,
+    chrome_trace_doc,
     clear_spans,
     export_chrome_trace,
     get_spans,
@@ -66,6 +80,14 @@ from .spans import (
     tracing_enabled,
 )
 from .profiling import annotate, monitor, start_trace, stop_trace, trace
+from .aggregate import (
+    gather_snapshots,
+    merge_snapshots,
+    tag_snapshot,
+    write_worker_snapshot,
+)
+from .flight_recorder import dump_bundle
+from .server import start_server, stop_server
 
 __all__ = [
     "Counter",
@@ -75,24 +97,32 @@ __all__ = [
     "REGISTRY",
     "SpanRecord",
     "annotate",
+    "chrome_trace_doc",
     "clear_spans",
     "counter",
+    "dump_bundle",
     "dump_json",
     "expose",
     "export_chrome_trace",
+    "gather_snapshots",
     "gauge",
     "get_spans",
     "histogram",
+    "merge_snapshots",
     "monitor",
     "reset_all",
     "set_tracing",
     "snapshot",
     "span",
+    "start_server",
     "start_trace",
+    "stop_server",
     "stop_trace",
     "summary_line",
+    "tag_snapshot",
     "trace",
     "tracing_enabled",
+    "write_worker_snapshot",
 ]
 
 #: legacy per-domain reset functions delegate here with these names;
@@ -106,7 +136,9 @@ _DOMAIN_PREFIXES = {
     "comm": ("comm.",),
     "fit": ("fit.",),
     "spans": ("spans.",),
-    "telemetry": ("spans.", "fit."),
+    "flight": ("flight.",),
+    "checkpoint": ("checkpoint.",),
+    "telemetry": ("spans.", "fit.", "telemetry.", "flight.", "checkpoint."),
 }
 
 
@@ -164,7 +196,9 @@ def summary_line(iter_rate: Optional[float] = None) -> str:
 def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
     """``HEAT_TPU_METRICS_DUMP=<path>``: write the final metrics snapshot
     as JSON at interpreter exit (checked at exit time, so setting the
-    variable after import still works)."""
+    variable after import still works).  The write goes through the
+    resilience atomic+CRC32 writer, so a crash mid-dump can never leave
+    a truncated artifact."""
     path = os.environ.get("HEAT_TPU_METRICS_DUMP")
     if not path:
         return
@@ -172,3 +206,10 @@ def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
         metrics.dump_json(path)
     except Exception:  # lint: allow H501(best-effort metrics dump at interpreter exit)
         pass
+
+
+# runtime introspection: HEAT_TPU_HTTP_PORT starts the HTTP endpoint,
+# HEAT_TPU_FLIGHT_RECORDER arms the crash recorder — both off by
+# default, both zero-cost when off (docs/observability.md)
+server.maybe_start_from_env()
+flight_recorder.maybe_install_from_env()

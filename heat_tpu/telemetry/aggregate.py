@@ -1,0 +1,335 @@
+"""Cross-worker telemetry: merge per-process snapshots into one view.
+
+A multi-host fit runs one Python controller per host, each with its own
+process-local metrics registry — so "is worker 3 slow?" cannot be
+answered from any single registry.  This module makes it a queryable
+number:
+
+* :func:`tag_snapshot` stamps the local registry snapshot with
+  ``process_index`` / ``process_count`` plus a per-span-name duration
+  digest (:func:`span_stats` — the ``fit.chunk`` and ``comm.*`` wall
+  times the skew math needs);
+* :func:`write_worker_snapshot` / :func:`read_worker_snapshots` are the
+  shared-filesystem transport (atomic JSON per worker — the fallback
+  that always works);
+* :func:`gather_snapshots` collects every worker's tagged snapshot —
+  over the comm layer (``jax.experimental.multihost_utils``) when the
+  distributed runtime is up, else from per-host JSON files;
+* :func:`merge_snapshots` folds them into ONE deterministic labeled
+  view — counters summed, gauges per-worker with min/max/mean — and
+  computes the skew gauges:
+
+  - ``telemetry.straggler_score`` — relative excess of the slowest
+    worker's mean ``fit.chunk`` duration over the median worker
+    (``0`` = perfectly balanced; ``1`` = the slowest worker takes 2x
+    the median; a dead worker with no heartbeat scores ``inf`` capped
+    to ``1e9``).  The number ROADMAP item 2's reshape decision reads.
+  - ``telemetry.chunk_spread`` — (max - min) / mean of the per-worker
+    mean chunk durations.
+  - ``telemetry.comm_imbalance`` — same spread over per-worker total
+    ``comm.*`` span wall time (a worker waiting in collectives much
+    longer than its peers is being dragged by a straggler even when
+    its own compute is fine).
+
+Merging is a pure function of the input snapshots (sorted by
+``process_index``, no clocks, no RNG), so two hosts merging the same
+set of snapshot files compute byte-identical views.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import metrics as _metrics
+from . import spans as _spans
+
+__all__ = [
+    "gather_snapshots",
+    "merge_snapshots",
+    "read_worker_snapshots",
+    "span_stats",
+    "straggler_score",
+    "tag_snapshot",
+    "write_worker_snapshot",
+]
+
+_SCORE_CAP = 1e9  # a dead worker's score: finite, JSON-safe, unmistakable
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # lint: allow H501(no backend yet: single-process identity)
+        return 0
+
+
+def _process_count() -> int:
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # lint: allow H501(no backend yet: single-process identity)
+        return 1
+
+
+def span_stats() -> Dict[str, Dict[str, float]]:
+    """Per-span-name digest of the ring buffer: ``{name: {count,
+    total_ms, mean_ms, max_ms}}`` — the fixed-size summary that travels
+    in a worker snapshot instead of the raw ring."""
+    out: Dict[str, Dict[str, float]] = {}
+    for rec in _spans.get_spans():
+        d = out.get(rec.name)
+        ms = rec.duration_ns / 1e6
+        if d is None:
+            out[rec.name] = {"count": 1, "total_ms": ms, "max_ms": ms}
+        else:
+            d["count"] += 1
+            d["total_ms"] += ms
+            if ms > d["max_ms"]:
+                d["max_ms"] = ms
+    for d in out.values():
+        d["mean_ms"] = d["total_ms"] / d["count"]
+        d["total_ms"] = round(d["total_ms"], 6)
+        d["mean_ms"] = round(d["mean_ms"], 6)
+        d["max_ms"] = round(d["max_ms"], 6)
+    return dict(sorted(out.items()))
+
+
+def tag_snapshot() -> Dict[str, Any]:
+    """The local registry snapshot tagged with this worker's identity."""
+    import time
+
+    return {
+        "process_index": _process_index(),
+        "process_count": _process_count(),
+        "pid": os.getpid(),
+        "timestamp": time.time(),
+        "metrics": _metrics.snapshot(),
+        "span_stats": span_stats(),
+    }
+
+
+# ----------------------------------------------------------------------
+# transport
+# ----------------------------------------------------------------------
+def _worker_path(directory: str, index: int) -> str:
+    return os.path.join(directory, f"worker_{index:05d}.json")
+
+
+def write_worker_snapshot(directory: str, snapshot: Optional[Dict] = None) -> str:
+    """Write this worker's tagged snapshot into ``directory`` (atomic +
+    CRC sidecar, one file per ``process_index``); returns the path."""
+    from ..resilience.atomic import atomic_write
+
+    snap = tag_snapshot() if snapshot is None else snapshot
+    path = _worker_path(directory, int(snap["process_index"]))
+    with atomic_write(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(snap, f, indent=1, default=str)
+    return path
+
+
+def read_worker_snapshots(directory: str) -> List[Dict]:
+    """Checksum-verified worker snapshots from ``directory``, sorted by
+    ``process_index``."""
+    from ..resilience.atomic import verify_checksum
+
+    snaps = []
+    if os.path.isdir(directory):
+        for name in sorted(os.listdir(directory)):
+            if not (name.startswith("worker_") and name.endswith(".json")):
+                continue
+            path = os.path.join(directory, name)
+            verify_checksum(path)
+            with open(path) as f:
+                snaps.append(json.load(f))
+    return sorted(snaps, key=lambda s: int(s.get("process_index", 0)))
+
+
+def gather_snapshots(directory: Optional[str] = None) -> List[Dict]:
+    """Every worker's tagged snapshot, one list on every caller.
+
+    Transport preference: when the comm layer is initialized on a real
+    multi-process world, all-gather the JSON payloads over the
+    distributed runtime (no shared filesystem needed); otherwise — or
+    when the gather is unavailable on this jax version — fall back to
+    ``directory`` (each worker must have called
+    :func:`write_worker_snapshot` there).  A single-process world
+    returns ``[tag_snapshot()]`` directly."""
+    nproc = _process_count()
+    if nproc <= 1:
+        return [tag_snapshot()]
+    from ..parallel import comm as _comm
+
+    if _comm.is_initialized():
+        snaps = _gather_via_comm()
+        if snaps is not None:
+            return snaps
+    if directory is None:
+        raise ValueError(
+            "gather_snapshots on a multi-process world needs either an "
+            "initialized comm layer with a working all-gather or a shared "
+            "`directory` of write_worker_snapshot files"
+        )
+    write_worker_snapshot(directory)
+    return read_worker_snapshots(directory)
+
+
+def _gather_via_comm() -> Optional[List[Dict]]:  # pragma: no cover - multi-host only
+    """All-gather the tagged snapshots as padded utf-8 buffers; None when
+    this jax version has no process_allgather."""
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        payload = json.dumps(tag_snapshot(), default=str).encode("utf-8")
+        n = np.asarray([len(payload)], np.int32)
+        max_n = int(multihost_utils.process_allgather(n).max())
+        buf = np.zeros(max_n, np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+        lens = multihost_utils.process_allgather(n)[:, 0]
+        bufs = multihost_utils.process_allgather(buf)
+        snaps = [
+            json.loads(bytes(bufs[i, : int(lens[i])]).decode("utf-8"))
+            for i in range(bufs.shape[0])
+        ]
+        return sorted(snaps, key=lambda s: int(s.get("process_index", 0)))
+    except Exception:  # lint: allow H501(older jax: caller falls back to the file transport)
+        return None
+
+
+# ----------------------------------------------------------------------
+# merge + skew
+# ----------------------------------------------------------------------
+def _spread(values: Sequence[float]) -> float:
+    """(max - min) / mean, 0 for degenerate inputs."""
+    vals = [float(v) for v in values]
+    if len(vals) < 2:
+        return 0.0
+    mean = sum(vals) / len(vals)
+    return (max(vals) - min(vals)) / mean if mean > 0 else 0.0
+
+
+def straggler_score(chunk_means_ms: Sequence[float]) -> float:
+    """Relative excess of the slowest worker over the median worker.
+
+    ``(max - median) / median``: 0 when balanced, 1 when the slowest
+    worker takes twice the median chunk time.  A worker reporting no
+    ``fit.chunk`` spans at all (dead or hung before its first chunk)
+    is treated as infinitely slow, capped to ``1e9``."""
+    vals = sorted(float(v) for v in chunk_means_ms if v is not None)
+    n_missing = sum(1 for v in chunk_means_ms if v is None)
+    if n_missing and vals:
+        return _SCORE_CAP
+    if len(vals) < 2:
+        return 0.0
+    mid = vals[len(vals) // 2] if len(vals) % 2 else 0.5 * (
+        vals[len(vals) // 2 - 1] + vals[len(vals) // 2]
+    )
+    if mid <= 0:
+        return 0.0
+    return (vals[-1] - mid) / mid
+
+
+def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str, Any]:
+    """Fold worker-tagged snapshots into one deterministic labeled view.
+
+    * ``workers`` — each input's metrics keyed by ``process_index``;
+    * ``merged`` — counters summed across workers; gauges and histogram
+      sub-documents reported per worker plus a ``{min, max, mean}``
+      digest (summing a gauge like ``fit.iter_rate`` would be a lie);
+    * ``skew`` — the straggler/spread/imbalance gauges described in the
+      module docstring, each also published into the local registry
+      (``publish=False`` for a pure computation).
+
+    Determinism: output depends only on the input snapshots; workers are
+    ordered by ``process_index`` and every dict is key-sorted."""
+    snaps = sorted(snapshots, key=lambda s: int(s.get("process_index", 0)))
+    if not snaps:
+        raise ValueError("merge_snapshots needs at least one snapshot")
+
+    workers: Dict[str, Any] = {}
+    merged_counters: Dict[str, float] = {}
+    per_value: Dict[str, Dict[str, Any]] = {}
+    for s in snaps:
+        ix = str(int(s.get("process_index", 0)))
+        workers[ix] = {
+            "pid": s.get("pid"),
+            "timestamp": s.get("timestamp"),
+            "metrics": s.get("metrics", {}),
+            "span_stats": s.get("span_stats", {}),
+        }
+        for name, val in (s.get("metrics") or {}).items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                # counters AND plain gauges are numeric; summing is only
+                # meaningful for counters, so both forms are kept: the
+                # sum (counter semantics) and the per-worker spread
+                merged_counters[name] = merged_counters.get(name, 0) + val
+            per_value.setdefault(name, {})[ix] = val
+
+    merged_values: Dict[str, Any] = {}
+    for name in sorted(per_value):
+        by_worker = per_value[name]
+        numeric = [
+            v for v in by_worker.values()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        ]
+        entry: Dict[str, Any] = {"per_worker": dict(sorted(by_worker.items()))}
+        if numeric:
+            entry["sum"] = merged_counters.get(name, 0)
+            entry["min"] = min(numeric)
+            entry["max"] = max(numeric)
+            entry["mean"] = sum(numeric) / len(numeric)
+        merged_values[name] = entry
+
+    # -- skew gauges ----------------------------------------------------
+    chunk_means: List[Optional[float]] = []
+    comm_totals: List[float] = []
+    for s in snaps:
+        ss = s.get("span_stats") or {}
+        chunk = ss.get("fit.chunk")
+        chunk_means.append(float(chunk["mean_ms"]) if chunk else None)
+        comm_totals.append(
+            sum(
+                float(d.get("total_ms", 0.0))
+                for nm, d in ss.items()
+                if nm.startswith("comm.")
+            )
+        )
+    known_chunks = [c for c in chunk_means if c is not None]
+    skew = {
+        "workers": len(snaps),
+        "straggler_score": straggler_score(chunk_means)
+        if any(c is not None for c in chunk_means)
+        else 0.0,
+        "chunk_spread": _spread(known_chunks),
+        "comm_imbalance": _spread(comm_totals),
+        "chunk_mean_ms": dict(
+            sorted(
+                (str(int(s.get("process_index", 0))), c)
+                for s, c in zip(snaps, chunk_means)
+            )
+        ),
+    }
+    if publish:
+        _metrics.gauge(
+            "telemetry.straggler_score",
+            "slowest worker's fit.chunk mean vs the median worker (merged view)",
+        ).set(skew["straggler_score"])
+        _metrics.gauge(
+            "telemetry.chunk_spread",
+            "(max-min)/mean of per-worker fit.chunk mean durations",
+        ).set(skew["chunk_spread"])
+        _metrics.gauge(
+            "telemetry.comm_imbalance",
+            "(max-min)/mean of per-worker total comm.* span wall time",
+        ).set(skew["comm_imbalance"])
+    return {
+        "workers": dict(sorted(workers.items())),
+        "merged": merged_values,
+        "skew": skew,
+    }

@@ -1,0 +1,269 @@
+"""Crash flight recorder: an atomic forensic bundle on unhandled failure.
+
+A crashed fit today leaves a traceback on stderr and nothing else — the
+span ring, the metrics registry and the dispatch-cache state die with
+the process, which is exactly the evidence that explains *why* it
+crashed.  With ``HEAT_TPU_FLIGHT_RECORDER=<dir>`` (or an explicit
+:func:`install` call) an excepthook writes a single JSON **crash
+bundle** into ``<dir>`` on any unhandled exception — including
+``PermanentFault`` and ``DivergenceError``, the resilience layer's
+terminal verdicts — through the resilience atomic+CRC32 writer, so the
+bundle itself can never be torn and a reader can verify it.
+
+One bundle carries everything the post-mortem needs::
+
+    exception   type / message / formatted traceback
+    metrics     full registry snapshot (comm bytes, compile time, ...)
+    spans       the span ring (what the process was doing, in order)
+    knobs       every registered HEAT_TPU_* knob's effective value
+    dispatch    cache stats + keys + per-executable cost accounting
+    checkpoint  last durable step (where a resume would restart)
+    runtime     python/jax/device/version info
+
+Pretty-print one with::
+
+    python -m heat_tpu.telemetry.inspect <bundle.json>
+
+The hook chains to the previous ``sys.excepthook`` (the traceback still
+prints), ``threading.excepthook`` is wrapped the same way (a crashed
+checkpoint-writer thread is exactly a case worth a bundle), and bundle
+writing is best-effort: a failure to write can never mask the original
+exception.  ``KeyboardInterrupt``/``SystemExit`` are not crashes and do
+not record.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback as _traceback
+from typing import Any, Dict, Optional
+
+from . import metrics as _metrics
+from . import spans as _spans
+
+__all__ = [
+    "BUNDLE_SCHEMA",
+    "dump_bundle",
+    "install",
+    "installed",
+    "last_bundle_path",
+    "maybe_install_from_env",
+    "uninstall",
+]
+
+#: bundle schema version; bump on breaking layout changes so
+#: ``telemetry.inspect`` can refuse bundles it cannot render
+BUNDLE_SCHEMA = 1
+
+_LOCK = threading.Lock()
+_DIR: Optional[str] = None
+_PREV_SYS_HOOK = None
+_PREV_THREAD_HOOK = None
+_LAST_PATH: Optional[str] = None
+
+_BUNDLES = _metrics.counter(
+    "flight.bundles_written", "crash bundles written by the flight recorder"
+)
+
+
+def installed() -> bool:
+    """Whether the crash excepthook is active."""
+    return _DIR is not None
+
+
+def last_bundle_path() -> Optional[str]:
+    """Path of the most recently written bundle (None before the first)."""
+    return _LAST_PATH
+
+
+def install(directory: Optional[str] = None) -> str:
+    """Arm the flight recorder; returns the bundle directory.
+
+    ``directory=None`` reads ``HEAT_TPU_FLIGHT_RECORDER``.  Idempotent —
+    a second install only updates the directory."""
+    global _DIR, _PREV_SYS_HOOK, _PREV_THREAD_HOOK
+    if directory is None:
+        from ..core import _env as envmod
+
+        directory = envmod.env_str("HEAT_TPU_FLIGHT_RECORDER")
+    if not directory:
+        raise ValueError(
+            "flight recorder needs a bundle directory (argument or "
+            "HEAT_TPU_FLIGHT_RECORDER)"
+        )
+    with _LOCK:
+        first = _DIR is None
+        _DIR = str(directory)
+        if first:
+            _PREV_SYS_HOOK = sys.excepthook
+            sys.excepthook = _sys_hook
+            _PREV_THREAD_HOOK = getattr(threading, "excepthook", None)
+            if _PREV_THREAD_HOOK is not None:
+                threading.excepthook = _thread_hook
+    return _DIR
+
+
+def uninstall() -> None:
+    """Disarm and restore the previous hooks (no-op when not armed)."""
+    global _DIR, _PREV_SYS_HOOK, _PREV_THREAD_HOOK
+    with _LOCK:
+        if _DIR is None:
+            return
+        _DIR = None
+        if _PREV_SYS_HOOK is not None:
+            sys.excepthook = _PREV_SYS_HOOK
+            _PREV_SYS_HOOK = None
+        if _PREV_THREAD_HOOK is not None:
+            threading.excepthook = _PREV_THREAD_HOOK
+            _PREV_THREAD_HOOK = None
+
+
+def maybe_install_from_env() -> Optional[str]:
+    """Arm iff ``HEAT_TPU_FLIGHT_RECORDER`` names a directory (called
+    once at ``heat_tpu.telemetry`` import).  Direct environ read (the
+    knob IS registered in core/_env.py KNOBS): this runs during package
+    init, where importing core._env would re-enter the import chain."""
+    directory = os.environ.get("HEAT_TPU_FLIGHT_RECORDER", "")
+    if not directory:
+        return None
+    return install(directory)
+
+
+# ----------------------------------------------------------------------
+# bundle construction
+# ----------------------------------------------------------------------
+def _knob_values() -> Dict[str, Any]:
+    try:
+        from ..core import _env as envmod
+
+        out = {}
+        for name in sorted(envmod.KNOBS):
+            raw = os.environ.get(name)
+            out[name] = {
+                "value": raw if raw is not None else envmod.KNOBS[name][1],
+                "set": raw is not None,
+            }
+        return out
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return {}
+
+
+def _dispatch_state() -> Optional[Dict[str, Any]]:
+    try:
+        from ..core import dispatch
+
+        return {
+            "stats": dispatch.cache_stats(),
+            "cache_keys": dispatch.cache_keys(),
+            "cost": dispatch.cost_summary(),
+        }
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
+def _span_dump() -> list:
+    return [
+        {
+            "name": r.name,
+            "start_ns": r.start_ns,
+            "duration_ns": r.duration_ns,
+            "thread_id": r.thread_id,
+            "depth": r.depth,
+            "attrs": {k: str(v) for k, v in r.attrs.items()},
+        }
+        for r in _spans.get_spans()
+    ]
+
+
+def build_bundle(
+    exc: Optional[BaseException] = None,
+    reason: str = "manual",
+) -> Dict[str, Any]:
+    """The bundle document (pure construction, no IO)."""
+    from .server import _runtime_info  # same probe the /statusz page uses
+
+    ck_ts = float(_metrics.gauge("checkpoint.last_step_ts").value or 0.0)
+    doc: Dict[str, Any] = {
+        "schema": BUNDLE_SCHEMA,
+        "reason": reason,
+        "timestamp": time.time(),
+        "pid": os.getpid(),
+        "exception": None,
+        "knobs": _knob_values(),
+        "metrics": _metrics.snapshot(),
+        "spans": _span_dump(),
+        "dispatch": _dispatch_state(),
+        "checkpoint": {
+            "last_step": int(_metrics.gauge("checkpoint.last_step").value)
+            if ck_ts > 0.0
+            else None,
+            "last_step_ts": ck_ts or None,
+        },
+        "runtime": _runtime_info(),
+    }
+    if exc is not None:
+        doc["exception"] = {
+            "type": type(exc).__name__,
+            "message": str(exc),
+            "traceback": _traceback.format_exception(type(exc), exc, exc.__traceback__),
+            "site": getattr(exc, "site", None),
+            "iteration": getattr(exc, "iteration", None),
+        }
+    return doc
+
+
+def dump_bundle(
+    exc: Optional[BaseException] = None,
+    reason: str = "manual",
+    directory: Optional[str] = None,
+) -> str:
+    """Write one crash bundle (atomic + CRC sidecar); returns its path.
+
+    Public so a caller that *catches* a terminal fault (and therefore
+    keeps the excepthook from ever seeing it) can still record the
+    forensics before degrading."""
+    import json
+
+    from ..resilience.atomic import atomic_write
+
+    global _LAST_PATH
+    directory = directory or _DIR
+    if not directory:
+        raise ValueError("flight recorder not installed and no directory given")
+    doc = build_bundle(exc, reason=reason)
+    path = os.path.join(
+        directory, f"flight_{int(doc['timestamp'] * 1e3)}_{os.getpid()}.json"
+    )
+    with atomic_write(path) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+    _BUNDLES.inc()
+    _LAST_PATH = path
+    return path
+
+
+# ----------------------------------------------------------------------
+# hooks
+# ----------------------------------------------------------------------
+def _record(exc: Optional[BaseException], reason: str) -> None:
+    if exc is None or isinstance(exc, (KeyboardInterrupt, SystemExit)):
+        return
+    try:
+        dump_bundle(exc, reason=reason)
+    except Exception:  # lint: allow H501(a bundle-write failure must never mask the crash itself)
+        pass
+
+
+def _sys_hook(exc_type, exc, tb):
+    _record(exc, reason="unhandled_exception")
+    prev = _PREV_SYS_HOOK or sys.__excepthook__
+    prev(exc_type, exc, tb)
+
+
+def _thread_hook(args):  # pragma: no cover - exercised via subprocess tests
+    _record(args.exc_value, reason=f"thread_crash:{getattr(args.thread, 'name', '?')}")
+    if _PREV_THREAD_HOOK is not None:
+        _PREV_THREAD_HOOK(args)

@@ -1,0 +1,163 @@
+"""Pretty-print a flight-recorder crash bundle.
+
+::
+
+    python -m heat_tpu.telemetry.inspect <bundle.json> [--metrics N] [--spans N]
+
+Verifies the bundle against its CRC32 sidecar (a torn bundle fails
+loudly), then renders the post-mortem sections in reading order: the
+exception and traceback, where a resume would restart, what the process
+was doing (last spans), the headline metrics, the dispatch-cache /
+cost-accounting state, and the knob values that were in effect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+__all__ = ["format_bundle", "load_bundle", "main"]
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Checksum-verified bundle document."""
+    from ..resilience.atomic import verify_checksum
+
+    verify_checksum(path)
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "schema" not in doc:
+        raise ValueError(f"{path!r} is not a flight-recorder bundle")
+    return doc
+
+
+def _rule(title: str) -> str:
+    return f"\n== {title} " + "=" * max(0, 64 - len(title))
+
+
+def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -> str:
+    """The bundle as human-readable text (pure; tests render in-memory)."""
+    lines: List[str] = []
+    import datetime
+
+    ts = doc.get("timestamp")
+    when = (
+        datetime.datetime.fromtimestamp(ts).isoformat(sep=" ", timespec="seconds")
+        if isinstance(ts, (int, float))
+        else "?"
+    )
+    lines.append(
+        f"flight-recorder bundle (schema {doc.get('schema')}) — "
+        f"{doc.get('reason')} — pid {doc.get('pid')} — {when}"
+    )
+
+    exc = doc.get("exception")
+    lines.append(_rule("exception"))
+    if exc:
+        lines.append(f"{exc.get('type')}: {exc.get('message')}")
+        if exc.get("site"):
+            lines.append(f"fault site: {exc['site']}")
+        if exc.get("iteration") is not None:
+            lines.append(f"iteration: {exc['iteration']}")
+        tb = exc.get("traceback") or []
+        lines.append("".join(tb).rstrip())
+    else:
+        lines.append("(none recorded — manual bundle)")
+
+    ck = doc.get("checkpoint") or {}
+    lines.append(_rule("checkpoint"))
+    if ck.get("last_step") is not None:
+        lines.append(f"last durable step: {ck['last_step']} (resume restarts here)")
+    else:
+        lines.append("no durable checkpoint recorded")
+
+    spans = doc.get("spans") or []
+    lines.append(_rule(f"last spans ({min(n_spans, len(spans))} of {len(spans)})"))
+    for rec in spans[-n_spans:]:
+        ms = float(rec.get("duration_ns", 0)) / 1e6
+        indent = "  " * int(rec.get("depth", 0))
+        attrs = rec.get("attrs") or {}
+        attr_s = (
+            " {" + ", ".join(f"{k}={v}" for k, v in sorted(attrs.items())) + "}"
+            if attrs
+            else ""
+        )
+        lines.append(f"{indent}{rec.get('name')}  {ms:.3f} ms{attr_s}")
+    if not spans:
+        lines.append("(span ring empty)")
+
+    metrics = doc.get("metrics") or {}
+    nonzero = {
+        k: v
+        for k, v in metrics.items()
+        if (isinstance(v, dict) and v.get("count")) or (not isinstance(v, dict) and v)
+    }
+    lines.append(_rule(f"metrics ({min(n_metrics, len(nonzero))} of {len(nonzero)} nonzero)"))
+    for name in sorted(nonzero)[:n_metrics]:
+        v = nonzero[name]
+        if isinstance(v, dict):
+            lines.append(
+                f"{name}: count={v.get('count')} sum={v.get('sum')} "
+                f"p50={v.get('p50')} p99={v.get('p99')}"
+            )
+        else:
+            lines.append(f"{name}: {v}")
+
+    disp = doc.get("dispatch")
+    lines.append(_rule("dispatch"))
+    if disp:
+        stats = disp.get("stats") or {}
+        lines.append(
+            f"hit_rate={stats.get('hit_rate')} cache_size={stats.get('cache_size')} "
+            f"compile_fallbacks={stats.get('compile_fallbacks')}"
+        )
+        cost = disp.get("cost") or {}
+        if cost.get("enabled"):
+            lines.append(
+                f"cost accounting: flops_total={cost.get('flops_total')} "
+                f"bytes_total={cost.get('bytes_total')} over {len(cost.get('per_key') or {})} executables"
+            )
+        keys = disp.get("cache_keys") or []
+        for k in keys[:10]:
+            lines.append(f"  {k}")
+        if len(keys) > 10:
+            lines.append(f"  ... {len(keys) - 10} more")
+    else:
+        lines.append("(not recorded)")
+
+    knobs = doc.get("knobs") or {}
+    set_knobs = {k: v for k, v in knobs.items() if isinstance(v, dict) and v.get("set")}
+    lines.append(_rule(f"knobs ({len(set_knobs)} set, {len(knobs)} registered)"))
+    for name in sorted(set_knobs):
+        lines.append(f"{name}={set_knobs[name].get('value')}")
+    if not set_knobs:
+        lines.append("(all at registered defaults)")
+
+    rt = doc.get("runtime") or {}
+    lines.append(_rule("runtime"))
+    lines.append(
+        f"python {rt.get('python')} · jax {rt.get('jax')} · backend "
+        f"{rt.get('backend')} · {rt.get('device_count')}x {rt.get('device_kind')} · "
+        f"process {rt.get('process_index')}/{rt.get('process_count')}"
+    )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m heat_tpu.telemetry.inspect",
+        description="pretty-print a heat_tpu flight-recorder crash bundle",
+    )
+    ap.add_argument("bundle", help="path to a flight_*.json crash bundle")
+    ap.add_argument("--metrics", type=int, default=20, help="max metrics to show")
+    ap.add_argument("--spans", type=int, default=15, help="max trailing spans to show")
+    args = ap.parse_args(argv)
+    doc = load_bundle(args.bundle)
+    sys.stdout.write(format_bundle(doc, n_metrics=args.metrics, n_spans=args.spans))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    sys.exit(main())

@@ -297,14 +297,18 @@ class MetricsRegistry:
             m.reset()
 
     def dump_json(self, path: str) -> None:
-        """Write the full snapshot as JSON (atomic tmp + rename), the
-        artifact the ``HEAT_TPU_METRICS_DUMP`` atexit hook produces for
-        CI scraping."""
+        """Write the full snapshot as JSON through the resilience atomic
+        writer (write-temp-fsync-rename + CRC32 sidecar) — the artifact
+        the ``HEAT_TPU_METRICS_DUMP`` atexit hook produces for CI
+        scraping.  A crash mid-dump can never leave a truncated file,
+        and a reader can verify the payload against the sidecar."""
+        # lazy import: resilience.faults imports this module at its top
+        from ..resilience.atomic import atomic_write
+
         doc = {"timestamp": time.time(), "pid": os.getpid(), "metrics": self.snapshot()}
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1, default=str)
-        os.replace(tmp, path)
+        with atomic_write(path) as tmp:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
 
     def expose(self) -> str:
         """Prometheus text exposition of every metric.

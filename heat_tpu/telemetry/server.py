@@ -1,0 +1,330 @@
+"""Runtime-introspection HTTP endpoint: watch a live fit from a browser.
+
+A stdlib-only (``http.server``) daemon-thread endpoint serving the
+telemetry layer's state over HTTP — the scrape target ROADMAP item 1
+(Prometheus-based serving observability) asks for, and the liveness
+probe item 2 (elastic resume) needs before any reshape decision:
+
+========  ============================================================
+route     payload
+========  ============================================================
+/metrics  Prometheus text exposition (:func:`metrics.expose`)
+/varz     full registry snapshot as JSON (:func:`metrics.snapshot`)
+/healthz  liveness: fit-heartbeat age + last checkpoint step; HTTP 503
+          when the heartbeat is stale (``HEAT_TPU_HEALTH_MAX_AGE_S``)
+/trace    Chrome trace-event JSON of the span ring (load the response
+          body in chrome://tracing or https://ui.perfetto.dev)
+/statusz  build/runtime info: every registered env knob's effective
+          value, dispatch cache keys + hit rate + per-executable cost
+          accounting, jax/device/version info
+========  ============================================================
+
+Off by default.  ``HEAT_TPU_HTTP_PORT=<port>`` starts the server when
+``heat_tpu.telemetry`` is imported; :func:`start_server` starts it
+programmatically (``port=0`` binds an ephemeral port — the test
+harness's path).  The server runs on a daemon thread and every handler
+only *reads* telemetry state, so it can never block or corrupt a fit;
+request logging is routed to nowhere (a scraper polling /metrics every
+few seconds must not spam stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from . import metrics as _metrics
+from . import spans as _spans
+
+__all__ = [
+    "IntrospectionServer",
+    "health_report",
+    "maybe_start_from_env",
+    "server_running",
+    "start_server",
+    "statusz_report",
+    "stop_server",
+]
+
+#: the process's single running server (one port is plenty; tests stop
+#: and restart on fresh ephemeral ports)
+_SERVER: Optional["IntrospectionServer"] = None
+_LOCK = threading.Lock()
+
+
+def _env():
+    # lazy: core._env imports jax; keep `import heat_tpu.telemetry` light
+    from ..core import _env as envmod
+
+    return envmod
+
+
+# ----------------------------------------------------------------------
+# reports (plain functions, so tests and the flight recorder can use the
+# same payloads without going through a socket)
+# ----------------------------------------------------------------------
+def health_report() -> Tuple[bool, Dict[str, Any]]:
+    """``(healthy, doc)`` liveness derived from telemetry state.
+
+    * ``fit.heartbeat_ts`` — unix time of the last ``resumable_fit_loop``
+      chunk boundary (0.0 until a resumable fit runs);
+    * ``checkpoint.last_step`` / ``checkpoint.last_step_ts`` — the most
+      recent durable checkpoint commit;
+    * ``HEAT_TPU_HEALTH_MAX_AGE_S`` — with a positive value, a process
+      whose last heartbeat is older than this is UNHEALTHY (a hung
+      device program, a dead worker); 0 (the default) disables the
+      staleness verdict so idle/non-fit processes stay green.
+    """
+    env = _env()
+    now = time.time()
+    hb_ts = float(_metrics.gauge("fit.heartbeat_ts").value or 0.0)
+    ck_ts = float(_metrics.gauge("checkpoint.last_step_ts").value or 0.0)
+    max_age = env.env_float("HEAT_TPU_HEALTH_MAX_AGE_S")
+    heartbeat_age = (now - hb_ts) if hb_ts > 0.0 else None
+    doc: Dict[str, Any] = {
+        "status": "ok",
+        "timestamp": now,
+        "heartbeat_age_s": round(heartbeat_age, 3) if heartbeat_age is not None else None,
+        "max_age_s": max_age,
+        "fit": {
+            "iter_rate": _metrics.gauge("fit.iter_rate").value,
+            "shift": _metrics.gauge("fit.shift").value,
+        },
+        "checkpoint": {
+            "last_step": int(_metrics.gauge("checkpoint.last_step").value)
+            if ck_ts > 0.0
+            else None,
+            "age_s": round(now - ck_ts, 3) if ck_ts > 0.0 else None,
+        },
+    }
+    healthy = True
+    if hb_ts == 0.0:
+        doc["status"] = "idle"  # no resumable fit has run; nothing to judge
+    elif max_age > 0.0 and heartbeat_age is not None and heartbeat_age > max_age:
+        healthy = False
+        doc["status"] = "stale"
+    return healthy, doc
+
+
+def statusz_report() -> Dict[str, Any]:
+    """Env-knob registry values, dispatch cache + cost accounting, and
+    jax/device/version info — the "what exactly is this process running"
+    page."""
+    env = _env()
+    knobs: Dict[str, Any] = {}
+    for name in sorted(env.KNOBS):
+        typ, default, _doc = env.KNOBS[name]
+        raw = os.environ.get(name)
+        knobs[name] = {
+            "type": typ,
+            "value": raw if raw is not None else default,
+            "set": raw is not None,
+        }
+    doc: Dict[str, Any] = {
+        "timestamp": time.time(),
+        "pid": os.getpid(),
+        "knobs": knobs,
+        "runtime": _runtime_info(),
+    }
+    try:
+        from ..core import dispatch
+
+        stats = dispatch.cache_stats()
+        doc["dispatch"] = {
+            "hit_rate": stats["hit_rate"],
+            "cache_size": stats["cache_size"],
+            "compile_fallbacks": stats["compile_fallbacks"],
+            "cache_keys": dispatch.cache_keys(),
+            "cost": dispatch.cost_summary(),
+        }
+    except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
+        doc["dispatch"] = None
+    return doc
+
+
+def _runtime_info() -> Dict[str, Any]:
+    import platform
+
+    info: Dict[str, Any] = {
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    try:
+        import jax
+
+        devs = jax.devices()
+        info.update(
+            jax=jax.__version__,
+            backend=jax.default_backend(),
+            device_count=len(devs),
+            device_kind=devs[0].device_kind if devs else None,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+    except Exception:  # lint: allow H501(introspection must work before/without a jax backend)
+        info["jax"] = None
+    try:
+        from .. import version
+
+        info["heat_tpu"] = version.__version__
+    except Exception:  # lint: allow H501(version probe is decorative)
+        pass
+    return info
+
+
+# ----------------------------------------------------------------------
+# the server
+# ----------------------------------------------------------------------
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "heat-tpu-introspection/1"
+
+    def log_message(self, fmt, *args):  # scrapers poll; stay silent
+        pass
+
+    def _send(self, code: int, body: str, content_type: str) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_json(self, doc: Any, code: int = 200) -> None:
+        self._send(code, json.dumps(doc, indent=1, default=str), "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, _metrics.expose(), "text/plain; version=0.0.4")
+            elif path == "/varz":
+                self._send_json(
+                    {
+                        "timestamp": time.time(),
+                        "pid": os.getpid(),
+                        "metrics": _metrics.snapshot(),
+                    }
+                )
+            elif path == "/healthz":
+                healthy, doc = health_report()
+                self._send_json(doc, 200 if healthy else 503)
+            elif path == "/trace":
+                self._send_json(_spans.chrome_trace_doc())
+            elif path == "/statusz":
+                self._send_json(statusz_report())
+            elif path == "/":
+                self._send(
+                    200,
+                    "heat_tpu runtime introspection: "
+                    "/metrics /varz /healthz /trace /statusz\n",
+                    "text/plain",
+                )
+            else:
+                self._send(404, f"unknown route {path!r}\n", "text/plain")
+        except BrokenPipeError:  # scraper hung up mid-response; its problem
+            pass
+        except Exception as e:  # lint: allow H501(a handler bug must 500, never kill the serving thread)
+            try:
+                self._send(500, f"{type(e).__name__}: {e}\n", "text/plain")
+            except Exception:  # lint: allow H501(socket already gone)
+                pass
+
+
+class IntrospectionServer:
+    """A running introspection endpoint: bound socket + daemon thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="heat-tpu-introspection",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        """The bound port (the OS's pick when constructed with 0)."""
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __repr__(self) -> str:
+        return f"IntrospectionServer(url={self.url!r})"
+
+
+def start_server(port: Optional[int] = None) -> IntrospectionServer:
+    """Start (or return the already-running) introspection server.
+
+    ``port=None`` reads ``HEAT_TPU_HTTP_PORT``; ``port=0`` binds an
+    ephemeral port (tests).  Idempotent: a second call returns the live
+    server rather than binding a second socket."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        if port is None:
+            port = _env().env_int("HEAT_TPU_HTTP_PORT")
+        _SERVER = IntrospectionServer(port=int(port))
+        return _SERVER
+
+
+def stop_server() -> None:
+    """Shut the running server down (no-op when none is running)."""
+    global _SERVER
+    with _LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.close()
+
+
+def server_running() -> bool:
+    """Whether an introspection server is currently serving."""
+    return _SERVER is not None
+
+
+def maybe_start_from_env() -> Optional[IntrospectionServer]:
+    """Start the server iff ``HEAT_TPU_HTTP_PORT`` is a nonzero port
+    (called once at ``heat_tpu.telemetry`` import; a bind failure —
+    port already taken by a neighbor process — warns instead of
+    breaking the import)."""
+    # direct environ read (the knob IS registered in core/_env.py KNOBS):
+    # this runs during package init, where importing core._env would
+    # re-enter the parallel->resilience->telemetry import chain
+    try:
+        port = int(os.environ.get("HEAT_TPU_HTTP_PORT", "0") or "0")
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            f"HEAT_TPU_HTTP_PORT={os.environ.get('HEAT_TPU_HTTP_PORT')!r} is not "
+            "an integer; introspection server stays off",
+            RuntimeWarning,
+        )
+        return None
+    if not port:
+        return None
+    try:
+        return start_server(port)
+    except OSError as e:
+        import warnings
+
+        warnings.warn(
+            f"HEAT_TPU_HTTP_PORT={port}: introspection server failed to "
+            f"bind ({e}); continuing without it",
+            RuntimeWarning,
+        )
+        return None

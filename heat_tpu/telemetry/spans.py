@@ -38,6 +38,7 @@ __all__ = [
     "set_tracing",
     "get_spans",
     "clear_spans",
+    "chrome_trace_doc",
     "export_chrome_trace",
 ]
 
@@ -182,14 +183,14 @@ def _json_safe(v: Any) -> Any:
     return str(v)
 
 
-def export_chrome_trace(path: str, clear: bool = False) -> int:
-    """Write the ring buffer as Chrome trace-event JSON; returns the
-    number of events written.
+def chrome_trace_doc() -> Dict[str, Any]:
+    """The ring buffer as an in-memory Chrome trace-event document.
 
     The format is the ``traceEvents`` list of complete ("ph": "X")
     events — microsecond timestamps relative to the process's monotonic
     clock — that ``chrome://tracing`` and Perfetto load directly.  Span
-    attrs land in each event's ``args``."""
+    attrs land in each event's ``args``.  This is the payload the
+    introspection server's ``/trace`` endpoint returns."""
     events: List[Dict[str, Any]] = []
     pid = os.getpid()
     for rec in list(_RING):
@@ -205,11 +206,22 @@ def export_chrome_trace(path: str, clear: bool = False) -> int:
             }
         )
     events.sort(key=lambda e: e["ts"])
-    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
-    tmp = f"{path}.tmp.{pid}"
-    with open(tmp, "w") as f:
-        json.dump(doc, f)
-    os.replace(tmp, path)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str, clear: bool = False) -> int:
+    """Write the ring buffer as Chrome trace-event JSON (atomic
+    write-temp-fsync-rename); returns the number of events written.
+    See :func:`chrome_trace_doc` for the format."""
+    # lazy import: resilience.faults imports telemetry.metrics at its top
+    from ..resilience.atomic import atomic_write
+
+    doc = chrome_trace_doc()
+    # no CRC sidecar: the artifact is consumed by chrome://tracing /
+    # perfetto, which would not know what a .crc32 neighbor means
+    with atomic_write(path, checksum=False) as tmp:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
     if clear:
         clear_spans()
-    return len(events)
+    return len(doc["traceEvents"])

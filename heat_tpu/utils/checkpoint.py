@@ -46,11 +46,26 @@ from ..core.dndarray import DNDarray
 from ..resilience import atomic as _ratomic
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import default_io_policy as _io_policy
+from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
 
 __all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
 
 _STEP_PREFIX = "step_"
+
+#: last durable checkpoint step + when it committed — the recovery
+#: anchor /healthz and the crash flight recorder report
+_LAST_STEP_G = _tm.gauge("checkpoint.last_step", "most recent durable checkpoint step")
+_LAST_STEP_TS_G = _tm.gauge(
+    "checkpoint.last_step_ts", "unix time the last checkpoint step committed"
+)
+
+
+def _note_durable_step(step: int) -> None:
+    import time
+
+    _LAST_STEP_G.set(step)
+    _LAST_STEP_TS_G.set(time.time())
 
 
 def _orbax():
@@ -220,6 +235,7 @@ class Checkpointer:
             self._mngr.wait_until_finished()
         else:
             _io_policy().call(self._native_save, int(step), state)
+        _note_durable_step(int(step))
         if extra_metadata is not None:
             self._write_metadata(int(step), extra_metadata)
 

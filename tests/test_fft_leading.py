@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
-from heat_tpu.fft import _leading, _planar
+from heat_tpu.fft import _leading, _planar, _weight_cache
 
 
 def _rel(a, b):
@@ -186,7 +186,7 @@ def test_rfft3_leading_fused_ext_path(monkeypatch):
 # cannot pin ~1 GB of host RAM for the process lifetime.
 # ----------------------------------------------------------------------
 def test_weight_cache_stays_under_byte_budget(monkeypatch):
-    monkeypatch.setattr(_leading, "_WEIGHT_CACHE_BUDGET", 4 << 20)  # 4 MB
+    monkeypatch.setattr(_weight_cache, "_WEIGHT_CACHE_BUDGET", 4 << 20)  # 4 MB
     _leading.weight_cache_clear()
     try:
         for n in (64, 96, 128, 192, 256, 320, 384):
@@ -216,7 +216,7 @@ def test_weight_cache_hit_returns_same_object_and_recomputes_after_eviction():
 def test_weight_cache_values_unchanged_by_eviction(monkeypatch):
     """Evicted-and-recomputed weights are bitwise identical — the cache
     is a pure memoization layer, never a source of drift."""
-    monkeypatch.setattr(_leading, "_WEIGHT_CACHE_BUDGET", 1 << 20)  # tiny: thrash
+    monkeypatch.setattr(_weight_cache, "_WEIGHT_CACHE_BUDGET", 1 << 20)  # tiny: thrash
     _leading.weight_cache_clear()
     try:
         first = {n: np.asarray(_leading._w_cat(n, "float32", False, 1.0)).copy()
