@@ -1,6 +1,7 @@
-"""Static analysis: SPMD program lint + framework-invariant AST lint.
+"""Static analysis: SPMD program lint + framework-invariant AST lint +
+concurrency sanitizer.
 
-Two cooperating analyzers (docs/static_analysis.md):
+Three cooperating analyzers (docs/static_analysis.md):
 
 * :mod:`~heat_tpu.analysis.program_lint` — walks the jaxpr and compiled
   (post-GSPMD) HLO of a program for SPMD hazards the type system cannot
@@ -16,28 +17,35 @@ Two cooperating analyzers (docs/static_analysis.md):
   the repo's own invariants with stable rule IDs (H101 raw writes, H201
   unregistered env knobs, H301 unaccounted collectives, H302
   unregistered fault sites, H401 host syncs in chunk bodies, H501
-  fault-swallowing broad excepts, H601 host-entropy seeding).  Run as
+  fault-swallowing broad excepts, H601 clock-entropy seeding, and the
+  H701–H705 concurrency rules over the central
+  :data:`~heat_tpu.analysis.concurrency.LOCK_REGISTRY`).  Run as
   ``python -m heat_tpu.analysis <paths>``; ``scripts/lint_gate.py``
   gates CI against ``scripts/lint_baseline.json``.
+* :mod:`~heat_tpu.analysis.tsan` — the runtime concurrency sanitizer
+  (``HEAT_TPU_TSAN=0/1/raise``): every lock in ``LOCK_REGISTRY`` is an
+  instrumented proxy feeding a global lock-order graph (cycle =
+  potential deadlock, ``tsan.lock_cycle``) and guarded-structure
+  checkpoints (``tsan.unguarded_access``), with acquisition stacks
+  attached to every finding.
+
+This package ``__init__`` is **lazy** (PEP 562): the low-level modules
+that create registered locks at import time (``telemetry.metrics`` is
+among the first modules the package loads) import
+``heat_tpu.analysis.tsan`` — a stdlib-only module — and must not drag
+in the jax-dependent analyzers (``diagnostics`` reads the env-knob
+registry, ``program_lint`` imports jax) while they are themselves mid-
+import.  Attribute access resolves the public API on first use.
 """
 
 from __future__ import annotations
 
-from .ast_lint import RULES, Violation, lint_file, lint_paths
-from .diagnostics import (
-    AnalysisWarning,
-    Diagnostic,
-    ProgramLintError,
-    analysis_mode,
-    clear_diagnostics,
-    recent_diagnostics,
-    set_analysis_mode,
-)
-from .program_lint import analyze, analyze_compiled_text, analyze_jaxpr
+import importlib
 
 __all__ = [
     "AnalysisWarning",
     "Diagnostic",
+    "LOCK_REGISTRY",
     "ProgramLintError",
     "RULES",
     "Violation",
@@ -46,8 +54,45 @@ __all__ = [
     "analyze_compiled_text",
     "analyze_jaxpr",
     "clear_diagnostics",
+    "concurrency",
     "lint_file",
     "lint_paths",
     "recent_diagnostics",
     "set_analysis_mode",
+    "tsan",
 ]
+
+#: public name -> defining submodule (resolved lazily on first access)
+_EXPORTS = {
+    "RULES": "ast_lint",
+    "Violation": "ast_lint",
+    "lint_file": "ast_lint",
+    "lint_paths": "ast_lint",
+    "AnalysisWarning": "diagnostics",
+    "Diagnostic": "diagnostics",
+    "ProgramLintError": "diagnostics",
+    "analysis_mode": "diagnostics",
+    "clear_diagnostics": "diagnostics",
+    "recent_diagnostics": "diagnostics",
+    "set_analysis_mode": "diagnostics",
+    "analyze": "program_lint",
+    "analyze_compiled_text": "program_lint",
+    "analyze_jaxpr": "program_lint",
+    "LOCK_REGISTRY": "concurrency",
+}
+
+_SUBMODULES = ("ast_lint", "concurrency", "diagnostics", "program_lint", "tsan")
+
+
+def __getattr__(name: str):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    modname = _EXPORTS.get(name)
+    if modname is not None:
+        mod = importlib.import_module(f".{modname}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

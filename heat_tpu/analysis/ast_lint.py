@@ -33,6 +33,23 @@ H501        ``except Exception:`` / ``except BaseException:`` / bare
 H601        host-entropy seeding (``time.time`` inside a ``seed``
             function) — collision-prone across hosts; use
             ``heat_tpu.core.random.default_seed`` (os.urandom)
+H701        module-global mutated from thread-reachable code (functions
+            reachable from ``threading.Thread(target=...)``, excepthook
+            registration, or an HTTP handler class) outside a ``with``
+            over a lock registered in ``analysis/concurrency.py
+            LOCK_REGISTRY``
+H702        explicit ``.acquire()`` on a lock — leaks the lock when the
+            guarded region raises; hold locks with ``with``
+H703        ``threading.Thread`` created without an explicit ``daemon=``
+            and no ``join()`` close path in the module — leaks a
+            non-daemon thread (or silently truncates work) at exit
+H704        blocking call (``queue.get`` / ``join`` /
+            ``block_until_ready`` / ``time.sleep``) lexically inside a
+            ``with`` over a registered lock — stalls every other thread
+            contending for it
+H705        ``time.sleep`` polling loop in a class that already owns a
+            ``threading.Condition``/``Event`` — wait on the primitive
+            instead of burning wakeups
 ==========  ==========================================================
 
 Suppressions: append ``# lint: allow H501(<reason>)`` to the flagged
@@ -61,6 +78,7 @@ __all__ = [
     "lint_paths",
     "load_registered_knobs",
     "load_registered_sites",
+    "load_lock_spellings",
 ]
 
 #: rule ID -> one-line description (the catalogue docs and the CLI share)
@@ -72,7 +90,18 @@ RULES = {
     "H401": "host-sync call inside a resumable_fit_loop chunk body",
     "H501": "broad except that can swallow PermanentFault/ChecksumError",
     "H601": "host-entropy seeding; use core.random.default_seed",
+    "H701": "thread-reachable module-global mutation outside a registered lock",
+    "H702": "explicit lock acquire() outside a with statement (leak on exception)",
+    "H703": "Thread without explicit daemon= and no join()/close path",
+    "H704": "blocking call while holding a registered lock",
+    "H705": "time.sleep polling loop where a Condition/Event exists in the class",
 }
+
+#: repo-relative files whose explicit acquire() IS the sanctioned
+#: implementation (the instrumented-lock proxy itself)
+H702_SANCTIONED_FILES = (
+    "heat_tpu/analysis/tsan.py",
+)
 
 #: repo-relative files whose raw writes are the sanctioned implementation
 #: (the atomic layer itself; the telemetry dump paths now write through
@@ -134,6 +163,17 @@ def load_registered_sites(repo_root: str) -> Set[str]:
     return set(_literal_assignment(path, "KNOWN_SITES"))
 
 
+def load_lock_spellings(repo_root: str) -> Set[str]:
+    """Lexical ``with`` spellings of every registered lock, from
+    ``analysis/concurrency.py LOCK_REGISTRY`` (static parse)."""
+    path = os.path.join(repo_root, "heat_tpu", "analysis", "concurrency.py")
+    table = _literal_assignment(path, "LOCK_REGISTRY")
+    out: Set[str] = set()
+    for rec in table.values():
+        out.update(rec.get("spellings", ()))
+    return out
+
+
 def _find_repo_root(start: str) -> str:
     """Walk up from ``start`` to the directory containing ``heat_tpu/``."""
     d = os.path.abspath(start)
@@ -172,22 +212,58 @@ _COMM_COLLECTIVES = {
 }
 
 
+#: HTTP handler base classes: every method of a subclass runs on a
+#: per-request server thread
+_HANDLER_BASES = {
+    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+    "StreamRequestHandler", "DatagramRequestHandler", "BaseRequestHandler",
+}
+
+#: mutating container methods: called directly on a module-global name
+#: they rewrite shared state in place
+_MUTATORS = {
+    "append", "appendleft", "add", "clear", "update", "pop", "popitem",
+    "extend", "insert", "remove", "discard", "setdefault", "move_to_end",
+}
+
+
 class _Linter(ast.NodeVisitor):
-    def __init__(self, rel_path: str, source: str, knobs: Set[str], sites: Set[str]):
+    def __init__(
+        self,
+        rel_path: str,
+        source: str,
+        knobs: Set[str],
+        sites: Set[str],
+        lock_spellings: Optional[Set[str]] = None,
+    ):
         self.rel = rel_path
         self.lines = source.splitlines()
         self.knobs = knobs
         self.sites = sites
+        self.lock_spellings = lock_spellings or set()
         self.violations: List[Violation] = []
         # lexical context stacks
         self._with_atomic = 0       # inside atomic_write/_atomic_out block
         self._with_account = 0      # inside *_account(...) span block
+        self._with_lock = 0         # inside `with <registered lock>:`
         self._func_stack: List[str] = []
+        self._global_decls: List[Set[str]] = []  # per-function `global` names
+        self._class_stack: List[str] = []
+        self._loop_depth = 0
+        self._thread_depth = 0      # inside a thread-reachable function
         self._chunk_depth = 0       # inside a resumable chunk body
         self._chunk_fn_names: Set[str] = set()
+        # thread-context pre-pass results
+        self._module_globals: Set[str] = set()
+        self._thread_reachable: Set[str] = set()
+        self._module_has_join = False
+        self._cond_classes: Set[str] = set()
         self._is_comm = rel_path.replace(os.sep, "/").endswith("parallel/comm.py")
         self._h101_sanctioned = any(
             self.rel.replace(os.sep, "/").endswith(p) for p in H101_SANCTIONED_FILES
+        )
+        self._h702_sanctioned = any(
+            self.rel.replace(os.sep, "/").endswith(p) for p in H702_SANCTIONED_FILES
         )
 
     # -- plumbing -------------------------------------------------------
@@ -220,9 +296,77 @@ class _Linter(ast.NodeVisitor):
                 self._chunk_fn_names.add(cand.id)
         self._chunk_fn_names.add("run_chunk")  # the estimator convention
 
+    # -- pre-pass: thread reachability (H701), join/Condition inventory --
+    def collect_thread_context(self, tree: ast.AST) -> None:
+        """Seed the set of functions that can run on a non-main thread —
+        ``threading.Thread(target=...)`` targets, excepthook
+        registrations, every method of an HTTP handler class — and close
+        it over the module's (name-based) call graph.  Also records the
+        module-level global names (the H701 mutation targets), whether
+        the module ever ``join()``\\ s a thread (H703), and which classes
+        own a ``Condition``/``Event`` (H705)."""
+        entries: Set[str] = set()
+        call_graph: Dict[str, Set[str]] = {}
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                targets = [node.target]
+            self._module_globals.update(t.id for t in targets)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                callees = call_graph.setdefault(node.name, set())
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        callees.add(_dotted(sub.func).rsplit(".", 1)[-1])
+            elif isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name in ("threading.Thread", "Thread"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            tail = _dotted(kw.value).rsplit(".", 1)[-1]
+                            if tail:
+                                entries.add(tail)
+                if (
+                    _dotted(node.func).rsplit(".", 1)[-1] == "join"
+                    and not node.args
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    self._module_has_join = True
+            elif isinstance(node, ast.Assign):
+                # sys.excepthook = f / threading.excepthook = f
+                for t in node.targets:
+                    if _dotted(t) in ("sys.excepthook", "threading.excepthook"):
+                        tail = _dotted(node.value).rsplit(".", 1)[-1]
+                        if tail:
+                            entries.add(tail)
+            elif isinstance(node, ast.ClassDef):
+                bases = {_dotted(b).rsplit(".", 1)[-1] for b in node.bases}
+                if bases & _HANDLER_BASES:
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            entries.add(item.name)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and _dotted(sub.func).rsplit(
+                        ".", 1
+                    )[-1] in ("Condition", "Event"):
+                        self._cond_classes.add(node.name)
+                        break
+        # transitive closure over the name-based call graph
+        reachable = set(entries)
+        frontier = list(entries)
+        while frontier:
+            fn = frontier.pop()
+            for callee in call_graph.get(fn, ()):
+                if callee in call_graph and callee not in reachable:
+                    reachable.add(callee)
+                    frontier.append(callee)
+        self._thread_reachable = reachable
+
     # -- with blocks ----------------------------------------------------
     def visit_With(self, node: ast.With) -> None:
-        atomic = account = False
+        atomic = account = lock = False
         for item in node.items:
             ctx = item.context_expr
             if isinstance(ctx, ast.Call):
@@ -232,27 +376,86 @@ class _Linter(ast.NodeVisitor):
                     atomic = True
                 if tail.endswith("_account") or tail == "account_implicit":
                     account = True
+            elif _dotted(ctx) in self.lock_spellings:
+                lock = True
         self._with_atomic += atomic
         self._with_account += account
+        self._with_lock += lock
         self.generic_visit(node)
         self._with_atomic -= atomic
         self._with_account -= account
+        self._with_lock -= lock
 
-    # -- function context (H401, H601) ----------------------------------
+    # -- function context (H401, H601, H701) -----------------------------
     def _visit_func(self, node) -> None:
         self._func_stack.append(node.name)
+        self._global_decls.append(set())
         is_chunk = node.name in self._chunk_fn_names
+        is_threaded = node.name in self._thread_reachable
         self._chunk_depth += is_chunk
+        self._thread_depth += is_threaded
         for default in list(getattr(node.args, "defaults", ())) + list(
             getattr(node.args, "kw_defaults", ())
         ):
             self._check_site_default(node, default)
         self.generic_visit(node)
         self._chunk_depth -= is_chunk
+        self._thread_depth -= is_threaded
+        self._global_decls.pop()
         self._func_stack.pop()
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    def visit_Global(self, node: ast.Global) -> None:
+        if self._global_decls:
+            self._global_decls[-1].update(node.names)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = _visit_loop
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    # -- H701: module-global mutation in thread-reachable code -----------
+    def _check_global_mutation(self, target: ast.AST, node: ast.AST) -> None:
+        if self._thread_depth <= 0 or self._with_lock > 0:
+            return
+        name = None
+        if isinstance(target, ast.Name):
+            # a bare-name store only hits module state under a `global`
+            # declaration; the declaration alone marks it shared
+            if any(target.id in g for g in self._global_decls):
+                name = target.id
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            base = target.value
+            if isinstance(base, ast.Name) and base.id in self._module_globals:
+                name = base.id
+        if name is not None:
+            self._add(
+                "H701", node,
+                f"module-global {name!r} mutated from thread-reachable code "
+                "without holding a lock registered in analysis/concurrency.py "
+                "LOCK_REGISTRY — another thread can observe or corrupt the "
+                "intermediate state",
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_global_mutation(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_global_mutation(node.target, node)
+        self.generic_visit(node)
 
     def _check_site_default(self, fn_node, default) -> None:
         # FunctionDef defaults for parameters named site/fault_site
@@ -359,6 +562,82 @@ class _Linter(ast.NodeVisitor):
                     "at chunk boundaries",
                 )
 
+        # H701: mutating container method on a module-global from
+        # thread-reachable code outside a registered lock
+        if (
+            self._thread_depth > 0
+            and self._with_lock == 0
+            and tail in _MUTATORS
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self._module_globals
+        ):
+            self._add(
+                "H701", node,
+                f"module-global {node.func.value.id!r}.{tail}() from "
+                "thread-reachable code without holding a lock registered in "
+                "analysis/concurrency.py LOCK_REGISTRY",
+            )
+
+        # H702: explicit lock acquire — the guarded region leaks the lock
+        # on an exception; `with` releases unconditionally
+        if (
+            tail == "acquire"
+            and not self._h702_sanctioned
+            and isinstance(node.func, ast.Attribute)
+            and "lock" in _dotted(node.func.value).lower()
+        ):
+            self._add(
+                "H702", node,
+                f"{_dotted(node.func.value)}.acquire() outside a with "
+                "statement leaks the lock when the guarded region raises; "
+                "hold it with `with`",
+            )
+
+        # H703: Thread without explicit daemon= and no join close path
+        if name in ("threading.Thread", "Thread"):
+            has_daemon = any(kw.arg == "daemon" for kw in node.keywords)
+            if not has_daemon and not self._module_has_join:
+                self._add(
+                    "H703", node,
+                    "threading.Thread without an explicit daemon= and no "
+                    "join() close path in this module — a non-daemon thread "
+                    "blocks interpreter exit, a daemon one is silently "
+                    "truncated; decide explicitly and join on the close path",
+                )
+
+        # H704: blocking call while holding a registered lock
+        if self._with_lock > 0:
+            blocking = (
+                (tail == "join" and not node.args and isinstance(node.func, ast.Attribute))
+                or tail == "block_until_ready"
+                or (tail == "get" and not node.args and isinstance(node.func, ast.Attribute))
+                or name == "time.sleep"
+            )
+            if blocking:
+                self._add(
+                    "H704", node,
+                    f"blocking call {name or tail}() while holding a "
+                    "registered lock — every thread contending for the lock "
+                    "stalls behind this wait; move the wait outside the "
+                    "critical section",
+                )
+
+        # H705: sleep-polling loop in a class that owns a Condition/Event
+        if (
+            name == "time.sleep"
+            and self._loop_depth > 0
+            and self._class_stack
+            and self._class_stack[-1] in self._cond_classes
+        ):
+            self._add(
+                "H705", node,
+                f"time.sleep polling loop in class "
+                f"{self._class_stack[-1]!r}, which already owns a "
+                "threading.Condition/Event — wait on the primitive instead "
+                "of burning periodic wakeups",
+            )
+
         # H601: host-entropy seeding
         if name in ("time.time", "time.time_ns") and any(
             "seed" in f.lower() for f in self._func_stack
@@ -431,6 +710,7 @@ def lint_file(
     sites: Optional[Set[str]] = None,
     source: Optional[str] = None,
     rel_path: Optional[str] = None,
+    lock_spellings: Optional[Set[str]] = None,
 ) -> List[Violation]:
     """Lint one Python file; returns its violations (suppressions
     applied).  ``source``/``rel_path`` let tests lint embedded fixture
@@ -441,14 +721,17 @@ def lint_file(
         knobs = load_registered_knobs(repo_root)
     if sites is None:
         sites = load_registered_sites(repo_root)
+    if lock_spellings is None:
+        lock_spellings = load_lock_spellings(repo_root)
     if source is None:
         with open(path) as f:
             source = f.read()
     if rel_path is None:
         rel_path = os.path.relpath(os.path.abspath(path), repo_root)
     tree = ast.parse(source, filename=rel_path)
-    linter = _Linter(rel_path, source, knobs, sites)
+    linter = _Linter(rel_path, source, knobs, sites, lock_spellings)
     linter.collect_chunk_fns(tree)
+    linter.collect_thread_context(tree)
     linter.visit(tree)
     return sorted(linter.violations, key=lambda v: (v.file, v.line, v.rule))
 
@@ -461,6 +744,7 @@ def lint_paths(
         repo_root = _find_repo_root(paths[0] if paths else os.getcwd())
     knobs = load_registered_knobs(repo_root)
     sites = load_registered_sites(repo_root)
+    spellings = load_lock_spellings(repo_root)
     out: List[Violation] = []
     for p in paths:
         if os.path.isfile(p):
@@ -473,7 +757,8 @@ def lint_paths(
                 if f.endswith(".py")
             )
         for f in files:
-            out.extend(lint_file(f, repo_root, knobs, sites))
+            out.extend(lint_file(f, repo_root, knobs, sites,
+                                 lock_spellings=spellings))
     return sorted(out, key=lambda v: (v.file, v.line, v.rule))
 
 

@@ -1,0 +1,156 @@
+"""Central lock registry: the concurrency analogue of ``KNOBS``/``KNOWN_SITES``.
+
+The reference design is single-threaded per rank (one MPI process runs
+one program over one local chunk — PAPER.md), but this framework has
+grown real thread surface: the async-checkpoint writer
+(``utils/overlap.py``), prefetch loader threads
+(``utils/data/partial_dataset.py``), the introspection HTTP server and
+crash excepthooks (``telemetry/``), and the fault injector evaluated
+from any of them.  Every lock that guards cross-thread state is declared
+ONCE in the :data:`LOCK_REGISTRY` table below — name, owning file, the
+lexical spelling(s) a ``with`` statement uses to hold it, the shared
+structures it guards, and a one-line doc.  Three consumers share the
+table:
+
+* the AST linter's **H7xx** rules (``heat_tpu/analysis/ast_lint.py``)
+  statically parse it (``ast.literal_eval``, no imports) — H701 flags a
+  module-global mutated from thread-reachable code outside a registered
+  lock's ``with`` block, H704 flags blocking calls lexically inside one;
+* the runtime sanitizer (:mod:`heat_tpu.analysis.tsan`) wraps every
+  registered lock in an instrumented proxy when ``HEAT_TPU_TSAN=1`` —
+  recording per-thread acquisition stacks, the global lock-order graph
+  (cycle = potential deadlock), and off-thread access to the registered
+  structures without their lock;
+* ``docs/static_analysis.md`` documents the workflow: a new lock that
+  guards cross-thread state must be registered here (and created via
+  ``tsan.register_lock``) before it can merge.
+
+The table is a **pure literal** so the linter can read it without
+importing jax or the modules it describes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set, Tuple
+
+__all__ = [
+    "LOCK_REGISTRY",
+    "lock_for_structure",
+    "registered_lock_names",
+    "registered_spellings",
+    "registered_structures",
+]
+
+#: Every registered cross-thread lock: name -> {file, spellings,
+#: structures, doc}.  ``file`` is the repo-relative module that creates
+#: the lock; ``spellings`` are the lexical forms a ``with`` statement
+#: holding it uses in that module (what the H701/H704 rules match);
+#: ``structures`` are the shared-state names the lock guards (what
+#: ``tsan.note_access`` checkpoints reference).  PURE LITERAL — the AST
+#: linter parses this assignment statically (ast.literal_eval).
+LOCK_REGISTRY = {
+    "telemetry.metrics.registry": {
+        "file": "heat_tpu/telemetry/metrics.py",
+        "spellings": ("self._lock",),
+        "structures": ("telemetry.metrics.registry",),
+        "doc": "MetricsRegistry._metrics name->metric map (get-or-make, snapshot, reset, Prometheus expose); per-metric value locks stay unregistered leaf locks",
+    },
+    "telemetry.spans.ring": {
+        "file": "heat_tpu/telemetry/spans.py",
+        "spellings": ("_RING_LOCK",),
+        "structures": ("telemetry.spans.ring",),
+        "doc": "the bounded span ring buffer: appended by span() from any thread, iterated by get_spans/chrome_trace_doc (the /trace route runs on an HTTP handler thread)",
+    },
+    "telemetry.server": {
+        "file": "heat_tpu/telemetry/server.py",
+        "spellings": ("_LOCK",),
+        "structures": ("telemetry.server.singleton",),
+        "doc": "the process's single IntrospectionServer handle: start_server/stop_server swap it; handler threads never take this lock",
+    },
+    "telemetry.flight_recorder.hooks": {
+        "file": "heat_tpu/telemetry/flight_recorder.py",
+        "spellings": ("_LOCK",),
+        "structures": (),
+        "doc": "install/uninstall state of the sys/threading excepthooks (_DIR and the saved previous hooks)",
+    },
+    "telemetry.flight_recorder.dump": {
+        "file": "heat_tpu/telemetry/flight_recorder.py",
+        "spellings": ("_DUMP_LOCK",),
+        "structures": ("telemetry.flight_recorder.state",),
+        "doc": "serializes crash-bundle writes: two threads crashing concurrently write one bundle each (distinct thread-id suffixes) instead of racing on one path; guards _LAST_PATH",
+    },
+    "analysis.program_lint.keys": {
+        "file": "heat_tpu/analysis/program_lint.py",
+        "spellings": ("_KEY_LOCK",),
+        "structures": ("analysis.program_lint.key_groups",),
+        "doc": "normalized-dispatch-key groups the J103 recompile-churn check accumulates; misses can compile on any thread that dispatches",
+    },
+    "analysis.diagnostics.ring": {
+        "file": "heat_tpu/analysis/diagnostics.py",
+        "spellings": ("_LOCK",),
+        "structures": ("analysis.diagnostics.ring",),
+        "doc": "the bounded recent-diagnostics ring: emit() appends from any thread (program lint on the dispatch path, tsan findings), recent_diagnostics() lists",
+    },
+    "resilience.faults.injector": {
+        "file": "heat_tpu/resilience/faults.py",
+        "spellings": ("self._lock",),
+        "structures": ("resilience.faults.counters",),
+        "doc": "FaultInjector per-site call indices + injected lists: sites are evaluated from the async-writer and loader threads; the lock keeps per-site call order deterministic",
+    },
+    "overlap.async_writer": {
+        "file": "heat_tpu/utils/overlap.py",
+        "spellings": ("self._error_lock",),
+        "structures": ("overlap.async_writer.state",),
+        "doc": "AsyncCheckpointer pending-error slot: written by the background writer thread, swapped out by save()/wait()/close() on the fit thread",
+    },
+    "dispatch.cache": {
+        "file": "heat_tpu/core/dispatch.py",
+        "spellings": ("_CACHE_LOCK",),
+        "structures": ("dispatch.cache",),
+        "doc": "the compiled-executable LRU + cost records: mutated per dispatch on the fit thread, iterated by cache_keys()/cost_summary() from HTTP handler threads (/statusz) and the crash excepthook",
+    },
+    "data.partial_loader": {
+        "file": "heat_tpu/utils/data/partial_dataset.py",
+        "spellings": ("self._lifecycle",),
+        "structures": ("data.partial_loader.state",),
+        "doc": "PartialH5DataLoaderIter worker-thread handle: close() is reachable from the consumer, __del__ (any thread via GC) and error paths concurrently",
+    },
+}
+
+
+def registered_lock_names() -> Set[str]:
+    """All registered lock names."""
+    return set(LOCK_REGISTRY)
+
+
+def registered_spellings() -> Set[str]:
+    """Union of every registered lock's lexical ``with`` spellings (the
+    set the H701/H704 lint rules match a ``with`` context against)."""
+    out: Set[str] = set()
+    for rec in LOCK_REGISTRY.values():
+        out.update(rec["spellings"])
+    return out
+
+
+def registered_structures() -> Dict[str, str]:
+    """structure name -> owning lock name, for every registered guarded
+    structure (the table :func:`heat_tpu.analysis.tsan.note_access`
+    checks against)."""
+    out: Dict[str, str] = {}
+    for lock_name, rec in LOCK_REGISTRY.items():
+        for s in rec["structures"]:
+            out[s] = lock_name
+    return out
+
+
+def lock_for_structure(name: str) -> str:
+    """The registered owner lock of guarded structure ``name``."""
+    try:
+        return registered_structures()[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a registered guarded structure; add it to a "
+            "lock's 'structures' tuple in heat_tpu.analysis.concurrency."
+            "LOCK_REGISTRY — the H7xx lint rules and the runtime sanitizer "
+            "share that one table"
+        ) from None

@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional
 
 from ..core import _env
 from ..telemetry import metrics as _tm
+from . import tsan as _tsan
 
 __all__ = [
     "AnalysisWarning",
@@ -99,7 +100,9 @@ def _parse_mode(raw: Optional[str]) -> str:
 _MODE = _parse_mode(os.environ.get("HEAT_TPU_ANALYZE"))
 _RING_SIZE = _env.env_int("HEAT_TPU_ANALYZE_RING")
 _RING: "deque[Diagnostic]" = deque(maxlen=max(1, _RING_SIZE))
-_LOCK = threading.Lock()
+#: emit() appends from any thread (dispatch-path program lint, sanitizer
+#: findings); registered so the sanitizer can check the ring itself
+_LOCK = _tsan.register_lock("analysis.diagnostics.ring")
 
 
 def analysis_mode() -> str:
@@ -129,12 +132,14 @@ def recent_diagnostics() -> List[Diagnostic]:
     """Recent program-lint diagnostics, oldest first (bounded ring,
     ``HEAT_TPU_ANALYZE_RING`` capacity)."""
     with _LOCK:
+        _tsan.note_access("analysis.diagnostics.ring", write=False)
         return list(_RING)
 
 
 def clear_diagnostics() -> None:
     """Drop every recorded diagnostic."""
     with _LOCK:
+        _tsan.note_access("analysis.diagnostics.ring")
         _RING.clear()
 
 
@@ -148,6 +153,7 @@ def emit(diag: Diagnostic, mode: Optional[str] = None) -> None:
         f"program-lint diagnostics of rule {diag.rule}",
     ).inc()
     with _LOCK:
+        _tsan.note_access("analysis.diagnostics.ring")
         _RING.append(diag)
     mode = _MODE if mode is None else mode
     if mode == MODE_RAISE:

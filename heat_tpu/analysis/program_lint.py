@@ -48,6 +48,7 @@ import jax
 import numpy as np
 
 from ..telemetry import metrics as _tm
+from . import tsan as _tsan
 from .diagnostics import Diagnostic, analysis_mode, emit
 
 __all__ = [
@@ -358,7 +359,7 @@ def analyze(
 #: entries that differ only in a scalar leaf's dtype (J103 at the
 #: dispatch level).  Bounded: cleared past _KEY_TRACK_MAX groups.
 _KEY_GROUPS: Dict[Any, set] = {}
-_KEY_LOCK = threading.Lock()
+_KEY_LOCK = _tsan.register_lock("analysis.program_lint.keys")
 _KEY_TRACK_MAX = 4096
 
 _ANALYZED = _tm.counter(
@@ -369,6 +370,7 @@ _ANALYZED = _tm.counter(
 def reset_dispatch_state() -> None:
     """Drop the dispatch-key tracking state (tests)."""
     with _KEY_LOCK:
+        _tsan.note_access("analysis.program_lint.key_groups")
         _KEY_GROUPS.clear()
 
 
@@ -400,6 +402,7 @@ def note_dispatch_key(key) -> None:
     if norm == key:
         return  # no scalar leaves -> nothing to group
     with _KEY_LOCK:
+        _tsan.note_access("analysis.program_lint.key_groups")
         if len(_KEY_GROUPS) > _KEY_TRACK_MAX:
             _KEY_GROUPS.clear()
         group = _KEY_GROUPS.setdefault(norm, set())

@@ -1,0 +1,59 @@
+"""Version-gated jax API resolver, resolved ONCE at import.
+
+The framework targets a range of jax releases; the public homes of a
+few APIs moved across it.  Every call site imports the resolved symbol
+from here instead of probing per call (or worse, assuming the newest
+spelling — ``jax.shard_map`` only exists on jax >= 0.6/0.8 lines, and a
+runner on 0.4.x previously recorded ``fft3d_64`` / ``sort_psrs`` /
+``sparse_spmm_ring`` as ``error`` in BENCH_CI, leaving a third of the
+perf grid dark):
+
+* :func:`shard_map` — ``jax.shard_map`` when present, else
+  ``jax.experimental.shard_map.shard_map`` behind an adapter that
+  translates the renamed ``check_vma`` kwarg to the old ``check_rep``.
+* :func:`psum_scatter` — ``jax.lax.psum_scatter`` (stable for the whole
+  supported range; resolved here so the next rename has one home).
+* :func:`pcast` — ``jax.lax.pcast`` (the varying-manual-axes cast the
+  modern shard_map's vma checker needs on scan carries); older jax has
+  no vma system, so the cast resolves to identity there.
+
+Keep this module dependency-light: it is imported by the lowest-level
+kernel modules.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.lax
+
+__all__ = ["HAS_NATIVE_SHARD_MAP", "pcast", "psum_scatter", "shard_map"]
+
+#: whether this jax exposes top-level ``jax.shard_map`` (the modern API)
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+if HAS_NATIVE_SHARD_MAP:
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f=None, **kwargs):
+        """``jax.experimental.shard_map.shard_map`` with the modern
+        keyword surface: ``check_vma`` (the current name) maps onto the
+        old ``check_rep``."""
+        if "check_vma" in kwargs:
+            kwargs.setdefault("check_rep", kwargs.pop("check_vma"))
+        if f is None:  # decorator form: shard_map(mesh=..., ...)(f)
+            return lambda g: _exp_shard_map(g, **kwargs)
+        return _exp_shard_map(f, **kwargs)
+
+
+psum_scatter = jax.lax.psum_scatter
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+
+    def pcast(x, axes=None, to=None):
+        """No-op on jax without the varying-manual-axes (vma) system —
+        there is nothing to cast a shard_map carry into."""
+        return x

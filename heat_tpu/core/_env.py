@@ -56,6 +56,9 @@ KNOBS = {
     # -- static analysis (heat_tpu/analysis, docs/static_analysis.md) ---
     "HEAT_TPU_ANALYZE": ("choice", "0", "SPMD program analyzer on the dispatch compile path: 0 = off, 1 = warn, raise = error on any diagnostic"),
     "HEAT_TPU_ANALYZE_RING": ("int", "256", "capacity of the recent-diagnostics ring buffer"),
+    "HEAT_TPU_TSAN": ("choice", "0", "concurrency sanitizer over the registered locks: 0 = off, 1 = armed (record tsan.* diagnostics), raise = armed + ProgramLintError at the finding site"),
+    "HEAT_TPU_TSAN_DUMP": ("path", "", "write the sanitizer's findings as JSON to this path at process exit (the sanitized CI lane's audit artifact)"),
+    "HEAT_TPU_TSAN_STACK_DEPTH": ("int", "10", "frames captured per lock-acquisition/access stack while the sanitizer is armed"),
     # -- telemetry (heat_tpu/telemetry, docs/observability.md) ----------
     "HEAT_TPU_TRACE": ("bool", "1", "host-side span recording (0 = span() costs two attribute reads and records nothing)"),
     "HEAT_TPU_TRACE_RING": ("int", "4096", "span ring-buffer capacity (newest spans win)"),

@@ -71,6 +71,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import tsan as _tsan
 from ..resilience.errors import ChecksumError as _ChecksumError
 from ..resilience.errors import PermanentFault as _PermanentFault
 from ..resilience.faults import inject as _inject
@@ -138,6 +139,14 @@ _COMPILE_MS = _tm.histogram(
 #: accumulate one dead entry per call.
 _cache: "OrderedDict[Any, Callable]" = OrderedDict()
 
+#: the cache (and the cost records below) are mutated per dispatch on
+#: the fit thread but ITERATED from other threads — /statusz handler
+#: threads call cache_keys()/cost_summary(), the crash excepthook reads
+#: the same, and iterating an OrderedDict mid-insert raises.  Every
+#: mutation and every iteration holds this registered lock; lookups
+#: inside the lock keep the LRU move-to-end ordered.
+_CACHE_LOCK = _tsan.register_lock("dispatch.cache")
+
 _tm.gauge("dispatch.cache_size", "live compiled-executable cache entries",
           fn=lambda: len(_cache))
 _tm.gauge(
@@ -188,9 +197,11 @@ def reset_stats() -> None:
 def clear_cache() -> None:
     """Drop every compiled executable (and its cost records) and zero
     the counters."""
-    _cache.clear()
+    with _CACHE_LOCK:
+        _tsan.note_access("dispatch.cache")
+        _cache.clear()
+        _cost_records.clear()
     _aval_cache.clear()
-    _cost_records.clear()
     reset_stats()
 
 
@@ -257,7 +268,10 @@ def _key_repr(key, limit: int = 200) -> str:
 def cache_keys() -> list:
     """Readable reprs of every live executable-cache key (insertion
     order: oldest first, like the LRU itself)."""
-    return [_key_repr(k) for k in list(_cache)]
+    with _CACHE_LOCK:
+        _tsan.note_access("dispatch.cache", write=False)
+        keys = list(_cache)
+    return [_key_repr(k) for k in keys]
 
 
 def cost_summary() -> dict:
@@ -267,12 +281,16 @@ def cost_summary() -> dict:
     "per_key": {key_repr: {flops, bytes_accessed, ...}}}`` — totals are
     the ``dispatch.flops_total`` / ``dispatch.cost_bytes_total``
     registry counters, so they survive record eviction."""
+    with _CACHE_LOCK:
+        _tsan.note_access("dispatch.cache", write=False)
+        per_key = {_key_repr(k): dict(v) for k, v in _cost_records.items()}
+        n = len(_cost_records)
     return {
         "enabled": _COST_ENABLED,
-        "executables": len(_cost_records),
+        "executables": n,
         "flops_total": _FLOPS_TOTAL.value,
         "bytes_total": _COST_BYTES_TOTAL.value,
-        "per_key": {_key_repr(k): dict(v) for k, v in _cost_records.items()},
+        "per_key": per_key,
     }
 
 
@@ -312,9 +330,11 @@ def _record_cost(key, entry, leaves) -> None:
         pass
     _FLOPS_TOTAL.inc(rec["flops"])
     _COST_BYTES_TOTAL.inc(rec["bytes_accessed"])
-    _cost_records[key] = rec
-    while len(_cost_records) > _CACHE_MAXSIZE:
-        _cost_records.popitem(last=False)
+    with _CACHE_LOCK:
+        _tsan.note_access("dispatch.cache")
+        _cost_records[key] = rec
+        while len(_cost_records) > _CACHE_MAXSIZE:
+            _cost_records.popitem(last=False)
 
 
 def _note_lookup(hit: bool) -> None:
@@ -528,9 +548,12 @@ def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
     where ``fresh`` marks a miss — the first execution of a fresh entry
     pays trace+compile, which :func:`_run` times into the
     ``dispatch.compile_ms`` histogram."""
-    entry = _cache.get(key)
+    with _CACHE_LOCK:
+        _tsan.note_access("dispatch.cache")
+        entry = _cache.get(key)
+        if entry is not None:
+            _cache.move_to_end(key)
     if entry is not None:
-        _cache.move_to_end(key)
         _note_lookup(True)
         return entry, False
     _note_lookup(False)
@@ -541,9 +564,11 @@ def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
     if donate_argnums:
         jit_kwargs["donate_argnums"] = donate_argnums
     entry = jax.jit(builder(), **jit_kwargs)
-    _cache[key] = entry
-    while len(_cache) > _CACHE_MAXSIZE:
-        _cache.popitem(last=False)
+    with _CACHE_LOCK:
+        _tsan.note_access("dispatch.cache")
+        _cache[key] = entry
+        while len(_cache) > _CACHE_MAXSIZE:
+            _cache.popitem(last=False)
     return entry, True
 
 
@@ -610,7 +635,9 @@ def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=No
             # check: importing analysis here would cycle through core)
             raise
         _C["compile_fallbacks"].inc()
-        _cache.pop(key, None)
+        with _CACHE_LOCK:
+            _tsan.note_access("dispatch.cache")
+            _cache.pop(key, None)
         warnings.warn(
             f"dispatch: compiled execution failed ({type(e).__name__}: {e}); "
             "falling back to eager execution for this call",

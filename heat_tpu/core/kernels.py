@@ -43,11 +43,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ._compat import shard_map
 
 try:  # pallas TPU backend (present in all jax>=0.4.30 installs)
     from jax.experimental.pallas import tpu as pltpu

@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..dndarray import DNDarray
 from .. import types
+from .._compat import shard_map as _shard_map
 
 __all__ = ["cholesky_dist", "det_dist", "inv_dist", "solve_dist", "supports_dist_factor"]
 
@@ -123,7 +124,7 @@ def _chol_fn(comm, n_pad: int, dtype: str):
         return a_loc * lower
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body, mesh=comm.mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
         )
     )
@@ -211,7 +212,7 @@ def _lu_fn(comm, n_pad: int, dtype: str):
         return a_loc, phys_of_log, sign, logdet
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=P(axis),
@@ -330,7 +331,7 @@ def _lu_solve_fn(comm, n_pad: int, k: int, dtype: str):
         return x_loc
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=(P(axis), P(axis), P()),

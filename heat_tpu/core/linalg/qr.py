@@ -41,6 +41,7 @@ from jax.sharding import PartitionSpec as P
 from .. import types
 from ..dndarray import DNDarray
 from ..sanitation import sanitize_in
+from .._compat import shard_map as _shard_map
 
 __all__ = ["qr"]
 
@@ -163,7 +164,7 @@ def _tsqr_fn(comm, compute_q: bool, m_true: int):
         return q_loc, r2
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             in_specs=P(axis, None),
@@ -239,7 +240,7 @@ def _bgs_fn(comm, n_true: int, nb: int):
         return q_loc, r_loc[:n_true]
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             in_specs=P(None, axis),

@@ -22,6 +22,7 @@ from ..parallel.comm import sanitize_comm
 from . import types
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
+from ._compat import shard_map as _shard_map
 
 __all__ = [
     "balance",
@@ -570,7 +571,7 @@ def _topk_merge_fn(comm, k: int, largest: bool, n_true: int, block: int):
         return vals, cand_i[fi]
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=P(axis),

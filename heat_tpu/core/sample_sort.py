@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+from ._compat import shard_map as _shard_map
 
 __all__ = [
     "sample_sort_1d",
@@ -282,7 +283,7 @@ def _psrs_fn(comm, m: int, b: int, batch: tuple, dtype_name: str, descending: bo
         )
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=mesh,
             in_specs=P(axis),
@@ -309,7 +310,7 @@ def _select_fn(comm, b: int, k: int, dtype_name: str):
         return jax.lax.pmax(contrib, axis)
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=(P(axis), P()),

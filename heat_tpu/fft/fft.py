@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..core.stride_tricks import sanitize_axis
+from ..core._compat import shard_map as _shard_map
 
 __all__ = [
     "fft",
@@ -353,7 +354,7 @@ def _pencil_planar_kind_fn(
     n_in = 2 if have_im else 1
     n_out = 1 if op_kind in ("irfft", "hfft") else 2
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             run, mesh=comm.mesh, in_specs=(spec,) * n_in, out_specs=(spec,) * n_out
         )
     )
@@ -527,7 +528,7 @@ def _pencil_fn(comm, kind: str, axis: int, partner: int, n_true: int, ndim: int,
         return jax.lax.all_to_all(res, name, split_axis=axis, concat_axis=partner, tiled=True)
 
     return jax.jit(
-        jax.shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+        _shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec)
     )
 
 

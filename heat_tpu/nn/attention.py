@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.dndarray import DNDarray
 from ..parallel.comm import Communication, sanitize_comm
+from ..core._compat import shard_map as _shard_map
 
 __all__ = ["scaled_dot_product_attention", "ring_attention", "ulysses_attention"]
 
@@ -182,7 +183,7 @@ def _ring_fn(comm, scale, causal, n_true, block):
         _ring_body, comm=comm, scale=scale, causal=causal, n_true=n_true, block=block
     )
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=(P(comm.axis_name), P(comm.axis_name), P(comm.axis_name)),
@@ -266,7 +267,7 @@ def _ulysses_fn(comm, scale, causal, n_true, use_flash=False):
         use_flash=use_flash,
     )
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=(P(comm.axis_name), P(comm.axis_name), P(comm.axis_name)),

@@ -365,7 +365,7 @@ class DataParallel:
                 # reverse-order or one fused collective) — the loss mean
                 # over equal shards equals the global batch mean, so the
                 # update matches the implicit schedule mathematically
-                from jax.experimental.shard_map import shard_map
+                from ..core._compat import shard_map
 
                 spec = P(comm.axis_name)
                 blocking = schedule == "fused"

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .comm import Communication
+from ..core._compat import shard_map as _shard_map
 
 __all__ = ["halo_exchange", "with_halos"]
 
@@ -71,7 +72,7 @@ def _with_halos_fn(comm: Communication, halo_size: int):
         return jnp.concatenate([prev, local, nxt], axis=0)[None]
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=P(comm.axis_name),

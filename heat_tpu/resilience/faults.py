@@ -63,6 +63,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from .errors import PermanentFault, TransientFault
+from ..analysis import tsan as _tsan
 from ..telemetry import metrics as _tm
 
 __all__ = [
@@ -149,7 +150,10 @@ class FaultInjector:
         self.injected: Dict[str, List] = {}
         self._fired: Dict[int, int] = {}  # id(rule) -> times fired
         self._rngs: Dict[str, random.Random] = {}
-        self._lock = threading.Lock()
+        # sites fire from the async-writer and loader threads; the
+        # registered lock keeps per-site call indices deterministic and
+        # lets the sanitizer verify every evaluation holds it
+        self._lock = _tsan.register_lock("resilience.faults.injector")
         self._prev: Optional["FaultInjector"] = None
 
     # -- plan evaluation ------------------------------------------------
@@ -167,6 +171,7 @@ class FaultInjector:
     def check(self, site: str, info: Dict) -> None:
         """Record one evaluation of ``site`` and raise if the plan says so."""
         with self._lock:
+            _tsan.note_access("resilience.faults.counters")
             index = self.hits.get(site, 0)
             self.hits[site] = index + 1
             _SITES_EVALUATED.inc()

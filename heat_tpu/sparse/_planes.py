@@ -34,6 +34,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ..core._compat import pcast as _pcast
+from ..core._compat import shard_map as _shard_map
 
 __all__ = []
 
@@ -46,7 +48,7 @@ def _shard_spec(ndim_specs):
 
 def _smap(comm, body, in_specs, out_specs):
     return jax.jit(
-        jax.shard_map(body, mesh=comm.mesh, in_specs=in_specs, out_specs=out_specs)
+        _shard_map(body, mesh=comm.mesh, in_specs=in_specs, out_specs=out_specs)
     )
 
 
@@ -531,7 +533,7 @@ def _spmm_comp_rows_ring_prog(comm, P: int, C: int, comp_pad: int, k_pad: int, n
             return (acc, xc), None
 
         acc0 = jnp.zeros((comp_pad + 1, n), jnp.result_type(val.dtype, x_loc.dtype))
-        acc0 = jax.lax.pcast(acc0, (name,), to="varying")  # scan carry vma
+        acc0 = _pcast(acc0, (name,), to="varying")  # scan carry vma
         (acc, _), _ = jax.lax.scan(step, (acc0, x_loc), jnp.arange(P, dtype=jnp.int32))
         return acc[:comp_pad]
 

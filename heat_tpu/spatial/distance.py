@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in
+from ..core._compat import shard_map as _shard_map
 
 __all__ = ["cdist", "cdist_small", "cdist_topk", "manhattan", "rbf"]
 
@@ -120,7 +121,7 @@ def _ring_cdist_fn(comm, metric: str, symmetric: bool, bn: int, bm: int, f: int,
         return out
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=(P(axis), P(axis)),
@@ -229,7 +230,7 @@ def _ring_topk_fn(comm, k: int, bn: int, bm: int, m_true: int, dtype: str):
         return jnp.sqrt(vals), idxs
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             body,
             mesh=comm.mesh,
             in_specs=(P(axis), P(axis)),

@@ -41,6 +41,7 @@ import time
 import traceback as _traceback
 from typing import Any, Dict, Optional
 
+from ..analysis import tsan as _tsan
 from . import metrics as _metrics
 from . import spans as _spans
 
@@ -58,7 +59,12 @@ __all__ = [
 #: ``telemetry.inspect`` can refuse bundles it cannot render
 BUNDLE_SCHEMA = 1
 
-_LOCK = threading.Lock()
+#: install/uninstall state of the excepthooks
+_LOCK = _tsan.register_lock("telemetry.flight_recorder.hooks")
+#: serializes bundle writes: two threads crashing concurrently each get
+#: their own bundle (distinct thread-id suffixes) written one at a time
+#: instead of racing on a shared path; also guards _LAST_PATH
+_DUMP_LOCK = _tsan.register_lock("telemetry.flight_recorder.dump")
 _DIR: Optional[str] = None
 _PREV_SYS_HOOK = None
 _PREV_THREAD_HOOK = None
@@ -202,6 +208,10 @@ def build_bundle(
             else None,
             "last_step_ts": ck_ts or None,
         },
+        "tsan": {
+            "mode": _tsan.mode(),
+            "findings": _tsan.findings(),
+        },
         "runtime": _runtime_info(),
     }
     if exc is not None:
@@ -224,7 +234,12 @@ def dump_bundle(
 
     Public so a caller that *catches* a terminal fault (and therefore
     keeps the excepthook from ever seeing it) can still record the
-    forensics before degrading."""
+    forensics before degrading.
+
+    Re-entrancy-safe: two threads crashing concurrently serialize on the
+    registered dump lock and write one bundle each — the path carries
+    the crashing thread's id, so neither can clobber the other's
+    evidence even within the same millisecond."""
     import json
 
     from ..resilience.atomic import atomic_write
@@ -235,13 +250,17 @@ def dump_bundle(
         raise ValueError("flight recorder not installed and no directory given")
     doc = build_bundle(exc, reason=reason)
     path = os.path.join(
-        directory, f"flight_{int(doc['timestamp'] * 1e3)}_{os.getpid()}.json"
+        directory,
+        f"flight_{int(doc['timestamp'] * 1e3)}_{os.getpid()}"
+        f"_t{threading.get_ident()}.json",
     )
-    with atomic_write(path) as tmp:
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1, default=str)
+    with _DUMP_LOCK:
+        _tsan.note_access("telemetry.flight_recorder.state")
+        with atomic_write(path) as tmp:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=str)
+        _LAST_PATH = path
     _BUNDLES.inc()
-    _LAST_PATH = path
     return path
 
 

@@ -135,6 +135,17 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
     if not set_knobs:
         lines.append("(all at registered defaults)")
 
+    tsan_doc = doc.get("tsan") or {}
+    tsan_findings = tsan_doc.get("findings") or []
+    if tsan_findings:
+        lines.append(_rule(f"concurrency sanitizer ({len(tsan_findings)} finding(s), mode {tsan_doc.get('mode')})"))
+        for f in tsan_findings[:10]:
+            lines.append(f"{f.get('rule')}: {f.get('message')}")
+            for frame in (f.get("access_stack") or f.get("closing_edge", {}).get("acquire_stack") or [])[:3]:
+                lines.append(f"    {frame}")
+        if len(tsan_findings) > 10:
+            lines.append(f"  ... {len(tsan_findings) - 10} more")
+
     rt = doc.get("runtime") or {}
     lines.append(_rule("runtime"))
     lines.append(

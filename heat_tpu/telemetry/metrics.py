@@ -26,7 +26,12 @@ Every island re-registers its counters here, so one
 
 All operations are thread-safe (per-metric locks; the overlap layer's
 background checkpoint writer and data-loader workers bump counters from
-their own threads).
+their own threads).  The registry-level name->metric map is guarded by
+a lock registered in ``analysis/concurrency.py LOCK_REGISTRY``
+(``telemetry.metrics.registry``) — under ``HEAT_TPU_TSAN=1`` the
+concurrency sanitizer verifies every cross-thread access holds it; the
+per-metric value locks stay unregistered leaf locks (they guard one
+scalar each and are never held across another acquire).
 """
 
 from __future__ import annotations
@@ -37,6 +42,8 @@ import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ..analysis import tsan as _tsan
 
 __all__ = [
     "Counter",
@@ -228,10 +235,15 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: "Dict[str, Union[Counter, Gauge, Histogram]]" = {}
-        self._lock = threading.Lock()
+        # re-entrant: a sanitizer finding inside a locked section reports
+        # through a telemetry counter, which re-enters this registry
+        self._lock = _tsan.register_lock(
+            "telemetry.metrics.registry", threading.RLock()
+        )
 
     def _get_or_make(self, name: str, cls, **kwargs):
         with self._lock:
+            _tsan.note_access("telemetry.metrics.registry")
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, **kwargs)
@@ -256,10 +268,12 @@ class MetricsRegistry:
 
     def get(self, name: str):
         with self._lock:
+            _tsan.note_access("telemetry.metrics.registry", write=False)
             return self._metrics.get(name)
 
     def names(self) -> List[str]:
         with self._lock:
+            _tsan.note_access("telemetry.metrics.registry", write=False)
             return sorted(self._metrics)
 
     def snapshot(self, include_zero: bool = True) -> Dict[str, Any]:
@@ -270,6 +284,7 @@ class MetricsRegistry:
         ``include_zero=False`` drops zero counters and empty histograms
         (compact per-config embedding for bench artifacts)."""
         with self._lock:
+            _tsan.note_access("telemetry.metrics.registry", write=False)
             items = sorted(self._metrics.items())
         out: Dict[str, Any] = {}
         for name, m in items:
@@ -288,6 +303,7 @@ class MetricsRegistry:
         """Zero every metric (or only names under ``prefix``).  Callback
         gauges are left alone — their value is derived live."""
         with self._lock:
+            _tsan.note_access("telemetry.metrics.registry", write=False)
             items = list(self._metrics.items())
         for name, m in items:
             if prefix is not None and not name.startswith(prefix):
@@ -319,6 +335,7 @@ class MetricsRegistry:
         ``heat_tpu_`` namespace prefix."""
         lines: List[str] = []
         with self._lock:
+            _tsan.note_access("telemetry.metrics.registry", write=False)
             items = sorted(self._metrics.items())
         for name, m in items:
             pname = "heat_tpu_" + "".join(

@@ -37,6 +37,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from ..analysis import tsan as _tsan
 from . import metrics as _metrics
 from . import spans as _spans
 
@@ -51,9 +52,12 @@ __all__ = [
 ]
 
 #: the process's single running server (one port is plenty; tests stop
-#: and restart on fresh ephemeral ports)
+#: and restart on fresh ephemeral ports).  The registered lock guards
+#: only the handle swap — the (blocking) socket close/join runs outside
+#: it, so a wedged in-flight request can never wedge every later
+#: start_server() behind a held module lock
 _SERVER: Optional["IntrospectionServer"] = None
-_LOCK = threading.Lock()
+_LOCK = _tsan.register_lock("telemetry.server")
 
 
 def _env():
@@ -240,6 +244,9 @@ class IntrospectionServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
+        # the bound address outlives the socket so port/url stay
+        # answerable after close() (repr in logs, test assertions)
+        self._address = self._httpd.server_address
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="heat-tpu-introspection",
@@ -250,17 +257,29 @@ class IntrospectionServer:
     @property
     def port(self) -> int:
         """The bound port (the OS's pick when constructed with 0)."""
-        return self._httpd.server_address[1]
+        return self._address[1]
 
     @property
     def url(self) -> str:
-        host = self._httpd.server_address[0]
-        return f"http://{host}:{self.port}"
+        return f"http://{self._address[0]}:{self.port}"
 
     def close(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._thread.join(timeout=5)
+        """Stop serving; idempotent and safe to call concurrently.
+
+        ``shutdown()`` only stops the accept loop — an in-flight request
+        keeps its already-accepted connection socket and finishes (or
+        dies on a ``BrokenPipeError`` its handler already swallows), so
+        a scrape racing a ``stop_server()`` can never raise into either
+        side.  Called from a handler thread itself, the serve-thread
+        join is skipped (a thread cannot join itself)."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
 
     def __repr__(self) -> str:
         return f"IntrospectionServer(url={self.url!r})"
@@ -274,6 +293,7 @@ def start_server(port: Optional[int] = None) -> IntrospectionServer:
     server rather than binding a second socket."""
     global _SERVER
     with _LOCK:
+        _tsan.note_access("telemetry.server.singleton")
         if _SERVER is not None:
             return _SERVER
         if port is None:
@@ -283,9 +303,11 @@ def start_server(port: Optional[int] = None) -> IntrospectionServer:
 
 
 def stop_server() -> None:
-    """Shut the running server down (no-op when none is running)."""
+    """Shut the running server down (no-op when none is running; safe
+    to call concurrently — exactly one caller closes the socket)."""
     global _SERVER
     with _LOCK:
+        _tsan.note_access("telemetry.server.singleton")
         srv, _SERVER = _SERVER, None
     if srv is not None:
         srv.close()

@@ -29,6 +29,7 @@ import time
 from collections import deque, namedtuple
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis import tsan as _tsan
 from . import metrics as _metrics
 
 __all__ = [
@@ -53,6 +54,11 @@ def _env_on(name: str, default: bool = True) -> bool:
 _ENABLED = _env_on("HEAT_TPU_TRACE", True)
 _RING_SIZE = int(os.environ.get("HEAT_TPU_TRACE_RING", "4096"))
 _RING: "deque[SpanRecord]" = deque(maxlen=max(1, _RING_SIZE))
+#: spans complete on any thread (async writer, loader workers) while the
+#: introspection server's /trace handler iterates the ring from its own
+#: thread — iterating a deque during an append raises RuntimeError, so
+#: both sides hold the registered ring lock
+_RING_LOCK = _tsan.register_lock("telemetry.spans.ring")
 _TLS = threading.local()
 
 #: completed-span counter in the shared registry; the ONLY registry
@@ -98,12 +104,16 @@ def refresh_env() -> bool:
 
 def get_spans() -> List[SpanRecord]:
     """Completed spans currently in the ring buffer, oldest first."""
-    return list(_RING)
+    with _RING_LOCK:
+        _tsan.note_access("telemetry.spans.ring", write=False)
+        return list(_RING)
 
 
 def clear_spans() -> None:
     """Drop every recorded span."""
-    _RING.clear()
+    with _RING_LOCK:
+        _tsan.note_access("telemetry.spans.ring")
+        _RING.clear()
 
 
 class span:
@@ -153,16 +163,17 @@ class span:
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
         _TLS.depth = self._depth
-        _RING.append(
-            SpanRecord(
-                self.name,
-                self._t0,
-                dur,
-                threading.get_ident(),
-                self._depth,
-                self.attrs,
-            )
+        rec = SpanRecord(
+            self.name,
+            self._t0,
+            dur,
+            threading.get_ident(),
+            self._depth,
+            self.attrs,
         )
+        with _RING_LOCK:
+            _tsan.note_access("telemetry.spans.ring")
+            _RING.append(rec)
         _RECORDED.inc()
         return False
 
@@ -193,7 +204,7 @@ def chrome_trace_doc() -> Dict[str, Any]:
     introspection server's ``/trace`` endpoint returns."""
     events: List[Dict[str, Any]] = []
     pid = os.getpid()
-    for rec in list(_RING):
+    for rec in get_spans():
         events.append(
             {
                 "name": rec.name,

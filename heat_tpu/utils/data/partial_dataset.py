@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...analysis import tsan as _tsan
 from ...core.dndarray import DNDarray
 from ..overlap import _bump
 
@@ -125,6 +126,11 @@ class PartialH5DataLoaderIter:
         self._pos = 0
         self._work: "queue.Queue" = queue.Queue()
         self._ready: "queue.Queue" = queue.Queue(maxsize=2)
+        # close() races itself: the consumer's StopIteration path, an
+        # error path and __del__ (GC, possibly on another thread) can
+        # all retire the worker concurrently — exactly one caller may
+        # claim the thread handle
+        self._lifecycle = _tsan.register_lock("data.partial_loader")
         self._thread = threading.Thread(target=queue_thread, args=(self._work,), daemon=True)
         self._thread.start()
         self._windows_queued = 0
@@ -166,7 +172,12 @@ class PartialH5DataLoaderIter:
         alone would never reach it.  Drain pending windows until the
         thread consumes the sentinel and exits, bounded by a deadline for
         a thread wedged inside a backing-store read."""
-        t, self._thread = self._thread, None
+        lifecycle = getattr(self, "_lifecycle", None)
+        if lifecycle is None:  # __init__ failed before the worker existed
+            return
+        with lifecycle:
+            _tsan.note_access("data.partial_loader.state")
+            t, self._thread = self._thread, None
         if t is None:
             return
         self._work.put(None)

@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..analysis import tsan as _tsan
 from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
@@ -184,7 +185,9 @@ class AsyncCheckpointer:
             )
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
-        self._error_lock = threading.Lock()
+        # written by the background writer, swapped out by the fit
+        # thread — the registered lock is what the sanitizer checks
+        self._error_lock = _tsan.register_lock("overlap.async_writer")
 
     # -- write side -----------------------------------------------------
     def save(self, step: int, state: Any, extra_metadata=None, async_: bool = True) -> None:
@@ -210,6 +213,7 @@ class AsyncCheckpointer:
                         self.checkpointer.save(step, snap, extra_metadata)
                 except BaseException as e:  # lint: allow H501(writer error surfaced at next save/wait/close)
                     with self._error_lock:
+                        _tsan.note_access("overlap.async_writer.state")
                         self._error = e
 
         t = threading.Thread(
@@ -235,6 +239,7 @@ class AsyncCheckpointer:
             self._thread = None
             _bump("ckpt_stall_ms", (time.perf_counter() - t0) * 1e3)
         with self._error_lock:
+            _tsan.note_access("overlap.async_writer.state")
             err, self._error = self._error, None
         if err is not None:
             raise err

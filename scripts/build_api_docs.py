@@ -29,7 +29,9 @@ MODULES = [
     ("Resilience", "heat_tpu.resilience", "fault injection, retry policies, atomic IO, divergence guards (docs/resilience.md)"),
     ("Overlap", "heat_tpu.utils.overlap", "async checkpointing, device prefetch + bucketed gradient-reduction counters (docs/overlap.md)"),
     ("Observability", "heat_tpu.telemetry", "unified metrics registry, structured spans, comm-volume accounting (docs/observability.md)"),
-    ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601) (docs/static_analysis.md)"),
+    ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601, H701-H705) (docs/static_analysis.md)"),
+    ("Concurrency sanitizer", "heat_tpu.analysis.tsan", "runtime lock-order/unguarded-access sanitizer over the central LOCK_REGISTRY (HEAT_TPU_TSAN; docs/static_analysis.md)"),
+    ("Lock registry", "heat_tpu.analysis.concurrency", "central registry of cross-thread locks and the structures they guard (the H7xx rules and the sanitizer share it)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),
     ("Linear algebra", "heat_tpu.core.linalg.basics", None),
     ("QR / SVD / solvers", "heat_tpu.core.linalg.qr", None),

@@ -395,6 +395,56 @@ def main():
 
     guarded("introspection_overhead", bench_introspection_overhead)
 
+    # concurrency-sanitizer overhead: the SAME kmeans lloyd kernel with
+    # HEAT_TPU_TSAN armed (every registered lock recording acquisition
+    # stacks + guarded-structure checkpoints live) vs disarmed — paired
+    # per-round median, same methodology as the other overhead gates.
+    # Hard cap: the sanitizer must stay under 3% of the kernel it
+    # sanitizes, or nobody will run the sanitized lane.
+    def bench_tsan_overhead():
+        from heat_tpu.analysis import tsan
+
+        def fit_sanitized():
+            tsan.arm("1")
+            return fit()
+
+        def fit_plain():
+            tsan.disarm()
+            return fit()
+
+        try:
+            fetch = lambda km: float(km.cluster_centers_.sum())
+            overhead_pct, on_per, off_per, sp = _paired_overhead_pct(
+                fit_sanitized, fit_plain, fetch
+            )
+            n_findings = tsan.finding_count()
+        finally:
+            tsan.disarm()
+            tsan.clear_findings()
+        results["tsan_overhead"] = {
+            "overhead_pct": round(overhead_pct, 2),
+            "max_overhead_pct": 3.0,
+            "enabled_s": round(on_per, 5),
+            "disabled_s": round(off_per, 5),
+            "spread_pct": sp,
+            "findings_during_bench": n_findings,
+        }
+
+    guarded("tsan_overhead", bench_tsan_overhead)
+
+    # sanitized test lane: the threaded test subset (test_overlap /
+    # test_introspection / test_telemetry) in a subprocess under
+    # HEAT_TPU_TSAN=1 — gated as a hard-cap count: red tests or ANY
+    # sanitizer finding (lock-order cycle, off-thread unguarded access)
+    # fails the same perf_gate run that guards the kernels
+    def bench_tsan_lane():
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tsan_lane import run_lane
+
+        results["tsan_lane"] = run_lane(quiet=True)
+
+    guarded("tsan_lane", bench_tsan_lane)
+
     # framework-invariant lint gate (scripts/lint_gate.py): violations
     # are reported alongside the perf metrics and gated as a hard-cap
     # count — ANY new violation (not in scripts/lint_baseline.json)
