@@ -769,6 +769,43 @@ def bench_resilience(ht, sync_floor, roofline=None):
         finally:
             os.environ.pop("HEAT_TPU_RETRY_NO_SLEEP", None)
         counters = rz.resilience_stats()
+
+        # elastic worker-loss recovery (ISSUE 8): one subprocess fit
+        # killed mid-fit by the fault plan, reshaped one device smaller,
+        # resumed from the surviving checkpoint; the recorded latency is
+        # loss detection -> resumed worker's first heartbeat (the same
+        # quantity scripts/perf_ci.py gates with max_seconds)
+        elastic_recovery_s = None
+        elastic_world = None
+        try:
+            import json as _json
+            import sys as _sys
+
+            from heat_tpu.elastic.process import (
+                ProcessSupervisor,
+                kmeans_worker_source,
+            )
+
+            eck = os.path.join(d, "elastic")
+            kill_plan = _json.dumps(
+                {"plan": {"kmeans.iter": [{"at": 1, "kind": "kill", "exit_code": 137}]}}
+            )
+
+            def _ebuild(ws, resume, attempt):
+                src = kmeans_worker_source(eck, resume_from=resume, x64=False)
+                return (
+                    [_sys.executable, "-c", src],
+                    {"HEAT_TPU_FAULT_PLAN": kill_plan if attempt == 0 else ""},
+                )
+
+            eout = ProcessSupervisor(
+                _ebuild, eck, world_size=4, shrink_by=1, max_recoveries=2,
+                poll_s=0.2, attempt_timeout_s=280,
+            ).run()
+            elastic_recovery_s = round(eout["recovery_s"][0], 2)
+            elastic_world = f"{4}->{eout['world_size']}"
+        except Exception as e:  # lint: allow H501(optional bench section records its error)
+            elastic_recovery_s = f"error: {type(e).__name__}: {e}"[:120]
     finally:
         shutil.rmtree(d, ignore_errors=True)
 
@@ -785,6 +822,8 @@ def bench_resilience(ht, sync_floor, roofline=None):
         "faults_injected": counters["faults_injected"],
         "faults_survived": counters["faults_survived"],
         "retry_gave_up": counters["gave_up"],
+        "elastic_recovery_s": elastic_recovery_s,
+        "elastic_world": elastic_world,
     }
 
 

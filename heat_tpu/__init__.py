@@ -31,6 +31,7 @@ from . import regression
 from . import nn
 from . import optim
 from . import resilience
+from . import elastic
 from . import sparse
 from . import telemetry
 from . import utils

@@ -62,5 +62,5 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         idx = idx_arr._dense()
         labels_oh = self.y._dense()
         votes = jnp.sum(labels_oh[idx], axis=1)
-        pred = jnp.argmax(votes, axis=1).astype(jnp.int64)
+        pred = jnp.argmax(votes, axis=1).astype(types.canonical_dtype(jnp.int64))
         return DNDarray.from_dense(pred, x.split, x.device, x.comm)

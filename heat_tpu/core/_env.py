@@ -77,6 +77,12 @@ KNOBS = {
     "HEAT_TPU_INIT_RETRY_BASE_DELAY": ("float", "0.5", "first backoff delay (s) of the init retry policy"),
     "HEAT_TPU_INIT_RETRY_MAX_DELAY": ("float", "10.0", "backoff delay cap (s) of the init retry policy"),
     "HEAT_TPU_IO_CHECKSUM": ("bool", "1", "CRC32 sidecar writing + load-side verification on every io path"),
+    # -- elastic (heat_tpu/elastic, docs/elasticity.md) -----------------
+    "HEAT_TPU_ELASTIC_MAX_RECOVERIES": ("int", "2", "how many worker-loss recoveries (reshape + resume) the elastic supervisor attempts before re-raising"),
+    "HEAT_TPU_ELASTIC_MIN_WORLD": ("int", "1", "smallest world size the elastic supervisor may reshape down to"),
+    "HEAT_TPU_ELASTIC_HEARTBEAT_TIMEOUT_S": ("float", "0", "declare a worker lost when its fit heartbeat is older than this many seconds (0 = liveness detection off, exit-code detection only)"),
+    "HEAT_TPU_ELASTIC_POLL_S": ("float", "0.5", "polling interval of the elastic supervisor's heartbeat monitor"),
+    "HEAT_TPU_HEARTBEAT_FILE": ("path", "", "touch this file at every resumable-fit chunk boundary (the cross-process liveness signal the elastic process supervisor watches)"),
     # -- overlap / nn (docs/overlap.md) ---------------------------------
     "HEAT_TPU_ASYNC_CKPT": ("bool", "1", "asynchronous checkpoint writes in resumable fits (0 = fully synchronous saves)"),
     "HEAT_TPU_GRAD_BUCKET_MB": ("float", "4", "byte bound (MiB) of one bucketed gradient-reduction psum"),

@@ -89,6 +89,7 @@ def resumable_fit_loop(
     loop never returns before its final checkpoint is committed.
     ``HEAT_TPU_ASYNC_CKPT=0`` restores fully synchronous saves.
     """
+    import os as _os
     import sys as _sys
     import time as _time
 
@@ -99,6 +100,7 @@ def resumable_fit_loop(
     from ..telemetry.spans import span as _span
     from ..utils.checkpoint import Checkpointer
     from ..utils.overlap import async_checkpoint_enabled
+    from ._env import env_str
 
     # fit heartbeat: iterations/s of the most recent chunk and its
     # convergence delta, refreshed at every chunk boundary so a stalled
@@ -110,6 +112,20 @@ def resumable_fit_loop(
     heartbeat_g = _tm.gauge(
         "fit.heartbeat_ts", "unix time of the last resumable-fit chunk boundary"
     )
+    # cross-process liveness: with HEAT_TPU_HEARTBEAT_FILE set, every
+    # chunk boundary also touches a file, so an external supervisor (the
+    # elastic process supervisor, docs/elasticity.md) can distinguish a
+    # computing worker from a hung one without an HTTP scrape
+    hb_file = env_str("HEAT_TPU_HEARTBEAT_FILE")
+
+    def _beat() -> None:
+        heartbeat_g.set(_time.time())
+        if hb_file:
+            try:
+                _os.close(_os.open(hb_file, _os.O_CREAT | _os.O_WRONLY, 0o644))
+                _os.utime(hb_file, None)
+            except OSError:
+                pass  # liveness signal is best-effort; never fail the fit
 
     ckpt = None
     directory = checkpoint_dir or resume_from
@@ -139,7 +155,7 @@ def resumable_fit_loop(
     try:
         while total < max_iter:
             n = min(chunk, max_iter - total)
-            heartbeat_g.set(_time.time())  # entering a chunk counts as alive
+            _beat()  # entering a chunk counts as alive
             t0 = _time.perf_counter()
             # heartbeat span: one per chunk, attrs filled in once the
             # chunk's device values are known
@@ -149,7 +165,7 @@ def resumable_fit_loop(
                 shift = float(shift_dev)
             elapsed = _time.perf_counter() - t0
             sp.attrs.update(iters=iters, shift=shift, total=total + iters)
-            heartbeat_g.set(_time.time())
+            _beat()
             iter_rate_g.set(iters / elapsed if elapsed > 0 else 0.0)
             shift_g.set(shift)
             total += iters

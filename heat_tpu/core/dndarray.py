@@ -738,6 +738,49 @@ class DNDarray:
         self.__ragged_buffer = None
         return self
 
+    def reshard_(self, comm: Optional[Communication] = None) -> "DNDarray":
+        """In-place re-materialization onto a different :class:`Communication`.
+
+        The elastic-resume primitive (docs/elasticity.md): after
+        ``comm.reshape(n)`` replaced the mesh, every live array must move
+        to the survivors.  Keeps the global value and the split axis;
+        recomputes the canonical padded distribution for the NEW world
+        size (slice the old world's padding, pad for the new, place with
+        the new canonical sharding).  Unlike ``resplit_`` — one donated
+        executable within a mesh — the placement across meshes is a
+        ``device_put`` copy: XLA cannot alias buffers across two device
+        assignments, so the old backing is freed only when its last
+        reference drops.  No-op when ``comm`` is this array's comm."""
+        comm = sanitize_comm(comm)
+        if comm is self.__comm or comm == self.__comm:
+            return self
+        split = self.__split
+        if self.__planar is not None:
+            re, im = self.__planar
+            # planar planes carry the OLD world's padding: strip it
+            # through the dense view, then re-pad per plane for the new
+            pad = self._pad
+            if pad:
+                sl = tuple(
+                    slice(0, self.__gshape[d]) if d == split else slice(None)
+                    for d in range(self.ndim)
+                )
+                re, im = re[sl], im[sl]
+            self.__planar = (
+                _pad_to_canonical(re, self.__gshape, split, comm),
+                _pad_to_canonical(im, self.__gshape, split, comm),
+            )
+            self.__array = None
+        else:
+            dense = self._dense()
+            self.__array = _pad_to_canonical(dense, self.__gshape, split, comm)
+            self.__planar = None
+        self.__pending = None
+        self.__target_map = None
+        self.__ragged_buffer = None
+        self.__comm = comm
+        return self
+
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
         """Out-of-place resplit (manipulations.py:3633)."""
         axis = sanitize_axis(self.__gshape, axis)

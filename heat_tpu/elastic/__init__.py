@@ -1,0 +1,59 @@
+"""Elastic multi-host execution: survive worker loss, reshape the mesh,
+resume the fit.
+
+The reference framework's L1 layer assumes a fixed MPI world for the
+life of the program (``heat/core/communication.py``); the TPU reality
+this framework targets is **preemptible pods** — workers vanish mid-fit
+and capacity comes back at a different size.  This package composes the
+pieces the earlier layers built into a recovery loop:
+
+detect -> reshape -> resume
+---------------------------
+* **detect** — worker loss surfaces either as a typed exception
+  (:class:`~heat_tpu.resilience.errors.WorkerLostError`, a failed
+  collective) in-process, or as a dead/stale worker process under the
+  :class:`~heat_tpu.elastic.process.ProcessSupervisor` (exit code +
+  the ``fit.heartbeat_ts``-backed heartbeat file every
+  ``resumable_fit_loop`` chunk boundary touches when
+  ``HEAT_TPU_HEARTBEAT_FILE`` is set).  Fault site ``elastic.detect``.
+* **reshape** — ``comm.reshape(n)`` rebuilds the (ICI-node x
+  DCN-global) mesh metadata for the surviving device set
+  (:meth:`~heat_tpu.parallel.comm.Communication.reshape`); all
+  distribution metadata (``chunk``/``lshape_map``/``sharding``) is a
+  pure function of (shape, split, size) and recomputes implicitly.
+  Live arrays move with :meth:`~heat_tpu.core.dndarray.DNDarray.reshard_`;
+  checkpointed state re-splits through
+  ``Checkpointer.restore(..., comm=new)``.  Bounded-retry under the
+  init :class:`~heat_tpu.resilience.retry.RetryPolicy`; fault site
+  ``elastic.reshape``.
+* **resume** — the fit re-enters ``resumable_fit_loop`` with
+  ``resume_from=<checkpoint_dir>``: the iteration sequence continues
+  from the last durable step on the new world.  Same-size resume stays
+  bitwise identical (the PR 2/3 property); a smaller world converges to
+  the same result within floating-point reduction-order tolerance.
+  Fault site ``elastic.resume``.
+
+Telemetry: ``elastic.worker_losses`` / ``elastic.reshapes`` counters,
+``elastic.recovery_ms`` histogram, ``elastic.world_size`` gauge — all in
+the process-global registry, so they flow into ``/metrics``, ``/varz``,
+crash flight-recorder bundles, and the ``/statusz`` elastic section.
+
+See ``docs/elasticity.md`` for the walkthrough and the failure-mode
+table.
+"""
+
+from __future__ import annotations
+
+from ..resilience.errors import ReshapeError, WorkerLostError
+from .supervisor import ElasticSupervisor, HeartbeatMonitor, elastic_state
+from .process import ProcessSupervisor, kmeans_worker_source
+
+__all__ = [
+    "ElasticSupervisor",
+    "HeartbeatMonitor",
+    "ProcessSupervisor",
+    "ReshapeError",
+    "WorkerLostError",
+    "elastic_state",
+    "kmeans_worker_source",
+]

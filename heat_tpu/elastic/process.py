@@ -1,0 +1,268 @@
+"""Cross-process elastic supervision: the kill-and-resume harness as a
+production driver.
+
+A real preemption kills a *worker process*, not an exception handler.
+:class:`ProcessSupervisor` drives one single-controller fit subprocess
+per attempt — the simulated mesh size rides
+``--xla_force_host_platform_device_count`` exactly like the MULTICHIP
+dryrun — and supervises it through two loss signals:
+
+* **exit code** — a worker that dies non-zero (the fault injector's
+  ``kind: "kill"`` ``os._exit(137)``, a real OOM-kill, a preemption
+  SIGKILL) is a lost worker;
+* **heartbeat file** — the worker's ``resumable_fit_loop`` touches
+  ``HEAT_TPU_HEARTBEAT_FILE`` at every chunk boundary (the file-mtime
+  projection of the ``fit.heartbeat_ts`` gauge); a live process whose
+  heartbeat goes stale past ``heartbeat_timeout_s`` is *hung* and gets
+  killed, then treated as lost.
+
+On loss the supervisor reshapes the simulated world (``shrink_by``
+devices smaller, never below ``min_world``) and relaunches with
+``resume_from=checkpoint_dir``, so the fit continues from its last
+durable step on the smaller mesh.  Recovery latency — loss detection to
+the resumed worker's first heartbeat — feeds the shared
+``elastic.recovery_ms`` histogram; losses/reshapes/world-size use the
+same counters and gauge as the in-process supervisor.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..core._env import env_float, env_int
+from ..resilience.errors import ReshapeError, WorkerLostError
+from ..resilience.faults import inject as _inject
+from .supervisor import LOSSES_C, RECOVERY_H, RESHAPES_C, WORLD_G
+
+__all__ = ["ProcessSupervisor", "kmeans_worker_source"]
+
+#: build_worker(world_size, resume_from, attempt) -> (argv, extra_env)
+WorkerBuilder = Callable[[int, Optional[str], int], Tuple[List[str], dict]]
+
+
+def kmeans_worker_source(
+    checkpoint_dir: str,
+    *,
+    resume_from: Optional[str] = None,
+    n: int = 240,
+    f: int = 6,
+    k: int = 4,
+    max_iter: int = 40,
+    tol: float = 1e-4,
+    seed: int = 13,
+    random_state: int = 3,
+    checkpoint_every: int = 2,
+    x64: bool = True,
+) -> str:
+    """Source of a self-contained KMeans fit worker.
+
+    The canonical elastic workload: seeded data generation is
+    world-size-independent (a global array is drawn, then sharded), the
+    fit checkpoints every ``checkpoint_every`` iterations into
+    ``checkpoint_dir``, and the final converged state is readable from
+    the same checkpoint directory — the supervisor never parses stdout.
+    Used by the elastic tests, the MULTICHIP ``elastic_recovery``
+    scenario and the ``bench_resilience`` recovery-time metric."""
+    lines = [
+        "import jax",
+        "jax.config.update('jax_platforms', 'cpu')",
+    ]
+    if x64:
+        lines.append("jax.config.update('jax_enable_x64', True)")
+    lines += [
+        "import heat_tpu as ht",
+        f"ht.random.seed({seed})",
+        f"x = ht.random.randn({n}, {f}, split=0).astype(ht.float32)",
+        f"km = ht.cluster.KMeans(n_clusters={k}, init='random', max_iter={max_iter},",
+        f"                       tol={tol}, random_state={random_state},",
+        f"                       checkpoint_every={checkpoint_every},",
+        f"                       checkpoint_dir={checkpoint_dir!r},",
+        f"                       resume_from={resume_from!r})",
+        "km.fit(x)",
+        "print('ELASTIC-WORKER-OK', km.n_iter_, flush=True)",
+    ]
+    return "\n".join(lines)
+
+
+class ProcessSupervisor:
+    """Supervise a fit subprocess through preemption, reshape, resume.
+
+    ``build_worker(world_size, resume_from, attempt)`` returns
+    ``(argv, extra_env)`` for one attempt; the supervisor adds the mesh
+    size (``XLA_FLAGS`` host-device count), the heartbeat file and a
+    clean CPU platform to the environment.  ``run()`` returns a summary
+    dict (final world size, recoveries, per-recovery latency, worker
+    tails); a worker that keeps dying past ``max_recoveries`` raises
+    :class:`WorkerLostError`, a shrink below ``min_world`` raises
+    :class:`ReshapeError`."""
+
+    def __init__(
+        self,
+        build_worker: WorkerBuilder,
+        checkpoint_dir: str,
+        world_size: int,
+        *,
+        min_world: Optional[int] = None,
+        shrink_by: int = 1,
+        max_recoveries: Optional[int] = None,
+        heartbeat_timeout_s: Optional[float] = None,
+        poll_s: Optional[float] = None,
+        attempt_timeout_s: float = 600.0,
+        env: Optional[dict] = None,
+    ):
+        if world_size < 1:
+            raise ReshapeError(f"world_size must be >= 1, got {world_size}")
+        self.build_worker = build_worker
+        self.checkpoint_dir = os.path.abspath(checkpoint_dir)
+        self.world_size = int(world_size)
+        self.min_world = (
+            env_int("HEAT_TPU_ELASTIC_MIN_WORLD") if min_world is None else int(min_world)
+        )
+        self.shrink_by = int(shrink_by)
+        self.max_recoveries = (
+            env_int("HEAT_TPU_ELASTIC_MAX_RECOVERIES")
+            if max_recoveries is None
+            else int(max_recoveries)
+        )
+        self.heartbeat_timeout_s = (
+            env_float("HEAT_TPU_ELASTIC_HEARTBEAT_TIMEOUT_S")
+            if heartbeat_timeout_s is None
+            else float(heartbeat_timeout_s)
+        )
+        self.poll_s = env_float("HEAT_TPU_ELASTIC_POLL_S") if poll_s is None else float(poll_s)
+        self.attempt_timeout_s = float(attempt_timeout_s)
+        self.base_env = dict(os.environ if env is None else env)
+
+    # -- one attempt ----------------------------------------------------
+    def _attempt_env(self, world: int, extra: dict, hb_path: str) -> dict:
+        env = dict(self.base_env)
+        # the worker controls the platform itself (jax.config): strip
+        # inherited overrides that would pin the parent's device count
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={world}"
+        env["HEAT_TPU_HEARTBEAT_FILE"] = hb_path
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(extra or {})
+        return env
+
+    def _await_worker(
+        self, proc: subprocess.Popen, hb_path: str, launched_wall: float
+    ) -> Tuple[int, Optional[float]]:
+        """Poll one worker to completion (or kill it for staleness /
+        attempt timeout).  Returns ``(returncode, first_beat_monotonic)``."""
+        started = time.monotonic()
+        first_beat: Optional[float] = None
+        while True:
+            rc = proc.poll()
+            try:
+                beat_wall: Optional[float] = os.path.getmtime(hb_path)
+            except OSError:
+                beat_wall = None
+            if first_beat is None and beat_wall is not None and beat_wall >= launched_wall:
+                first_beat = time.monotonic()
+            if rc is not None:
+                return rc, first_beat
+            now_wall = time.time()
+            hb_age = now_wall - (beat_wall if beat_wall is not None else launched_wall)
+            if self.heartbeat_timeout_s > 0 and hb_age > self.heartbeat_timeout_s:
+                proc.kill()
+                proc.wait()
+                return -9, first_beat  # hung worker: killed, counts as lost
+            if time.monotonic() - started > self.attempt_timeout_s:
+                proc.kill()
+                proc.wait()
+                raise WorkerLostError(
+                    f"worker exceeded the attempt timeout "
+                    f"({self.attempt_timeout_s:.0f}s) without finishing",
+                    world_size=self.world_size,
+                )
+            time.sleep(self.poll_s)
+
+    @staticmethod
+    def _tail(path: str, limit: int = 2000) -> str:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            return data[-limit:].decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    # -- the supervision loop -------------------------------------------
+    def run(self) -> dict:
+        """Drive attempts until a worker finishes cleanly.
+
+        Returns ``{"world_size", "recoveries", "recovery_s": [...],
+        "attempts": [{"world_size", "returncode", "tail"}, ...]}``."""
+        world = self.world_size
+        WORLD_G.set(world)
+        resume: Optional[str] = None
+        recoveries = 0
+        recovery_s: List[float] = []
+        attempts: List[dict] = []
+        hb_path = os.path.join(self.checkpoint_dir, ".heartbeat")
+        t_loss: Optional[float] = None
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        while True:
+            argv, extra = self.build_worker(world, resume, len(attempts))
+            env = self._attempt_env(world, extra, hb_path)
+            log_path = os.path.join(self.checkpoint_dir, f".worker-{len(attempts)}.log")
+            launched_wall = time.time()
+            log_fd = os.open(log_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+            try:
+                proc = subprocess.Popen(
+                    argv, env=env, stdout=log_fd, stderr=subprocess.STDOUT
+                )
+            finally:
+                os.close(log_fd)
+            rc, first_beat = self._await_worker(proc, hb_path, launched_wall)
+            attempts.append(
+                {"world_size": world, "returncode": rc, "tail": self._tail(log_path)}
+            )
+            if t_loss is not None:
+                # recovery latency: previous worker's loss -> this
+                # worker's first heartbeat (its completion when it
+                # resumed straight into a converged checkpoint)
+                end = first_beat if first_beat is not None else time.monotonic()
+                dt = max(0.0, end - t_loss)
+                recovery_s.append(dt)
+                RECOVERY_H.observe(dt * 1000.0)
+                t_loss = None
+            if rc == 0:
+                return {
+                    "world_size": world,
+                    "recoveries": recoveries,
+                    "recovery_s": recovery_s,
+                    "attempts": attempts,
+                }
+            # -- loss detected ------------------------------------------
+            t_loss = time.monotonic()
+            _inject("elastic.detect", returncode=rc, world_size=world)
+            LOSSES_C.inc()
+            recoveries += 1
+            if recoveries > self.max_recoveries:
+                raise WorkerLostError(
+                    f"worker died (rc={rc}) and the recovery budget "
+                    f"({self.max_recoveries}) is exhausted; last output:\n"
+                    + attempts[-1]["tail"],
+                    world_size=world,
+                )
+            target = world - self.shrink_by
+            if target < self.min_world:
+                raise ReshapeError(
+                    f"worker loss would shrink the world to {target}, below "
+                    f"the configured minimum {self.min_world}",
+                    old_size=world,
+                    new_size=target,
+                )
+            _inject("elastic.reshape", old=world, new=target)
+            world = target
+            RESHAPES_C.inc()
+            WORLD_G.set(world)
+            resume = self.checkpoint_dir
+            _inject("elastic.resume", world_size=world)

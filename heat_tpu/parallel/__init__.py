@@ -11,6 +11,7 @@ from .comm import (
     init,
     is_initialized,
     finalize,
+    comm_epoch,
 )
 
 __all__ = [
@@ -24,4 +25,5 @@ __all__ = [
     "init",
     "is_initialized",
     "finalize",
+    "comm_epoch",
 ]

@@ -47,6 +47,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..resilience.errors import ReshapeError
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import default_init_policy as _init_policy
 from ..telemetry import metrics as _tm
@@ -63,6 +64,7 @@ __all__ = [
     "init",
     "is_initialized",
     "finalize",
+    "comm_epoch",
 ]
 
 #: Name of the mesh axis used for the (single) split dimension, mirroring the
@@ -132,6 +134,8 @@ class Communication:
         self._devices_spec = devices
         self.axis_name = axis_name
         self._resolved: Optional[Tuple[List, Mesh]] = None
+        self._resolved_epoch: int = -1
+        self._retired = False
 
     def _resolve_devices(self) -> List:
         spec = self._devices_spec
@@ -141,11 +145,29 @@ class Communication:
             return list(spec())
         return list(spec)
 
+    def _reresolvable(self) -> bool:
+        """Whether the device set can be recomputed after the runtime's
+        device inventory changes (spec-based comms: None / callable).  A
+        comm built over an explicit device list is pinned to those
+        objects — after ``finalize()``+``init()`` it must be rebuilt via
+        :meth:`reshape`, not silently re-pointed."""
+        return self._devices_spec is None or callable(self._devices_spec)
+
+    def _build(self) -> Tuple[List, Mesh]:
+        devs = self._resolve_devices()
+        mesh = Mesh(np.asarray(devs, dtype=object), (self.axis_name,))
+        return devs, mesh
+
     def _ensure(self) -> Tuple[List, Mesh]:
-        if self._resolved is None:
-            devs = self._resolve_devices()
-            mesh = Mesh(np.asarray(devs, dtype=object), (self.axis_name,))
-            self._resolved = (devs, mesh)
+        # Re-resolve after an init()/finalize() cycle bumped the device
+        # epoch: the old device objects belong to a dead runtime, and
+        # every derived mesh/sharding with them is stale.
+        if self._resolved is None or (
+            self._resolved_epoch != _EPOCH and self._reresolvable()
+        ):
+            self._resolved = self._build()
+            self._resolved_epoch = _EPOCH
+            self._retired = False  # a fresh resolution is a fresh mesh
         return self._resolved
 
     @property
@@ -359,6 +381,90 @@ class Communication:
         return Communication(devs, axis_name or self.axis_name)
 
     # ------------------------------------------------------------------
+    # elastic reshape
+    # ------------------------------------------------------------------
+    @property
+    def retired(self) -> bool:
+        """True once :meth:`reshape` replaced this mesh.  A retired comm
+        stays readable (its chunk/lshape metadata describes arrays not
+        yet re-split) but should not receive new work."""
+        return self._retired
+
+    def _surviving_devices(self, n_devices: Optional[int], devices) -> List:
+        """Resolve the survivor set for :meth:`reshape` and validate it
+        against the runtime's current device inventory."""
+        available = list(jax.devices())
+        if devices is not None:
+            devs = list(devices)
+            alive = {id(d) for d in available}
+            missing = [d for d in devs if id(d) not in alive]
+            if missing:
+                raise ReshapeError(
+                    f"reshape target names {len(missing)} device(s) not in the "
+                    f"current runtime inventory ({len(available)} available)",
+                    old_size=self.size, new_size=len(devs),
+                )
+            if not devs:
+                raise ReshapeError(
+                    "reshape target is empty", old_size=self.size, new_size=0
+                )
+            return devs
+        if n_devices is None:
+            raise ReshapeError(
+                "reshape needs n_devices or an explicit device list",
+                old_size=self.size,
+            )
+        n = int(n_devices)
+        if n < 1:
+            raise ReshapeError(
+                f"reshape target world size must be >= 1, got {n}",
+                old_size=self.size, new_size=n,
+            )
+        if n > len(available):
+            raise ReshapeError(
+                f"reshape target world size {n} exceeds the {len(available)} "
+                "devices the runtime currently exposes",
+                old_size=self.size, new_size=n,
+            )
+        # prefer this comm's own surviving devices (stable participant
+        # order for the unaffected prefix), then draw replacements from
+        # the runtime inventory (capacity that came back elsewhere)
+        alive = {id(d) for d in available}
+        survivors = [d for d in self._devices if id(d) in alive]
+        if len(survivors) < n:
+            have = {id(d) for d in survivors}
+            survivors += [d for d in available if id(d) not in have]
+        return survivors[:n]
+
+    def reshape(self, n_devices: Optional[int] = None, devices=None) -> "Communication":
+        """Rebuild this communication for a different world size.
+
+        The elastic-recovery primitive (docs/elasticity.md): after a
+        worker loss (or regrowth) the caller asks for a mesh over the
+        surviving ``n_devices`` — preferring this comm's own devices
+        that are still alive, topped up from the runtime inventory — and
+        receives a NEW :class:`Communication`.  All distribution
+        metadata (``chunk``/``lshape_map``/``sharding``/
+        ``counts_displs_shape``) is a pure function of (shape, split,
+        size), so it is implicitly recomputed for the new world; live
+        arrays must be re-materialized onto the new comm
+        (``DNDarray.reshard_``, or a cross-world
+        ``Checkpointer.restore(..., comm=new)``).
+
+        The old comm is marked retired but stays readable — its metadata
+        still describes the not-yet-resharded arrays.  Raises
+        :class:`~heat_tpu.resilience.errors.ReshapeError` for an
+        impossible target (empty, larger than the runtime inventory,
+        dead explicit devices)."""
+        devs = self._surviving_devices(n_devices, devices)
+        with _span("comm.reshape", old=self.size, new=len(devs)):
+            axis = self.axis_name if isinstance(self.axis_name, str) else SPLIT_AXIS_NAME
+            new = Communication(devs, axis)
+            new._ensure()  # build the mesh now: fail fast, not at first use
+        self._retired = True
+        return new
+
+    # ------------------------------------------------------------------
     # explicit collectives — for use inside jax.shard_map bodies only.
     # The ops layer almost never needs these; GSPMD infers communication
     # from shardings.  They exist for halo exchange, ring algorithms and
@@ -533,27 +639,29 @@ class HierarchicalCommunication(Communication):
         # sharding()/collectives shard/reduce over the flattened grid.
         super().__init__(devices=devices, axis_name=self._axis_names)
 
-    def _ensure(self) -> Tuple[List, Mesh]:
-        if self._resolved is None:
-            devs = self._resolve_devices()
-            grid = self._grid_spec
-            if grid is None:
-                # infer one 'node' per host process (the reference's
-                # node==host assumption); single host degenerates to (1, n)
-                nproc = len({d.process_index for d in devs})
-                if nproc > 1 and len(devs) % nproc == 0:
-                    grid = (nproc, len(devs) // nproc)
-                else:
-                    grid = (1, len(devs))
-            n_node, per_node = int(grid[0]), int(grid[1])
-            if n_node * per_node != len(devs):
-                raise ValueError(
-                    f"grid {grid} does not tile {len(devs)} devices"
-                )
-            arr = np.asarray(devs, dtype=object).reshape(n_node, per_node)
-            mesh = Mesh(arr, self._axis_names)
-            self._resolved = (devs, mesh)
-        return self._resolved
+    @staticmethod
+    def infer_grid(devices: Sequence) -> Tuple[int, int]:
+        """(n_node, per_node) for a device set: one 'node' per host
+        process (the reference's node==host assumption) when that tiles
+        the set evenly; a single host degenerates to ``(1, n)``."""
+        nproc = len({d.process_index for d in devices})
+        if nproc > 1 and len(devices) % nproc == 0:
+            return (nproc, len(devices) // nproc)
+        return (1, len(devices))
+
+    def _build(self) -> Tuple[List, Mesh]:
+        devs = self._resolve_devices()
+        grid = self._grid_spec
+        if grid is None:
+            grid = self.infer_grid(devs)
+        n_node, per_node = int(grid[0]), int(grid[1])
+        if n_node * per_node != len(devs):
+            raise ValueError(
+                f"grid {grid} does not tile {len(devs)} devices"
+            )
+        arr = np.asarray(devs, dtype=object).reshape(n_node, per_node)
+        mesh = Mesh(arr, self._axis_names)
+        return devs, mesh
 
     # -- hierarchy topology --------------------------------------------
     @property
@@ -586,6 +694,23 @@ class HierarchicalCommunication(Communication):
         devs = [self._devices[i] for i in color_ranks]
         return Communication(devs, axis_name or SPLIT_AXIS_NAME)
 
+    def reshape(
+        self, n_devices: Optional[int] = None, devices=None
+    ) -> "HierarchicalCommunication":
+        """Rebuild the (ICI-node x DCN-global) grid for the surviving
+        device set: the node structure is re-inferred from the
+        survivors' host processes (:meth:`infer_grid`), NOT carried over
+        — losing a worker usually leaves a partial node, and a stale
+        grid would put cross-host hops on the 'node' (ICI) axis."""
+        devs = self._surviving_devices(n_devices, devices)
+        with _span("comm.reshape", old=self.size, new=len(devs), hierarchical=True):
+            new = HierarchicalCommunication(
+                grid=self.infer_grid(devs), devices=devs, axis_names=self._axis_names
+            )
+            new._ensure()
+        self._retired = True
+        return new
+
     def __eq__(self, other) -> bool:
         # same devices in a different (n_node, per_node) layout is a
         # DIFFERENT topology: collectives over 'node'/'global' change
@@ -613,6 +738,19 @@ class HierarchicalCommunication(Communication):
 # ``jax.distributed.initialize``'s own contract)
 # ----------------------------------------------------------------------
 _initialized = False
+
+#: device-inventory epoch: bumped whenever init()/finalize() (may have)
+#: changed the runtime's device set.  Spec-based comms (WORLD/SELF and
+#: any Communication built without an explicit device list) lazily
+#: re-resolve when their stored epoch is stale, so repeated
+#: finalize()+init() cycles — the elastic supervisor's restart path —
+#: never leave a mesh pointing at a dead runtime's device objects.
+_EPOCH = 0
+
+
+def comm_epoch() -> int:
+    """Current device-inventory epoch (see :data:`_EPOCH`)."""
+    return _EPOCH
 
 
 def init(
@@ -702,19 +840,44 @@ def is_initialized() -> bool:
 
 
 def finalize() -> None:
-    """Tear down the distributed runtime (``MPI_Finalize`` analog)."""
+    """Tear down the distributed runtime (``MPI_Finalize`` analog).
+
+    Safe for repeated ``finalize()`` + ``init()`` cycles (the elastic
+    supervisor's restart path): beyond shutting the runtime down, it
+    bumps the device-inventory epoch so spec-based comms re-resolve,
+    resets the default comm, and drops every process cache keyed on the
+    dead mesh's device objects (compiled-executable dispatch cache and
+    its cost records, the FFT weight cache's device-placed constants)."""
     global _initialized
     if jax.process_count() > 1:  # pragma: no cover - multi-host only
         jax.distributed.shutdown()
     _initialized = False
+    _reset_defaults()
 
 
 def _reset_defaults() -> None:
-    """Re-resolve WORLD/SELF after the device set changes (post-``init``)."""
-    global __default_comm
+    """Invalidate device-derived state after the device set (may have)
+    changed: post-``init`` bootstrap and ``finalize`` teardown."""
+    global __default_comm, _EPOCH
+    _EPOCH += 1
     WORLD._resolved = None
     SELF._resolved = None
     __default_comm = WORLD
+    # compiled executables and device-placed constants are keyed on
+    # shardings whose meshes hold the previous epoch's device objects:
+    # entries can never hit again and pin a dead runtime's buffers
+    try:
+        from ..core import dispatch as _dispatch
+
+        _dispatch.clear_cache()
+    except Exception:  # lint: allow H501(cache drop is best-effort during teardown)
+        pass
+    try:
+        from ..fft._weight_cache import weight_cache_clear
+
+        weight_cache_clear()
+    except Exception:  # lint: allow H501(cache drop is best-effort during teardown)
+        pass
 
 
 # ----------------------------------------------------------------------

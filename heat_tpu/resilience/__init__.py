@@ -37,8 +37,10 @@ from .errors import (
     ChecksumError,
     DivergenceError,
     PermanentFault,
+    ReshapeError,
     ResilienceError,
     TransientFault,
+    WorkerLostError,
 )
 from .faults import (
     FaultInjector,
@@ -71,10 +73,12 @@ __all__ = [
     "DivergenceError",
     "FaultInjector",
     "PermanentFault",
+    "ReshapeError",
     "ResilienceError",
     "RetryPolicy",
     "RetryTimeout",
     "TransientFault",
+    "WorkerLostError",
     "active_injector",
     "all_finite",
     "atomic_write",

@@ -31,6 +31,8 @@ __all__ = [
     "PermanentFault",
     "ChecksumError",
     "DivergenceError",
+    "ReshapeError",
+    "WorkerLostError",
 ]
 
 
@@ -72,6 +74,50 @@ class ChecksumError(ResilienceError, OSError):
         self.path = path
         self.expected = expected
         self.actual = actual
+
+
+class WorkerLostError(ResilienceError, RuntimeError):
+    """A participant of the SPMD world stopped responding (preempted
+    host, dead heartbeat, failed collective).  Carries what the detector
+    knew: ``lost`` (how many participants are gone, best-effort),
+    ``world_size`` (the size of the world the loss was observed in) and
+    ``heartbeat_age`` (seconds since the last observed heartbeat, when
+    heartbeat-based detection fired).  The elastic supervisor reacts by
+    reshaping the mesh to the survivors and resuming from the last
+    durable checkpoint; without a supervisor it propagates like any
+    other fatal error."""
+
+    def __init__(
+        self,
+        message: str = "worker lost",
+        lost: int = 1,
+        world_size: Optional[int] = None,
+        heartbeat_age: Optional[float] = None,
+    ):
+        super().__init__(message)
+        self.lost = int(lost)
+        self.world_size = world_size
+        self.heartbeat_age = heartbeat_age
+
+
+class ReshapeError(ResilienceError, ValueError):
+    """An elastic mesh reshape or a cross-world checkpoint restore
+    cannot be performed: target world invalid (zero/negative, more
+    devices than exist), or restored state does not fit the template
+    (shape/dtype mismatch).  Never retried — the inputs will not
+    change."""
+
+    def __init__(
+        self,
+        message: str,
+        old_size: Optional[int] = None,
+        new_size: Optional[int] = None,
+        leaf: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.old_size = old_size
+        self.new_size = new_size
+        self.leaf = leaf
 
 
 class DivergenceError(ResilienceError, ArithmeticError):

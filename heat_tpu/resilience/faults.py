@@ -101,6 +101,9 @@ KNOWN_SITES = (
     "kmedoids.iter",
     "lasso.iter",
     "pca.stage",
+    "elastic.detect",
+    "elastic.reshape",
+    "elastic.resume",
 )
 
 #: process-lifetime totals (survive injector deactivation) — registered

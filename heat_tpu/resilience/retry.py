@@ -28,7 +28,13 @@ import os
 import time
 from typing import Callable, List, Optional, Tuple, Type
 
-from .errors import ChecksumError, DivergenceError, PermanentFault, TransientFault
+from .errors import (
+    ChecksumError,
+    DivergenceError,
+    PermanentFault,
+    ReshapeError,
+    TransientFault,
+)
 from ..telemetry import metrics as _tm
 
 __all__ = [
@@ -71,7 +77,7 @@ class RetryTimeout(TransientFault):
 #: exception types retrying can never fix — checked before the
 #: retryable filter, so even a filter of ``(Exception,)`` cannot loop
 #: on them
-NON_RETRYABLE = (PermanentFault, ChecksumError, DivergenceError)
+NON_RETRYABLE = (PermanentFault, ChecksumError, DivergenceError, ReshapeError)
 
 
 class RetryPolicy:

@@ -184,6 +184,17 @@ def _span_dump() -> list:
     ]
 
 
+def _elastic_state() -> Optional[Dict[str, Any]]:
+    """World size + loss/reshape counters at crash time — the first
+    question a preemption postmortem asks."""
+    try:
+        from ..elastic.supervisor import elastic_state
+
+        return elastic_state()
+    except Exception:  # lint: allow H501(bundle section degrades, the crash dump must land)
+        return None
+
+
 def build_bundle(
     exc: Optional[BaseException] = None,
     reason: str = "manual",
@@ -212,6 +223,7 @@ def build_bundle(
             "mode": _tsan.mode(),
             "findings": _tsan.findings(),
         },
+        "elastic": _elastic_state(),
         "runtime": _runtime_info(),
     }
     if exc is not None:

@@ -73,6 +73,14 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
     else:
         lines.append("no durable checkpoint recorded")
 
+    el = doc.get("elastic")
+    if el and (el.get("worker_losses") or el.get("reshapes") or el.get("world_size")):
+        lines.append(_rule("elastic"))
+        lines.append(
+            f"world_size={el.get('world_size')} "
+            f"worker_losses={el.get('worker_losses')} reshapes={el.get('reshapes')}"
+        )
+
     spans = doc.get("spans") or []
     lines.append(_rule(f"last spans ({min(n_spans, len(spans))} of {len(spans)})"))
     for rec in spans[-n_spans:]:

@@ -147,6 +147,12 @@ def statusz_report() -> Dict[str, Any]:
         }
     except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
         doc["dispatch"] = None
+    try:
+        from ..elastic.supervisor import elastic_state
+
+        doc["elastic"] = elastic_state()
+    except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
+        doc["elastic"] = None
     return doc
 
 

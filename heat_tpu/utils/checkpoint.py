@@ -44,12 +44,20 @@ import numpy as np
 
 from ..core.dndarray import DNDarray
 from ..resilience import atomic as _ratomic
+from ..resilience.errors import ReshapeError
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import default_io_policy as _io_policy
 from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
 
 __all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
+
+#: cross-world restores performed (checkpoint written at world size P,
+#: restored onto Q != P — the elastic resume path)
+_CROSSWORLD_C = _tm.counter(
+    "checkpoint.crossworld_restores",
+    "checkpoint restores onto a world size different from the writer's",
+)
 
 _STEP_PREFIX = "step_"
 
@@ -79,10 +87,31 @@ def _orbax():
 # state estimators and optimizers actually save — nested dict/list/tuple
 # of arrays (np/jax/DNDarray) and python scalars.
 # ----------------------------------------------------------------------
+class DNDSnapshot:
+    """Async-snapshot carrier for a DNDarray leaf: the (immutable) dense
+    device array plus the distribution intent the cross-world codec
+    records.  ``overlap.snapshot_state`` produces these so the split
+    axis survives the background-writer handoff."""
+
+    __slots__ = ("dense", "split", "world_size")
+
+    def __init__(self, dense, split, world_size):
+        self.dense = dense
+        self.split = split
+        self.world_size = world_size
+
+
 def _encode(obj: Any, leaves: List[np.ndarray]):
+    if isinstance(obj, DNDSnapshot):
+        leaves.append(np.asarray(obj.dense))
+        return {"t": "dnd", "i": len(leaves) - 1, "split": obj.split}
     if isinstance(obj, DNDarray):
+        # store the dense GLOBAL value plus the distribution intent
+        # (split axis): a cross-world restore re-splits the leaf onto
+        # the restoring comm's canonical distribution — sharding is a
+        # property of the restoring mesh, never of the payload bytes
         leaves.append(np.asarray(obj._dense()))
-        return {"t": "arr", "i": len(leaves) - 1}
+        return {"t": "dnd", "i": len(leaves) - 1, "split": obj.split}
     if isinstance(obj, (np.ndarray, np.generic, jax.Array)):
         leaves.append(np.asarray(obj))
         return {"t": "arr", "i": len(leaves) - 1}
@@ -105,21 +134,85 @@ def _encode(obj: Any, leaves: List[np.ndarray]):
     )
 
 
-def _decode(node: Dict, leaves) -> Any:
+def _decode(node: Dict, leaves, comm=None) -> Any:
     t = node["t"]
     if t == "arr":
         return leaves[f"a{node['i']}"]
+    if t == "dnd":
+        arr = leaves[f"a{node['i']}"]
+        if comm is None:
+            # no target mesh: hand back the global host value (the
+            # pre-elastic behavior, and what version-1 checkpoints did)
+            return arr
+        import jax.numpy as jnp
+
+        return DNDarray.from_dense(jnp.asarray(arr), node.get("split"), None, comm)
     if t == "py":
         return node["v"]
     if t == "complex":
         return complex(node["re"], node["im"])
     if t == "list":
-        return [_decode(x, leaves) for x in node["v"]]
+        return [_decode(x, leaves, comm) for x in node["v"]]
     if t == "tuple":
-        return tuple(_decode(x, leaves) for x in node["v"])
+        return tuple(_decode(x, leaves, comm) for x in node["v"])
     if t == "dict":
-        return {k: _decode(v, leaves) for k, v in node["v"].items()}
+        return {k: _decode(v, leaves, comm) for k, v in node["v"].items()}
     raise ValueError(f"unknown checkpoint node type {t!r}")
+
+
+def _leaf_shape_dtype(x):
+    """(shape, dtype-name) of an array-like template/state leaf, or None
+    for non-arrays."""
+    if isinstance(x, DNDarray):
+        return tuple(x.shape), np.dtype(x.dtype.jax_type()).name
+    if isinstance(x, (np.ndarray, np.generic, jax.Array)):
+        return tuple(x.shape), np.dtype(x.dtype).name
+    return None
+
+
+def _validate_template(template: Any, restored: Any, path: str = "state") -> None:
+    """Shape/dtype validation of a restored tree against a template.
+
+    The elastic resume path restores onto a world the writer never saw;
+    what must NOT change across worlds is the global shape and dtype of
+    every array leaf and the tree structure around them.  Mismatch
+    raises :class:`ReshapeError` naming the offending leaf."""
+    want = _leaf_shape_dtype(template)
+    if want is not None:
+        got = _leaf_shape_dtype(restored)
+        if got is None:
+            raise ReshapeError(
+                f"checkpoint leaf {path!r}: template expects an array "
+                f"{want[0]}/{want[1]}, restored a {type(restored).__name__}",
+                leaf=path,
+            )
+        if want[0] != got[0] or want[1] != got[1]:
+            raise ReshapeError(
+                f"checkpoint leaf {path!r}: template expects {want[0]}/{want[1]}, "
+                f"checkpoint holds {got[0]}/{got[1]} — global shapes and dtypes "
+                "must be world-size invariant",
+                leaf=path,
+            )
+        return
+    if isinstance(template, dict):
+        if not isinstance(restored, dict) or set(template) != set(restored):
+            raise ReshapeError(
+                f"checkpoint node {path!r}: dict keys differ from template",
+                leaf=path,
+            )
+        for k in template:
+            _validate_template(template[k], restored[k], f"{path}.{k}")
+        return
+    if isinstance(template, (list, tuple)):
+        if not isinstance(restored, (list, tuple)) or len(template) != len(restored):
+            raise ReshapeError(
+                f"checkpoint node {path!r}: sequence arity differs from template",
+                leaf=path,
+            )
+        for i, (t, r) in enumerate(zip(template, restored)):
+            _validate_template(t, r, f"{path}[{i}]")
+        return
+    # scalars/None: nothing to pin
 
 
 def _strip_dndarrays(tree: Any) -> Any:
@@ -130,6 +223,24 @@ def _strip_dndarrays(tree: Any) -> Any:
         tree,
         is_leaf=lambda x: isinstance(x, DNDarray),
     )
+
+
+def _infer_world_size(state: Any) -> int:
+    """World size a checkpoint is written at: the comm size of the first
+    DNDarray leaf, else the process device count.  Best-effort metadata
+    — the payload is world-size-independent (dense global arrays); the
+    elastic layer reads it back to count cross-world restores."""
+    for leaf in jax.tree_util.tree_leaves(
+        state, is_leaf=lambda x: isinstance(x, (DNDarray, DNDSnapshot))
+    ):
+        if isinstance(leaf, DNDSnapshot):
+            return leaf.world_size
+        if isinstance(leaf, DNDarray):
+            return leaf.comm.size
+    try:
+        return jax.device_count()
+    except Exception:  # lint: allow H501(backend-less save still checkpoints)
+        return 1
 
 
 class Checkpointer:
@@ -251,7 +362,15 @@ class Checkpointer:
         try:
             with _ratomic.atomic_write(os.path.join(staging, "state.json"), fault_site="checkpoint.write") as tmp:
                 with open(tmp, "w") as f:
-                    json.dump({"version": 1, "step": step, "tree": tree}, f)
+                    json.dump(
+                        {
+                            "version": 2,
+                            "step": step,
+                            "world_size": _infer_world_size(state),
+                            "tree": tree,
+                        },
+                        f,
+                    )
             with _ratomic.atomic_write(os.path.join(staging, "arrays.npz"), fault_site="checkpoint.write") as tmp:
                 with open(tmp, "wb") as f:
                     np.savez(f, **{f"a{i}": a for i, a in enumerate(leaves)})
@@ -273,27 +392,47 @@ class Checkpointer:
         for s in steps[: max(0, len(steps) - self.max_to_keep)]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
-    def restore(self, step: Optional[int] = None, template: Any = None) -> Any:
+    def restore(
+        self, step: Optional[int] = None, template: Any = None, comm=None
+    ) -> Any:
         """Restore a step (latest by default).
 
         Native: both files verify against their CRC32 sidecars before
         decoding — a corrupt checkpoint raises ``ChecksumError`` instead
-        of returning garbage.  ``template`` is only consulted by the
-        orbax backend (the native codec is structure-lossless)."""
+        of returning garbage.
+
+        ``comm`` (native backend) is the **cross-world restore** path:
+        DNDarray leaves re-materialize onto ``comm``'s canonical
+        distribution — re-split to its device count — even when the
+        checkpoint was written at a different world size; a restore onto
+        a world of size Q != writer's P is counted in
+        ``checkpoint.crossworld_restores``.  ``template`` validates the
+        restored tree's structure and every array leaf's global
+        shape/dtype (world-size invariants), raising
+        :class:`~heat_tpu.resilience.errors.ReshapeError` on mismatch;
+        for orbax it is the StandardRestore template."""
         self.close()
         step = self.latest_step() if step is None else int(step)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
         if self.backend == "orbax":
+            if comm is not None:
+                raise ValueError(
+                    "cross-world restore (comm=...) is a native-backend feature; "
+                    "the orbax backend restores with orbax's own sharding rules"
+                )
             ocp = _orbax()
             if template is not None:
                 template = _strip_dndarrays(template)
                 return self._mngr.restore(step, args=ocp.args.StandardRestore(template))
             return self._mngr.restore(step)
-        return self._native_restore(step)
+        state = self._native_restore(step, comm)
+        if template is not None:
+            _validate_template(template, state)
+        return state
 
     @_span("checkpoint.read")
-    def _native_restore(self, step: int) -> Any:
+    def _native_restore(self, step: int, comm=None) -> Any:
         _inject("checkpoint.restore", step=step)
         d = self._step_dir(step)
         state_path = os.path.join(d, "state.json")
@@ -304,8 +443,29 @@ class Checkpointer:
         _ratomic.verify_checksum(arrays_path)
         with open(state_path) as f:
             doc = json.load(f)
+        if comm is not None:
+            written = doc.get("world_size")
+            if written is not None and int(written) != comm.size:
+                _CROSSWORLD_C.inc()
         with np.load(arrays_path) as leaves:
-            return _decode(doc["tree"], leaves)
+            return _decode(doc["tree"], leaves, comm)
+
+    def world_size(self, step: Optional[int] = None) -> Optional[int]:
+        """World size a (native) step was written at, or None when the
+        checkpoint predates the metadata (version 1) or is orbax-backed."""
+        if self.backend == "orbax":
+            return None
+        self.close()
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        state_path = os.path.join(self._step_dir(step), "state.json")
+        if not os.path.exists(state_path):
+            raise FileNotFoundError(f"no checkpoint for step {step} in {self.directory}")
+        with open(state_path) as f:
+            doc = json.load(f)
+        ws = doc.get("world_size")
+        return int(ws) if ws is not None else None
 
     # -- metadata -------------------------------------------------------
     def _write_metadata(self, step: int, meta: Dict) -> None:

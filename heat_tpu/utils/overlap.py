@@ -136,10 +136,13 @@ def snapshot_state(state: Any) -> Any:
     orders of magnitude cheaper than the encode+CRC+fsync write).
     Scalars/strings pass through."""
     from ..core.dndarray import DNDarray  # lazy: avoid import cycle
+    from .checkpoint import DNDSnapshot
 
     def one(x):
         if isinstance(x, DNDarray):
-            return x._dense()
+            # carry the distribution intent (split, writer world) so the
+            # cross-world restore codec can re-split the leaf later
+            return DNDSnapshot(x._dense(), x.split, x.comm.size)
         if isinstance(x, np.ndarray):
             return np.array(x, copy=True)
         return x
@@ -262,14 +265,20 @@ class AsyncCheckpointer:
                 pass
 
     # -- read side (sees in-flight writes through) ----------------------
-    def restore(self, step=None, template=None):
+    def restore(self, step=None, template=None, comm=None):
+        """Drain in-flight writes, then restore — cross-world ``comm``
+        re-splitting included (see ``Checkpointer.restore``)."""
         self.wait()
         with _span("checkpoint.restore", step=step if step is not None else -1):
-            return self.checkpointer.restore(step, template)
+            return self.checkpointer.restore(step, template, comm)
 
     def latest_step(self):
         self.wait()
         return self.checkpointer.latest_step()
+
+    def world_size(self, step=None):
+        self.wait()
+        return self.checkpointer.world_size(step)
 
     def all_steps(self) -> List[int]:
         self.wait()

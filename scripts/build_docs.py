@@ -45,6 +45,7 @@ NAV = [
     ("Internals", [
         ("Dispatch layer", "docs/dispatch.md"),
         ("Resilience", "docs/resilience.md"),
+        ("Elasticity", "docs/elasticity.md"),
         ("Overlap layer", "docs/overlap.md"),
         ("Observability", "docs/observability.md"),
         ("Static analysis", "docs/static_analysis.md"),

@@ -43,6 +43,22 @@ def gate(committed: dict, current: dict, margin_pct: float) -> int:
                 for item in cur.get("items", [])[:20]:
                     failures.append(f"{name}:   {item}")
             continue
+        # hard-cap latency metrics (``max_seconds``): absolute wall-time
+        # bound, e.g. the elastic worker-loss recovery (loss detection
+        # -> resumed worker's first heartbeat) must stay under its cap
+        if "max_seconds" in rec:
+            cur = current.get(name)
+            if cur is None or "seconds" not in cur:
+                failures.append(f"{name}: missing from current run")
+                continue
+            cap = float(rec["max_seconds"])
+            got = float(cur["seconds"])
+            failed = got > cap
+            status = "FAIL" if failed else "ok"
+            print(f"{name}: current {got:.2f}s cap {cap:.2f}s [{status}]")
+            if failed:
+                failures.append(f"{name}: {got:.2f}s > cap {cap:.2f}s")
+            continue
         # hard-cap metrics (``max_overhead_pct``): absolute bound, no
         # anchor or slack — e.g. telemetry tracing overhead must stay
         # under its cap regardless of runner speed

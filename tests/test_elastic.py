@@ -1,0 +1,489 @@
+"""Elastic multi-host execution: mesh reshape, cross-world checkpoint
+restore, and the detect -> reshape -> resume supervision loop.
+
+The acceptance property (ISSUE 8): a fit killed at world size P resumes
+and converges at world size Q < P with the result matching the
+uninterrupted fit within floating-point tolerance, and a same-size
+resume (Q = P) stays bitwise identical.  Worker loss is simulated two
+ways — an in-process typed exception (ElasticSupervisor) and a real
+``os._exit``-killed subprocess (ProcessSupervisor), mirroring the PR 2
+kill-and-resume harness.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.elastic import (
+    ElasticSupervisor,
+    HeartbeatMonitor,
+    ProcessSupervisor,
+    ReshapeError,
+    WorkerLostError,
+    elastic_state,
+    kmeans_worker_source,
+)
+from heat_tpu.parallel.comm import Communication, HierarchicalCommunication
+from heat_tpu.telemetry import metrics as tm
+from heat_tpu.utils.checkpoint import Checkpointer
+
+
+def _world():
+    return ht.get_comm()
+
+
+def _data(n=240, f=6, seed=13):
+    ht.random.seed(seed)
+    return np.asarray(ht.random.randn(n, f, split=0).astype(ht.float32).numpy())
+
+
+KW = dict(n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3)
+
+
+# ----------------------------------------------------------------------
+# comm.reshape
+# ----------------------------------------------------------------------
+class TestReshape:
+    def test_shrink_rebuilds_canonical_metadata(self):
+        w = _world()
+        c5 = w.reshape(5)
+        assert c5.size == 5 and isinstance(c5, Communication)
+        assert w.retired and not c5.retired
+        # lshape_map/chunk/sharding recompute for the new world
+        lm = c5.lshape_map((13,), 0)[:, 0]
+        assert lm.sum() == 13 and lm.max() == 3  # ceil(13/5)=3 with padding
+        offs = [c5.chunk((13,), 0, rank=r)[0] for r in range(5)]
+        assert offs == sorted(offs)
+        counts, displs, _ = c5.counts_displs_shape((13,), 0)
+        assert sum(counts) == 13
+        assert list(displs) == list(np.cumsum((0,) + counts[:-1]))
+        sh = c5.sharding(0)
+        assert sh.mesh.devices.size == 5
+
+    def test_same_size_and_grow_within_inventory(self):
+        w = _world()
+        n = w.size
+        same = w.reshape(n)
+        assert same.size == n
+        small = same.reshape(3)
+        regrown = small.reshape(n)  # capacity came back
+        assert regrown.size == n
+
+    def test_invalid_targets_raise_typed(self):
+        w = _world()
+        with pytest.raises(ReshapeError):
+            w.reshape(0)
+        with pytest.raises(ReshapeError):
+            w.reshape(w.size + 1000)
+        with pytest.raises(ReshapeError):
+            w.reshape()  # neither n_devices nor devices
+        with pytest.raises(ReshapeError):
+            w.reshape(devices=[])
+
+    def test_explicit_device_list(self):
+        import jax
+
+        w = _world()
+        devs = jax.devices()[:3]
+        c = w.reshape(devices=devs)
+        assert c.size == 3 and c.devices == list(devs)
+
+    def test_hierarchical_reshape_reinfers_grid(self):
+        hc = HierarchicalCommunication(grid=(2, 4))
+        assert (hc.num_nodes, hc.node_size) == (2, 4)
+        h6 = hc.reshape(6)
+        assert isinstance(h6, HierarchicalCommunication)
+        assert h6.size == 6
+        # single host: survivors re-infer to one node
+        assert (h6.num_nodes, h6.node_size) == (1, 6)
+        assert hc.retired
+
+    def test_reshape_error_is_never_retried(self):
+        pol = rz.RetryPolicy(max_attempts=5, no_sleep=True, retryable=(Exception,))
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ReshapeError("no")
+
+        with pytest.raises(ReshapeError):
+            pol.call(bad)
+        assert calls["n"] == 1
+
+
+# ----------------------------------------------------------------------
+# finalize() + re-init() cycles (the elastic restart path)
+# ----------------------------------------------------------------------
+class TestFinalizeInitCycles:
+    def test_repeated_cycles_keep_world_usable(self):
+        from heat_tpu.parallel import comm as C
+
+        e0 = C.comm_epoch()
+        for _ in range(2):
+            ht.parallel.finalize()
+            ht.parallel.init()
+        assert C.comm_epoch() > e0
+        w = ht.get_comm()
+        assert w.size >= 1
+        a = ht.arange(13, split=0)
+        assert float(a.sum()) == 78.0
+
+    def test_finalize_drops_mesh_keyed_dispatch_cache(self):
+        from heat_tpu.core import dispatch
+
+        a = ht.arange(16, split=0).astype(ht.float32)
+        _ = float((a * 2.0 + 1.0).sum())
+        ht.parallel.finalize()
+        assert dispatch.cache_stats()["cache_size"] == 0
+        ht.parallel.init()
+        b = ht.arange(16, split=0).astype(ht.float32)
+        assert float((b * 2.0 + 1.0).sum()) == float((np.arange(16) * 2.0 + 1.0).sum())
+
+
+# ----------------------------------------------------------------------
+# DNDarray.reshard_
+# ----------------------------------------------------------------------
+class TestReshard:
+    @pytest.mark.parametrize("split", [0, 1, None])
+    @pytest.mark.parametrize("shape", [(13, 4), (16, 3), (7, 5)])
+    def test_values_preserved_across_worlds(self, split, shape):
+        w = _world()
+        vals = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+        x = ht.array(vals, split=split)
+        for target in (5, 3, w.size):
+            c = w.reshape(target)
+            x.reshard_(c)
+            assert x.comm.size == target
+            assert x.split == split
+            assert np.array_equal(x.numpy(), vals)
+            if split is not None:
+                pad = c.pad_amount(shape[split])
+                assert x.larray_padded.shape[split] == shape[split] + pad
+
+    def test_reshard_noop_on_same_comm(self):
+        x = ht.arange(8, split=0)
+        buf = x.larray_padded
+        x.reshard_(x.comm)
+        assert x.larray_padded is buf
+
+    def test_reshard_then_ops_match_numpy(self):
+        w = _world()
+        vals = np.arange(26, dtype=np.float64).reshape(13, 2)
+        x = ht.array(vals, split=0)
+        x.reshard_(w.reshape(3))
+        assert float(x.sum()) == vals.sum()
+        assert float(x.max()) == vals.max()
+        y = (x * 2.0 + 1.0).numpy()
+        assert np.allclose(y, vals * 2.0 + 1.0)
+
+
+# ----------------------------------------------------------------------
+# cross-world checkpoint restore
+# ----------------------------------------------------------------------
+class TestCrossWorldRestore:
+    def test_world_size_recorded_and_crossworld_counted(self, tmp_path):
+        w = _world()
+        x = ht.array(np.arange(26, dtype=np.float32).reshape(13, 2), split=0)
+        ck = Checkpointer(str(tmp_path))
+        ck.save(1, {"x": x, "n_iter": 4})
+        assert ck.world_size(1) == w.size
+        before = tm.counter("checkpoint.crossworld_restores").value
+        st = ck.restore(1, comm=w.reshape(5))
+        assert tm.counter("checkpoint.crossworld_restores").value == before + 1
+        assert st["x"].comm.size == 5 and st["x"].split == 0
+        assert np.array_equal(st["x"].numpy(), np.arange(26, dtype=np.float32).reshape(13, 2))
+
+    def test_restore_without_comm_keeps_host_arrays(self, tmp_path):
+        x = ht.array(np.arange(10, dtype=np.float32), split=0)
+        ck = Checkpointer(str(tmp_path))
+        ck.save(0, {"x": x})
+        st = ck.restore(0)
+        assert isinstance(st["x"], np.ndarray)
+        assert np.array_equal(st["x"], np.arange(10, dtype=np.float32))
+
+    def test_split_none_leaf_restores_replicated(self, tmp_path):
+        x = ht.array(np.ones((4, 4), np.float32))  # split=None
+        ck = Checkpointer(str(tmp_path))
+        ck.save(0, {"x": x})
+        st = ck.restore(0, comm=_world().reshape(3))
+        assert st["x"].split is None and st["x"].comm.size == 3
+
+    def test_template_validation_raises_typed(self, tmp_path):
+        ck = Checkpointer(str(tmp_path))
+        ck.save(0, {"c": np.ones((4, 2), np.float32), "n": 1})
+        ck.restore(0, template={"c": np.zeros((4, 2), np.float32), "n": 0})
+        with pytest.raises(ReshapeError):  # shape drift
+            ck.restore(0, template={"c": np.zeros((5, 2), np.float32), "n": 0})
+        with pytest.raises(ReshapeError):  # dtype drift
+            ck.restore(0, template={"c": np.zeros((4, 2), np.float64), "n": 0})
+        with pytest.raises(ReshapeError):  # structure drift
+            ck.restore(0, template={"other": np.zeros((4, 2), np.float32)})
+
+    def test_async_checkpointer_crossworld_passthrough(self, tmp_path):
+        w = _world()
+        x = ht.array(np.arange(12, dtype=np.float32), split=0)
+        ack = Checkpointer(str(tmp_path)).as_async()
+        ack.save(2, {"x": x})
+        st = ack.restore(comm=w.reshape(3))
+        assert st["x"].comm.size == 3
+        assert ack.world_size(2) == w.size
+        ack.close()
+
+    def test_orbax_comm_rejected_without_orbax_import(self, tmp_path):
+        ck = Checkpointer(str(tmp_path))
+        ck.save(0, {"a": np.ones(3)})
+        ck.backend = "orbax"  # simulate: the check precedes any orbax use
+        with pytest.raises(ValueError):
+            ck.restore(0, comm=_world())
+        ck.backend = "native"
+
+
+# ----------------------------------------------------------------------
+# heartbeat monitor
+# ----------------------------------------------------------------------
+class TestHeartbeatMonitor:
+    def test_gauge_staleness(self):
+        clock = {"t": 1000.0}
+        mon = HeartbeatMonitor(timeout_s=5.0, clock=lambda: clock["t"])
+        prev = tm.gauge("fit.heartbeat_ts").value
+        try:
+            tm.gauge("fit.heartbeat_ts").set(1000.0)
+            clock["t"] = 1003.0
+            mon.check()  # fresh
+            clock["t"] = 1006.5
+            with pytest.raises(WorkerLostError) as ei:
+                mon.check()
+            assert ei.value.heartbeat_age == pytest.approx(6.5)
+        finally:
+            tm.gauge("fit.heartbeat_ts").set(prev)
+
+    def test_never_beaten_counts_from_arming(self):
+        clock = {"t": 50.0}
+        mon = HeartbeatMonitor(
+            timeout_s=2.0, heartbeat_file="/nonexistent/hb", clock=lambda: clock["t"]
+        )
+        mon.check()
+        clock["t"] = 53.0
+        with pytest.raises(WorkerLostError):
+            mon.check()
+
+    def test_file_mtime_source(self, tmp_path):
+        hb = tmp_path / "hb"
+        hb.touch()
+        mon = HeartbeatMonitor(timeout_s=3600.0, heartbeat_file=str(hb))
+        mon.check()
+        assert mon.age() < 60.0
+
+    def test_detect_site_scriptable(self):
+        mon = HeartbeatMonitor(timeout_s=0.0)
+        with rz.fault_plan({"elastic.detect": [{"at": 0, "kind": "transient"}]}) as inj:
+            with pytest.raises(rz.TransientFault):
+                mon.check()
+        assert inj.hits["elastic.detect"] == 1
+
+
+# ----------------------------------------------------------------------
+# in-process elastic supervisor
+# ----------------------------------------------------------------------
+class TestElasticSupervisor:
+    def _fit_fn(self, x_np, d):
+        def fit_fn(comm, resume_from):
+            x = ht.array(x_np, split=0, comm=comm)
+            km = ht.cluster.KMeans(
+                **KW, checkpoint_every=2, checkpoint_dir=d, resume_from=resume_from
+            )
+            km.fit(x)
+            return km
+
+        return fit_fn
+
+    def test_lose_one_worker_resume_smaller_matches(self, tmp_path):
+        x_np = _data()
+        plain = ht.cluster.KMeans(**KW).fit(ht.array(x_np, split=0))
+        d = str(tmp_path / "ck")
+        sup = ElasticSupervisor(
+            self._fit_fn(x_np, d), d,
+            loss_types=(WorkerLostError, rz.TransientFault),
+        )
+        losses0 = tm.counter("elastic.worker_losses").value
+        with rz.fault_plan({"kmeans.iter": [{"at": 1, "kind": "transient"}]}):
+            km = sup.run()
+        assert sup.recoveries == 1
+        assert sup.world.size == _world().size - 1
+        assert tm.counter("elastic.worker_losses").value == losses0 + 1
+        assert elastic_state()["world_size"] == sup.world.size
+        assert km.n_iter_ == plain.n_iter_
+        assert np.allclose(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(km.cluster_centers_._dense()),
+            atol=1e-4,
+        )
+
+    def test_same_size_resume_is_bitwise(self, tmp_path):
+        x_np = _data()
+        plain = ht.cluster.KMeans(**KW).fit(ht.array(x_np, split=0))
+        d = str(tmp_path / "ck")
+        sup = ElasticSupervisor(
+            self._fit_fn(x_np, d), d, shrink_by=0,
+            loss_types=(WorkerLostError, rz.TransientFault),
+        )
+        with rz.fault_plan({"kmeans.iter": [{"at": 1, "kind": "transient"}]}):
+            km = sup.run()
+        assert sup.recoveries == 1 and sup.world.size == _world().size
+        assert np.array_equal(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(km.cluster_centers_._dense()),
+        )
+        assert km.n_iter_ == plain.n_iter_
+
+    def test_recovery_budget_exhaustion_reraises(self, tmp_path):
+        d = str(tmp_path / "ck")
+
+        def always_lost(comm, resume_from):
+            raise WorkerLostError("gone", lost=1)
+
+        sup = ElasticSupervisor(always_lost, d, max_recoveries=2)
+        with pytest.raises(WorkerLostError):
+            sup.run()
+        assert sup.recoveries == 3  # 2 recoveries + the budget-blowing 3rd
+
+    def test_min_world_floor(self, tmp_path):
+        d = str(tmp_path / "ck")
+
+        def always_lost(comm, resume_from):
+            raise WorkerLostError("gone", lost=comm.size - 1)
+
+        sup = ElasticSupervisor(always_lost, d, min_world=4, max_recoveries=5)
+        with pytest.raises(ReshapeError):
+            sup.run()
+
+    def test_on_world_change_reshards_live_arrays(self, tmp_path):
+        x_np = _data(64, 3)
+        x = ht.array(x_np, split=0)
+        d = str(tmp_path / "ck")
+        seen = []
+
+        def fit_fn(comm, resume_from):
+            if not seen:
+                raise WorkerLostError("first pass dies", lost=2)
+            assert x.comm.size == comm.size  # resharded before resume
+            return float(x.sum())
+
+        sup = ElasticSupervisor(
+            fit_fn, d,
+            on_world_change=lambda c: (seen.append(c), x.reshard_(c)),
+        )
+        total = sup.run()
+        assert len(seen) == 1 and seen[0].size == _world().size - 2
+        assert total == pytest.approx(float(x_np.sum()), rel=1e-6)
+
+    def test_recovery_sites_scriptable(self, tmp_path):
+        """A transient fault at elastic.reshape is absorbed by the retry
+        policy; the recovery still completes."""
+        x_np = _data(64, 3)
+        d = str(tmp_path / "ck")
+        calls = {"n": 0}
+
+        def fit_fn(comm, resume_from):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise WorkerLostError("die once")
+            return comm.size
+
+        pol = rz.RetryPolicy(max_attempts=3, no_sleep=True)
+        sup = ElasticSupervisor(fit_fn, d, retry_policy=pol)
+        with rz.fault_plan(
+            {"elastic.reshape": [{"at": 0, "kind": "transient"}]}
+        ) as inj:
+            size = sup.run()
+        assert size == _world().size - 1
+        assert inj.hits["elastic.reshape"] == 2  # failed once, retried
+
+
+# ----------------------------------------------------------------------
+# subprocess supervision: real os._exit preemption (the acceptance test)
+# ----------------------------------------------------------------------
+@pytest.mark.multiprocess
+class TestProcessSupervisor:
+    def _run(self, tmp_path, name, world, shrink_by, max_recoveries=2):
+        d = str(tmp_path / name)
+        kill_plan = json.dumps(
+            {"plan": {"kmeans.iter": [{"at": 1, "kind": "kill", "exit_code": 137}]}}
+        )
+
+        def build(ws, resume, attempt):
+            src = kmeans_worker_source(d, resume_from=resume, x64=True)
+            extra = {"HEAT_TPU_FAULT_PLAN": kill_plan if attempt == 0 else ""}
+            return [sys.executable, "-c", src], extra
+
+        sup = ProcessSupervisor(
+            build, d, world_size=world, shrink_by=shrink_by,
+            max_recoveries=max_recoveries, poll_s=0.2, attempt_timeout_s=280,
+        )
+        return d, sup.run()
+
+    def test_kill_at_p_resume_at_q_converges(self, tmp_path):
+        """Worker killed at P=4 mid-fit; the supervisor reshapes to Q=3
+        and the resumed fit converges to the uninterrupted result within
+        float32 reduction-order tolerance."""
+        x_np = _data()
+        plain = ht.cluster.KMeans(**KW).fit(ht.array(x_np, split=0))
+        d, out = self._run(tmp_path, "pq", world=4, shrink_by=1)
+        assert out["recoveries"] == 1 and out["world_size"] == 3
+        assert out["attempts"][0]["returncode"] == 137
+        assert out["attempts"][1]["returncode"] == 0
+        assert len(out["recovery_s"]) == 1 and out["recovery_s"][0] < 280
+        st = Checkpointer(d).restore()
+        assert st["converged"]
+        assert st["n_iter"] == plain.n_iter_
+        assert np.allclose(
+            st["state"], np.asarray(plain.cluster_centers_._dense()), atol=1e-4
+        )
+
+    def test_same_size_resume_bitwise(self, tmp_path):
+        """Q = P: the resumed fit must reproduce the uninterrupted fit
+        at the same world size BITWISE (the PR 2/3 resume property,
+        now through the elastic supervisor)."""
+        d, out = self._run(tmp_path, "same", world=4, shrink_by=0)
+        assert out["recoveries"] == 1 and out["world_size"] == 4
+        # uninterrupted reference at the same world size
+        ref_dir = str(tmp_path / "ref")
+
+        def build_ref(ws, resume, attempt):
+            return (
+                [sys.executable, "-c", kmeans_worker_source(ref_dir, x64=True)],
+                {"HEAT_TPU_FAULT_PLAN": ""},
+            )
+
+        ref = ProcessSupervisor(
+            build_ref, ref_dir, world_size=4, poll_s=0.2, attempt_timeout_s=280
+        ).run()
+        assert ref["recoveries"] == 0
+        a = Checkpointer(d).restore()
+        b = Checkpointer(ref_dir).restore()
+        assert a["n_iter"] == b["n_iter"]
+        assert np.array_equal(a["state"], b["state"])
+
+    def test_recovery_budget_exhaustion(self, tmp_path):
+        d = str(tmp_path / "budget")
+        always_kill = json.dumps(
+            {"plan": {"kmeans.iter": [{"at": 0, "kind": "kill", "exit_code": 137}]}}
+        )
+
+        def build(ws, resume, attempt):
+            src = kmeans_worker_source(d, resume_from=resume, x64=True)
+            return [sys.executable, "-c", src], {"HEAT_TPU_FAULT_PLAN": always_kill}
+
+        sup = ProcessSupervisor(
+            build, d, world_size=3, shrink_by=0, max_recoveries=1,
+            poll_s=0.2, attempt_timeout_s=280,
+        )
+        with pytest.raises(WorkerLostError):
+            sup.run()

@@ -162,10 +162,7 @@ def test_collective_wrappers(ht):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from heat_tpu.core._compat import shard_map
 
     comm = ht.get_comm()
     n = comm.size
