@@ -928,6 +928,132 @@ def bench_overlap(ht, sync_floor, roofline=None):
     }
 
 
+def bench_serving(ht, sync_floor, roofline=None):
+    """Config 11: sustained-load serving (ISSUE 9).
+
+    A fitted KMeans is saved, hot-loaded into an
+    :class:`~heat_tpu.serving.InferenceService`, and hammered by client
+    threads issuing requests of varied sizes while one over-quota tenant
+    sheds against its token bucket.  Reported: admitted request rate and
+    its p50/p99 latency, the coalesced batch-size distribution, the
+    shed rate, and — the cache acceptance property — new executable
+    compiles during steady state (must be 0: pad-to-bucket keeps the
+    key set finite).  ``vs_baseline`` divides the served rate by the
+    same request stream predicted *directly* (per-request shapes, no
+    coalescing) — the naive serving loop the coalescer replaces."""
+    import shutil
+    import tempfile
+    import threading
+
+    from heat_tpu import serving as srv
+    from heat_tpu.core import dispatch
+    from heat_tpu.resilience import OverloadedError
+    from heat_tpu.serving import model_io
+
+    rng = np.random.default_rng(0)
+    pts = rng.standard_normal((1 << 12, 16)).astype(np.float32)
+    x = ht.array(pts, split=0)
+    km = ht.cluster.KMeans(n_clusters=8, init="random", max_iter=5, random_state=0).fit(x)
+
+    sizes = [1, 3, 7, 12, 18, 27, 33, 50, 64]
+    n_requests = 400
+    d = tempfile.mkdtemp(prefix="heat_tpu_bench_srv_")
+    try:
+        srv.save_model(km, d, version=1, name="km")
+        svc = srv.InferenceService(max_delay_ms=1.0, max_batch=64)
+        svc.load("km", d)
+        for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+            svc.predict("km", pts[:b])
+
+        # baseline: the same request stream, predicted directly one
+        # request at a time (per-request shapes -> per-shape compiles)
+        t0 = time.perf_counter()
+        for i in range(n_requests // 4):
+            n = sizes[i % len(sizes)]
+            model_io.infer(km, ht.array(pts[i % 64 : i % 64 + n], split=None)).numpy()
+        direct_rate = (n_requests // 4) / (time.perf_counter() - t0)
+
+        # sustained load: 4 client threads, varied sizes; one noisy
+        # tenant hammers an over-quota bucket concurrently
+        svc.set_quota("noisy", rate=2.0, burst=4.0)
+        stop = threading.Event()
+        noisy_counts = {"ok": 0, "shed": 0}
+
+        def noisy():
+            while not stop.is_set():
+                try:
+                    svc.predict("km", pts[:2], tenant="noisy", timeout=30)
+                    noisy_counts["ok"] += 1
+                except OverloadedError:
+                    noisy_counts["shed"] += 1
+                time.sleep(0.002)
+
+        nt = threading.Thread(target=noisy, name="bench-noisy-tenant", daemon=True)
+        s0 = dispatch.cache_stats()
+        lat_lock = threading.Lock()
+        latencies = []
+
+        def client(worker):
+            for i in range(n_requests // 4):
+                n = sizes[(worker + i) % len(sizes)]
+                off = (worker * 61 + i * 7) % 64
+                t1 = time.perf_counter()
+                svc.predict("km", pts[off : off + n], timeout=30)
+                dt = time.perf_counter() - t1
+                with lat_lock:
+                    latencies.append(dt)
+
+        nt.start()
+        t0 = time.perf_counter()
+        clients = [
+            threading.Thread(target=client, args=(w,), name=f"bench-client-{w}", daemon=True)
+            for w in range(4)
+        ]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        nt.join()
+        s1 = dispatch.cache_stats()
+        svc.close()
+
+        lat = np.sort(np.asarray(latencies))
+        batch_rows = ht.telemetry.metrics.histogram("serving.batch_rows")
+        shed_total = noisy_counts["shed"]
+        served_rate = len(latencies) / wall
+        new_compiles = s1["misses"] - s0["misses"]
+        steady_lookups = (s1["hits"] - s0["hits"]) + new_compiles
+        return {
+            "metric": "serving_req_per_s",
+            "value": round(served_rate, 1),
+            "unit": "req/s",
+            "vs_baseline": round(served_rate / direct_rate, 2) if direct_rate else 0.0,
+            "vs_baseline_kind": "uncoalesced_direct_predict_same_process",
+            "p50_ms": round(float(lat[len(lat) // 2]) * 1e3, 3),
+            "p99_ms": round(float(lat[int(len(lat) * 0.99)]) * 1e3, 3),
+            "requests": len(latencies),
+            "steady_state_new_compiles": new_compiles,
+            "steady_state_hit_rate": round(
+                (s1["hits"] - s0["hits"]) / steady_lookups, 4
+            ) if steady_lookups else 1.0,
+            "coalesced_batch_rows": {
+                "count": batch_rows.count,
+                "p50": batch_rows.quantile(0.5),
+                "p99": batch_rows.quantile(0.99),
+                "max": batch_rows.max,
+            },
+            "noisy_tenant_shed": shed_total,
+            "noisy_tenant_admitted": noisy_counts["ok"],
+            "shed_rate": round(
+                shed_total / (shed_total + noisy_counts["ok"]), 3
+            ) if (shed_total + noisy_counts["ok"]) else 0.0,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_telemetry(ht, sync_floor, roofline=None):
     """Config 9: telemetry-layer self-cost (ISSUE 4 + ISSUE 6).
 
@@ -1131,7 +1257,7 @@ def main() -> None:
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
                   bench_dispatch, bench_resilience, bench_overlap, bench_telemetry,
-                  bench_analysis):
+                  bench_analysis, bench_serving):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

@@ -32,6 +32,7 @@ from . import nn
 from . import optim
 from . import resilience
 from . import elastic
+from . import serving
 from . import sparse
 from . import telemetry
 from . import utils

@@ -64,8 +64,8 @@ LOCK_REGISTRY = {
     "telemetry.server": {
         "file": "heat_tpu/telemetry/server.py",
         "spellings": ("_LOCK",),
-        "structures": ("telemetry.server.singleton",),
-        "doc": "the process's single IntrospectionServer handle: start_server/stop_server swap it; handler threads never take this lock",
+        "structures": ("telemetry.server.singleton", "telemetry.server.routes"),
+        "doc": "the process's single IntrospectionServer handle (start_server/stop_server swap it) and the registered extra-route map (register_route/unregister_route mutate, handler threads take it briefly for the prefix lookup and call the handler outside it)",
     },
     "telemetry.flight_recorder.hooks": {
         "file": "heat_tpu/telemetry/flight_recorder.py",
@@ -114,6 +114,30 @@ LOCK_REGISTRY = {
         "spellings": ("self._lifecycle",),
         "structures": ("data.partial_loader.state",),
         "doc": "PartialH5DataLoaderIter worker-thread handle: close() is reachable from the consumer, __del__ (any thread via GC) and error paths concurrently",
+    },
+    "serving.registry": {
+        "file": "heat_tpu/serving/registry.py",
+        "spellings": ("self._lock",),
+        "structures": ("serving.registry.models",),
+        "doc": "ModelRegistry name->versions table + active pointers + loader error slot: mutated by (possibly background) loads and promote/rollback, read per batch by the coalescer thread and per request by HTTP handler threads",
+    },
+    "serving.coalescer": {
+        "file": "heat_tpu/serving/coalescer.py",
+        "spellings": ("self._cond", "self._lock"),
+        "structures": ("serving.coalescer.queue",),
+        "doc": "ModelBatcher request queue + open flag: request threads append under the Condition, the batcher thread drains per tick; the inference dispatch itself always runs outside the lock",
+    },
+    "serving.admission": {
+        "file": "heat_tpu/serving/admission.py",
+        "spellings": ("self._lock",),
+        "structures": ("serving.admission.buckets",),
+        "doc": "AdmissionController per-tenant token buckets + in-flight row count: admit/release fire on every request thread",
+    },
+    "serving.service": {
+        "file": "heat_tpu/serving/service.py",
+        "spellings": ("self._lock", "_SERVICE_LOCK"),
+        "structures": ("serving.service.state",),
+        "doc": "InferenceService per-model batcher map + the module's default-service singleton: batchers are created lazily on first request (any handler thread), closed by close()",
     },
 }
 

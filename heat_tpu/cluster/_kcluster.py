@@ -127,7 +127,14 @@ class _KCluster(BaseEstimator, ClusteringMixin):
                 raise ValueError(f"passed centroids need to be of shape ({k}, {f}), but are {self.init.shape}")
             centers = self.init._dense().astype(dense.dtype)
         elif self.init == "random":
-            idx = ht_random.randint(0, n, size=(k,), comm=x.comm)._dense()
+            # k DISTINCT data points (argsort of one uniform draw = a
+            # random sample without replacement).  Sampling indices WITH
+            # replacement could seed two centers on the same point — a
+            # state the median/medoid update can never leave (their
+            # clusters tie forever), and which cost the KMedians/
+            # KMedoids blob fits a whole blob at unlucky seeds
+            u = ht_random.rand(n, comm=x.comm)._dense()
+            idx = jnp.argsort(u)[:k]
             centers = dense[idx]
         elif self.init in ("kmeans++", "probability_based", "++"):
             # kmeans++ sampling (_kcluster.py:112-180): greedy D^2 weighting.

@@ -83,6 +83,12 @@ KNOBS = {
     "HEAT_TPU_ELASTIC_HEARTBEAT_TIMEOUT_S": ("float", "0", "declare a worker lost when its fit heartbeat is older than this many seconds (0 = liveness detection off, exit-code detection only)"),
     "HEAT_TPU_ELASTIC_POLL_S": ("float", "0.5", "polling interval of the elastic supervisor's heartbeat monitor"),
     "HEAT_TPU_HEARTBEAT_FILE": ("path", "", "touch this file at every resumable-fit chunk boundary (the cross-process liveness signal the elastic process supervisor watches)"),
+    # -- serving (heat_tpu/serving, docs/serving.md) --------------------
+    "HEAT_TPU_SERVE_MAX_BATCH": ("int", "64", "largest coalesced inference batch (rows) and the top pad-to-bucket shape; also the largest single request"),
+    "HEAT_TPU_SERVE_MAX_DELAY_MS": ("float", "2.0", "longest a queued predict request waits for batch-mates before its coalesced dispatch (the latency/throughput dial)"),
+    "HEAT_TPU_SERVE_QUEUE_DEPTH": ("int", "256", "admission bound: rows queued-or-in-flight across the service before requests shed with OverloadedError/429"),
+    "HEAT_TPU_SERVE_RATE": ("float", "0", "default per-tenant token-bucket refill (rows/s); 0 = unlimited (tenants without an explicit set_quota are not rate-limited)"),
+    "HEAT_TPU_SERVE_BURST": ("float", "64", "default per-tenant token-bucket burst capacity (rows)"),
     # -- overlap / nn (docs/overlap.md) ---------------------------------
     "HEAT_TPU_ASYNC_CKPT": ("bool", "1", "asynchronous checkpoint writes in resumable fits (0 = fully synchronous saves)"),
     "HEAT_TPU_GRAD_BUCKET_MB": ("float", "4", "byte bound (MiB) of one bucketed gradient-reduction psum"),

@@ -81,6 +81,7 @@ from . import _env as _env
 
 __all__ = [
     "PendingExpr",
+    "batch_bucket",
     "cache_enabled",
     "cache_keys",
     "cache_stats",
@@ -209,6 +210,30 @@ def record_external_dispatch(n: int = 1) -> None:
     """Count ``n`` executable launches made outside this layer (consumers
     with their own jitted programs: kmeans/lasso loops, ``fusion.jit``)."""
     _C["external_dispatches"].inc(n)
+
+
+def batch_bucket(n: int, cap: Optional[int] = None) -> int:
+    """Quantized leading extent for variable-size batch dispatch.
+
+    Online traffic produces arbitrary batch sizes; dispatching each one
+    verbatim would mint one executable-cache key (and one XLA compile)
+    per distinct size.  Padding every batch up to the next power of two
+    — capped at ``cap``, which is itself a valid bucket — bounds the key
+    set to ``log2(cap)+1`` shapes: after one warmup pass per bucket, any
+    traffic mix runs entirely on cache hits.  The serving layer's
+    request coalescer (``heat_tpu/serving/coalescer.py``) pads with real
+    rows to the returned extent, so the bucket is the true shape every
+    cached program sees."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    b = 1 << (n - 1).bit_length()
+    if cap is not None:
+        cap = int(cap)
+        if n > cap:
+            raise ValueError(f"batch size {n} exceeds the bucket cap {cap}")
+        b = min(b, cap)
+    return b
 
 
 # ----------------------------------------------------------------------

@@ -50,10 +50,23 @@ __all__ = [
 
 def _dense_reduce(fn, x: DNDarray, axis, keepdims: bool = False, force_int64=False) -> DNDarray:
     """Apply a jnp reduction on the dense view and re-wrap with the
-    reduced split (helper for ops whose masking would be fiddly)."""
+    reduced split (helper for ops whose masking would be fiddly).
+
+    A module-level ``fn`` marked ``_dispatch_cacheable`` routes through
+    the executable cache (stable op identity -> stable cache key); the
+    per-call lambdas other reductions pass stay eager — caching those
+    would mint a fresh key (and a fresh XLA compile) per call."""
     axis_s = sanitize_axis(x.shape, axis)
     axes = tuple(range(x.ndim)) if axis_s is None else (axis_s if isinstance(axis_s, tuple) else (axis_s,))
-    result = fn(x._dense(), axis_s, keepdims)
+    if getattr(fn, "_dispatch_cacheable", False):
+        from . import dispatch
+
+        kd_axis = tuple(axis_s) if isinstance(axis_s, list) else axis_s
+        result = dispatch.eager_apply(
+            fn, (x._dense(),), {"axis": kd_axis, "keepdims": bool(keepdims)}
+        )
+    else:
+        result = fn(x._dense(), axis_s, keepdims)
     if x.split is None:
         out_split = None
     elif x.split in axes:
@@ -65,24 +78,36 @@ def _dense_reduce(fn, x: DNDarray, axis, keepdims: bool = False, force_int64=Fal
     return DNDarray.from_dense(result, out_split, x.device, x.comm)
 
 
+def _argmax_fn(a, axis=None, keepdims=False):
+    return jnp.argmax(a, axis=axis, keepdims=keepdims).astype(
+        types.canonical_dtype(jnp.int64)
+    )
+
+
+def _argmin_fn(a, axis=None, keepdims=False):
+    return jnp.argmin(a, axis=axis, keepdims=keepdims).astype(
+        types.canonical_dtype(jnp.int64)
+    )
+
+
+# stable module-level identity -> one executable-cache entry per shape;
+# argmin/argmax sit on the KMeans-family predict hot path the serving
+# layer batches, where an eager launch per request is the difference
+# between a cache hit and a fresh dispatch
+_argmax_fn._dispatch_cacheable = True
+_argmin_fn._dispatch_cacheable = True
+
+
 def argmax(x, axis=None, out=None, keepdims=False, **kwargs):
     """Index of the maximum (statistics.py:33; distributed via custom
     MPI_ARGMAX in the reference, a plain global argmax here)."""
-    res = _dense_reduce(
-        lambda a, ax, kd: jnp.argmax(a, axis=ax, keepdims=kd).astype(
-            types.canonical_dtype(jnp.int64)
-        ), x, axis, keepdims
-    )
+    res = _dense_reduce(_argmax_fn, x, axis, keepdims)
     return _to_out(res, out)
 
 
 def argmin(x, axis=None, out=None, keepdims=False, **kwargs):
     """Index of the minimum (statistics.py:119)."""
-    res = _dense_reduce(
-        lambda a, ax, kd: jnp.argmin(a, axis=ax, keepdims=kd).astype(
-            types.canonical_dtype(jnp.int64)
-        ), x, axis, keepdims
-    )
+    res = _dense_reduce(_argmin_fn, x, axis, keepdims)
     return _to_out(res, out)
 
 

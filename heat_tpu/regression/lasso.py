@@ -18,6 +18,13 @@ from ..core.base import BaseEstimator, RegressionMixin, lazy_scalar_property
 from ..core.dndarray import DNDarray
 
 
+def _linear_predict_op(xd, th):
+    """Intercept + coefficients in one cached program (the predict hot
+    path the serving layer batches)."""
+    yest = jnp.matmul(xd, th[1:], precision=jax.lax.Precision.HIGHEST) + th[0]
+    return yest.reshape(-1, 1)
+
+
 def _soft_threshold_op(d, *, lam):
     return jnp.sign(d) * jnp.maximum(jnp.abs(d) - lam, 0.0)
 
@@ -199,5 +206,5 @@ class Lasso(BaseEstimator, RegressionMixin):
         if not types.heat_type_is_inexact(x.dtype):
             xd = xd.astype(jnp.float32)
         th = self.__theta._dense().ravel()
-        yest = jnp.matmul(xd, th[1:], precision=jax.lax.Precision.HIGHEST) + th[0]
-        return DNDarray.from_dense(yest.reshape(-1, 1), x.split, x.device, x.comm)
+        yest = dispatch.eager_apply(_linear_predict_op, (xd, th))
+        return DNDarray.from_dense(yest, x.split, x.device, x.comm)

@@ -36,6 +36,7 @@ from __future__ import annotations
 from .errors import (
     ChecksumError,
     DivergenceError,
+    OverloadedError,
     PermanentFault,
     ReshapeError,
     ResilienceError,
@@ -73,6 +74,7 @@ __all__ = [
     "DivergenceError",
     "FaultInjector",
     "PermanentFault",
+    "OverloadedError",
     "ReshapeError",
     "ResilienceError",
     "RetryPolicy",

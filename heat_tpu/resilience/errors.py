@@ -31,6 +31,7 @@ __all__ = [
     "PermanentFault",
     "ChecksumError",
     "DivergenceError",
+    "OverloadedError",
     "ReshapeError",
     "WorkerLostError",
 ]
@@ -118,6 +119,31 @@ class ReshapeError(ResilienceError, ValueError):
         self.old_size = old_size
         self.new_size = new_size
         self.leaf = leaf
+
+
+class OverloadedError(ResilienceError, RuntimeError):
+    """The serving layer shed this request instead of queueing it.
+
+    Deliberate load shedding, not a malfunction: either the caller's
+    tenant is over its token-bucket quota (``cause="quota"``, with
+    ``retry_after_s`` saying when the bucket will cover the request) or
+    the service-wide admission queue is at its depth bound
+    (``cause="queue"``).  The HTTP surface maps it to 429 with a
+    ``Retry-After`` header.  Never retried by the resilience machinery
+    — an immediate retry is exactly the traffic the shed exists to
+    refuse; back off for ``retry_after_s`` instead."""
+
+    def __init__(
+        self,
+        message: str = "overloaded",
+        tenant: Optional[str] = None,
+        cause: str = "queue",
+        retry_after_s: Optional[float] = None,
+    ):
+        super().__init__(message)
+        self.tenant = tenant
+        self.cause = cause
+        self.retry_after_s = retry_after_s
 
 
 class DivergenceError(ResilienceError, ArithmeticError):

@@ -104,6 +104,9 @@ KNOWN_SITES = (
     "elastic.detect",
     "elastic.reshape",
     "elastic.resume",
+    "serve.load",
+    "serve.predict",
+    "serve.batch",
 )
 
 #: process-lifetime totals (survive injector deactivation) — registered

@@ -1,0 +1,80 @@
+"""Online serving layer: fitted estimators as a low-latency service.
+
+The heat L5 estimator API (PAPER.md §1) fits and predicts inside one
+batch program; this subsystem turns a *fitted* estimator into an online
+inference service — the "heavy traffic from millions of users" scenario
+the north star names — by composing four existing layers that had never
+met:
+
+* the **dispatch executable cache** (PR 1) makes a repeated predict
+  shape an amortized-zero-compile launch; the request **coalescer**
+  (:mod:`~heat_tpu.serving.coalescer`) + pad-to-bucket shapes
+  (:func:`heat_tpu.core.dispatch.batch_bucket`) make every traffic mix
+  a repeated shape;
+* the **Checkpointer** (PR 2/8) is the model store; the **registry**
+  (:mod:`~heat_tpu.serving.registry`) hot-loads named, versioned
+  estimators from it — asynchronously, cross-world (fit at world P,
+  serve at world Q), with atomic zero-downtime promote/rollback;
+* the **metrics registry** (PR 4) drives **admission control**
+  (:mod:`~heat_tpu.serving.admission`): per-tenant token buckets and a
+  bounded queue shed overload with a typed
+  :class:`~heat_tpu.resilience.errors.OverloadedError` (429) instead
+  of collapsing, with p50/p99 latency and queue-depth gauges scraped
+  from ``/metrics``;
+* the **introspection HTTP server** (PR 6) carries the service's
+  ``/v1/models``, ``/v1/predict`` and per-model ``/healthz`` routes
+  (:mod:`~heat_tpu.serving.service`) through the new route-registry
+  hook — one process, one port.
+
+Quick start::
+
+    import heat_tpu as ht
+    from heat_tpu import serving
+
+    km = ht.cluster.KMeans(n_clusters=8).fit(x)
+    serving.save_model(km, "/models/segmenter", version=1)
+
+    svc = serving.InferenceService()
+    svc.load("segmenter", "/models/segmenter")
+    labels = svc.predict("segmenter", rows)        # coalesced + cached
+    url = svc.serve(8080)                          # ...or over HTTP
+
+See ``docs/serving.md`` for the registry lifecycle, coalescing
+semantics, quota knobs and curl examples.
+"""
+
+from __future__ import annotations
+
+from ..resilience.errors import OverloadedError
+from .admission import AdmissionController, TokenBucket
+from .coalescer import ModelBatcher
+from .model_io import (
+    SUPPORTED_KINDS,
+    build_estimator,
+    export_state,
+    save_model,
+)
+from .registry import ModelRegistry, PendingLoad
+from .service import (
+    InferenceService,
+    default_service,
+    start_serving,
+    stop_serving,
+)
+
+__all__ = [
+    "AdmissionController",
+    "InferenceService",
+    "ModelBatcher",
+    "ModelRegistry",
+    "OverloadedError",
+    "PendingLoad",
+    "SUPPORTED_KINDS",
+    "TokenBucket",
+    "build_estimator",
+    "default_service",
+    "export_state",
+    "save_model",
+    "start_serving",
+    "stop_serving",
+]

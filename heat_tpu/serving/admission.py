@@ -1,0 +1,156 @@
+"""Admission control: per-tenant quotas and bounded queues, shed don't sink.
+
+An overloaded batch system slows down; an overloaded *serving* system
+must stay fast for the traffic it admits and refuse the rest loudly.
+Two mechanisms, both evaluated before a request touches a coalescer
+queue:
+
+* **per-tenant token buckets** — each tenant refills at ``rate`` tokens
+  per second up to ``burst``; a request costs one token per row.  A
+  tenant over its quota is shed with a typed
+  :class:`~heat_tpu.resilience.errors.OverloadedError`
+  (``cause="quota"``, HTTP 429 with a computed ``Retry-After``) and
+  never competes with in-quota tenants for batch slots — the isolation
+  property the acceptance gate measures (an over-quota tenant hammers,
+  in-quota p99 holds).
+* **bounded admission depth** — at most ``HEAT_TPU_SERVE_QUEUE_DEPTH``
+  rows may be queued-or-in-flight across the service; past it every
+  tenant is shed (``cause="queue"``) instead of the queue growing
+  without bound and collapsing tail latency for everyone.
+
+Every decision is accounted in the metrics registry:
+``serving.requests`` / ``serving.shed_quota`` / ``serving.shed_queue``
+counters and the ``serving.queue_depth`` gauge — the signals a load
+balancer or autoscaler watches on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..analysis import tsan as _tsan
+from ..resilience.errors import OverloadedError
+from ..telemetry import metrics as _tm
+
+__all__ = ["AdmissionController", "TokenBucket"]
+
+_REQS_C = _tm.counter("serving.requests", "prediction requests admitted")
+_SHED_QUOTA_C = _tm.counter(
+    "serving.shed_quota", "requests shed by per-tenant quota (429)"
+)
+_SHED_QUEUE_C = _tm.counter(
+    "serving.shed_queue", "requests shed by the bounded admission queue (429)"
+)
+_DEPTH_G = _tm.gauge(
+    "serving.queue_depth", "rows admitted and not yet answered"
+)
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``.
+
+    ``rate <= 0`` means unlimited (every take succeeds).  Not
+    self-locking — the controller serializes access."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.stamp = time.monotonic()
+
+    def take(self, cost: float = 1.0, now: Optional[float] = None) -> float:
+        """Try to spend ``cost`` tokens; returns 0.0 on success or the
+        seconds until enough tokens will have refilled (the 429
+        ``Retry-After``)."""
+        if self.rate <= 0:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        self.tokens = min(self.burst, self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return 0.0
+        return (cost - self.tokens) / self.rate
+
+
+class AdmissionController:
+    """Per-tenant quotas + one bounded admission count for the service.
+
+    ``admit(tenant, rows)`` either accounts the rows in (returning a
+    token the caller must ``release``) or raises
+    :class:`OverloadedError`; unknown tenants get a bucket at the
+    default rate/burst on first sight."""
+
+    def __init__(
+        self,
+        max_depth: int,
+        default_rate: float = 0.0,
+        default_burst: float = 64.0,
+    ):
+        self.max_depth = int(max_depth)
+        self.default_rate = float(default_rate)
+        self.default_burst = float(default_burst)
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._depth = 0
+        self._lock = _tsan.register_lock("serving.admission")
+
+    def set_quota(self, tenant: str, rate: float, burst: Optional[float] = None) -> None:
+        """Pin ``tenant``'s refill rate (rows/s) and burst (defaults to
+        ``rate``, floor 1); replaces any existing bucket."""
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets")
+            self._buckets[tenant] = TokenBucket(
+                rate, burst if burst is not None else max(rate, 1.0)
+            )
+
+    def admit(self, tenant: str, rows: int = 1) -> None:
+        """Admit ``rows`` for ``tenant`` or raise :class:`OverloadedError`.
+
+        Queue bound first (protects the process), quota second (bills
+        the tenant only for admittable work)."""
+        rows = max(1, int(rows))
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets")
+            if self._depth + rows > self.max_depth:
+                _SHED_QUEUE_C.inc()
+                raise OverloadedError(
+                    f"admission queue full ({self._depth}/{self.max_depth} rows "
+                    f"in flight); request of {rows} rows shed",
+                    tenant=tenant,
+                    cause="queue",
+                )
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = TokenBucket(
+                    self.default_rate, self.default_burst
+                )
+            retry_after = bucket.take(rows)
+            if retry_after > 0.0:
+                _SHED_QUOTA_C.inc()
+                raise OverloadedError(
+                    f"tenant {tenant!r} over quota ({bucket.rate:g} rows/s, "
+                    f"burst {bucket.burst:g}); retry in {retry_after:.3f}s",
+                    tenant=tenant,
+                    cause="quota",
+                    retry_after_s=retry_after,
+                )
+            self._depth += rows
+            _DEPTH_G.set(self._depth)
+        _REQS_C.inc()
+
+    def release(self, rows: int = 1) -> None:
+        """Return ``rows`` previously admitted (request answered or
+        failed)."""
+        rows = max(1, int(rows))
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets")
+            self._depth = max(0, self._depth - rows)
+            _DEPTH_G.set(self._depth)
+
+    def depth(self) -> int:
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets", write=False)
+            return self._depth

@@ -1,0 +1,212 @@
+"""Request coalescer: many concurrent ``predict()`` calls, one dispatch.
+
+The serving hot path must never pay per-request what the framework
+amortizes per batch — Python dispatch, DNDarray wrapping, an XLA
+launch.  Each served model gets one :class:`ModelBatcher`: callers
+enqueue their rows and block on a per-request event; a dedicated
+batcher thread drains the queue into one batch per **tick** (up to
+``HEAT_TPU_SERVE_MAX_BATCH`` rows, waiting at most
+``HEAT_TPU_SERVE_MAX_DELAY_MS`` from the first queued request), pads
+the batch up to a **bucket** shape
+(:func:`heat_tpu.core.dispatch.batch_bucket`: next power of two), runs
+ONE estimator inference over the padded batch, and scatters each
+caller's slice of the result back.
+
+The bucket padding is what keeps the executable-cache key set finite:
+request traffic produces arbitrary batch sizes, but the dispatch layer
+only ever sees ``log2(max_batch)+1`` distinct leading extents — after
+one warmup pass per bucket, steady-state serving triggers **zero new
+compiles** whatever the traffic mix (the ``bench_serving`` acceptance
+gate).  Pad rows are real zero rows (not mask metadata), so the true
+extent baked into cached programs is the bucket itself; pad outputs are
+simply dropped by the scatter.
+
+Lock discipline (sanitized by the TSAN lane): the queue is only touched
+under the registered ``serving.coalescer`` lock via its Condition; the
+inference itself — the blocking part — always runs *outside* the lock,
+so enqueues never stall behind XLA.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import tsan as _tsan
+from ..core import dispatch as _dispatch
+from ..resilience.faults import inject as _inject
+from ..telemetry import metrics as _tm
+from ..telemetry.spans import span as _span
+
+__all__ = ["ModelBatcher"]
+
+_BATCHES_C = _tm.counter("serving.batches", "coalesced inference dispatches")
+_BATCH_ROWS_H = _tm.histogram(
+    "serving.batch_rows", "true rows per coalesced inference batch"
+)
+_PAD_ROWS_C = _tm.counter(
+    "serving.pad_rows", "bucket-padding rows dispatched (wasted compute rows)"
+)
+
+
+class _Request:
+    __slots__ = ("rows", "n", "event", "result", "error", "enqueued_at")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.n = int(rows.shape[0])
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.monotonic()
+
+
+class ModelBatcher:
+    """One model's coalescing queue + batcher thread.
+
+    ``infer_fn(batch_rows: np.ndarray) -> np.ndarray`` is the model
+    inference over a padded batch (the service wires it to the
+    registry's *active* version at every tick, so a promote/rollback
+    applies from the next batch with zero downtime).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        infer_fn: Callable[[np.ndarray], np.ndarray],
+        max_batch: int,
+        max_delay_s: float,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.name = name
+        self._infer_fn = infer_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self._queue: List[_Request] = []
+        self._queued_rows = 0
+        self._open = True
+        self.last_batch_ts = 0.0
+        self._lock = _tsan.register_lock("serving.coalescer")
+        self._cond = threading.Condition(self._lock)
+        self._thread = threading.Thread(
+            target=self._run, name=f"heat-tpu-serve-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # -- caller side ----------------------------------------------------
+    def submit(self, rows: np.ndarray, timeout: Optional[float] = None) -> np.ndarray:
+        """Enqueue ``rows`` and block until their predictions return.
+
+        Raises the batch's inference error if its dispatch failed,
+        ``TimeoutError`` past ``timeout``, ``RuntimeError`` after
+        ``close()``."""
+        rows = np.asarray(rows)
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be 2-D (n, features), got shape {rows.shape}")
+        if rows.shape[0] == 0:
+            return rows[:0]
+        if rows.shape[0] > self.max_batch:
+            raise ValueError(
+                f"request of {rows.shape[0]} rows exceeds the coalescer's "
+                f"max batch {self.max_batch} (HEAT_TPU_SERVE_MAX_BATCH); "
+                "split the request"
+            )
+        req = _Request(rows)
+        with self._cond:
+            _tsan.note_access("serving.coalescer.queue")
+            if not self._open:
+                raise RuntimeError(f"batcher for model {self.name!r} is closed")
+            self._queue.append(req)
+            self._queued_rows += req.n
+            self._cond.notify_all()
+        if not req.event.wait(timeout):
+            # the batcher may still complete it; the caller stops waiting
+            raise TimeoutError(
+                f"predict on model {self.name!r} timed out after {timeout}s"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def queued_rows(self) -> int:
+        with self._lock:
+            _tsan.note_access("serving.coalescer.queue", write=False)
+            return self._queued_rows
+
+    def alive(self) -> bool:
+        """Whether the batcher thread is serving (per-model /healthz)."""
+        return self._thread.is_alive() and self._open
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop accepting work, drain queued requests, join the batcher
+        thread.  Idempotent and safe to call concurrently."""
+        with self._cond:
+            _tsan.note_access("serving.coalescer.queue")
+            self._open = False
+            self._cond.notify_all()
+        t = self._thread
+        if t is not threading.current_thread():
+            t.join(timeout)
+
+    # -- batcher thread -------------------------------------------------
+    def _take_batch(self) -> List[_Request]:
+        """Pop requests up to max_batch rows (caller holds the lock)."""
+        batch: List[_Request] = []
+        rows = 0
+        while self._queue and rows + self._queue[0].n <= self.max_batch:
+            req = self._queue.pop(0)
+            rows += req.n
+            batch.append(req)
+        self._queued_rows -= rows
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                _tsan.note_access("serving.coalescer.queue")
+                while self._open and not self._queue:
+                    self._cond.wait()
+                if not self._open and not self._queue:
+                    return
+                # batching window: from the first queued request, wait
+                # for more work until the delay elapses or a full batch
+                # is ready — the latency/throughput dial of the design
+                deadline = self._queue[0].enqueued_at + self.max_delay_s
+                while self._open and self._queued_rows < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                batch = self._take_batch()
+            if batch:
+                self._execute(batch)  # outside the lock: XLA must not block enqueues
+
+    def _execute(self, batch: List[_Request]) -> None:
+        try:
+            _inject("serve.batch", model=self.name)
+            n = sum(r.n for r in batch)
+            bucket = _dispatch.batch_bucket(n, self.max_batch)
+            rows = np.concatenate([r.rows for r in batch], axis=0)
+            if bucket > n:
+                pad = np.zeros((bucket - n,) + rows.shape[1:], rows.dtype)
+                rows = np.concatenate([rows, pad], axis=0)
+            with _span("serve.batch", model=self.name, rows=n, bucket=bucket):
+                out = np.asarray(self._infer_fn(rows))
+            _BATCHES_C.inc()
+            _BATCH_ROWS_H.observe(n)
+            _PAD_ROWS_C.inc(bucket - n)
+            self.last_batch_ts = time.time()
+            off = 0
+            for r in batch:
+                r.result = out[off : off + r.n]
+                off += r.n
+                r.event.set()
+        except BaseException as e:  # lint: allow H501(per-request error delivery; the batcher thread must survive)
+            for r in batch:
+                if not r.event.is_set():
+                    r.error = e
+                    r.event.set()

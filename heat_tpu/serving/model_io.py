@@ -1,0 +1,240 @@
+"""Fitted-estimator <-> checkpoint codec: the model-store format.
+
+A served model is a fitted estimator reduced to three world-size
+invariant pieces — its kind (class name), its constructor params
+(scalars only), and its fitted state (the arrays ``predict``/
+``transform`` actually read) — written through the existing
+:class:`~heat_tpu.utils.checkpoint.Checkpointer` (atomic directory
+commit, CRC32 sidecars, io retry policy).  A checkpoint **step** is a
+model **version**; ``meta_<version>.json`` carries the listing metadata
+(kind, name, save time) so a registry can enumerate a model directory
+without decoding array payloads.
+
+Because the payload is the native codec's dense-global-array format, a
+model fitted at world size P hot-loads at world size Q through the
+cross-world restore path (``Checkpointer.restore(comm=...)``) with each
+DNDarray leaf re-split onto the serving mesh — the elastic layer's
+restore guarantee, inherited for free.
+
+Supported estimator kinds and their state:
+
+==================== ==============================================
+kind                 fitted state (array leaves)
+==================== ==============================================
+KMeans/KMedians/     ``cluster_centers`` (the full predict surface of
+KMedoids             the `_KCluster` family)
+PCA                  ``mean``, ``components``, ``singular_values``,
+                     ``explained_variance(_ratio)``, ``tevr``,
+                     ``n_components``
+Lasso                ``theta`` (intercept + coefficients)
+KNeighborsClassifier ``x`` (train points), ``y`` (one-hot labels)
+==================== ==============================================
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+
+__all__ = [
+    "SUPPORTED_KINDS",
+    "build_estimator",
+    "export_state",
+    "infer",
+    "save_model",
+]
+
+#: estimator class names the codec round-trips (the heat L5 surface
+#: turned serveable)
+SUPPORTED_KINDS = (
+    "KMeans",
+    "KMedians",
+    "KMedoids",
+    "PCA",
+    "Lasso",
+    "KNeighborsClassifier",
+)
+
+_KCLUSTER_KINDS = ("KMeans", "KMedians", "KMedoids")
+
+#: codec version stamped into every exported doc; a future layout change
+#: bumps it and keeps old models loadable
+CODEC_VERSION = 1
+
+
+def _estimator_classes() -> Dict[str, type]:
+    # lazy: the estimator modules import the full core stack
+    from ..classification import KNeighborsClassifier
+    from ..cluster import KMeans, KMedians, KMedoids
+    from ..decomposition import PCA
+    from ..regression import Lasso
+
+    return {
+        "KMeans": KMeans,
+        "KMedians": KMedians,
+        "KMedoids": KMedoids,
+        "PCA": PCA,
+        "Lasso": Lasso,
+        "KNeighborsClassifier": KNeighborsClassifier,
+    }
+
+
+class NotFittedError(ValueError):
+    """The estimator has no fitted state to export."""
+
+
+def _require(cond: bool, kind: str) -> None:
+    if not cond:
+        raise NotFittedError(
+            f"{kind} estimator is not fitted; call fit() before save_model()"
+        )
+
+
+def _scalar_params(est) -> Dict[str, Any]:
+    """JSON-safe constructor params: scalars/strings/None only.  Array
+    params (a DNDarray ``init=``) and resume plumbing are irrelevant to
+    a *fitted* model's predict path and are dropped."""
+    out: Dict[str, Any] = {}
+    for k, v in est.get_params(deep=False).items():
+        if k in ("checkpoint_every", "checkpoint_dir", "resume_from"):
+            continue
+        if v is None or isinstance(v, (bool, int, float, str)):
+            out[k] = v
+    return out
+
+
+def export_state(est) -> Dict[str, Any]:
+    """Fitted estimator -> checkpointable document (pure pytree of
+    scalars and array leaves; DNDarray leaves keep their split intent
+    through the native codec)."""
+    kind = type(est).__name__
+    if kind not in SUPPORTED_KINDS:
+        raise TypeError(
+            f"cannot serve a {kind}; supported estimator kinds: "
+            f"{', '.join(SUPPORTED_KINDS)}"
+        )
+    state: Dict[str, Any]
+    if kind in _KCLUSTER_KINDS:
+        _require(est._cluster_centers is not None, kind)
+        state = {"cluster_centers": est._cluster_centers}
+    elif kind == "PCA":
+        _require(getattr(est, "components_", None) is not None, kind)
+        state = {
+            "mean": est.mean_,
+            "components": est.components_,
+            "singular_values": est.singular_values_,
+            "explained_variance": est.explained_variance_,
+            "explained_variance_ratio": est.explained_variance_ratio_,
+            "tevr": float(est._tevr),
+            "n_components": int(est.n_components_),
+        }
+    elif kind == "Lasso":
+        _require(est.theta is not None, kind)
+        state = {"theta": est.theta}
+    else:  # KNeighborsClassifier
+        _require(est.x is not None and est.y is not None, kind)
+        state = {"x": est.x, "y": est.y}
+    return {
+        "serving_codec": CODEC_VERSION,
+        "kind": kind,
+        "params": _scalar_params(est),
+        "state": state,
+    }
+
+
+def _as_dnd(leaf, comm, split=None) -> DNDarray:
+    """Array leaf -> DNDarray on ``comm``.  Restores through the
+    cross-world path already hand back DNDarrays (split re-applied);
+    a comm-less restore hands back host arrays, wrapped replicated."""
+    if isinstance(leaf, DNDarray):
+        return leaf
+    return DNDarray.from_dense(jnp.asarray(leaf), split, None, comm)
+
+
+def build_estimator(doc: Dict[str, Any], comm=None):
+    """Checkpoint document -> fitted estimator ready to ``predict``.
+
+    ``comm`` wraps any host-array leaves (comm-less restore); leaves the
+    cross-world restore already re-split are used as-is."""
+    if comm is None:
+        from ..parallel import get_comm
+
+        comm = get_comm()
+    try:
+        kind = doc["kind"]
+        params = doc["params"]
+        state = doc["state"]
+    except (TypeError, KeyError):
+        raise ValueError(
+            "checkpoint does not hold a serving model document "
+            "(missing kind/params/state — was it written by save_model?)"
+        ) from None
+    classes = _estimator_classes()
+    if kind not in classes:
+        raise ValueError(f"unknown estimator kind {kind!r} in model document")
+    est = classes[kind](**params)
+    if kind in _KCLUSTER_KINDS:
+        est._cluster_centers = _as_dnd(state["cluster_centers"], comm)
+    elif kind == "PCA":
+        est.mean_ = _as_dnd(state["mean"], comm)
+        est.components_ = _as_dnd(state["components"], comm)
+        est.singular_values_ = _as_dnd(state["singular_values"], comm)
+        est.explained_variance_ = _as_dnd(state["explained_variance"], comm)
+        est.explained_variance_ratio_ = _as_dnd(state["explained_variance_ratio"], comm)
+        est._tevr = float(state["tevr"])
+        est.n_components_ = int(state["n_components"])
+    elif kind == "Lasso":
+        est._Lasso__theta = _as_dnd(state["theta"], comm)
+    else:  # KNeighborsClassifier
+        est.x = _as_dnd(state["x"], comm)
+        est.y = _as_dnd(state["y"], comm)
+    return est
+
+
+def infer(est, x: DNDarray) -> DNDarray:
+    """The estimator's inference surface: ``predict`` where it exists
+    (clustering/regression/classification), else ``transform`` (PCA)."""
+    fn = getattr(est, "predict", None)
+    if fn is None:
+        fn = est.transform
+    return fn(x)
+
+
+def save_model(
+    est,
+    directory: str,
+    version: int = 0,
+    name: Optional[str] = None,
+    checkpointer=None,
+    async_: bool = False,
+) -> int:
+    """Export a fitted estimator as model ``version`` in ``directory``.
+
+    The write is the Checkpointer's native path — staged directory,
+    CRC32 sidecars, one atomic rename — so a model directory only ever
+    holds complete versions.  ``async_=True`` routes through the bounded
+    background writer; pass your own ``checkpointer`` to keep the write
+    in flight past this call (and ``close()`` it for durability) —
+    without one, the internal checkpointer is drained before returning
+    so the version is durable either way.  Returns the version
+    written."""
+    from ..utils.checkpoint import Checkpointer
+
+    doc = export_state(est)
+    meta = {
+        "serving_codec": CODEC_VERSION,
+        "kind": doc["kind"],
+        "name": name if name is not None else doc["kind"].lower(),
+        "saved_at": time.time(),
+    }
+    ck = checkpointer if checkpointer is not None else Checkpointer(directory)
+    try:
+        ck.save(int(version), doc, extra_metadata=meta, async_=async_)
+    finally:
+        if async_ and checkpointer is None:
+            ck.close()  # internal checkpointer: drain so the write is durable
+    return int(version)

@@ -1,0 +1,325 @@
+"""Model registry: named, versioned estimators hot-loaded from checkpoints.
+
+The serving analogue of the elastic layer's resume path: a registry
+maps ``name -> {version -> fitted estimator}`` with one **active**
+version per name, loaded from a :class:`~heat_tpu.utils.checkpoint.
+Checkpointer` directory written by :func:`~heat_tpu.serving.model_io.
+save_model`.  Three properties the online path needs:
+
+* **hot load** — :meth:`ModelRegistry.load` decodes and rebuilds the
+  estimator *outside* the registry lock, then installs it with one
+  locked pointer swap: requests in flight keep reading the old active
+  version and never observe a half-loaded model.
+  :meth:`~ModelRegistry.load_async` is the PR 3 background-writer
+  pattern **inverted**: the restore (checksum verify, decode, device
+  upload) runs on a bounded background *loader* thread (at most one in
+  flight, back-pressure on overrun) and the atomic swap happens when
+  the load completes; loader errors re-raise at the handle's
+  ``wait()`` or the next ``load_async``/``close()``, never silently.
+* **cross-world restore** — the registry's ``comm`` is handed to
+  ``Checkpointer.restore(comm=...)``, so a model fitted at world size P
+  re-splits onto the serving world Q (counted in
+  ``checkpoint.crossworld_restores``); ``template=`` forwards for
+  shape/dtype validation (:class:`~heat_tpu.resilience.errors.
+  ReshapeError` on mismatch).
+* **zero-downtime promote/rollback** — every version stays resident
+  until unloaded; :meth:`~ModelRegistry.promote` swaps the active
+  pointer under the lock and pushes the previous active onto a history
+  stack :meth:`~ModelRegistry.rollback` pops.  A bad canary rolls back
+  with one pointer swap, no filesystem IO.
+
+Fault site ``serve.load`` is evaluated on every (sync or async)
+load — a scripted fault plan can fail a hot-load to prove the active
+version keeps serving.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..analysis import tsan as _tsan
+from ..resilience.faults import inject as _inject
+from ..telemetry import metrics as _tm
+from ..telemetry.spans import span as _span
+from . import model_io as _mio
+
+__all__ = ["ModelRegistry", "PendingLoad"]
+
+_LOADS_C = _tm.counter("serving.loads", "model versions loaded into a registry")
+_MODELS_G = _tm.gauge("serving.models", "model names resident in the registry")
+
+
+class PendingLoad:
+    """Handle for one in-flight :meth:`ModelRegistry.load_async`.
+
+    ``wait()`` blocks until the load completes and re-raises the loader
+    error if it failed; ``version``/``error`` are readable afterwards.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.version: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Block until the load finished; returns the loaded version or
+        re-raises the loader's error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"model load {self.name!r} still in flight")
+        if self.error is not None:
+            raise self.error
+        return self.version
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class ModelRegistry:
+    """Named, versioned, hot-swappable fitted estimators.
+
+    Thread-safe: the version table is only touched under the registered
+    ``serving.registry`` lock; estimator objects themselves are
+    immutable after load (fitted state only), so serving threads read
+    them lock-free once handed out.
+    """
+
+    def __init__(self, comm=None):
+        self._comm = comm
+        # name -> {"versions": {v: record}, "active": v|None, "history": [v]}
+        self._models: Dict[str, Dict[str, Any]] = {}
+        self._lock = _tsan.register_lock("serving.registry")
+        # bounded background loader (<=1 in flight), inverted async-writer
+        self._loader: Optional[threading.Thread] = None
+        self._load_error: Optional[BaseException] = None
+
+    @property
+    def comm(self):
+        if self._comm is None:
+            from ..parallel import get_comm
+
+            self._comm = get_comm()
+        return self._comm
+
+    # -- loading --------------------------------------------------------
+    def load(
+        self,
+        name: str,
+        directory: str,
+        version: Optional[int] = None,
+        template: Any = None,
+        comm=None,
+        activate: bool = True,
+    ) -> int:
+        """Hot-load one model version from a checkpoint directory.
+
+        Decodes the latest (or the given) version through the
+        cross-world restore path onto the registry's comm, rebuilds the
+        estimator, and installs it with one atomic pointer swap.
+        ``activate=False`` loads a canary version without promoting it
+        (``promote`` later, or serve it explicitly by version).
+        Returns the version loaded."""
+        from ..utils.checkpoint import Checkpointer
+
+        _inject("serve.load", model=name)
+        comm = comm if comm is not None else self.comm
+        ck = Checkpointer(directory)
+        step = ck.latest_step() if version is None else int(version)
+        if step is None:
+            raise FileNotFoundError(f"no model versions in {directory}")
+        with _span("serve.load", model=name, version=step):
+            written_world = ck.world_size(step)
+            doc = ck.restore(step, template=template, comm=comm)
+            est = _mio.build_estimator(doc, comm=comm)
+            meta = ck.metadata(step) or {}
+        record = {
+            "estimator": est,
+            "kind": doc.get("kind"),
+            "version": step,
+            "directory": directory,
+            "loaded_at": time.time(),
+            "world_size_written": written_world,
+            "world_size_serving": comm.size,
+            "meta": meta,
+        }
+        with self._lock:
+            _tsan.note_access("serving.registry.models")
+            entry = self._models.setdefault(
+                name, {"versions": {}, "active": None, "history": []}
+            )
+            entry["versions"][step] = record
+            if activate or entry["active"] is None:
+                if entry["active"] is not None and entry["active"] != step:
+                    entry["history"].append(entry["active"])
+                entry["active"] = step
+            _MODELS_G.set(len(self._models))
+        _LOADS_C.inc()
+        return step
+
+    def load_async(
+        self,
+        name: str,
+        directory: str,
+        version: Optional[int] = None,
+        template: Any = None,
+        comm=None,
+        activate: bool = True,
+    ) -> PendingLoad:
+        """Hot-load on the bounded background loader thread.
+
+        At most one load is in flight; a second ``load_async`` during a
+        load back-pressures until the first completes (and re-raises its
+        error, if any).  The currently active version keeps serving
+        until the loaded one atomically swaps in.  Returns a
+        :class:`PendingLoad` handle."""
+        self.wait()  # back-pressure (<=1 in flight) + error surface
+        handle = PendingLoad(name)
+
+        def _run():
+            try:
+                handle.version = self.load(
+                    name, directory, version=version, template=template,
+                    comm=comm, activate=activate,
+                )
+            except BaseException as e:  # lint: allow H501(loader error surfaced at handle.wait/next load/close)
+                handle.error = e
+                with self._lock:
+                    _tsan.note_access("serving.registry.models")
+                    self._load_error = e
+            finally:
+                handle._done.set()
+
+        t = threading.Thread(
+            target=_run, name=f"heat-tpu-model-load-{name}", daemon=True
+        )
+        self._loader = t
+        t.start()
+        return handle
+
+    def wait(self) -> None:
+        """Drain the background loader; re-raise its pending error."""
+        t = self._loader
+        if t is not None and t is not threading.current_thread():
+            t.join()
+            self._loader = None
+        with self._lock:
+            _tsan.note_access("serving.registry.models")
+            err, self._load_error = self._load_error, None
+        if err is not None:
+            raise err
+
+    def close(self) -> None:
+        """Drain the loader (idempotent); re-raises a pending error."""
+        self.wait()
+
+    # -- version management ---------------------------------------------
+    def _entry(self, name: str) -> Dict[str, Any]:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown model {name!r}; loaded models: {sorted(self._models)}"
+            ) from None
+
+    def promote(self, name: str, version: int) -> None:
+        """Make ``version`` the active one (atomic pointer swap); the
+        previous active version goes onto the rollback history."""
+        with self._lock:
+            _tsan.note_access("serving.registry.models")
+            entry = self._entry(name)
+            if version not in entry["versions"]:
+                raise KeyError(
+                    f"model {name!r} has no loaded version {version}; "
+                    f"resident: {sorted(entry['versions'])}"
+                )
+            if entry["active"] is not None and entry["active"] != version:
+                entry["history"].append(entry["active"])
+            entry["active"] = version
+
+    def rollback(self, name: str) -> int:
+        """Re-activate the previously active version (atomic pointer
+        swap); returns the version now active."""
+        with self._lock:
+            _tsan.note_access("serving.registry.models")
+            entry = self._entry(name)
+            while entry["history"]:
+                prev = entry["history"].pop()
+                if prev in entry["versions"]:
+                    entry["active"] = prev
+                    return prev
+            raise ValueError(f"model {name!r} has no version to roll back to")
+
+    def unload(self, name: str, version: Optional[int] = None) -> None:
+        """Drop one version (or the whole model when ``version`` is
+        None).  Unloading the active version is refused — promote or
+        roll back first, so serving never loses its target mid-flight."""
+        with self._lock:
+            _tsan.note_access("serving.registry.models")
+            entry = self._entry(name)
+            if version is None:
+                del self._models[name]
+            else:
+                version = int(version)
+                if version == entry["active"]:
+                    raise ValueError(
+                        f"version {version} of {name!r} is active; promote or "
+                        "rollback before unloading it"
+                    )
+                entry["versions"].pop(version, None)
+                entry["history"] = [v for v in entry["history"] if v != version]
+            _MODELS_G.set(len(self._models))
+
+    # -- reading --------------------------------------------------------
+    def get(self, name: str, version: Optional[int] = None):
+        """The (active, or the given) fitted estimator for ``name``."""
+        return self.record(name, version)["estimator"]
+
+    def record(self, name: str, version: Optional[int] = None) -> Dict[str, Any]:
+        """The full version record (estimator + load metadata)."""
+        with self._lock:
+            _tsan.note_access("serving.registry.models", write=False)
+            entry = self._entry(name)
+            v = entry["active"] if version is None else int(version)
+            if v is None or v not in entry["versions"]:
+                raise KeyError(f"model {name!r} has no loaded version {v!r}")
+            return entry["versions"][v]
+
+    def active_version(self, name: str) -> Optional[int]:
+        with self._lock:
+            _tsan.note_access("serving.registry.models", write=False)
+            return self._entry(name)["active"]
+
+    def model_names(self) -> List[str]:
+        with self._lock:
+            _tsan.note_access("serving.registry.models", write=False)
+            return sorted(self._models)
+
+    def models(self) -> Dict[str, Any]:
+        """Listing document (the ``/v1/models`` payload): per model, the
+        active version, every resident version's kind/load time/world
+        sizes, and the rollback history."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            _tsan.note_access("serving.registry.models", write=False)
+            for name, entry in self._models.items():
+                out[name] = {
+                    "active": entry["active"],
+                    "history": list(entry["history"]),
+                    "versions": {
+                        str(v): {
+                            k: rec[k]
+                            for k in (
+                                "kind",
+                                "version",
+                                "directory",
+                                "loaded_at",
+                                "world_size_written",
+                                "world_size_serving",
+                            )
+                        }
+                        for v, rec in entry["versions"].items()
+                    },
+                }
+        return out

@@ -1,0 +1,350 @@
+"""Inference service: registry + coalescer + admission behind one surface.
+
+:class:`InferenceService` is the composition the serving design doc
+draws: a request enters through :meth:`~InferenceService.predict`
+(Python) or ``POST /v1/predict`` (HTTP), passes **admission control**
+(per-tenant quota, bounded depth — shed with
+:class:`~heat_tpu.resilience.errors.OverloadedError`/429, never
+queued-to-collapse), lands in its model's **coalescer** queue, rides a
+padded **bucket** batch through the executable cache, and returns with
+its slice of the batch result; end-to-end latency lands in the
+``serving.latency_ms`` histogram (p50/p99 on ``/metrics``).
+
+HTTP surface (mounted on the telemetry introspection server through
+:func:`~heat_tpu.telemetry.server.register_route` — one process, one
+port):
+
+=====================================  ================================
+route                                  payload
+=====================================  ================================
+``GET /v1/models``                     registry listing: versions,
+                                       active pointer, rollback history
+``POST /v1/predict``                   ``{"model", "inputs", "tenant"?,
+                                       "version"?}`` -> predictions
+``GET /v1/models/<name>/healthz``      per-model liveness: loaded
+                                       version, batcher thread alive,
+                                       queue depth, last batch age
+=====================================  ================================
+
+Estimators are hot-swappable: the coalescer resolves the registry's
+*active* version at every batch, so ``promote``/``rollback`` take
+effect on the next tick with zero downtime and zero dropped requests.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..analysis import tsan as _tsan
+from ..resilience.errors import OverloadedError
+from ..resilience.faults import inject as _inject
+from ..telemetry import metrics as _tm
+from ..telemetry import server as _tserver
+from .admission import AdmissionController
+from .coalescer import ModelBatcher
+from .model_io import infer as _infer
+from .registry import ModelRegistry
+
+__all__ = [
+    "InferenceService",
+    "default_service",
+    "start_serving",
+    "stop_serving",
+]
+
+_LATENCY_H = _tm.histogram(
+    "serving.latency_ms", "end-to-end predict latency (admission to result)"
+)
+
+#: route prefix the service mounts on the introspection server
+ROUTE_PREFIX = "/v1/"
+
+
+def _env():
+    from ..core import _env as envmod
+
+    return envmod
+
+
+class InferenceService:
+    """A running inference service over a :class:`ModelRegistry`.
+
+    ``split`` is the batch axis distribution of coalesced batches:
+    ``None`` (default) replicates the bucket-padded batch — the right
+    call at online batch sizes, and the path whose every op rides the
+    executable cache; ``0`` shards rows across the serving mesh for
+    large-bucket deployments (its predict programs are the jitted ring
+    kernels, cached per bucket by jax itself).  Knobs default from the
+    registry (``HEAT_TPU_SERVE_*``); constructor arguments override per
+    instance."""
+
+    def __init__(
+        self,
+        registry: Optional[ModelRegistry] = None,
+        comm=None,
+        split: Optional[int] = None,
+        max_batch: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        rate: Optional[float] = None,
+        burst: Optional[float] = None,
+    ):
+        env = _env()
+        self.registry = registry if registry is not None else ModelRegistry(comm=comm)
+        self.split = split
+        self.max_batch = (
+            int(max_batch) if max_batch is not None
+            else env.env_int("HEAT_TPU_SERVE_MAX_BATCH")
+        )
+        delay_ms = (
+            float(max_delay_ms) if max_delay_ms is not None
+            else env.env_float("HEAT_TPU_SERVE_MAX_DELAY_MS")
+        )
+        self.max_delay_s = delay_ms / 1e3
+        self.admission = AdmissionController(
+            max_depth=(
+                int(queue_depth) if queue_depth is not None
+                else env.env_int("HEAT_TPU_SERVE_QUEUE_DEPTH")
+            ),
+            default_rate=(
+                float(rate) if rate is not None
+                else env.env_float("HEAT_TPU_SERVE_RATE")
+            ),
+            default_burst=(
+                float(burst) if burst is not None
+                else env.env_float("HEAT_TPU_SERVE_BURST")
+            ),
+        )
+        self._batchers: Dict[str, ModelBatcher] = {}
+        self._open = True
+        self._lock = _tsan.register_lock("serving.service")
+
+    # -- model lifecycle (thin registry delegates) ----------------------
+    def load(self, name: str, directory: str, **kwargs) -> int:
+        """Hot-load a model version (see :meth:`ModelRegistry.load`)."""
+        return self.registry.load(name, directory, **kwargs)
+
+    def load_async(self, name: str, directory: str, **kwargs):
+        """Background hot-load (see :meth:`ModelRegistry.load_async`)."""
+        return self.registry.load_async(name, directory, **kwargs)
+
+    def set_quota(self, tenant: str, rate: float, burst: Optional[float] = None) -> None:
+        self.admission.set_quota(tenant, rate, burst)
+
+    # -- the hot path ---------------------------------------------------
+    def _batcher(self, name: str) -> ModelBatcher:
+        self.registry.record(name)  # KeyError -> 404 before a thread spawns
+        with self._lock:
+            _tsan.note_access("serving.service.state")
+            if not self._open:
+                raise RuntimeError("inference service is closed")
+            b = self._batchers.get(name)
+            if b is None:
+                b = self._batchers[name] = ModelBatcher(
+                    name,
+                    lambda rows, _n=name: self._infer_batch(_n, rows),
+                    max_batch=self.max_batch,
+                    max_delay_s=self.max_delay_s,
+                )
+            return b
+
+    def _infer_batch(self, name: str, rows: np.ndarray) -> np.ndarray:
+        """One coalesced inference on the ACTIVE version (batcher thread)."""
+        from ..core import factories
+
+        est = self.registry.get(name)
+        x = factories.array(rows, split=self.split, comm=self.registry.comm)
+        return _infer(est, x).numpy()
+
+    def predict(
+        self,
+        name: str,
+        rows,
+        tenant: str = "default",
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        """Predict ``rows`` (one (n, features) request) on model
+        ``name``; blocks until the coalesced batch answers.
+
+        Raises :class:`OverloadedError` when shed, ``KeyError`` for an
+        unknown model, the batch's error when its dispatch failed."""
+        rows = np.asarray(rows)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        _inject("serve.predict", model=name, rows=int(rows.shape[0]))
+        t0 = time.perf_counter()
+        n = int(rows.shape[0])
+        self.admission.admit(tenant, n)
+        try:
+            out = self._batcher(name).submit(rows, timeout=timeout)
+        finally:
+            self.admission.release(n)
+        _LATENCY_H.observe((time.perf_counter() - t0) * 1e3)
+        return out
+
+    # -- per-model health ----------------------------------------------
+    def model_health(self, name: str) -> Dict[str, Any]:
+        """``(healthy, doc)`` folded into one doc with a ``healthy``
+        key: loaded version, batcher liveness, queue depth."""
+        rec = self.registry.record(name)  # KeyError -> 404 upstream
+        with self._lock:
+            _tsan.note_access("serving.service.state", write=False)
+            b = self._batchers.get(name)
+        now = time.time()
+        doc: Dict[str, Any] = {
+            "model": name,
+            "status": "ok",
+            "healthy": True,
+            "version": rec["version"],
+            "kind": rec["kind"],
+            "loaded_age_s": round(now - rec["loaded_at"], 3),
+            "world_size_written": rec["world_size_written"],
+            "world_size_serving": rec["world_size_serving"],
+            "queued_rows": b.queued_rows() if b is not None else 0,
+            "last_batch_age_s": (
+                round(now - b.last_batch_ts, 3)
+                if b is not None and b.last_batch_ts > 0
+                else None
+            ),
+        }
+        if b is None:
+            doc["status"] = "idle"  # loaded, no traffic yet — healthy
+        elif not b.alive():
+            doc["status"] = "dead"
+            doc["healthy"] = False
+        return doc
+
+    # -- HTTP -----------------------------------------------------------
+    def serve(self, port: Optional[int] = None) -> str:
+        """Mount the /v1 routes on the introspection server (starting it
+        if needed); returns the server URL."""
+        srv = _tserver.start_server(port)
+        _tserver.register_route(ROUTE_PREFIX, self._handle_http)
+        return srv.url
+
+    def _handle_http(self, method: str, path: str, body: Optional[bytes]):
+        try:
+            if method == "GET" and path == "/v1/models":
+                return 200, "application/json", json.dumps(
+                    {"models": self.registry.models()}, indent=1, default=str
+                )
+            if method == "GET" and path.startswith("/v1/models/") and path.endswith("/healthz"):
+                name = path[len("/v1/models/") : -len("/healthz")].strip("/")
+                doc = self.model_health(name)
+                return (
+                    200 if doc["healthy"] else 503,
+                    "application/json",
+                    json.dumps(doc, indent=1, default=str),
+                )
+            if method == "POST" and path == "/v1/predict":
+                return self._handle_predict(body)
+            return 404, "text/plain", f"unknown serving route {path!r}\n"
+        except KeyError as e:
+            return 404, "application/json", json.dumps({"error": str(e)})
+        except OverloadedError as e:
+            headers = {}
+            if e.retry_after_s is not None:
+                headers["Retry-After"] = f"{max(e.retry_after_s, 0.001):.3f}"
+            return (
+                429,
+                "application/json",
+                json.dumps(
+                    {"error": str(e), "cause": e.cause, "tenant": e.tenant,
+                     "retry_after_s": e.retry_after_s}
+                ),
+                headers,
+            )
+        except (ValueError, TypeError) as e:
+            return 400, "application/json", json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}
+            )
+
+    def _handle_predict(self, body: Optional[bytes]):
+        try:
+            doc = json.loads(body or b"")
+        except ValueError:
+            return 400, "application/json", json.dumps(
+                {"error": "request body must be a JSON object"}
+            )
+        if not isinstance(doc, dict) or "model" not in doc or "inputs" not in doc:
+            return 400, "application/json", json.dumps(
+                {"error": 'POST /v1/predict needs {"model": name, "inputs": [[...], ...]}'}
+            )
+        name = doc["model"]
+        rows = np.asarray(doc["inputs"], dtype=np.float32)
+        tenant = str(doc.get("tenant", "default"))
+        t0 = time.perf_counter()
+        out = self.predict(
+            name, rows, tenant=tenant, timeout=doc.get("timeout")
+        )
+        version = self.registry.active_version(name)
+        return 200, "application/json", json.dumps(
+            {
+                "model": name,
+                "version": version,
+                "n": int(np.asarray(out).shape[0]),
+                "predictions": np.asarray(out).tolist(),
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
+        )
+
+    # -- shutdown -------------------------------------------------------
+    def close(self) -> None:
+        """Unmount the routes, drain and join every batcher, drain the
+        registry's background loader.  Idempotent."""
+        _tserver.unregister_route(ROUTE_PREFIX)
+        with self._lock:
+            _tsan.note_access("serving.service.state")
+            self._open = False
+            batchers, self._batchers = dict(self._batchers), {}
+        for b in batchers.values():
+            b.close()
+        self.registry.close()
+
+    def __enter__(self) -> "InferenceService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+# ----------------------------------------------------------------------
+# process-default service (the HTTP deployment shape: one process, one
+# registry, one port)
+# ----------------------------------------------------------------------
+_SERVICE: Optional[InferenceService] = None
+_SERVICE_LOCK = _tsan.register_lock("serving.service")
+
+
+def default_service(**kwargs) -> InferenceService:
+    """Get-or-create the process's default :class:`InferenceService`
+    (kwargs apply only on creation)."""
+    global _SERVICE
+    with _SERVICE_LOCK:
+        _tsan.note_access("serving.service.state")
+        if _SERVICE is None:
+            _SERVICE = InferenceService(**kwargs)
+        return _SERVICE
+
+
+def start_serving(port: Optional[int] = None, **kwargs) -> InferenceService:
+    """Start the default service and mount its HTTP routes; returns the
+    service (its URL comes from ``telemetry.server``)."""
+    svc = default_service(**kwargs)
+    svc.serve(port)
+    return svc
+
+
+def stop_serving() -> None:
+    """Close and drop the default service (no-op when none is running)."""
+    global _SERVICE
+    with _SERVICE_LOCK:
+        _tsan.note_access("serving.service.state")
+        svc, _SERVICE = _SERVICE, None
+    if svc is not None:
+        svc.close()

@@ -49,6 +49,16 @@ def _pairwise_direct(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
 
 
+def _pairwise_euclidean(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Expanded-form euclidean (the quadratic_expansion metric)."""
+    return jnp.sqrt(_pairwise_sqeuclidean(x, y))
+
+
+def _pairwise_manhattan(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """City-block tile (the reference _manhattan, distance.py:110)."""
+    return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+
+
 def _prep(X: DNDarray, Y: Optional[DNDarray]):
     sanitize_in(X)
     if X.ndim != 2:
@@ -172,10 +182,13 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
         _prep_checks(X, Y)
         return _ring_cdist(X, Y, "euclidean" if quadratic_expansion else "euclidean_direct")
     xd, yd = _prep(X, Y)
-    if quadratic_expansion:
-        d = jnp.sqrt(_pairwise_sqeuclidean(xd, yd))
-    else:
-        d = _pairwise_direct(xd, yd)
+    # through the executable cache: repeated shapes (iterative fits, the
+    # serving layer's bucket-padded predict batches) hit one compiled
+    # program instead of paying 4-6 eager jnp launches per call
+    from ..core import dispatch
+
+    op = _pairwise_euclidean if quadratic_expansion else _pairwise_direct
+    d = dispatch.eager_apply(op, (xd, yd))
     split = 0 if X.split is not None else None
     return DNDarray.from_dense(d, split, X.device, X.comm)
 
@@ -289,7 +302,9 @@ def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -
         _prep_checks(X, Y)
         return _ring_cdist(X, Y, "manhattan")
     xd, yd = _prep(X, Y)
-    d = jnp.sum(jnp.abs(xd[:, None, :] - yd[None, :, :]), axis=-1)
+    from ..core import dispatch
+
+    d = dispatch.eager_apply(_pairwise_manhattan, (xd, yd))
     split = 0 if X.split is not None else None
     return DNDarray.from_dense(d, split, X.device, X.comm)
 

@@ -19,6 +19,12 @@ route     payload
           accounting, jax/device/version info
 ========  ============================================================
 
+Other subsystems mount additional routes on this same server through
+:func:`register_route` (the serving layer's ``/v1/models`` /
+``/v1/predict`` / per-model ``/healthz`` endpoints do) — one process,
+one port, however many route owners; ``close()`` stays idempotent and
+routes survive a server stop/start cycle.
+
 Off by default.  ``HEAT_TPU_HTTP_PORT=<port>`` starts the server when
 ``heat_tpu.telemetry`` is imported; :func:`start_server` starts it
 programmatically (``port=0`` binds an ephemeral port — the test
@@ -45,10 +51,13 @@ __all__ = [
     "IntrospectionServer",
     "health_report",
     "maybe_start_from_env",
+    "register_route",
+    "registered_routes",
     "server_running",
     "start_server",
     "statusz_report",
     "stop_server",
+    "unregister_route",
 ]
 
 #: the process's single running server (one port is plenty; tests stop
@@ -58,6 +67,57 @@ __all__ = [
 #: start_server() behind a held module lock
 _SERVER: Optional["IntrospectionServer"] = None
 _LOCK = _tsan.register_lock("telemetry.server")
+
+#: extra HTTP routes registered by other subsystems (the serving layer's
+#: /v1/ endpoints): path prefix -> handler.  One process, one server,
+#: many route owners — a subsystem that needs HTTP extends THIS endpoint
+#: instead of binding a second socket.  Guarded by the same registered
+#: lock as the server handle; handler threads take it only for the
+#: (cheap) prefix lookup and call the handler outside it.
+_ROUTES: Dict[str, Any] = {}
+
+
+def register_route(prefix: str, handler) -> None:
+    """Mount ``handler`` under ``prefix`` on the process's introspection
+    server (running or future — routes survive server restarts).
+
+    ``handler(method, path, body) -> (status, content_type, body_str)``
+    — or a 4-tuple with an extra ``{header: value}`` dict.  ``method``
+    is ``"GET"``/``"POST"``, ``path`` the full request path, ``body``
+    the raw request bytes (None for GET).  The longest registered
+    prefix wins; built-in routes (/metrics, /healthz, ...) cannot be
+    shadowed.  A handler exception becomes a 500 on that request only.
+    """
+    if not prefix.startswith("/"):
+        raise ValueError(f"route prefix must start with '/', got {prefix!r}")
+    with _LOCK:
+        _tsan.note_access("telemetry.server.routes")
+        _ROUTES[prefix] = handler
+
+
+def unregister_route(prefix: str) -> None:
+    """Unmount a registered route prefix (no-op when absent)."""
+    with _LOCK:
+        _tsan.note_access("telemetry.server.routes")
+        _ROUTES.pop(prefix, None)
+
+
+def registered_routes() -> list:
+    """The mounted route prefixes, longest first."""
+    with _LOCK:
+        _tsan.note_access("telemetry.server.routes", write=False)
+        return sorted(_ROUTES, key=len, reverse=True)
+
+
+def _route_for(path: str):
+    """The handler owning ``path`` (longest-prefix match), or None."""
+    with _LOCK:
+        _tsan.note_access("telemetry.server.routes", write=False)
+        best = None
+        for prefix, handler in _ROUTES.items():
+            if path.startswith(prefix) and (best is None or len(prefix) > len(best[0])):
+                best = (prefix, handler)
+    return best[1] if best is not None else None
 
 
 def _env():
@@ -206,6 +266,24 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(self, doc: Any, code: int = 200) -> None:
         self._send(code, json.dumps(doc, indent=1, default=str), "application/json")
 
+    def _dispatch_route(self, method: str, path: str, body: Optional[bytes]) -> bool:
+        """Try the registered extra routes; True when one handled it."""
+        handler = _route_for(path)
+        if handler is None:
+            return False
+        result = handler(method, path, body)
+        status, ctype, payload = result[0], result[1], result[2]
+        headers = result[3] if len(result) > 3 else None
+        data = payload.encode("utf-8") if isinstance(payload, str) else payload
+        self.send_response(int(status))
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(data)
+        return True
+
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
@@ -227,15 +305,34 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/statusz":
                 self._send_json(statusz_report())
             elif path == "/":
+                extra = " ".join(f"{p}..." for p in registered_routes())
                 self._send(
                     200,
                     "heat_tpu runtime introspection: "
-                    "/metrics /varz /healthz /trace /statusz\n",
+                    "/metrics /varz /healthz /trace /statusz"
+                    + (f" | mounted: {extra}" if extra else "")
+                    + "\n",
                     "text/plain",
                 )
+            elif self._dispatch_route("GET", self.path.split("?", 1)[0], None):
+                pass
             else:
                 self._send(404, f"unknown route {path!r}\n", "text/plain")
         except BrokenPipeError:  # scraper hung up mid-response; its problem
+            pass
+        except Exception as e:  # lint: allow H501(a handler bug must 500, never kill the serving thread)
+            try:
+                self._send(500, f"{type(e).__name__}: {e}\n", "text/plain")
+            except Exception:  # lint: allow H501(socket already gone)
+                pass
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if not self._dispatch_route("POST", self.path.split("?", 1)[0], body):
+                self._send(404, f"no POST route for {self.path!r}\n", "text/plain")
+        except BrokenPipeError:  # client hung up mid-response; its problem
             pass
         except Exception as e:  # lint: allow H501(a handler bug must 500, never kill the serving thread)
             try:

@@ -475,8 +475,14 @@ class Checkpointer:
                 json.dump(meta, f)
 
     def metadata(self, step: int) -> Optional[Dict]:
+        """Step metadata (``extra_metadata`` of the save), or None.
+
+        Checksum-verified like the step payload itself — the serving
+        model registry trusts this document for its listing, so a torn
+        metadata write must raise, not return garbage."""
         path = os.path.join(self.directory, f"meta_{step}.json")
         if os.path.exists(path):
+            _ratomic.verify_checksum(path)
             with open(path) as f:
                 return json.load(f)
         return None

@@ -32,6 +32,7 @@ MODULES = [
     ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601, H701-H705) (docs/static_analysis.md)"),
     ("Concurrency sanitizer", "heat_tpu.analysis.tsan", "runtime lock-order/unguarded-access sanitizer over the central LOCK_REGISTRY (HEAT_TPU_TSAN; docs/static_analysis.md)"),
     ("Elastic", "heat_tpu.elastic", "worker-loss detection, mesh reshape + cross-world resume supervision (docs/elasticity.md)"),
+    ("Serving", "heat_tpu.serving", "online inference: model registry + hot-load, request coalescing with pad-to-bucket dispatch, per-tenant admission control, /v1 HTTP endpoints (docs/serving.md)"),
     ("Lock registry", "heat_tpu.analysis.concurrency", "central registry of cross-thread locks and the structures they guard (the H7xx rules and the sanitizer share it)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),
     ("Linear algebra", "heat_tpu.core.linalg.basics", None),

@@ -46,6 +46,7 @@ NAV = [
         ("Dispatch layer", "docs/dispatch.md"),
         ("Resilience", "docs/resilience.md"),
         ("Elasticity", "docs/elasticity.md"),
+        ("Serving", "docs/serving.md"),
         ("Overlap layer", "docs/overlap.md"),
         ("Observability", "docs/observability.md"),
         ("Static analysis", "docs/static_analysis.md"),

@@ -479,6 +479,119 @@ def main():
 
     guarded("elastic_recovery", bench_elastic_recovery)
 
+    # online serving gates (ISSUE 9): a fitted KMeans saved, hot-loaded
+    # into an InferenceService, and driven under sustained concurrent
+    # load with an over-quota tenant shedding alongside.  Two absolute
+    # caps (max_seconds): serving_p99 — the in-quota tail latency under
+    # load (a recompile-per-request regression, a lost pad-to-bucket, or
+    # a sleep-polling coalescer all blow it by an order of magnitude) —
+    # and serving_overhead — the p50 stack tax of one request (admission
+    # + coalescer handoff + scatter) over the same rows predicted
+    # directly, which catches a lost warm path even when the tail gate
+    # stays green.  Both records also assert the cache property:
+    # steady-state new compiles must be 0.
+    def bench_serving_gates():
+        import shutil
+        import tempfile
+        import threading
+
+        from heat_tpu import serving as srv
+        from heat_tpu.core import dispatch
+        from heat_tpu.resilience import OverloadedError
+        from heat_tpu.serving import model_io
+
+        rows = np.random.default_rng(3).standard_normal((64, f)).astype(np.float32)
+        km = fit()
+        d = tempfile.mkdtemp(prefix="heat_tpu_ci_srv_")
+        svc = None
+        try:
+            srv.save_model(km, d, version=1, name="km")
+            svc = srv.InferenceService(max_delay_ms=1.0, max_batch=64)
+            svc.load("km", d)
+            for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+                svc.predict("km", rows[:b])
+
+            # stack overhead: p50 of a single warmed request through
+            # admission+coalescer+scatter vs the same padded rows
+            # predicted directly (the coalescer's own dispatch shape)
+            est = svc.registry.get("km")
+            direct, stacked = [], []
+            for _ in range(40):
+                t0 = time.perf_counter()
+                model_io.infer(est, ht.array(rows[:8], split=None)).numpy()
+                direct.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                svc.predict("km", rows[:8], timeout=30)
+                stacked.append(time.perf_counter() - t0)
+            overhead = float(np.median(stacked) - np.median(direct))
+
+            # sustained load: 4 client threads x 60 varied-size requests,
+            # one over-quota tenant hammering its token bucket alongside
+            svc.set_quota("noisy", rate=2.0, burst=4.0)
+            stop = threading.Event()
+            noisy_counts = {"ok": 0, "shed": 0}
+
+            def noisy():
+                while not stop.is_set():
+                    try:
+                        svc.predict("km", rows[:2], tenant="noisy", timeout=30)
+                        noisy_counts["ok"] += 1
+                    except OverloadedError:
+                        noisy_counts["shed"] += 1
+                    time.sleep(0.002)
+
+            sizes = (1, 3, 7, 12, 18, 27, 33, 50, 64)
+            lat_lock = threading.Lock()
+            latencies = []
+
+            def client(w):
+                for i in range(60):
+                    n = sizes[(w + i) % len(sizes)]
+                    t1 = time.perf_counter()
+                    svc.predict("km", rows[:n], timeout=30)
+                    dt = time.perf_counter() - t1
+                    with lat_lock:
+                        latencies.append(dt)
+
+            nt = threading.Thread(target=noisy, daemon=True)
+            s0 = dispatch.cache_stats()
+            nt.start()
+            t0 = time.perf_counter()
+            clients = [
+                threading.Thread(target=client, args=(w,), daemon=True)
+                for w in range(4)
+            ]
+            for t in clients:
+                t.start()
+            for t in clients:
+                t.join()
+            wall = time.perf_counter() - t0
+            stop.set()
+            nt.join()
+            s1 = dispatch.cache_stats()
+            lat = np.sort(np.asarray(latencies))
+            results["serving_p99"] = {
+                "seconds": round(float(lat[int(len(lat) * 0.99)]), 5),
+                "max_seconds": 0.25,
+                "p50_seconds": round(float(lat[len(lat) // 2]), 5),
+                "req_per_s": round(len(lat) / wall, 1),
+                "steady_state_new_compiles": s1["misses"] - s0["misses"],
+                "noisy_tenant_shed": noisy_counts["shed"],
+                "noisy_tenant_admitted": noisy_counts["ok"],
+            }
+            results["serving_overhead"] = {
+                "seconds": round(max(overhead, 0.0), 5),
+                "max_seconds": 0.05,
+                "stack_p50_s": round(float(np.median(stacked)), 5),
+                "direct_p50_s": round(float(np.median(direct)), 5),
+            }
+        finally:
+            if svc is not None:
+                svc.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+    guarded("serving_p99", bench_serving_gates)
+
     # sanitized test lane: the threaded test subset (test_overlap /
     # test_introspection / test_telemetry) in a subprocess under
     # HEAT_TPU_TSAN=1 — gated as a hard-cap count: red tests or ANY

@@ -12,6 +12,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import heat_tpu as ht
+from heat_tpu.core._compat import shard_map as _compat_shard_map
 
 
 @pytest.fixture(scope="module")
@@ -22,7 +23,7 @@ def comm():
 def _smap(comm, body, n_in=1, out=None):
     spec = P(comm.axis_name)
     return jax.jit(
-        jax.shard_map(
+        _compat_shard_map(
             body, mesh=comm.mesh, in_specs=(spec,) * n_in,
             out_specs=out if out is not None else spec,
         )
@@ -110,7 +111,7 @@ class TestHaloProgram:
 
         spec = P(comm.axis_name)
         got = jax.jit(
-            jax.shard_map(body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec)
+            _compat_shard_map(body, mesh=comm.mesh, in_specs=(spec,), out_specs=spec)
         )(x)
         blocks = np.asarray(got).reshape(p, 5)
         for r in range(p):

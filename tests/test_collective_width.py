@@ -14,6 +14,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import heat_tpu as ht
+from heat_tpu.core._compat import shard_map as _compat_shard_map
 
 
 @pytest.fixture(scope="module")
@@ -24,7 +25,7 @@ def comm():
 def _smap(comm, body, n_in=1, out=None):
     spec = P(comm.axis_name)
     return jax.jit(
-        jax.shard_map(
+        _compat_shard_map(
             body, mesh=comm.mesh, in_specs=(spec,) * n_in,
             out_specs=out if out is not None else spec,
         )
@@ -82,7 +83,7 @@ class TestPrefixSubAxis:
         nodes, per = comm.size // 2, 2
         x = jnp.arange(comm.size, dtype=jnp.float32)
 
-        body = jax.shard_map(
+        body = _compat_shard_map(
             lambda v: h.pscan(v, axis_name=nx),
             mesh=h.mesh,
             in_specs=(P((gx, nx)),),
