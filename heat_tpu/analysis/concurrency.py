@@ -61,6 +61,12 @@ LOCK_REGISTRY = {
         "structures": ("telemetry.spans.ring",),
         "doc": "the bounded span ring buffer: appended by span() from any thread, iterated by get_spans/chrome_trace_doc (the /trace route runs on an HTTP handler thread)",
     },
+    "telemetry.tracing.store": {
+        "file": "heat_tpu/telemetry/tracing.py",
+        "spellings": ("_STORE_LOCK",),
+        "structures": ("telemetry.tracing.store",),
+        "doc": "the tail-sampled trace store: in-flight trace table mutations (begin/finish on request threads) and the recent/slowest/error retention structures (snapshots from /tracez handler threads and the crash excepthook); per-trace span lists are unregistered leaf structures appended lock-free (GIL-atomic list.append, dict read-only) on the serving hot path — like the per-metric value locks",
+    },
     "telemetry.server": {
         "file": "heat_tpu/telemetry/server.py",
         "spellings": ("_LOCK",),

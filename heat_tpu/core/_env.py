@@ -62,6 +62,9 @@ KNOBS = {
     # -- telemetry (heat_tpu/telemetry, docs/observability.md) ----------
     "HEAT_TPU_TRACE": ("bool", "1", "host-side span recording (0 = span() costs two attribute reads and records nothing)"),
     "HEAT_TPU_TRACE_RING": ("int", "4096", "span ring-buffer capacity (newest spans win)"),
+    "HEAT_TPU_TRACE_KEEP": ("int", "32", "tail-sampled trace store: complete span trees retained per class (recent / slowest / shed+errored) after the span ring rotates (/tracez)"),
+    "HEAT_TPU_TRACE_MAX_SPANS": ("int", "256", "span cap per retained trace in the tail store (extra spans are counted as dropped, never unbounded)"),
+    "HEAT_TPU_TRACE_EXEMPLARS": ("bool", "1", "histogram exemplars: stage/latency histogram buckets remember the most recent trace_id that landed in them (OpenMetrics exemplar syntax on /metrics)"),
     "HEAT_TPU_METRICS_DUMP": ("path", "", "write the final metrics snapshot as JSON to this path at process exit"),
     "HEAT_TPU_HTTP_PORT": ("int", "0", "serve the runtime-introspection HTTP endpoint (/metrics /varz /healthz /trace /statusz) on this port (0 = off)"),
     "HEAT_TPU_HEALTH_MAX_AGE_S": ("float", "0", "/healthz flips unhealthy when the fit heartbeat is older than this many seconds (0 = staleness check off)"),

@@ -25,6 +25,19 @@ Lock discipline (sanitized by the TSAN lane): the queue is only touched
 under the registered ``serving.coalescer`` lock via its Condition; the
 inference itself — the blocking part — always runs *outside* the lock,
 so enqueues never stall behind XLA.
+
+**Request tracing** (:mod:`heat_tpu.telemetry.tracing`): ``submit()``
+captures the caller's trace context into the request; the batch's
+``serve.batch``/``serve.pad``/``serve.scatter`` (plus the service's
+dispatch/execute) spans run under the *primary* (first traced) request's
+context across the thread hop.  Per-request bookkeeping — the
+``serve.coalesce_wait`` span for the time in queue, and mirroring the
+batch records into co-batched traces — happens on each *woken caller*,
+never on the batcher thread: the batcher is the throughput bottleneck
+and pays only per-batch tracing work, while callers do their own
+accounting in time they would have spent blocked anyway.  One slow
+``/v1/predict`` therefore shows its whole pipeline in ``/tracez``
+whichever batch slot it rode in.
 """
 
 from __future__ import annotations
@@ -39,9 +52,12 @@ from ..analysis import tsan as _tsan
 from ..core import dispatch as _dispatch
 from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
-from ..telemetry.spans import span as _span
+from ..telemetry import tracing as _tracing
+from ..telemetry.spans import clear_notes as _clear_notes
+from ..telemetry.spans import flush_notes as _flush_notes
+from ..telemetry.spans import stage_note as _stage_note
 
-__all__ = ["ModelBatcher"]
+__all__ = ["ModelBatcher", "observe_stage"]
 
 _BATCHES_C = _tm.counter("serving.batches", "coalesced inference dispatches")
 _BATCH_ROWS_H = _tm.histogram(
@@ -51,9 +67,35 @@ _PAD_ROWS_C = _tm.counter(
     "serving.pad_rows", "bucket-padding rows dispatched (wasted compute rows)"
 )
 
+#: per-stage latency decomposition of one served request — the
+#: histograms that replace eyeballing a single end-to-end number.
+#: Exemplars (most recent trace_id per bucket) connect each bucket to a
+#: retained trace in /tracez.
+_STAGES = ("admission", "coalesce", "pad", "dispatch", "execute", "scatter")
+_STAGE_H = {
+    s: _tm.histogram(
+        f"serving.stage.{s}_ms",
+        f"per-request serving latency decomposition: the {s} stage",
+    )
+    for s in _STAGES
+}
+
+
+def observe_stage(stage: str, ms: float, trace_id: Optional[str] = None) -> None:
+    """Observe one serving-stage duration, exemplared with the given (or
+    the ambient) trace id when exemplars are enabled."""
+    if trace_id is None:
+        trace_id = _tracing.current_trace_id()
+    # direct module-flag read: this runs up to 6x per request
+    _STAGE_H[stage].observe(
+        ms, exemplar=trace_id if (trace_id and _tracing._EXEMPLARS) else None
+    )
+
 
 class _Request:
-    __slots__ = ("rows", "n", "event", "result", "error", "enqueued_at")
+    __slots__ = ("rows", "n", "event", "result", "error", "enqueued_at",
+                 "enqueued_ns", "ctx", "taken_ns", "primary_trace_id",
+                 "batch_records")
 
     def __init__(self, rows: np.ndarray):
         self.rows = rows
@@ -62,6 +104,15 @@ class _Request:
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.monotonic()
+        self.enqueued_ns = time.perf_counter_ns()  # span clock for coalesce_wait
+        self.ctx = _tracing.current_context()  # caller -> batcher handoff
+        # stamped by the batcher, consumed by the caller after wake-up:
+        # the caller records its own coalesce_wait span and mirrors the
+        # batch's raw note batch into its trace, so the batcher thread —
+        # the throughput bottleneck — pays no per-request tracing work
+        self.taken_ns: Optional[int] = None
+        self.primary_trace_id: Optional[str] = None
+        self.batch_records: Optional[tuple] = None
 
 
 class ModelBatcher:
@@ -90,6 +141,7 @@ class ModelBatcher:
         self._queued_rows = 0
         self._open = True
         self.last_batch_ts = 0.0
+        self.last_batch_trace_id: Optional[str] = None
         self._lock = _tsan.register_lock("serving.coalescer")
         self._cond = threading.Condition(self._lock)
         self._thread = threading.Thread(
@@ -128,6 +180,20 @@ class ModelBatcher:
             raise TimeoutError(
                 f"predict on model {self.name!r} timed out after {timeout}s"
             )
+        if req.ctx is not None and req.taken_ns is not None:
+            # trace bookkeeping runs HERE, on the woken caller (its trace
+            # context is still ambient), never on the batcher thread: the
+            # caller notes its queue wait (materialized when its request
+            # root flushes) and — when it rode another request's batch —
+            # mirrors the shared batch records into its own trace
+            wait_ns = req.taken_ns - req.enqueued_ns
+            _stage_note(
+                "serve.coalesce_wait", req.enqueued_ns, wait_ns,
+                model=self.name, rows=req.n,
+            )
+            observe_stage("coalesce", wait_ns / 1e6, req.ctx.trace_id)
+            if req.batch_records is not None and req.ctx.trace_id != req.primary_trace_id:
+                _tracing.link_batch([req.ctx.trace_id], req.batch_records)
         if req.error is not None:
             raise req.error
         return req.result
@@ -186,26 +252,57 @@ class ModelBatcher:
                 self._execute(batch)  # outside the lock: XLA must not block enqueues
 
     def _execute(self, batch: List[_Request]) -> None:
+        taken_ns = time.perf_counter_ns()
+        for r in batch:
+            r.taken_ns = taken_ns  # callers derive their queue wait
         try:
             _inject("serve.batch", model=self.name)
             n = sum(r.n for r in batch)
             bucket = _dispatch.batch_bucket(n, self.max_batch)
-            rows = np.concatenate([r.rows for r in batch], axis=0)
-            if bucket > n:
-                pad = np.zeros((bucket - n,) + rows.shape[1:], rows.dtype)
-                rows = np.concatenate([rows, pad], axis=0)
-            with _span("serve.batch", model=self.name, rows=n, bucket=bucket):
+            n_traced = sum(1 for r in batch if r.ctx is not None)
+            primary = next((r.ctx for r in batch if r.ctx is not None), None)
+            ptid = primary.trace_id if primary is not None else None
+            # batch-level stages (pad/dispatch/execute/scatter and the
+            # batch envelope) are NOTED under the primary request's
+            # context and materialized in one flush; the woken callers
+            # mirror the records into their co-batched traces (see
+            # submit()), so each retained trace is complete while the
+            # batcher thread pays only one buffered append per stage
+            with _tracing.use_context(primary):
+                tb0 = time.perf_counter_ns()
+                rows = np.concatenate([r.rows for r in batch], axis=0)
+                if bucket > n:
+                    pad = np.zeros((bucket - n,) + rows.shape[1:], rows.dtype)
+                    rows = np.concatenate([rows, pad], axis=0)
+                t1 = time.perf_counter_ns()
+                _stage_note("serve.pad", tb0, t1 - tb0, rows=n, bucket=bucket)
+                observe_stage("pad", (t1 - tb0) / 1e6, ptid)
                 out = np.asarray(self._infer_fn(rows))
+                t0 = time.perf_counter_ns()
+                off = 0
+                for r in batch:
+                    r.result = out[off : off + r.n]
+                    off += r.n
+                t1 = time.perf_counter_ns()
+                _stage_note("serve.scatter", t0, t1 - t0, requests=len(batch))
+                observe_stage("scatter", (t1 - t0) / 1e6, ptid)
+                _stage_note(
+                    "serve.batch", tb0, t1 - tb0,
+                    model=self.name, rows=n, bucket=bucket, traces=n_traced,
+                )
+                records = _flush_notes()
             _BATCHES_C.inc()
             _BATCH_ROWS_H.observe(n)
             _PAD_ROWS_C.inc(bucket - n)
             self.last_batch_ts = time.time()
-            off = 0
+            self.last_batch_trace_id = ptid
+            # wake the callers only after every shared field is in place
             for r in batch:
-                r.result = out[off : off + r.n]
-                off += r.n
+                r.primary_trace_id = ptid
+                r.batch_records = records
                 r.event.set()
         except BaseException as e:  # lint: allow H501(per-request error delivery; the batcher thread must survive)
+            _clear_notes()  # a failed batch must not leak notes into the next
             for r in batch:
                 if not r.event.is_set():
                     r.error = e

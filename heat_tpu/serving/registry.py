@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 from ..analysis import tsan as _tsan
 from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
+from ..telemetry import tracing as _tracing
 from ..telemetry.spans import span as _span
 from . import model_io as _mio
 
@@ -176,13 +177,15 @@ class ModelRegistry:
         :class:`PendingLoad` handle."""
         self.wait()  # back-pressure (<=1 in flight) + error surface
         handle = PendingLoad(name)
+        ctx = _tracing.current_context()  # caller -> loader-thread handoff
 
         def _run():
             try:
-                handle.version = self.load(
-                    name, directory, version=version, template=template,
-                    comm=comm, activate=activate,
-                )
+                with _tracing.use_context(ctx):
+                    handle.version = self.load(
+                        name, directory, version=version, template=template,
+                        comm=comm, activate=activate,
+                    )
             except BaseException as e:  # lint: allow H501(loader error surfaced at handle.wait/next load/close)
                 handle.error = e
                 with self._lock:

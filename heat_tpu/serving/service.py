@@ -7,8 +7,18 @@ draws: a request enters through :meth:`~InferenceService.predict`
 :class:`~heat_tpu.resilience.errors.OverloadedError`/429, never
 queued-to-collapse), lands in its model's **coalescer** queue, rides a
 padded **bucket** batch through the executable cache, and returns with
-its slice of the batch result; end-to-end latency lands in the
-``serving.latency_ms`` histogram (p50/p99 on ``/metrics``).
+its slice of the batch result.
+
+Every request runs under a **trace**
+(:mod:`heat_tpu.telemetry.tracing`): one ``trace_id`` stamps the
+``serve.request`` root, the per-stage spans (admission → coalesce_wait →
+pad → dispatch → execute → scatter, across the request and batcher
+threads), and any nested compile/comm spans.  End-to-end latency lands
+in ``serving.latency_ms`` and each stage in its
+``serving.stage.{stage}_ms`` histogram — bucket exemplars carry the
+most recent trace_id, so a ``/metrics`` latency bucket links to the
+concrete request retained in ``/tracez``; shed and errored requests are
+always retained there.
 
 HTTP surface (mounted on the telemetry introspection server through
 :func:`~heat_tpu.telemetry.server.register_route` — one process, one
@@ -45,8 +55,10 @@ from ..resilience.errors import OverloadedError
 from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
 from ..telemetry import server as _tserver
+from ..telemetry import tracing as _tracing
+from ..telemetry.spans import stage_note as _stage_note
 from .admission import AdmissionController
-from .coalescer import ModelBatcher
+from .coalescer import ModelBatcher, observe_stage
 from .model_io import infer as _infer
 from .registry import ModelRegistry
 
@@ -154,12 +166,29 @@ class InferenceService:
             return b
 
     def _infer_batch(self, name: str, rows: np.ndarray) -> np.ndarray:
-        """One coalesced inference on the ACTIVE version (batcher thread)."""
+        """One coalesced inference on the ACTIVE version (batcher thread,
+        under the primary request's trace context).  Decomposed into the
+        ``dispatch`` stage (DNDarray wrap + program dispatch — any
+        compile span nests here and inherits the trace) and the
+        ``execute`` stage (forcing the result: device compute + fetch)."""
         from ..core import factories
 
         est = self.registry.get(name)
+        tid = _tracing.current_trace_id()
+        t0 = time.perf_counter_ns()
+        # the ambient trace context is live here, so a cold bucket's
+        # dispatch.compile span inherits the request that paid for it
         x = factories.array(rows, split=self.split, comm=self.registry.comm)
-        return _infer(est, x).numpy()
+        y = _infer(est, x)
+        t1 = time.perf_counter_ns()
+        _stage_note("serve.dispatch", t0, t1 - t0, model=name, rows=int(rows.shape[0]))
+        observe_stage("dispatch", (t1 - t0) / 1e6, tid)
+        t0 = time.perf_counter_ns()
+        out = y.numpy()
+        t1 = time.perf_counter_ns()
+        _stage_note("serve.execute", t0, t1 - t0, model=name)
+        observe_stage("execute", (t1 - t0) / 1e6, tid)
+        return out
 
     def predict(
         self,
@@ -173,24 +202,59 @@ class InferenceService:
 
         Raises :class:`OverloadedError` when shed, ``KeyError`` for an
         unknown model, the batch's error when its dispatch failed."""
+        out, _info = self._predict(name, rows, tenant=tenant, timeout=timeout)
+        return out
+
+    def _predict(
+        self,
+        name: str,
+        rows,
+        tenant: str = "default",
+        timeout: Optional[float] = None,
+    ):
+        """The traced predict path: returns ``(out, info)`` where
+        ``info`` carries the request's ``trace_id`` and its measured
+        ``latency_ms`` — the ONE timing source both the
+        ``serving.latency_ms`` histogram and the HTTP response report
+        (the route must never re-time the request independently)."""
         rows = np.asarray(rows)
         if rows.ndim == 1:
             rows = rows[None, :]
         _inject("serve.predict", model=name, rows=int(rows.shape[0]))
-        t0 = time.perf_counter()
         n = int(rows.shape[0])
-        self.admission.admit(tenant, n)
-        try:
-            out = self._batcher(name).submit(rows, timeout=timeout)
-        finally:
-            self.admission.release(n)
-        _LATENCY_H.observe((time.perf_counter() - t0) * 1e3)
-        return out
+        req = _tracing.request_span(
+            f"/v1/predict/{name}", model=name, tenant=tenant, rows=n
+        )
+        with req:
+            t0 = time.perf_counter_ns()
+            try:
+                self.admission.admit(tenant, n)
+            finally:
+                t1 = time.perf_counter_ns()
+                _stage_note(
+                    "serve.admission", t0, t1 - t0, tenant=tenant, rows=n
+                )
+            observe_stage("admission", (t1 - t0) / 1e6, req.trace_id)
+            try:
+                out = self._batcher(name).submit(rows, timeout=timeout)
+            finally:
+                self.admission.release(n)
+        _LATENCY_H.observe(
+            req.duration_ms,
+            exemplar=req.trace_id
+            if (req.trace_id and _tracing.exemplars_enabled())
+            else None,
+        )
+        return out, {"trace_id": req.trace_id, "latency_ms": req.duration_ms}
 
     # -- per-model health ----------------------------------------------
     def model_health(self, name: str) -> Dict[str, Any]:
         """``(healthy, doc)`` folded into one doc with a ``healthy``
-        key: loaded version, batcher liveness, queue depth."""
+        key: loaded version, batcher liveness, queue depth, last-batch
+        timestamp + trace_id — enough for an operator to tell "idle"
+        (no queue, old batch) from "stuck" (deep queue, old batch) and
+        to jump from a stuck model straight to its last served trace in
+        ``/tracez``, without scraping ``/varz``."""
         rec = self.registry.record(name)  # KeyError -> 404 upstream
         with self._lock:
             _tsan.note_access("serving.service.state", write=False)
@@ -206,11 +270,16 @@ class InferenceService:
             "world_size_written": rec["world_size_written"],
             "world_size_serving": rec["world_size_serving"],
             "queued_rows": b.queued_rows() if b is not None else 0,
+            "admitted_rows_in_flight": self.admission.depth(),
+            "last_batch_ts": (
+                b.last_batch_ts if b is not None and b.last_batch_ts > 0 else None
+            ),
             "last_batch_age_s": (
                 round(now - b.last_batch_ts, 3)
                 if b is not None and b.last_batch_ts > 0
                 else None
             ),
+            "last_batch_trace_id": b.last_batch_trace_id if b is not None else None,
         }
         if b is None:
             doc["status"] = "idle"  # loaded, no traffic yet — healthy
@@ -278,8 +347,10 @@ class InferenceService:
         name = doc["model"]
         rows = np.asarray(doc["inputs"], dtype=np.float32)
         tenant = str(doc.get("tenant", "default"))
-        t0 = time.perf_counter()
-        out = self.predict(
+        # one timing source: the latency (and trace id) the response
+        # reports IS the measurement serving.latency_ms observed — the
+        # route never re-times the request independently
+        out, info = self._predict(
             name, rows, tenant=tenant, timeout=doc.get("timeout")
         )
         version = self.registry.active_version(name)
@@ -289,7 +360,8 @@ class InferenceService:
                 "version": version,
                 "n": int(np.asarray(out).shape[0]),
                 "predictions": np.asarray(out).tolist(),
-                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                "latency_ms": round(info["latency_ms"], 3),
+                "trace_id": info["trace_id"],
             }
         )
 

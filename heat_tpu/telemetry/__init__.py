@@ -51,6 +51,7 @@ import os
 from typing import Any, Dict, Optional
 
 from . import metrics
+from . import tracing
 from . import spans
 from . import profiling
 from . import aggregate
@@ -75,9 +76,19 @@ from .spans import (
     clear_spans,
     export_chrome_trace,
     get_spans,
+    record_span,
     set_tracing,
     span,
     tracing_enabled,
+)
+from .tracing import (
+    TraceContext,
+    bind_context,
+    current_context,
+    current_trace_id,
+    request_span,
+    tracez_report,
+    use_context,
 )
 from .profiling import annotate, monitor, start_trace, stop_trace, trace
 from .aggregate import (
@@ -96,10 +107,14 @@ __all__ = [
     "MetricsRegistry",
     "REGISTRY",
     "SpanRecord",
+    "TraceContext",
     "annotate",
+    "bind_context",
     "chrome_trace_doc",
     "clear_spans",
     "counter",
+    "current_context",
+    "current_trace_id",
     "dump_bundle",
     "dump_json",
     "expose",
@@ -110,6 +125,8 @@ __all__ = [
     "histogram",
     "merge_snapshots",
     "monitor",
+    "record_span",
+    "request_span",
     "reset_all",
     "set_tracing",
     "snapshot",
@@ -121,7 +138,9 @@ __all__ = [
     "summary_line",
     "tag_snapshot",
     "trace",
+    "tracez_report",
     "tracing_enabled",
+    "use_context",
     "write_worker_snapshot",
 ]
 
@@ -136,9 +155,10 @@ _DOMAIN_PREFIXES = {
     "comm": ("comm.",),
     "fit": ("fit.",),
     "spans": ("spans.",),
+    "tracing": ("tracing.",),
     "flight": ("flight.",),
     "checkpoint": ("checkpoint.",),
-    "telemetry": ("spans.", "fit.", "telemetry.", "flight.", "checkpoint."),
+    "telemetry": ("spans.", "tracing.", "fit.", "telemetry.", "flight.", "checkpoint."),
 }
 
 
@@ -146,15 +166,17 @@ def reset_all(domain: Optional[str] = None) -> None:
     """Zero telemetry state in one call.
 
     With no argument: every registered metric (dispatch, resilience,
-    overlap, comm, fit, ...) AND the span ring buffer — the single
-    replacement for the four legacy reset conventions.  With a domain
-    name (``"dispatch"``, ``"resilience"``, ``"overlap"``, ``"comm"``,
-    ...), only that island's metrics; the legacy ``reset_stats`` /
+    overlap, comm, fit, ...) AND the span ring buffer AND the tail-
+    sampled trace store — the single replacement for the four legacy
+    reset conventions.  With a domain name (``"dispatch"``,
+    ``"resilience"``, ``"overlap"``, ``"comm"``, ...), only that
+    island's metrics; the legacy ``reset_stats`` /
     ``reset_fault_stats`` / ``reset_retry_stats`` /
     ``reset_overlap_stats`` functions delegate here per-domain."""
     if domain is None:
         metrics.reset(None)
         spans.clear_spans()
+        tracing.reset_store()
         return
     prefixes = _DOMAIN_PREFIXES.get(domain)
     if prefixes is None:
@@ -165,6 +187,8 @@ def reset_all(domain: Optional[str] = None) -> None:
         metrics.reset(p)
     if domain in ("spans", "telemetry"):
         spans.clear_spans()
+    if domain in ("tracing", "telemetry"):
+        tracing.reset_store()
 
 
 def summary_line(iter_rate: Optional[float] = None) -> str:

@@ -44,12 +44,14 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from . import metrics as _metrics
 from . import spans as _spans
+from . import tracing as _tracing
 
 __all__ = [
     "gather_snapshots",
     "merge_snapshots",
     "read_worker_snapshots",
     "span_stats",
+    "stitch_traces",
     "straggler_score",
     "tag_snapshot",
     "write_worker_snapshot",
@@ -100,7 +102,14 @@ def span_stats() -> Dict[str, Dict[str, float]]:
 
 
 def tag_snapshot() -> Dict[str, Any]:
-    """The local registry snapshot tagged with this worker's identity."""
+    """The local registry snapshot tagged with this worker's identity.
+
+    Carries, besides the metrics and the per-span-name digest, the tail
+    store's compact **trace digests** (``tracing.trace_digest()``) — the
+    per-worker half of cross-worker trace stitching: one global request
+    fans out into per-process local work (PAPER.md L1/L5), and a merged
+    view can reassemble it only if every worker ships its view of each
+    ``trace_id``."""
     import time
 
     return {
@@ -110,6 +119,7 @@ def tag_snapshot() -> Dict[str, Any]:
         "timestamp": time.time(),
         "metrics": _metrics.snapshot(),
         "span_stats": span_stats(),
+        "traces": _tracing.trace_digest(),
     }
 
 
@@ -235,6 +245,52 @@ def straggler_score(chunk_means_ms: Sequence[float]) -> float:
     return (vals[-1] - mid) / mid
 
 
+def stitch_traces(snapshots: Sequence[Dict]) -> Dict[str, Any]:
+    """Reassemble request traces across workers by ``trace_id``.
+
+    Pure and deterministic: for every trace_id any worker's snapshot
+    carries, the stitched entry lists each worker's view (span count,
+    duration, stage breakdown) keyed by ``process_index``, the union
+    span/thread counts, the worst status (``error`` > ``shed`` > ``ok``
+    > ``active``), and the max duration — one global operation's
+    per-process local work folded back into one record."""
+    rank = {"error": 3, "shed": 2, "ok": 1, "active": 0}
+    stitched: Dict[str, Dict[str, Any]] = {}
+    for s in sorted(snapshots, key=lambda s: int(s.get("process_index", 0))):
+        ix = str(int(s.get("process_index", 0)))
+        for d in s.get("traces") or []:
+            tid = d.get("trace_id")
+            if not tid:
+                continue
+            e = stitched.setdefault(
+                tid,
+                {
+                    "trace_id": tid,
+                    "route": d.get("route"),
+                    "status": d.get("status"),
+                    "workers": {},
+                    "span_count": 0,
+                    "thread_count": 0,
+                    "duration_ms": None,
+                },
+            )
+            e["workers"][ix] = {
+                "status": d.get("status"),
+                "duration_ms": d.get("duration_ms"),
+                "n_spans": d.get("n_spans", 0),
+                "n_threads": d.get("n_threads", 0),
+                "stages": d.get("stages", {}),
+            }
+            if rank.get(d.get("status"), 0) > rank.get(e["status"], 0):
+                e["status"] = d.get("status")
+            e["span_count"] += int(d.get("n_spans", 0))
+            e["thread_count"] += int(d.get("n_threads", 0))
+            dur = d.get("duration_ms")
+            if dur is not None and (e["duration_ms"] is None or dur > e["duration_ms"]):
+                e["duration_ms"] = dur
+    return dict(sorted(stitched.items()))
+
+
 def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str, Any]:
     """Fold worker-tagged snapshots into one deterministic labeled view.
 
@@ -244,7 +300,9 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
       digest (summing a gauge like ``fit.iter_rate`` would be a lie);
     * ``skew`` — the straggler/spread/imbalance gauges described in the
       module docstring, each also published into the local registry
-      (``publish=False`` for a pure computation).
+      (``publish=False`` for a pure computation);
+    * ``traces`` — request traces stitched across workers by trace_id
+      (:func:`stitch_traces`).
 
     Determinism: output depends only on the input snapshots; workers are
     ordered by ``process_index`` and every dict is key-sorted."""
@@ -332,4 +390,5 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
         "workers": dict(sorted(workers.items())),
         "merged": merged_values,
         "skew": skew,
+        "traces": stitch_traces(snaps),
     }

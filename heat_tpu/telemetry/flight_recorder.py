@@ -15,6 +15,9 @@ One bundle carries everything the post-mortem needs::
     exception   type / message / formatted traceback
     metrics     full registry snapshot (comm bytes, compile time, ...)
     spans       the span ring (what the process was doing, in order)
+    traces      the tail-sampled trace store: requests IN FLIGHT at
+                crash time (full span trees) + retained slow/shed/error
+                traces (see docs/observability.md, /tracez)
     knobs       every registered HEAT_TPU_* knob's effective value
     dispatch    cache stats + keys + per-executable cost accounting
     checkpoint  last durable step (where a resume would restart)
@@ -44,6 +47,7 @@ from typing import Any, Dict, Optional
 from ..analysis import tsan as _tsan
 from . import metrics as _metrics
 from . import spans as _spans
+from . import tracing as _tracing
 
 __all__ = [
     "BUNDLE_SCHEMA",
@@ -178,10 +182,23 @@ def _span_dump() -> list:
             "duration_ns": r.duration_ns,
             "thread_id": r.thread_id,
             "depth": r.depth,
+            "trace_id": r.trace_id,
+            "span_id": r.span_id,
+            "parent_id": r.parent_id,
             "attrs": {k: str(v) for k, v in r.attrs.items()},
         }
         for r in _spans.get_spans()
     ]
+
+
+def _traces_state() -> Optional[Dict[str, Any]]:
+    """The tail store at crash time — the requests in flight (full span
+    trees: what the process was *serving* when it died) plus the
+    retained recent/slowest/shed-or-errored classes."""
+    try:
+        return _tracing.traces_snapshot()
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
 
 
 def _elastic_state() -> Optional[Dict[str, Any]]:
@@ -212,6 +229,7 @@ def build_bundle(
         "knobs": _knob_values(),
         "metrics": _metrics.snapshot(),
         "spans": _span_dump(),
+        "traces": _traces_state(),
         "dispatch": _dispatch_state(),
         "checkpoint": {
             "last_step": int(_metrics.gauge("checkpoint.last_step").value)

@@ -96,6 +96,30 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
     if not spans:
         lines.append("(span ring empty)")
 
+    traces = doc.get("traces") or {}
+    active = traces.get("active") or []
+    t_errors = traces.get("errors") or []
+    if active or t_errors:
+        lines.append(_rule(
+            f"request traces ({len(active)} in flight, {len(t_errors)} shed/errored retained)"
+        ))
+        for tr in active[:5]:
+            lines.append(
+                f"IN FLIGHT {tr.get('trace_id')} {tr.get('route')} — "
+                f"{tr.get('n_spans')} spans on {tr.get('n_threads')} thread(s)"
+            )
+            for sp in (tr.get("spans") or [])[-8:]:
+                lines.append(
+                    f"    {sp.get('name')}  {sp.get('duration_ms')} ms"
+                    + (f"  [t{sp.get('thread_id')}]" if sp.get("thread_id") else "")
+                )
+        for tr in t_errors[-5:]:
+            lines.append(
+                f"{str(tr.get('status', '?')).upper()} {tr.get('trace_id')} "
+                f"{tr.get('route')} — {tr.get('duration_ms')} ms, "
+                f"{tr.get('n_spans')} spans"
+            )
+
     metrics = doc.get("metrics") or {}
     nonzero = {
         k: v

@@ -134,9 +134,18 @@ class Histogram:
     inside the crossing bucket, clamped to the exact observed [min, max].
     Non-positive observations land in a dedicated low bucket valued at
     the observed minimum (durations are the intended payload; zeros
-    happen on sub-resolution clocks)."""
+    happen on sub-resolution clocks).
 
-    __slots__ = ("name", "doc", "_buckets", "_low", "_count", "_sum", "_min", "_max", "_lock")
+    ``observe(v, exemplar=trace_id)`` additionally makes the bucket ``v``
+    lands in remember that trace id (most recent wins) — an OpenMetrics
+    **exemplar**, the link from an aggregate latency bucket back to one
+    concrete request retained in the ``/tracez`` tail store.  Exemplars
+    cost one dict write per exemplared observation and nothing
+    otherwise; :func:`MetricsRegistry.expose` renders histograms that
+    carry them in OpenMetrics bucket syntax."""
+
+    __slots__ = ("name", "doc", "_buckets", "_low", "_count", "_sum", "_min",
+                 "_max", "_exemplars", "_lock")
 
     def __init__(self, name: str, doc: str = ""):
         self.name = name
@@ -147,9 +156,11 @@ class Histogram:
         self._sum = 0.0
         self._min = float("inf")
         self._max = float("-inf")
+        # bucket index (-1 = low bucket) -> (value, trace_id, unix_ts)
+        self._exemplars: Dict[int, Tuple[float, str, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: Number) -> None:
+    def observe(self, v: Number, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             self._count += 1
@@ -159,10 +170,43 @@ class Histogram:
             if v > self._max:
                 self._max = v
             if v <= _BOUNDS[0]:
+                ix = -1
                 self._low += 1
             else:
                 ix = bisect.bisect_left(_BOUNDS, v)
                 self._buckets[ix] = self._buckets.get(ix, 0) + 1
+            if exemplar is not None:
+                self._exemplars[ix] = (v, str(exemplar), time.time())
+
+    def exemplars(self) -> Dict[float, Dict[str, Any]]:
+        """Per-bucket exemplars keyed by the bucket's upper bound:
+        ``{le: {"value", "trace_id", "ts"}}`` (empty when none were
+        recorded)."""
+        with self._lock:
+            items = dict(self._exemplars)
+        return {
+            (_BOUNDS[0] if ix < 0 else _BOUNDS[ix]): {
+                "value": val, "trace_id": tid, "ts": ts
+            }
+            for ix, (val, tid, ts) in sorted(items.items())
+        }
+
+    def _bucket_rows(self) -> List[Tuple[float, int, Optional[Tuple[float, str, float]]]]:
+        """Cumulative ``(le, count, exemplar)`` rows over the touched
+        buckets (the OpenMetrics exposition shape)."""
+        with self._lock:
+            buckets = dict(self._buckets)
+            low = self._low
+            ex = dict(self._exemplars)
+        rows: List[Tuple[float, int, Optional[Tuple[float, str, float]]]] = []
+        cum = 0
+        if low:
+            cum += low
+            rows.append((_BOUNDS[0], cum, ex.get(-1)))
+        for ix in sorted(buckets):
+            cum += buckets[ix]
+            rows.append((_BOUNDS[ix], cum, ex.get(ix)))
+        return rows
 
     @property
     def count(self) -> int:
@@ -206,7 +250,7 @@ class Histogram:
             return min(max(val, self._min), self._max)
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        doc = {
             "count": self.count,
             "sum": round(self.sum, 6),
             "min": self.min,
@@ -215,6 +259,10 @@ class Histogram:
             "p90": self.quantile(0.9),
             "p99": self.quantile(0.99),
         }
+        ex = self.exemplars()
+        if ex:
+            doc["exemplars"] = {f"{le:g}": rec for le, rec in ex.items()}
+        return doc
 
     def reset(self) -> None:
         with self._lock:
@@ -224,6 +272,7 @@ class Histogram:
             self._sum = 0.0
             self._min = float("inf")
             self._max = float("-inf")
+            self._exemplars.clear()
 
 
 class MetricsRegistry:
@@ -330,7 +379,13 @@ class MetricsRegistry:
         """Prometheus text exposition of every metric.
 
         Counters/gauges emit one sample; histograms emit a summary
-        (quantile-labeled samples plus ``_sum``/``_count``).  Metric
+        (quantile-labeled samples plus ``_sum``/``_count``) — except
+        histograms carrying **exemplars**, which emit OpenMetrics
+        histogram syntax instead (cumulative ``_bucket{le=...}`` samples
+        over the touched buckets, each annotated
+        ``# {trace_id="..."} value timestamp`` with the most recent
+        trace that landed in it), so a scraper can jump from a latency
+        bucket straight to the retained trace in ``/tracez``.  Metric
         names are sanitized to the Prometheus charset with a
         ``heat_tpu_`` namespace prefix."""
         lines: List[str] = []
@@ -347,6 +402,18 @@ class MetricsRegistry:
             elif isinstance(m, Gauge):
                 lines.append(f"# TYPE {pname} gauge")
                 lines.append(f"{pname} {m.value}")
+            elif m.exemplars():
+                lines.append(f"# TYPE {pname} histogram")
+                rows = m._bucket_rows()
+                for le, cum, ex in rows:
+                    sample = f'{pname}_bucket{{le="{le:g}"}} {cum}'
+                    if ex is not None:
+                        val, tid, ts = ex
+                        sample += f' # {{trace_id="{tid}"}} {val:g} {ts:.3f}'
+                    lines.append(sample)
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{pname}_sum {m.sum}")
+                lines.append(f"{pname}_count {m.count}")
             else:
                 lines.append(f"# TYPE {pname} summary")
                 for q in (0.5, 0.9, 0.99):

@@ -13,7 +13,12 @@ route     payload
 /healthz  liveness: fit-heartbeat age + last checkpoint step; HTTP 503
           when the heartbeat is stale (``HEAT_TPU_HEALTH_MAX_AGE_S``)
 /trace    Chrome trace-event JSON of the span ring (load the response
-          body in chrome://tracing or https://ui.perfetto.dev)
+          body in chrome://tracing or https://ui.perfetto.dev) — spans
+          carrying a request trace_id draw as connected flow arrows
+/tracez   tail-sampled request traces per route (recent / slowest /
+          shed+errored) with a per-stage latency table; HTML by
+          default, ``?format=json`` for the machine form, and
+          ``?trace_id=<id>`` for one trace's full span tree
 /statusz  build/runtime info: every registered env knob's effective
           value, dispatch cache keys + hit rate + per-executable cost
           accounting, jax/device/version info
@@ -46,6 +51,7 @@ from typing import Any, Dict, Optional, Tuple
 from ..analysis import tsan as _tsan
 from . import metrics as _metrics
 from . import spans as _spans
+from . import tracing as _tracing
 
 __all__ = [
     "IntrospectionServer",
@@ -302,6 +308,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(doc, 200 if healthy else 503)
             elif path == "/trace":
                 self._send_json(_spans.chrome_trace_doc())
+            elif path == "/tracez":
+                query = self.path.split("?", 1)[1] if "?" in self.path else ""
+                params = dict(
+                    kv.split("=", 1) for kv in query.split("&") if "=" in kv
+                )
+                if "trace_id" in params:
+                    doc = _tracing.get_trace(params["trace_id"])
+                    if doc is None:
+                        self._send_json(
+                            {"error": f"trace {params['trace_id']!r} not retained"},
+                            404,
+                        )
+                    else:
+                        self._send_json(doc)
+                elif params.get("format") == "json":
+                    self._send_json(_tracing.tracez_report())
+                else:
+                    self._send(200, _tracing.render_tracez_html(), "text/html")
             elif path == "/statusz":
                 self._send_json(statusz_report())
             elif path == "/":
@@ -309,7 +333,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200,
                     "heat_tpu runtime introspection: "
-                    "/metrics /varz /healthz /trace /statusz"
+                    "/metrics /varz /healthz /trace /tracez /statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",

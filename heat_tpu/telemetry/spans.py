@@ -31,10 +31,15 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..analysis import tsan as _tsan
 from . import metrics as _metrics
+from . import tracing as _tracing
 
 __all__ = [
     "SpanRecord",
     "span",
+    "record_span",
+    "stage_note",
+    "flush_notes",
+    "clear_notes",
     "tracing_enabled",
     "set_tracing",
     "get_spans",
@@ -75,9 +80,16 @@ except Exception:  # lint: allow H501(optional jax profiler import guard)
     _ANNOTATION = None
 
 #: one completed span: monotonic start, duration, owning thread, nesting
-#: depth at entry, and the user attrs (payload bytes, step ids, ...)
+#: depth at entry, the user attrs (payload bytes, step ids, ...), and —
+#: when a request trace context was active — the trace identity
+#: (``trace_id``/``span_id``/``parent_id``, else all None) that lets
+#: ``/tracez`` and the Chrome flow export reassemble one request's spans
+#: across threads (see :mod:`heat_tpu.telemetry.tracing`)
 SpanRecord = namedtuple(
-    "SpanRecord", ["name", "start_ns", "duration_ns", "thread_id", "depth", "attrs"]
+    "SpanRecord",
+    ["name", "start_ns", "duration_ns", "thread_id", "depth", "attrs",
+     "trace_id", "span_id", "parent_id"],
+    defaults=(None, None, None),
 )
 
 
@@ -133,11 +145,13 @@ class span:
     attribute reads — nothing is recorded anywhere.
     """
 
-    __slots__ = ("name", "attrs", "_t0", "_depth", "_ann", "_live")
+    __slots__ = ("name", "attrs", "record", "_t0", "_depth", "_ann", "_live",
+                 "_ctx", "_sid", "_token")
 
     def __init__(self, name: str, **attrs):
         self.name = name
         self.attrs = attrs
+        self.record: Optional[SpanRecord] = None
         self._live = False
 
     def __enter__(self) -> "span":
@@ -147,6 +161,19 @@ class span:
         depth = getattr(_TLS, "depth", 0)
         _TLS.depth = depth + 1
         self._depth = depth
+        # request-trace stamping: inside an active trace context this
+        # span becomes the context's current span for anything it
+        # encloses (child spans, nested dispatch/comm spans inherit)
+        ctx = _tracing._CTX.get()
+        if ctx is not None:
+            self._ctx = ctx
+            self._sid = _tracing.next_span_id()
+            self._token = _tracing._CTX.set(
+                _tracing.TraceContext(ctx.trace_id, self._sid)
+            )
+        else:
+            self._ctx = None
+            self._token = None
         if _ANNOTATION is not None:
             self._ann = _ANNOTATION(self.name)
             self._ann.__enter__()
@@ -163,6 +190,10 @@ class span:
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
         _TLS.depth = self._depth
+        if self._token is not None:
+            _tracing._CTX.reset(self._token)
+            self._token = None
+        ctx = self._ctx
         rec = SpanRecord(
             self.name,
             self._t0,
@@ -170,11 +201,14 @@ class span:
             threading.get_ident(),
             self._depth,
             self.attrs,
+            ctx.trace_id if ctx is not None else None,
+            self._sid if ctx is not None else None,
+            ctx.span_id if ctx is not None else None,
         )
-        with _RING_LOCK:
-            _tsan.note_access("telemetry.spans.ring")
-            _RING.append(rec)
-        _RECORDED.inc()
+        self.record = rec
+        _append_record(rec)
+        if ctx is not None:
+            _tracing._on_span(rec)
         return False
 
     def __call__(self, fn: Callable) -> Callable:
@@ -186,6 +220,121 @@ class span:
                 return fn(*args, **kwargs)
 
         return wrapped
+
+
+def _append_record(rec: SpanRecord) -> None:
+    """Land one completed record in the ring (shared by the span
+    protocol, :func:`record_span`, and the trace root synthesis)."""
+    with _RING_LOCK:
+        _tsan.note_access("telemetry.spans.ring")
+        _RING.append(rec)
+    _RECORDED.inc()
+
+
+def stage_note(name: str, start_ns: int, duration_ns: int, **attrs) -> None:
+    """Buffer one explicitly-timed stage interval in thread-local scratch
+    — the serving hot path's cheap alternative to :func:`record_span`.
+
+    A note is a plain tuple append: no locks, no record construction,
+    no ring write.  :func:`flush_notes` materializes the buffered notes
+    into stamped :class:`SpanRecord`\\ s in ONE batch (one ring-lock
+    acquisition for all of them) — the serving layer flushes once per
+    request on the caller thread and once per coalesced batch on the
+    batcher thread, so per-stage instrumentation stays under the
+    ``tracing_overhead`` perf gate.  No-op while tracing is disabled."""
+    if not _ENABLED:
+        return
+    buf = getattr(_TLS, "notes", None)
+    if buf is None:
+        buf = _TLS.notes = []
+    buf.append((name, start_ns, duration_ns, attrs))
+
+
+def clear_notes() -> None:
+    """Drop this thread's buffered stage notes unrecorded (error paths:
+    a failed batch must not leak its partial notes into the next one)."""
+    buf = getattr(_TLS, "notes", None)
+    if buf:
+        buf.clear()
+
+
+def flush_notes(extra: Optional[SpanRecord] = None) -> Optional[tuple]:
+    """Hand this thread's buffered stage notes over — the buffer is
+    always cleared.
+
+    Inside a trace context the notes are NOT materialized at all: one
+    raw batch tuple ``(thread_id, depth, parent_id, notes)`` is
+    appended to the in-flight trace (a single lock-free append for
+    every stage of a request or coalesced batch), and views materialize
+    records later, off the request path.  The returned batch handle can
+    be mirrored into co-batched traces with
+    :func:`heat_tpu.telemetry.tracing.link_batch`.  ``extra`` is an
+    already-built record (the request root) written to the ring here.
+    Outside a trace context the notes materialize into the ring
+    directly (unstamped), as plain explicit-timing spans."""
+    buf = getattr(_TLS, "notes", None)
+    if not buf and extra is None:
+        return None
+    if not _ENABLED:
+        if buf:
+            buf.clear()
+        return None
+    ctx = _tracing._CTX.get()
+    if ctx is not None:
+        batch = None
+        if buf:
+            batch = (
+                threading.get_ident(), getattr(_TLS, "depth", 0),
+                ctx.span_id, tuple(buf),
+            )
+            buf.clear()
+            _tracing._on_notes(ctx.trace_id, batch)
+        if extra is not None:
+            _append_record(extra)
+        return batch
+    ident = threading.get_ident()
+    depth = getattr(_TLS, "depth", 0)
+    recs = [
+        SpanRecord(name, int(t0), int(dur), ident, depth, attrs)
+        for name, t0, dur, attrs in (buf or ())
+    ]
+    if buf:
+        buf.clear()
+    if extra is not None:
+        recs.append(extra)
+    with _RING_LOCK:
+        _tsan.note_access("telemetry.spans.ring")
+        _RING.extend(recs)
+    _RECORDED.inc(len(recs))
+    return None
+
+
+def record_span(name: str, start_ns: int, duration_ns: int, **attrs) -> Optional[SpanRecord]:
+    """Record one span with *explicit* timing — for intervals no single
+    ``with span(...)`` block can enclose (measured across threads, or
+    reconstructed after the fact).  Stamped with the caller's active
+    trace context exactly like a live span and recorded immediately;
+    hot paths that record several stages per request should prefer
+    :func:`stage_note` + :func:`flush_notes`, which batch the ring
+    traffic.  Returns the record (None when tracing is disabled)."""
+    if not _ENABLED:
+        return None
+    ctx = _tracing._CTX.get()
+    rec = SpanRecord(
+        name,
+        int(start_ns),
+        int(duration_ns),
+        threading.get_ident(),
+        getattr(_TLS, "depth", 0),
+        attrs,
+        ctx.trace_id if ctx is not None else None,
+        _tracing.next_span_id() if ctx is not None else None,
+        ctx.span_id if ctx is not None else None,
+    )
+    _append_record(rec)
+    if ctx is not None:
+        _tracing._on_span(rec)
+    return rec
 
 
 def _json_safe(v: Any) -> Any:
@@ -200,11 +349,22 @@ def chrome_trace_doc() -> Dict[str, Any]:
     The format is the ``traceEvents`` list of complete ("ph": "X")
     events — microsecond timestamps relative to the process's monotonic
     clock — that ``chrome://tracing`` and Perfetto load directly.  Span
-    attrs land in each event's ``args``.  This is the payload the
-    introspection server's ``/trace`` endpoint returns."""
+    attrs land in each event's ``args``.  Spans that carry a request
+    ``trace_id`` additionally emit **flow events** ("ph": "s"/"t"/"f",
+    one flow per trace_id), so a request coalesced across threads draws
+    as connected arrows from its caller-side spans through the batcher
+    thread's batch spans.  The tail store's deferred stage records
+    (never written to the ring on the hot path) are merged in here, so
+    a retained request renders its full stage tree.  This is the
+    payload the introspection server's ``/trace`` endpoint returns."""
     events: List[Dict[str, Any]] = []
     pid = os.getpid()
-    for rec in get_spans():
+    by_trace: Dict[str, List[SpanRecord]] = {}
+    for rec in list(get_spans()) + _tracing.note_records():
+        args = {k: _json_safe(v) for k, v in rec.attrs.items()}
+        if rec.trace_id is not None:
+            args["trace_id"] = rec.trace_id
+            by_trace.setdefault(rec.trace_id, []).append(rec)
         events.append(
             {
                 "name": rec.name,
@@ -213,9 +373,29 @@ def chrome_trace_doc() -> Dict[str, Any]:
                 "dur": rec.duration_ns / 1e3,
                 "pid": pid,
                 "tid": rec.thread_id,
-                "args": {k: _json_safe(v) for k, v in rec.attrs.items()},
+                "args": args,
             }
         )
+    # one flow per trace: start on its earliest span, step through the
+    # middle ones, finish on the last — Chrome/Perfetto draw the arrows
+    for trace_id, recs in by_trace.items():
+        if len(recs) < 2:
+            continue
+        recs.sort(key=lambda r: r.start_ns)
+        for i, rec in enumerate(recs):
+            ph = "s" if i == 0 else ("f" if i == len(recs) - 1 else "t")
+            ev = {
+                "name": "request",
+                "cat": "trace",
+                "ph": ph,
+                "id": trace_id,
+                "ts": rec.start_ns / 1e3 + 0.001,
+                "pid": pid,
+                "tid": rec.thread_id,
+            }
+            if ph == "f":
+                ev["bp"] = "e"
+            events.append(ev)
     events.sort(key=lambda e: e["ts"])
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 

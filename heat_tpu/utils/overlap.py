@@ -51,6 +51,7 @@ import numpy as np
 from ..analysis import tsan as _tsan
 from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
+from ..telemetry import tracing as _tracing
 from ..telemetry.spans import span as _span
 
 __all__ = [
@@ -207,10 +208,16 @@ class AsyncCheckpointer:
             return
         with _span("checkpoint.save", step=step, mode="async"):
             snap = snapshot_state(state)
+            ctx = _tracing.current_context()  # caller -> writer-thread handoff
 
             def _write():
                 try:
-                    with _span("checkpoint.async_write", step=step):
+                    # the writer's spans inherit the trace (if any) of
+                    # whoever enqueued the save, so an async write shows
+                    # up attached to its request/fit in /tracez
+                    with _tracing.use_context(ctx), _span(
+                        "checkpoint.async_write", step=step
+                    ):
                         jax.block_until_ready(snap)  # device->writer handoff point
                         _inject("checkpoint.async_write", step=step)
                         self.checkpointer.save(step, snap, extra_metadata)

@@ -1,0 +1,196 @@
+"""Perf-trajectory history: make the gate metrics visible BETWEEN runs.
+
+``perf_gate.py`` answers "did this run regress vs the committed
+record?"; nothing answered "how has sort_psrs moved over the last ten
+PRs?" — the trajectory was invisible because every BENCH_CI regeneration
+overwrites the previous one.  This script appends each BENCH_CI run's
+headline gate numbers to ``BENCH_HISTORY.jsonl`` (one JSON record per
+run, written through the resilience atomic+CRC32 writer so the log can
+never tear) and renders the trend into ``docs/perf_history.md``:
+
+    python scripts/perf_ci.py > BENCH_CI.json      # (CI does this)
+    python scripts/bench_history.py                # append + render
+
+Appends are idempotent: re-running against an unchanged BENCH_CI.json
+(same metrics) is a no-op, so the history records *runs*, not
+invocations.  Each record carries the run's git revision and UTC
+timestamp.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: how many trailing runs the rendered markdown table shows per metric
+SHOWN_RUNS = 8
+
+
+def _git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=REPO, capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "?"
+    except Exception:  # lint: allow H501(history works outside a git checkout)
+        return "?"
+
+
+def headline(rec: dict):
+    """One number per gate metric — the quantity its gate kind watches:
+    anchored kernels report ``rel_to_anchor``, overhead gates
+    ``overhead_pct``, latency caps ``seconds``, count caps ``count``,
+    anchors their ``value``; broken kernels record ``None``."""
+    if not isinstance(rec, dict):
+        return None
+    for key in ("rel_to_anchor", "overhead_pct", "count", "value", "seconds"):
+        if key in rec:
+            return rec[key]
+    return None  # error entry
+
+
+def extract_record(bench: dict, rev: str, timestamp: str) -> dict:
+    return {
+        "recorded_at": timestamp,
+        "git_rev": rev,
+        "metrics": {
+            name: headline(rec)
+            for name, rec in sorted(bench.items())
+            if isinstance(rec, dict)
+        },
+    }
+
+
+def load_history(path: str) -> list:
+    """Checksum-verified history records (empty when no log yet)."""
+    from heat_tpu.resilience.atomic import verify_checksum
+
+    if not os.path.exists(path):
+        return []
+    verify_checksum(path)
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def append_history(path: str, record: dict) -> bool:
+    """Append one run record (atomic rewrite + CRC sidecar); returns
+    False when the last record already carries identical metrics (an
+    idempotent re-run against the same BENCH_CI.json)."""
+    from heat_tpu.resilience.atomic import atomic_write
+
+    records = load_history(path)
+    if records and records[-1].get("metrics") == record["metrics"]:
+        return False
+    records.append(record)
+    with atomic_write(path) as tmp:
+        with open(tmp, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return True
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_markdown(records: list, out_path: str) -> None:
+    """One row per gate metric, one column per trailing run (newest
+    right), plus the latest-vs-previous delta."""
+    shown = records[-SHOWN_RUNS:]
+    names = sorted({n for r in shown for n in r.get("metrics", {})})
+    lines = [
+        "# Perf history",
+        "",
+        "Generated from `BENCH_HISTORY.jsonl` by `scripts/bench_history.py`"
+        " — do not edit.  Each column is one BENCH_CI regeneration (the"
+        " headline number of every gate metric: anchored ratio, overhead %,"
+        " seconds, or count — see the gate kinds in `scripts/perf_gate.py`);"
+        " `Δ` compares the two newest runs.",
+        "",
+        f"{len(records)} run(s) recorded; showing the last {len(shown)}.",
+        "",
+    ]
+    header = ["metric"] + [
+        f"{r.get('git_rev', '?')}<br>{str(r.get('recorded_at', '?'))[:10]}"
+        for r in shown
+    ] + ["Δ"]
+    lines.append("| " + " | ".join(header) + " |")
+    lines.append("|" + "---|" * len(header))
+    for name in names:
+        vals = [r.get("metrics", {}).get(name) for r in shown]
+        delta = "—"
+        nums = [v for v in vals if isinstance(v, (int, float))]
+        if len(nums) >= 2 and isinstance(vals[-1], (int, float)):
+            prev = next(
+                (v for v in reversed(vals[:-1]) if isinstance(v, (int, float))), None
+            )
+            if prev is not None:
+                d = vals[-1] - prev
+                delta = f"{d:+.4g}" + (
+                    f" ({100.0 * d / prev:+.1f}%)" if prev else ""
+                )
+        lines.append(
+            "| `" + name + "` | " + " | ".join(_fmt(v) for v in vals)
+            + f" | {delta} |"
+        )
+    lines += [
+        "",
+        "See also: [observability](observability.md), the committed gate"
+        " record `BENCH_CI.json`, and `scripts/perf_gate.py` for the"
+        " regression rules.",
+        "",
+    ]
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--bench", default=os.path.join(REPO, "BENCH_CI.json"))
+    ap.add_argument("--history", default=os.path.join(REPO, "BENCH_HISTORY.jsonl"))
+    ap.add_argument("--out", default=os.path.join(REPO, "docs", "perf_history.md"))
+    ap.add_argument(
+        "--render-only", action="store_true",
+        help="re-render the markdown from the existing history, no append",
+    )
+    args = ap.parse_args()
+
+    if not args.render_only:
+        with open(args.bench) as f:
+            bench = json.load(f)
+        record = extract_record(
+            bench,
+            rev=_git_rev(),
+            timestamp=datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"
+            ),
+        )
+        if append_history(args.history, record):
+            print(f"appended run {record['git_rev']} -> {args.history}")
+        else:
+            print("history unchanged (same metrics as the last record)")
+
+    records = load_history(args.history)
+    render_markdown(records, args.out)
+    print(f"rendered {len(records)} run(s) -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,7 @@ NAV = [
     ]),
     ("Reference", [
         ("API reference", "docs/api_reference.md"),
+        ("Perf history", "docs/perf_history.md"),
         ("API coverage", "coverage_tables.md"),
         ("Changelog", "CHANGELOG.md"),
         ("Round 5 notes", "docs/round5_notes.md"),

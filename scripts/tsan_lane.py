@@ -4,9 +4,10 @@ Runs the test files that exercise the framework's real thread
 surface — the async-checkpoint writer and loader threads
 (``test_overlap.py``), the introspection HTTP server and crash
 excepthooks (``test_introspection.py``), the shared metrics/span
-state (``test_telemetry.py``), and the serving layer's coalescer/
+state (``test_telemetry.py``), the serving layer's coalescer/
 registry-loader/admission threads plus its HTTP routes
-(``test_serving.py``) — in a subprocess with the concurrency
+(``test_serving.py``), and the request-tracing context handoffs +
+tail-store concurrency (``test_tracing.py``) — in a subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
 sanitizer recorded **zero** findings: no lock-order cycle and no
@@ -35,6 +36,7 @@ LANE_FILES = (
     "tests/test_introspection.py",
     "tests/test_telemetry.py",
     "tests/test_serving.py",
+    "tests/test_tracing.py",
 )
 
 

@@ -1,0 +1,519 @@
+"""Request-scoped distributed tracing (ISSUE 10 tentpole).
+
+The contract under test (docs/observability.md "Request tracing"):
+
+* a contextvars trace context stamps ``trace_id``/``span_id``/
+  ``parent_id`` into every span opened under it, and the explicit
+  handoff helpers carry it across the pipeline's thread hops — the
+  coalescer's batcher thread, the introspection server's handler
+  threads, and the async checkpoint-writer thread;
+* one concurrent ``predict`` yields ONE trace_id shared by the full
+  stage tree (admission → coalesce_wait → pad → dispatch → execute →
+  scatter) spanning ≥ 2 threads, retained in the tail store even after
+  the span ring rotates;
+* histogram exemplars remember the most recent trace_id per bucket and
+  render in OpenMetrics exemplar syntax;
+* the tail store retains the slowest-k and **every** shed/errored
+  request, bounded by ``HEAT_TPU_TRACE_KEEP``/``_MAX_SPANS``;
+* cross-worker stitching by trace_id in ``aggregate.merge_snapshots``
+  is deterministic and order-invariant;
+* disabled mode (``HEAT_TPU_TRACE=0``) records nothing anywhere while
+  still timing the request (one timing source).
+"""
+
+import collections
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import telemetry
+from heat_tpu.resilience import OverloadedError
+from heat_tpu.serving.coalescer import ModelBatcher, observe_stage
+from heat_tpu.telemetry import aggregate
+from heat_tpu.telemetry import flight_recorder
+from heat_tpu.telemetry import metrics as tm
+from heat_tpu.telemetry import server as tserver
+from heat_tpu.telemetry import spans as tspans
+from heat_tpu.telemetry import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    """Every test starts recording with a clean ring and empty store."""
+    prev = telemetry.set_tracing(True)
+    prev_ex = tracing.set_exemplars(True)
+    telemetry.clear_spans()
+    tracing.reset_store()
+    yield
+    telemetry.set_tracing(prev)
+    tracing.set_exemplars(prev_ex)
+    telemetry.clear_spans()
+    tracing.reset_store()
+
+
+# ----------------------------------------------------------------------
+# context plumbing
+# ----------------------------------------------------------------------
+class TestContext:
+    def test_trace_ids_unique_and_hex(self):
+        ids = {tracing.new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+    def test_no_ambient_context_by_default(self):
+        assert tracing.current_context() is None
+        assert tracing.current_trace_id() is None
+
+    def test_use_context_attach_and_restore(self):
+        ctx = tracing.TraceContext("aa" * 8, 7)
+        with tracing.use_context(ctx) as got:
+            assert got == ctx
+            assert tracing.current_trace_id() == ctx.trace_id
+        assert tracing.current_context() is None
+        # None context is a no-op, not an error
+        with tracing.use_context(None):
+            assert tracing.current_context() is None
+
+    def test_bind_context_carries_across_thread(self):
+        ctx = tracing.TraceContext("bb" * 8, 1)
+        seen = {}
+
+        def probe():
+            seen["tid"] = tracing.current_trace_id()
+
+        with tracing.use_context(ctx):
+            bound = tracing.bind_context(probe)
+        t = threading.Thread(target=bound, daemon=True)
+        t.start()
+        t.join()
+        assert seen["tid"] == ctx.trace_id
+
+    def test_spans_outside_trace_are_unstamped(self):
+        with telemetry.span("plain"):
+            pass
+        rec = telemetry.get_spans()[-1]
+        assert rec.trace_id is None and rec.span_id is None and rec.parent_id is None
+
+
+# ----------------------------------------------------------------------
+# span stamping + the request root
+# ----------------------------------------------------------------------
+class TestRequestSpan:
+    def test_stamping_and_parent_chain(self):
+        with tracing.request_span("/t/route") as req:
+            with telemetry.span("child"):
+                with telemetry.span("grandchild"):
+                    pass
+        recs = {r.name: r for r in telemetry.get_spans()}
+        root, child, grand = recs["serve.request"], recs["child"], recs["grandchild"]
+        assert root.trace_id == child.trace_id == grand.trace_id == req.trace_id
+        assert root.parent_id == 0  # root of the trace
+        assert child.parent_id == root.span_id
+        assert grand.parent_id == child.span_id
+        assert req.status == "ok" and req.duration_ms > 0
+
+    def test_nested_request_span_joins_not_forks(self):
+        with tracing.request_span("/outer") as outer:
+            with tracing.request_span("/inner") as inner:
+                assert inner.trace_id == outer.trace_id
+        retained = tracing.retained_traces()
+        # one trace finished, not two
+        assert len(retained["recent"]) == 1
+        assert retained["recent"][0]["route"] == "/outer"
+
+    def test_status_classification_and_error_retention(self):
+        with pytest.raises(ValueError):
+            with tracing.request_span("/err") as req:
+                raise ValueError("boom")
+        assert req.status == "error"
+        with pytest.raises(OverloadedError):
+            with tracing.request_span("/shed") as req2:
+                raise OverloadedError("full", tenant="t", cause="queue")
+        assert req2.status == "shed"
+        errors = tracing.retained_traces()["errors"]
+        assert [e["status"] for e in errors] == ["error", "shed"]
+        assert all(e["duration_ms"] is not None for e in errors)
+
+    def test_record_span_explicit_timing(self):
+        with tracing.request_span("/rs") as req:
+            rec = telemetry.record_span("waited", 1000, 2000, rows=3)
+        assert rec.trace_id == req.trace_id and rec.span_id is not None
+        doc = tracing.get_trace(req.trace_id)
+        assert "waited" in [s["name"] for s in doc["spans"]]
+
+    def test_store_survives_ring_rotation(self, monkeypatch):
+        monkeypatch.setattr(tspans, "_RING", collections.deque(maxlen=3))
+        with tracing.request_span("/ring") as req:
+            for i in range(8):
+                with telemetry.span(f"stage{i}"):
+                    pass
+        assert len(telemetry.get_spans()) == 3  # ring rotated
+        doc = tracing.get_trace(req.trace_id)
+        assert doc["n_spans"] == 9  # 8 stages + serve.request, all retained
+
+
+# ----------------------------------------------------------------------
+# propagation across the coalescer's thread hop
+# ----------------------------------------------------------------------
+class TestCoalescerPropagation:
+    def _batcher(self, max_delay_s=0.05):
+        def infer(rows):
+            # the service's stage notes, on the batcher thread (the same
+            # buffered form InferenceService._infer_batch uses, so they
+            # flush — and mirror — with the batch's own stage notes)
+            t = time.perf_counter_ns()
+            tspans.stage_note("serve.dispatch", t, 10, rows=int(rows.shape[0]))
+            tspans.stage_note("serve.execute", t, 10)
+            return rows * 2.0
+
+        return ModelBatcher("tb", infer, max_batch=64, max_delay_s=max_delay_s)
+
+    def test_one_trace_id_full_stage_tree_two_threads(self):
+        mb = self._batcher()
+        try:
+            with tracing.request_span("/v1/predict/tb") as req:
+                with telemetry.span("serve.admission"):
+                    pass
+                out = mb.submit(np.ones((3, 2), np.float32), timeout=30)
+            assert np.array_equal(out, np.full((3, 2), 2.0, np.float32))
+        finally:
+            mb.close()
+        doc = tracing.get_trace(req.trace_id)
+        names = {s["name"] for s in doc["spans"]}
+        assert {
+            "serve.request", "serve.admission", "serve.coalesce_wait",
+            "serve.pad", "serve.dispatch", "serve.execute", "serve.scatter",
+        } <= names
+        assert len(names) >= 6
+        assert doc["n_threads"] >= 2  # caller + batcher thread
+        assert doc["status"] == "ok"
+        assert mb.last_batch_trace_id == req.trace_id
+
+    def test_concurrent_requests_get_distinct_complete_traces(self):
+        mb = self._batcher(max_delay_s=0.1)
+        reqs = {}
+        barrier = threading.Barrier(3)
+
+        def client(i):
+            barrier.wait()
+            with tracing.request_span("/v1/predict/tb", client=i) as req:
+                mb.submit(np.full((2, 2), float(i), np.float32), timeout=30)
+            reqs[i] = req
+
+        try:
+            ts = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(3)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            mb.close()
+        tids = {r.trace_id for r in reqs.values()}
+        assert len(tids) == 3  # one trace per request, never shared
+        for req in reqs.values():
+            doc = tracing.get_trace(req.trace_id)
+            names = {s["name"] for s in doc["spans"]}
+            # co-batched requests get the batch spans MIRRORED into
+            # their trace; solo batches run them as the primary
+            assert {"serve.request", "serve.coalesce_wait", "serve.pad",
+                    "serve.dispatch", "serve.execute", "serve.scatter"} <= names
+            assert doc["n_threads"] >= 2
+
+    def test_link_spans_restamps_per_trace(self):
+        a = tracing._begin("aa" * 8, "/r")
+        b = tracing._begin("bb" * 8, "/r")
+        rec = tspans.SpanRecord("shared", 0, 10, 1, 0, {}, "aa" * 8, 5, 0)
+        tracing.link_spans(["aa" * 8, "bb" * 8], [rec])
+        assert b.spans[0].trace_id == "bb" * 8  # re-stamped copy
+        assert a.spans == []  # primary already had it via _on_span path
+        tracing._finish(a, "ok", 1.0)
+        tracing._finish(b, "ok", 1.0)
+
+
+# ----------------------------------------------------------------------
+# async-writer / server-handler thread handoffs
+# ----------------------------------------------------------------------
+class TestAsyncHandoffs:
+    def test_async_checkpoint_writer_inherits_trace(self, tmp_path):
+        from heat_tpu.utils.checkpoint import Checkpointer
+
+        ack = Checkpointer(str(tmp_path)).as_async()
+        with tracing.request_span("/ckpt") as req:
+            ack.save(1, {"w": np.ones(4, np.float32)})
+            ack.wait()
+        ack.close()
+        doc = tracing.get_trace(req.trace_id)
+        writes = [s for s in doc["spans"] if s["name"] == "checkpoint.async_write"]
+        assert writes, [s["name"] for s in doc["spans"]]
+        caller_spans = [s for s in doc["spans"] if s["name"] == "serve.request"]
+        assert writes[0]["thread_id"] != caller_spans[0]["thread_id"]
+
+    def test_tracez_endpoint_json_html_and_lookup(self):
+        srv = tserver.start_server(0)
+        try:
+            with tracing.request_span("/v1/predict/m") as req:
+                with telemetry.span("serve.admission"):
+                    pass
+            rep = json.loads(
+                urllib.request.urlopen(f"{srv.url}/tracez?format=json", timeout=10).read()
+            )
+            assert "/v1/predict/m" in rep["routes"]
+            assert rep["routes"]["/v1/predict/m"]["recent"][0]["trace_id"] == req.trace_id
+            html = urllib.request.urlopen(f"{srv.url}/tracez", timeout=10).read().decode()
+            assert req.trace_id in html and "coalesce_wait" in html
+            one = json.loads(
+                urllib.request.urlopen(
+                    f"{srv.url}/tracez?trace_id={req.trace_id}", timeout=10
+                ).read()
+            )
+            # spans sorted by start time: the root opened first
+            assert [s["name"] for s in one["spans"]] == ["serve.request", "serve.admission"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}/tracez?trace_id=deadbeef", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            tserver.stop_server()
+
+
+# ----------------------------------------------------------------------
+# exemplars
+# ----------------------------------------------------------------------
+class TestExemplars:
+    def test_bucket_exemplar_correctness(self):
+        h = tm.Histogram("t.ex_ms")
+        h.observe(10.4, exemplar="t1")
+        h.observe(10.9, exemplar="t2")   # same geometric bucket: t2 wins
+        h.observe(1000.0, exemplar="t3")
+        h.observe(500.0)                 # no exemplar: bucket untouched
+        ex = h.exemplars()
+        assert len(ex) == 2
+        by_tid = {rec["trace_id"]: le for le, rec in ex.items()}
+        assert "t1" not in by_tid  # most recent wins within a bucket
+        assert by_tid["t2"] >= 10.9 and by_tid["t3"] >= 1000.0
+        for le, rec in ex.items():
+            assert rec["value"] <= le
+
+    def test_openmetrics_exposition(self):
+        reg = tm.MetricsRegistry()
+        h = reg.histogram("stage.x_ms")
+        h.observe(3.0, exemplar="abcd")
+        h.observe(7.0)
+        text = reg.expose()
+        lines = [l for l in text.splitlines() if "stage_x_ms" in l]
+        assert "# TYPE heat_tpu_stage_x_ms histogram" in lines
+        bucket_lines = [l for l in lines if "_bucket" in l]
+        assert any('# {trace_id="abcd"} 3' in l for l in bucket_lines)
+        assert bucket_lines[-1].startswith('heat_tpu_stage_x_ms_bucket{le="+Inf"} 2')
+        # cumulative counts are non-decreasing
+        counts = [int(l.split("} ")[1].split(" #")[0]) for l in bucket_lines]
+        assert counts == sorted(counts)
+        # histograms WITHOUT exemplars keep the summary exposition
+        reg.histogram("plain_ms").observe(1.0)
+        assert "# TYPE heat_tpu_plain_ms summary" in reg.expose()
+
+    def test_snapshot_carries_exemplars_and_reset_clears(self):
+        h = tm.Histogram("t.snap_ms")
+        h.observe(5.0, exemplar="xyz")
+        snap = h.snapshot()
+        assert list(snap["exemplars"].values())[0]["trace_id"] == "xyz"
+        h.reset()
+        assert h.exemplars() == {} and "exemplars" not in h.snapshot()
+
+    def test_observe_stage_respects_exemplar_toggle(self):
+        h = tm.histogram("serving.stage.admission_ms")
+        with tracing.use_context(tracing.TraceContext("cc" * 8, 0)):
+            tracing.set_exemplars(False)
+            observe_stage("admission", 1.0)
+            before = dict(h.exemplars())
+            tracing.set_exemplars(True)
+            observe_stage("admission", 1.0)
+        assert any(r["trace_id"] == "cc" * 8 for r in h.exemplars().values())
+        assert not any(r["trace_id"] == "cc" * 8 for r in before.values())
+
+
+# ----------------------------------------------------------------------
+# tail store retention
+# ----------------------------------------------------------------------
+class TestTailStore:
+    def _finished(self, duration_ms, status="ok", route="/r"):
+        tr = tracing._begin(tracing.new_trace_id(), route)
+        tracing._finish(tr, status, duration_ms)
+        return tr
+
+    def test_recent_is_bounded_newest_win(self, monkeypatch):
+        monkeypatch.setattr(tracing, "_RECENT", collections.deque(maxlen=4))
+        ids = [self._finished(1.0).trace_id for _ in range(10)]
+        recent = tracing.retained_traces()["recent"]
+        assert [t["trace_id"] for t in recent] == ids[-4:]
+
+    def test_slowest_k_retained_after_rotation(self, monkeypatch):
+        monkeypatch.setattr(tracing, "_KEEP", 3)
+        monkeypatch.setattr(tracing, "_SLOWEST", [])
+        monkeypatch.setattr(tracing, "_SLOWEST_DURS", [])
+        slow_ids = []
+        for i in range(20):
+            dur = 1000.0 + i if i % 7 == 0 else 1.0
+            tr = self._finished(dur)
+            if dur > 100:
+                slow_ids.append(tr.trace_id)
+        slowest = tracing.retained_traces()["slowest"]
+        assert len(slowest) == 3
+        assert {t["trace_id"] for t in slowest} == set(slow_ids)
+        # slowest first
+        durs = [t["duration_ms"] for t in slowest]
+        assert durs == sorted(durs, reverse=True)
+
+    def test_shed_and_error_always_retained(self):
+        shed = self._finished(0.1, status="shed")
+        err = self._finished(0.2, status="error")
+        for _ in range(50):
+            self._finished(1.0)  # flood with ok traces
+        errors = tracing.retained_traces()["errors"]
+        assert {t["trace_id"] for t in errors} >= {shed.trace_id, err.trace_id}
+
+    def test_per_trace_span_cap(self, monkeypatch):
+        monkeypatch.setattr(tracing, "_MAX_SPANS", 4)
+        with tracing.request_span("/cap") as req:
+            for i in range(10):
+                with telemetry.span(f"s{i}"):
+                    pass
+        doc = tracing.get_trace(req.trace_id)
+        assert doc["n_spans"] == 4 and doc["dropped_spans"] == 7
+
+    def test_refresh_env_resizes(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_TRACE_KEEP", "2")
+        tracing.refresh_env()
+        try:
+            assert tracing._KEEP == 2
+            for _ in range(5):
+                self._finished(1.0)
+            assert len(tracing.retained_traces()["recent"]) == 2
+        finally:
+            monkeypatch.delenv("HEAT_TPU_TRACE_KEEP")
+            tracing.refresh_env()
+
+    def test_reset_store(self):
+        self._finished(1.0)
+        tracing.reset_store()
+        rt = tracing.retained_traces()
+        assert all(v == [] for v in rt.values())
+
+
+# ----------------------------------------------------------------------
+# cross-worker stitching
+# ----------------------------------------------------------------------
+def _worker_snap(ix, traces):
+    return {
+        "process_index": ix,
+        "process_count": 2,
+        "pid": 100 + ix,
+        "timestamp": 1.0,
+        "metrics": {},
+        "span_stats": {},
+        "traces": traces,
+    }
+
+
+class TestStitching:
+    def test_stitch_by_trace_id_deterministic(self):
+        tid = "ab" * 8
+        a = _worker_snap(0, [{"trace_id": tid, "route": "/r", "status": "ok",
+                              "duration_ms": 5.0, "n_spans": 7, "n_threads": 2,
+                              "stages": {"serve.dispatch": {"count": 1, "total_ms": 3.0}}}])
+        b = _worker_snap(1, [{"trace_id": tid, "route": "/r", "status": "ok",
+                              "duration_ms": 9.0, "n_spans": 3, "n_threads": 1,
+                              "stages": {"comm.psum": {"count": 2, "total_ms": 1.0}}}])
+        m1 = aggregate.merge_snapshots([a, b], publish=False)
+        m2 = aggregate.merge_snapshots([b, a], publish=False)
+        assert json.dumps(m1, sort_keys=True) == json.dumps(m2, sort_keys=True)
+        st = m1["traces"][tid]
+        assert set(st["workers"]) == {"0", "1"}
+        assert st["span_count"] == 10
+        assert st["duration_ms"] == 9.0  # the slowest worker's view
+        assert st["workers"]["1"]["stages"]["comm.psum"]["count"] == 2
+
+    def test_worst_status_wins(self):
+        tid = "cd" * 8
+        a = _worker_snap(0, [{"trace_id": tid, "route": "/r", "status": "ok",
+                              "duration_ms": 1.0, "n_spans": 1, "n_threads": 1, "stages": {}}])
+        b = _worker_snap(1, [{"trace_id": tid, "route": "/r", "status": "error",
+                              "duration_ms": 1.0, "n_spans": 1, "n_threads": 1, "stages": {}}])
+        assert aggregate.stitch_traces([a, b])[tid]["status"] == "error"
+
+    def test_local_snapshot_carries_digests(self):
+        with tracing.request_span("/v1/predict/m"):
+            pass
+        snap = aggregate.tag_snapshot()
+        assert any(t["route"] == "/v1/predict/m" for t in snap["traces"])
+
+
+# ----------------------------------------------------------------------
+# crash bundle + inspect rendering
+# ----------------------------------------------------------------------
+class TestFlightRecorderTraces:
+    def test_bundle_carries_in_flight_trace(self):
+        req = tracing.request_span("/v1/predict/crash")
+        req.__enter__()
+        try:
+            with telemetry.span("serve.admission"):
+                pass
+            doc = flight_recorder.build_bundle(RuntimeError("x"), reason="test")
+            active = doc["traces"]["active"]
+            assert [t["trace_id"] for t in active] == [req.trace_id]
+            assert "serve.admission" in [s["name"] for s in active[0]["spans"]]
+        finally:
+            req.__exit__(None, None, None)
+        # after the crash handler, the finished trace is retained
+        doc2 = flight_recorder.build_bundle(None, reason="test")
+        assert doc2["traces"]["active"] == []
+        assert any(t["trace_id"] == req.trace_id for t in doc2["traces"]["recent"])
+
+    def test_inspect_renders_traces_section(self):
+        from heat_tpu.telemetry.inspect import format_bundle
+
+        req = tracing.request_span("/v1/predict/crash")
+        req.__enter__()
+        try:
+            doc = flight_recorder.build_bundle(RuntimeError("x"), reason="test")
+        finally:
+            req.__exit__(None, None, None)
+        doc = json.loads(json.dumps(doc, default=str))  # the on-disk form
+        text = format_bundle(doc)
+        assert "request traces" in text and req.trace_id in text
+
+
+# ----------------------------------------------------------------------
+# disabled mode: zero writes, one timing source
+# ----------------------------------------------------------------------
+class TestDisabledMode:
+    def test_zero_writes_everywhere(self):
+        telemetry.set_tracing(False)
+        snap_before = telemetry.snapshot()
+        with tracing.request_span("/ghost") as req:
+            with telemetry.span("stage"):
+                pass
+            telemetry.record_span("explicit", 0, 1)
+        assert req.trace_id is None
+        assert req.duration_ms > 0  # still the timing source
+        assert req.status == "ok"
+        assert telemetry.get_spans() == []
+        rt = tracing.retained_traces()
+        assert all(v == [] for v in rt.values())
+        snap_after = telemetry.snapshot()
+        tr_keys = [k for k in set(snap_before) | set(snap_after)
+                   if k.startswith(("tracing.", "spans."))]
+        for k in tr_keys:
+            assert snap_after.get(k) == snap_before.get(k), k
+
+    def test_disabled_spans_cost_no_context(self):
+        telemetry.set_tracing(False)
+        with tracing.use_context(tracing.TraceContext("ee" * 8, 0)):
+            with telemetry.span("s"):
+                # disabled span must not consume span ids / set context
+                assert tracing.current_context().span_id == 0
