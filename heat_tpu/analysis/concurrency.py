@@ -85,6 +85,24 @@ LOCK_REGISTRY = {
         "structures": ("telemetry.flight_recorder.state",),
         "doc": "serializes crash-bundle writes: two threads crashing concurrently write one bundle each (distinct thread-id suffixes) instead of racing on one path; guards _LAST_PATH",
     },
+    "telemetry.alerts": {
+        "file": "heat_tpu/telemetry/alerts.py",
+        "spellings": ("_LOCK",),
+        "structures": ("telemetry.alerts.state",),
+        "doc": "the alert active table + fired/resolved transition ring: SLO monitors fire from the tick thread, drift checks from batcher threads, /sloz + /statusz handler threads read",
+    },
+    "telemetry.slo": {
+        "file": "heat_tpu/telemetry/slo.py",
+        "spellings": ("_LOCK",),
+        "structures": ("telemetry.slo.state",),
+        "doc": "the registered-SLO table, per-SLO cumulative sample rings, cached /sloz report, and the tick-thread handle: the evaluation tick mutates while /sloz handler threads render; alert transitions run OUTSIDE this lock (alerts has its own)",
+    },
+    "telemetry.sketch": {
+        "file": "heat_tpu/telemetry/sketch.py",
+        "spellings": ("self._lock",),
+        "structures": ("telemetry.sketch.registry",),
+        "doc": "SketchRegistry model->(live sketch, baseline) table: batcher threads fold coalesced batches in, freeze/set_baseline swaps documents, /driftz + per-model /healthz handler threads score",
+    },
     "analysis.program_lint.keys": {
         "file": "heat_tpu/analysis/program_lint.py",
         "spellings": ("_KEY_LOCK",),

@@ -70,6 +70,19 @@ KNOBS = {
     "HEAT_TPU_HEALTH_MAX_AGE_S": ("float", "0", "/healthz flips unhealthy when the fit heartbeat is older than this many seconds (0 = staleness check off)"),
     "HEAT_TPU_FLIGHT_RECORDER": ("path", "", "crash flight recorder: write atomic crash bundles into this directory on unhandled exceptions (empty = off)"),
     "HEAT_TPU_COST_ANALYSIS": ("bool", "0", "record per-executable XLA cost/memory analysis at dispatch compile time (/statusz cost accounting)"),
+    # -- quality signals: SLOs, drift, alerts (docs/observability.md) ---
+    "HEAT_TPU_SLO_TICK_S": ("float", "0", "background SLO-monitor evaluation interval in seconds (0 = manual evaluate() only, except a serving process, which defaults its monitor to 1s when the /v1 routes mount)"),
+    "HEAT_TPU_SLO_FAST_WINDOW_S": ("float", "60", "fast burn-rate window of the SLO monitors (page-latency window)"),
+    "HEAT_TPU_SLO_SLOW_WINDOW_S": ("float", "300", "slow burn-rate window of the SLO monitors (flap suppressor)"),
+    "HEAT_TPU_SLO_FAST_BURN": ("float", "14", "fast-window burn-rate factor an SLO must exceed to fire (error budget consumed 14x faster than allowed)"),
+    "HEAT_TPU_SLO_SLOW_BURN": ("float", "2", "slow-window burn-rate factor an SLO must also exceed to fire (both windows must burn)"),
+    "HEAT_TPU_SLO_LATENCY_MS": ("float", "25", "default serving latency objective: serving.latency_ms p99 must stay under this many milliseconds"),
+    "HEAT_TPU_SLO_SHED_PCT": ("float", "1", "default serving shed objective: shed requests (quota + queue) must stay under this percent of admitted+shed"),
+    "HEAT_TPU_SLO_HEARTBEAT_S": ("float", "0", "fit.heartbeat_ts freshness objective in seconds (0 = heartbeat SLO not installed; serving-only processes have no fit heartbeat)"),
+    "HEAT_TPU_ALERT_RING": ("int", "256", "capacity of the alert fired/resolved transition ring (/sloz, /statusz, crash bundles)"),
+    "HEAT_TPU_SKETCH": ("bool", "1", "input-drift sketches on the /v1/predict path: per-feature moments + log-bucket histograms folded per coalesced batch off the caller's latency path"),
+    "HEAT_TPU_DRIFT_THRESHOLD": ("float", "0.25", "PSI score above which a served model's input distribution counts as drifted (fires the drift:<model> alert and flips its /healthz status)"),
+    "HEAT_TPU_DRIFT_MIN_ROWS": ("int", "200", "rows the live sketch must hold before a drift score is reported (small-sample PSI is noise: ~0.2 at 100 in-distribution rows against a 0.25 threshold)"),
     # -- resilience (heat_tpu/resilience, docs/resilience.md) -----------
     "HEAT_TPU_FAULT_PLAN": ("str", "", "fault-injection plan: inline JSON or a path to a JSON file"),
     "HEAT_TPU_RETRY_NO_SLEEP": ("bool", "0", "record retry backoff delays without sleeping (deterministic failure tests)"),

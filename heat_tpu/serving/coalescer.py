@@ -130,11 +130,17 @@ class ModelBatcher:
         infer_fn: Callable[[np.ndarray], np.ndarray],
         max_batch: int,
         max_delay_s: float,
+        on_batch: Optional[Callable[[np.ndarray], None]] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.name = name
         self._infer_fn = infer_fn
+        #: post-batch hook: called with the TRUE (un-padded) rows after
+        #: every waiting caller has been woken — work here (the input
+        #: drift sketches) is off every caller's latency path by
+        #: construction, the data analogue of the deferred stage notes
+        self._on_batch = on_batch
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
         self._queue: List[_Request] = []
@@ -301,6 +307,13 @@ class ModelBatcher:
                 r.primary_trace_id = ptid
                 r.batch_records = records
                 r.event.set()
+            if self._on_batch is not None:
+                # callers are already awake: the hook's cost lands on
+                # the batcher thread between ticks, never on a request
+                try:
+                    self._on_batch(rows[:n])
+                except Exception:  # lint: allow H501(a sketch bug must never fail served requests)
+                    pass
         except BaseException as e:  # lint: allow H501(per-request error delivery; the batcher thread must survive)
             _clear_notes()  # a failed batch must not leak notes into the next
             for r in batch:

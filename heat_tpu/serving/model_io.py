@@ -211,6 +211,7 @@ def save_model(
     name: Optional[str] = None,
     checkpointer=None,
     async_: bool = False,
+    baseline: Optional[Dict[str, Any]] = None,
 ) -> int:
     """Export a fitted estimator as model ``version`` in ``directory``.
 
@@ -220,11 +221,25 @@ def save_model(
     background writer; pass your own ``checkpointer`` to keep the write
     in flight past this call (and ``close()`` it for durability) —
     without one, the internal checkpointer is drained before returning
-    so the version is durable either way.  Returns the version
+    so the version is durable either way.
+
+    ``baseline`` is an input-distribution sketch document
+    (:meth:`heat_tpu.telemetry.sketch.ModelSketch.doc`, typically the
+    training data's) persisted INSIDE the version: the model and the
+    distribution it expects travel as one atomic artifact, and the
+    registry re-attaches the baseline to the drift monitor on every
+    hot-load — no side-channel file to lose.  Returns the version
     written."""
+    import json as _json
+
     from ..utils.checkpoint import Checkpointer
 
     doc = export_state(est)
+    if baseline is not None:
+        # JSON-encoded string leaf: the sketch document is pure scalars
+        # and (stringified) bucket tables, and a string leaf rides the
+        # checkpoint codec untouched — no array-leaf shape to validate
+        doc["baseline_json"] = _json.dumps(baseline, sort_keys=True)
     meta = {
         "serving_codec": CODEC_VERSION,
         "kind": doc["kind"],

@@ -135,6 +135,15 @@ class ModelRegistry:
             doc = ck.restore(step, template=template, comm=comm)
             est = _mio.build_estimator(doc, comm=comm)
             meta = ck.metadata(step) or {}
+        baseline = None
+        bj = doc.get("baseline_json")
+        if bj:
+            import json as _json
+
+            try:
+                baseline = _json.loads(str(bj))
+            except ValueError:
+                baseline = None  # torn baseline must not fail the load
         record = {
             "estimator": est,
             "kind": doc.get("kind"),
@@ -143,6 +152,7 @@ class ModelRegistry:
             "loaded_at": time.time(),
             "world_size_written": written_world,
             "world_size_serving": comm.size,
+            "baseline": baseline,
             "meta": meta,
         }
         with self._lock:
@@ -155,7 +165,14 @@ class ModelRegistry:
                 if entry["active"] is not None and entry["active"] != step:
                     entry["history"].append(entry["active"])
                 entry["active"] = step
+            activated = entry["active"] == step
             _MODELS_G.set(len(self._models))
+        if baseline is not None and activated:
+            # drift-monitor attach OUTSIDE the registry lock (the sketch
+            # registry has its own registered lock; no nesting)
+            from ..telemetry import sketch as _sketch
+
+            _sketch.SKETCHES.set_baseline(name, baseline)
         _LOADS_C.inc()
         return step
 
@@ -228,7 +245,10 @@ class ModelRegistry:
 
     def promote(self, name: str, version: int) -> None:
         """Make ``version`` the active one (atomic pointer swap); the
-        previous active version goes onto the rollback history."""
+        previous active version goes onto the rollback history.  The
+        promoted version's persisted input baseline (when it carries
+        one) replaces the drift monitor's — each version is scored
+        against ITS OWN training distribution."""
         with self._lock:
             _tsan.note_access("serving.registry.models")
             entry = self._entry(name)
@@ -240,19 +260,36 @@ class ModelRegistry:
             if entry["active"] is not None and entry["active"] != version:
                 entry["history"].append(entry["active"])
             entry["active"] = version
+            baseline = entry["versions"][version].get("baseline")
+        self._attach_baseline(name, baseline)
 
     def rollback(self, name: str) -> int:
         """Re-activate the previously active version (atomic pointer
-        swap); returns the version now active."""
+        swap); returns the version now active.  Re-attaches that
+        version's persisted baseline like :meth:`promote`."""
         with self._lock:
             _tsan.note_access("serving.registry.models")
             entry = self._entry(name)
+            prev = None
             while entry["history"]:
-                prev = entry["history"].pop()
-                if prev in entry["versions"]:
-                    entry["active"] = prev
-                    return prev
-            raise ValueError(f"model {name!r} has no version to roll back to")
+                cand = entry["history"].pop()
+                if cand in entry["versions"]:
+                    entry["active"] = prev = cand
+                    break
+            if prev is None:
+                raise ValueError(f"model {name!r} has no version to roll back to")
+            baseline = entry["versions"][prev].get("baseline")
+        self._attach_baseline(name, baseline)
+        return prev
+
+    def _attach_baseline(self, name: str, baseline) -> None:
+        """Swap the drift monitor's baseline for ``name`` (outside the
+        registry lock — the sketch registry has its own)."""
+        if baseline is None:
+            return
+        from ..telemetry import sketch as _sketch
+
+        _sketch.SKETCHES.set_baseline(name, baseline)
 
     def unload(self, name: str, version: Optional[int] = None) -> None:
         """Drop one version (or the whole model when ``version`` is

@@ -53,8 +53,11 @@ import numpy as np
 from ..analysis import tsan as _tsan
 from ..resilience.errors import OverloadedError
 from ..resilience.faults import inject as _inject
+from ..telemetry import alerts as _alerts
 from ..telemetry import metrics as _tm
 from ..telemetry import server as _tserver
+from ..telemetry import sketch as _sketch
+from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
 from ..telemetry.spans import stage_note as _stage_note
 from .admission import AdmissionController
@@ -134,6 +137,7 @@ class InferenceService:
         )
         self._batchers: Dict[str, ModelBatcher] = {}
         self._open = True
+        self._started_monitor = False
         self._lock = _tsan.register_lock("serving.service")
 
     # -- model lifecycle (thin registry delegates) ----------------------
@@ -162,6 +166,9 @@ class InferenceService:
                     lambda rows, _n=name: self._infer_batch(_n, rows),
                     max_batch=self.max_batch,
                     max_delay_s=self.max_delay_s,
+                    # drift sketches fold each batch's TRUE rows in
+                    # after the callers are woken (HEAT_TPU_SKETCH)
+                    on_batch=lambda rows, _n=name: _sketch.record_batch(_n, rows),
                 )
             return b
 
@@ -286,14 +293,47 @@ class InferenceService:
         elif not b.alive():
             doc["status"] = "dead"
             doc["healthy"] = False
+        # quality signals: the model's drift score and any alert that
+        # names it — liveness (healthy/503) is unaffected, but the
+        # status string flips so a canary driver or operator sees a
+        # drifting model without scraping /driftz
+        drift = _sketch.SKETCHES.status(name)
+        doc["drift"] = {
+            "score": drift["score"],
+            "drifting": drift["drifting"],
+            "threshold": drift["threshold"],
+            "baseline": drift["baseline"],
+            "sketched_rows": drift["sketched_rows"],
+        }
+        doc["alerts"] = [
+            a for a in _alerts.active_alerts()
+            if a["labels"].get("model") == name or a["name"] == f"drift:{name}"
+        ]
+        if drift["drifting"] and doc["status"] in ("ok", "idle"):
+            doc["status"] = "drifting"
         return doc
+
+    def freeze_baseline(self, name: str) -> Dict[str, Any]:
+        """Freeze the model's live input sketch as its drift baseline
+        (runtime capture — e.g. right after warm-up traffic known to be
+        in-distribution); returns the baseline document, which
+        :func:`~heat_tpu.serving.model_io.save_model` can persist with
+        the next version."""
+        self.registry.record(name)  # KeyError -> 404 upstream
+        return _sketch.SKETCHES.freeze_baseline(name)
 
     # -- HTTP -----------------------------------------------------------
     def serve(self, port: Optional[int] = None) -> str:
         """Mount the /v1 routes on the introspection server (starting it
-        if needed); returns the server URL."""
+        if needed), install the default serving SLOs, and start the
+        burn-rate monitor tick (``HEAT_TPU_SLO_TICK_S``; unset/0 falls
+        back to 1 s for a serving process — a fleet replica must page
+        itself without configuration); returns the server URL."""
         srv = _tserver.start_server(port)
         _tserver.register_route(ROUTE_PREFIX, self._handle_http)
+        _slo.install_default_slos()
+        tick = _env().env_float("HEAT_TPU_SLO_TICK_S")
+        self._started_monitor = _slo.start_monitor(tick if tick > 0 else 1.0)
         return srv.url
 
     def _handle_http(self, method: str, path: str, body: Optional[bytes]):
@@ -370,6 +410,9 @@ class InferenceService:
         """Unmount the routes, drain and join every batcher, drain the
         registry's background loader.  Idempotent."""
         _tserver.unregister_route(ROUTE_PREFIX)
+        if self._started_monitor:
+            self._started_monitor = False
+            _slo.stop_monitor()
         with self._lock:
             _tsan.note_access("serving.service.state")
             self._open = False

@@ -24,6 +24,15 @@ XLA compiles, and where did the wall-clock go?*
 * :mod:`~heat_tpu.telemetry.server` — runtime-introspection HTTP
   endpoint (``HEAT_TPU_HTTP_PORT``; ``/metrics`` ``/varz`` ``/healthz``
   ``/trace`` ``/statusz`` on a daemon thread, off by default).
+* :mod:`~heat_tpu.telemetry.slo` — declarative SLO monitors with
+  multi-window burn-rate alerting over the bounded histograms
+  (``/sloz``; ``HEAT_TPU_SLO_*``).
+* :mod:`~heat_tpu.telemetry.sketch` — streaming input-drift sketches
+  (per-feature moments + log-bucket histograms, PSI/KL vs a persisted
+  baseline) for the serving path (``/driftz``; ``HEAT_TPU_SKETCH``).
+* :mod:`~heat_tpu.telemetry.alerts` — deduplicated, severity-tagged
+  fired/resolved alert events in a bounded ring, carrying exemplar
+  trace ids (``HEAT_TPU_ALERT_RING``).
 * :mod:`~heat_tpu.telemetry.aggregate` — cross-worker snapshot
   tagging/merging with straggler/skew gauges
   (``telemetry.straggler_score``).
@@ -54,6 +63,9 @@ from . import metrics
 from . import tracing
 from . import spans
 from . import profiling
+from . import alerts
+from . import slo
+from . import sketch
 from . import aggregate
 from . import flight_recorder
 from . import server
@@ -99,6 +111,17 @@ from .aggregate import (
 )
 from .flight_recorder import dump_bundle
 from .server import start_server, stop_server
+from .alerts import active_alerts, alert_events, alerts_snapshot
+from .slo import (
+    SLO,
+    install_default_slos,
+    parse_slo,
+    register_slo,
+    slo_report,
+    start_monitor,
+    stop_monitor,
+)
+from .sketch import SKETCHES, check_drift, drift_report, record_batch
 
 __all__ = [
     "Counter",
@@ -106,9 +129,23 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
+    "SKETCHES",
+    "SLO",
     "SpanRecord",
     "TraceContext",
+    "active_alerts",
+    "alert_events",
+    "alerts_snapshot",
     "annotate",
+    "check_drift",
+    "drift_report",
+    "install_default_slos",
+    "parse_slo",
+    "record_batch",
+    "register_slo",
+    "slo_report",
+    "start_monitor",
+    "stop_monitor",
     "bind_context",
     "chrome_trace_doc",
     "clear_spans",
@@ -158,7 +195,11 @@ _DOMAIN_PREFIXES = {
     "tracing": ("tracing.",),
     "flight": ("flight.",),
     "checkpoint": ("checkpoint.",),
-    "telemetry": ("spans.", "tracing.", "fit.", "telemetry.", "flight.", "checkpoint."),
+    "alerts": ("alerts.",),
+    "slo": ("slo.",),
+    "drift": ("drift.",),
+    "telemetry": ("spans.", "tracing.", "fit.", "telemetry.", "flight.",
+                  "checkpoint.", "alerts.", "slo.", "drift."),
 }
 
 
@@ -177,6 +218,9 @@ def reset_all(domain: Optional[str] = None) -> None:
         metrics.reset(None)
         spans.clear_spans()
         tracing.reset_store()
+        alerts.clear_alerts()
+        slo.reset_monitors()
+        sketch.SKETCHES.clear()
         return
     prefixes = _DOMAIN_PREFIXES.get(domain)
     if prefixes is None:
@@ -189,6 +233,12 @@ def reset_all(domain: Optional[str] = None) -> None:
         spans.clear_spans()
     if domain in ("tracing", "telemetry"):
         tracing.reset_store()
+    if domain in ("alerts", "telemetry"):
+        alerts.clear_alerts()
+    if domain in ("slo", "telemetry"):
+        slo.reset_monitors()
+    if domain in ("drift", "telemetry"):
+        sketch.SKETCHES.clear()
 
 
 def summary_line(iter_rate: Optional[float] = None) -> str:

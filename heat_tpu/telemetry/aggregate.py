@@ -42,7 +42,9 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Sequence
 
+from . import alerts as _alerts
 from . import metrics as _metrics
+from . import sketch as _sketch
 from . import spans as _spans
 from . import tracing as _tracing
 
@@ -120,6 +122,8 @@ def tag_snapshot() -> Dict[str, Any]:
         "metrics": _metrics.snapshot(),
         "span_stats": span_stats(),
         "traces": _tracing.trace_digest(),
+        "alerts": _alerts.alerts_snapshot(),
+        "drift": _sketch.SKETCHES.digest(),
     }
 
 
@@ -291,6 +295,38 @@ def stitch_traces(snapshots: Sequence[Dict]) -> Dict[str, Any]:
     return dict(sorted(stitched.items()))
 
 
+def _merge_drift(snaps: Sequence[Dict]) -> Dict[str, Any]:
+    """Per-model drift digests folded across workers: every worker's
+    score kept per model plus the fleet-worst score — a model drifting
+    on ANY replica is a drifting model.  Deterministic like the rest of
+    the merge (sorted keys, no clocks)."""
+    models: Dict[str, Dict[str, Any]] = {}
+    for s in sorted(snaps, key=lambda s: int(s.get("process_index", 0))):
+        ix = str(int(s.get("process_index", 0)))
+        for d in s.get("drift") or []:
+            name = d.get("model")
+            if not name:
+                continue
+            e = models.setdefault(
+                name,
+                {"model": name, "workers": {}, "worst_score": None,
+                 "drifting": False},
+            )
+            e["workers"][ix] = {
+                "score": d.get("score"),
+                "drifting": bool(d.get("drifting")),
+                "sketched_rows": d.get("sketched_rows", 0),
+                "baseline": bool(d.get("baseline")),
+            }
+            score = d.get("score")
+            if score is not None and (
+                e["worst_score"] is None or score > e["worst_score"]
+            ):
+                e["worst_score"] = score
+            e["drifting"] = e["drifting"] or bool(d.get("drifting"))
+    return dict(sorted(models.items()))
+
+
 def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str, Any]:
     """Fold worker-tagged snapshots into one deterministic labeled view.
 
@@ -302,7 +338,13 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
       module docstring, each also published into the local registry
       (``publish=False`` for a pure computation);
     * ``traces`` — request traces stitched across workers by trace_id
-      (:func:`stitch_traces`).
+      (:func:`stitch_traces`);
+    * ``alerts`` — every worker's active alerts + transition events in
+      one timeline (:func:`heat_tpu.telemetry.alerts.
+      merge_alert_snapshots`: the same SLO firing on two replicas stays
+      two rows — it IS two replicas burning budget);
+    * ``drift`` — per-model drift scores per worker plus the
+      fleet-worst score (:func:`_merge_drift`).
 
     Determinism: output depends only on the input snapshots; workers are
     ordered by ``process_index`` and every dict is key-sorted."""
@@ -391,4 +433,11 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
         "merged": merged_values,
         "skew": skew,
         "traces": stitch_traces(snaps),
+        "alerts": _alerts.merge_alert_snapshots(
+            [
+                (str(int(s.get("process_index", 0))), s.get("alerts") or {})
+                for s in snaps
+            ]
+        ),
+        "drift": _merge_drift(snaps),
     }

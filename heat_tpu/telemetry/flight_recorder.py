@@ -18,6 +18,10 @@ One bundle carries everything the post-mortem needs::
     traces      the tail-sampled trace store: requests IN FLIGHT at
                 crash time (full span trees) + retained slow/shed/error
                 traces (see docs/observability.md, /tracez)
+    alerts      active alerts + the fired/resolved transition ring
+                (was an SLO burning or a model drifting when it died?)
+    slo         every registered objective's last burn-rate verdict
+    drift       per-model input-drift scores vs their baselines
     knobs       every registered HEAT_TPU_* knob's effective value
     dispatch    cache stats + keys + per-executable cost accounting
     checkpoint  last durable step (where a resume would restart)
@@ -201,6 +205,35 @@ def _traces_state() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _alerts_state() -> Optional[Dict[str, Any]]:
+    """Active alerts + the transition ring at crash time — whether a
+    quality signal was already screaming before the process died."""
+    try:
+        from . import alerts as _alerts
+
+        return _alerts.alerts_snapshot()
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
+def _slo_state() -> Optional[Dict[str, Any]]:
+    try:
+        from . import slo as _slo
+
+        return _slo.slo_report()
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
+def _drift_state() -> Optional[Dict[str, Any]]:
+    try:
+        from . import sketch as _sketch
+
+        return _sketch.drift_report()
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
 def _elastic_state() -> Optional[Dict[str, Any]]:
     """World size + loss/reshape counters at crash time — the first
     question a preemption postmortem asks."""
@@ -230,6 +263,9 @@ def build_bundle(
         "metrics": _metrics.snapshot(),
         "spans": _span_dump(),
         "traces": _traces_state(),
+        "alerts": _alerts_state(),
+        "slo": _slo_state(),
+        "drift": _drift_state(),
         "dispatch": _dispatch_state(),
         "checkpoint": {
             "last_step": int(_metrics.gauge("checkpoint.last_step").value)

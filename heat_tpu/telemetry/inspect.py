@@ -120,6 +120,56 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
                 f"{tr.get('n_spans')} spans"
             )
 
+    alerts_doc = doc.get("alerts") or {}
+    a_active = alerts_doc.get("active") or []
+    a_events = alerts_doc.get("events") or []
+    if a_active or a_events:
+        lines.append(_rule(
+            f"alerts ({len(a_active)} firing, {len(a_events)} transition(s) retained)"
+        ))
+        for a in a_active:
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted((a.get("labels") or {}).items())
+            )
+            lines.append(
+                f"FIRING [{a.get('severity')}] {a.get('name')}"
+                + (f"{{{labels}}}" if labels else "")
+                + f" — {a.get('message')}"
+                + (f" (trace {a.get('trace_id')})" if a.get("trace_id") else "")
+            )
+        for e in a_events[-8:]:
+            lines.append(
+                f"  {str(e.get('event', '?')).upper():8s} {e.get('name')} "
+                f"value={e.get('value')} threshold={e.get('threshold')}"
+            )
+
+    slo_doc = doc.get("slo") or {}
+    slos = slo_doc.get("slos") or []
+    if slos:
+        lines.append(_rule(f"slo verdicts ({len(slos)} objective(s))"))
+        for s in slos:
+            state = "FIRING" if s.get("firing") else (
+                "no data" if s.get("no_data") else "ok"
+            )
+            lines.append(
+                f"{s.get('objective')}: burn fast {s.get('burn_fast')} / "
+                f"slow {s.get('burn_slow')} [{state}]"
+            )
+
+    drift_doc = doc.get("drift") or {}
+    d_models = drift_doc.get("models") or []
+    if d_models:
+        lines.append(_rule(f"input drift ({len(d_models)} sketched model(s))"))
+        for m in d_models:
+            score = m.get("score")
+            state = "DRIFTING" if m.get("drifting") else (
+                "ok" if score is not None else "no baseline"
+            )
+            lines.append(
+                f"{m.get('model')}: PSI {score if score is not None else '—'} "
+                f"over {m.get('sketched_rows')} rows [{state}]"
+            )
+
     metrics = doc.get("metrics") or {}
     nonzero = {
         k: v

@@ -191,6 +191,15 @@ class Histogram:
             for ix, (val, tid, ts) in sorted(items.items())
         }
 
+    def bucket_counts(self) -> Tuple[int, Dict[int, int], int, float]:
+        """Cumulative bucket state ``(low, buckets, count, sum)`` under
+        one lock acquisition — the sample the SLO monitors' windowed
+        burn-rate math diffs between ticks (:mod:`heat_tpu.telemetry.
+        slo`).  ``buckets`` maps ladder index -> count; ``low`` counts
+        observations at or under the first bound."""
+        with self._lock:
+            return (self._low, dict(self._buckets), self._count, self._sum)
+
     def _bucket_rows(self) -> List[Tuple[float, int, Optional[Tuple[float, str, float]]]]:
         """Cumulative ``(le, count, exemplar)`` rows over the touched
         buckets (the OpenMetrics exposition shape)."""
@@ -229,12 +238,22 @@ class Histogram:
             return self._max if self._count else None
 
     def quantile(self, q: float) -> Optional[float]:
-        """Estimated q-quantile (q in [0, 1]); None when empty."""
+        """Estimated q-quantile (q in [0, 1]); None when empty.
+
+        The extremes are exact, not bucket estimates: q=0 returns the
+        observed minimum and q=1 the observed maximum (the interpolated
+        walk would otherwise report a bucket midpoint below the true
+        max whenever the top bucket is wide — the edge the SLO windowed
+        math must not inherit)."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile must be in [0, 1], got {q}")
         with self._lock:
             if not self._count:
                 return None
+            if q == 0.0:
+                return self._min
+            if q == 1.0:
+                return self._max
             target = q * self._count
             seen = self._low
             if seen >= target:

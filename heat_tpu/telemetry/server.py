@@ -19,9 +19,15 @@ route     payload
           shed+errored) with a per-stage latency table; HTML by
           default, ``?format=json`` for the machine form, and
           ``?trace_id=<id>`` for one trace's full span tree
+/sloz     SLO burn-rate monitors: every registered objective's fast/
+          slow-window burn verdict plus the active alert table; HTML
+          by default, ``?format=json`` for the machine form
+/driftz   input-drift sketches: per served model, the live-vs-baseline
+          PSI score and per-feature breakdown; HTML by default,
+          ``?format=json`` for the machine form
 /statusz  build/runtime info: every registered env knob's effective
           value, dispatch cache keys + hit rate + per-executable cost
-          accounting, jax/device/version info
+          accounting, jax/device/version info, active alerts
 ========  ============================================================
 
 Other subsystems mount additional routes on this same server through
@@ -49,7 +55,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from ..analysis import tsan as _tsan
+from . import alerts as _alerts
 from . import metrics as _metrics
+from . import sketch as _sketch
+from . import slo as _slo
 from . import spans as _spans
 from . import tracing as _tracing
 
@@ -219,6 +228,15 @@ def statusz_report() -> Dict[str, Any]:
         doc["elastic"] = elastic_state()
     except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
         doc["elastic"] = None
+    try:
+        doc["alerts"] = {
+            "active": _alerts.active_alerts(),
+            "recent_events": _alerts.alert_events(limit=10),
+            "slos_registered": _slo.registered_slos(),
+            "drift": _sketch.SKETCHES.digest(),
+        }
+    except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
+        doc["alerts"] = None
     return doc
 
 
@@ -272,6 +290,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(self, doc: Any, code: int = 200) -> None:
         self._send(code, json.dumps(doc, indent=1, default=str), "application/json")
 
+    def _query_params(self) -> Dict[str, str]:
+        query = self.path.split("?", 1)[1] if "?" in self.path else ""
+        return dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+
     def _dispatch_route(self, method: str, path: str, body: Optional[bytes]) -> bool:
         """Try the registered extra routes; True when one handled it."""
         handler = _route_for(path)
@@ -309,10 +331,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/trace":
                 self._send_json(_spans.chrome_trace_doc())
             elif path == "/tracez":
-                query = self.path.split("?", 1)[1] if "?" in self.path else ""
-                params = dict(
-                    kv.split("=", 1) for kv in query.split("&") if "=" in kv
-                )
+                params = self._query_params()
                 if "trace_id" in params:
                     doc = _tracing.get_trace(params["trace_id"])
                     if doc is None:
@@ -326,6 +345,16 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(_tracing.tracez_report())
                 else:
                     self._send(200, _tracing.render_tracez_html(), "text/html")
+            elif path == "/sloz":
+                if self._query_params().get("format") == "json":
+                    self._send_json(_slo.slo_report())
+                else:
+                    self._send(200, _slo.render_sloz_html(), "text/html")
+            elif path == "/driftz":
+                if self._query_params().get("format") == "json":
+                    self._send_json(_sketch.drift_report())
+                else:
+                    self._send(200, _sketch.render_driftz_html(), "text/html")
             elif path == "/statusz":
                 self._send_json(statusz_report())
             elif path == "/":
@@ -333,7 +362,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200,
                     "heat_tpu runtime introspection: "
-                    "/metrics /varz /healthz /trace /tracez /statusz"
+                    "/metrics /varz /healthz /trace /tracez /sloz /driftz /statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",

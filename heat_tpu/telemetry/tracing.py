@@ -682,8 +682,14 @@ def render_tracez_html() -> str:
     """``/tracez`` as a small dependency-free HTML page: per route, the
     recent / slowest / shed+errored traces with a per-stage latency
     table (the columns are the serving pipeline's stages, in order)."""
+    import html as _html
+
     rep = tracez_report()
-    esc = lambda s: str(s).replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+    # EVERY user-influenced string (model/route names arrive verbatim
+    # from request bodies; tenant/status/attrs ride along) goes through
+    # html.escape — quote=True included, since several land inside
+    # attribute values.  A hand-rolled &/</> replacement is not enough.
+    esc = lambda s: _html.escape(str(s), quote=True)
     head = (
         "<!doctype html><html><head><title>heat_tpu /tracez</title><style>"
         "body{font-family:monospace;margin:1.5em}table{border-collapse:collapse;margin:.5em 0 1.5em}"
@@ -708,13 +714,13 @@ def render_tracez_html() -> str:
         for d in digests:
             cls = d["status"] if d["status"] in ("shed", "error") else ""
             parts.append(
-                f'<tr class="{cls}"><td class=l>{esc(d["trace_id"])}</td>'
-                f'<td>{esc(d["status"])}</td><td>{d["duration_ms"]}</td>'
-                f'<td>{d["n_spans"]}</td><td>{d["n_threads"]}</td>'
+                f'<tr class="{esc(cls)}"><td class=l>{esc(d["trace_id"])}</td>'
+                f'<td>{esc(d["status"])}</td><td>{esc(d["duration_ms"])}</td>'
+                f'<td>{esc(d["n_spans"])}</td><td>{esc(d["n_threads"])}</td>'
             )
             for st in _TRACEZ_STAGES:
                 cell = d["stages"].get(st)
-                parts.append(f"<td>{cell['total_ms'] if cell else '·'}</td>")
+                parts.append(f"<td>{esc(cell['total_ms']) if cell else '·'}</td>")
             parts.append("</tr>")
         parts.append("</table>")
 

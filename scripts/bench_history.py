@@ -15,10 +15,29 @@ Appends are idempotent: re-running against an unchanged BENCH_CI.json
 (same metrics) is a no-op, so the history records *runs*, not
 invocations.  Each record carries the run's git revision and UTC
 timestamp.
+
+**Trend gate** (ROADMAP 5c): single-run gating (`perf_gate.py`) gives
+each run ``spread_pct`` + margin of slack, so a regression that arrives
+in 2%-per-PR steps never trips it.  :func:`trend_verdicts` computes
+per-metric **k-run rolling medians** over the history and flags a
+metric whose latest median has moved against its *direction of good*
+(anchored ratios up = good, seconds/overhead/count down = good) by more
+than ``DRIFT_PCT`` vs the median of the k runs before — sustained
+drift, immune to the single-run noise the medians absorb.  The verdict
+column renders into ``docs/perf_history.md`` and ``perf_ci.py`` embeds
+:func:`trend_check` as the hard-cap ``perf_trend`` gate (count of
+DRIFT verdicts must stay 0).  A metric with fewer than ``2k`` recorded
+runs reports ``warming`` and cannot fail the gate.
+
+**Backfill** (``--backfill``): seeds the warm-up window from the
+archived chip-bench runs (``BENCH_r0*.json``) so the archived metrics'
+medians are defined from day one; archive records are stamped
+``archived`` and never re-appended.
 """
 
 import argparse
 import datetime
+import glob
 import json
 import os
 import subprocess
@@ -32,6 +51,24 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 #: how many trailing runs the rendered markdown table shows per metric
 SHOWN_RUNS = 8
+
+#: rolling-median window (runs) of the trend gate
+ROLL_K = 5
+
+#: sustained move (percent, against the metric's direction of good)
+#: between the two adjacent k-run medians that counts as drift
+DRIFT_PCT = 10.0
+
+#: gate-record key -> direction of good: +1 = bigger is better (anchored
+#: ratios), -1 = smaller is better (wall time, overhead, counts), 0 =
+#: informational (anchors themselves — runner speed is not a regression)
+KIND_DIRECTION = {
+    "rel_to_anchor": +1,
+    "overhead_pct": -1,
+    "seconds": -1,
+    "count": -1,
+    "value": 0,
+}
 
 
 def _git_rev() -> str:
@@ -57,6 +94,17 @@ def headline(rec: dict):
     return None  # error entry
 
 
+def headline_kind(rec: dict):
+    """Which gate-record key :func:`headline` reported (drives the
+    trend gate's direction of good); None for error entries."""
+    if not isinstance(rec, dict):
+        return None
+    for key in ("rel_to_anchor", "overhead_pct", "count", "value", "seconds"):
+        if key in rec:
+            return key
+    return None
+
+
 def extract_record(bench: dict, rev: str, timestamp: str) -> dict:
     return {
         "recorded_at": timestamp,
@@ -65,6 +113,11 @@ def extract_record(bench: dict, rev: str, timestamp: str) -> dict:
             name: headline(rec)
             for name, rec in sorted(bench.items())
             if isinstance(rec, dict)
+        },
+        "kinds": {
+            name: headline_kind(rec)
+            for name, rec in sorted(bench.items())
+            if isinstance(rec, dict) and headline_kind(rec) is not None
         },
     }
 
@@ -85,21 +138,193 @@ def load_history(path: str) -> list:
     return records
 
 
-def append_history(path: str, record: dict) -> bool:
-    """Append one run record (atomic rewrite + CRC sidecar); returns
-    False when the last record already carries identical metrics (an
-    idempotent re-run against the same BENCH_CI.json)."""
+def _write_history(path: str, records: list) -> None:
     from heat_tpu.resilience.atomic import atomic_write
 
-    records = load_history(path)
-    if records and records[-1].get("metrics") == record["metrics"]:
-        return False
-    records.append(record)
     with atomic_write(path) as tmp:
         with open(tmp, "w") as f:
             for rec in records:
                 f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def append_history(path: str, record: dict) -> bool:
+    """Append one run record (atomic rewrite + CRC sidecar); returns
+    False when the last record already carries identical metrics (an
+    idempotent re-run against the same BENCH_CI.json)."""
+    records = load_history(path)
+    if records and records[-1].get("metrics") == record["metrics"]:
+        return False
+    records.append(record)
+    _write_history(path, records)
     return True
+
+
+# ----------------------------------------------------------------------
+# backfill from the archived chip-bench runs
+# ----------------------------------------------------------------------
+def archive_records(repo: str = REPO) -> list:
+    """History records reconstructed from the ``BENCH_r0*.json``
+    archives (the chip-bench rounds): each archive's parsed metric set
+    becomes one ``archived``-stamped record.  Archives without parsed
+    metrics (raw log captures) are skipped — backfill is honest about
+    what the archives actually hold."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r0*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        entries = parsed.get("all")
+        if not isinstance(entries, list):
+            entries = [parsed] if parsed.get("metric") else []
+        metrics = {
+            e["metric"]: e.get("value")
+            for e in entries
+            if isinstance(e, dict) and e.get("metric")
+        }
+        if not metrics:
+            continue
+        out.append(
+            {
+                "recorded_at": None,
+                "git_rev": os.path.splitext(os.path.basename(path))[0],
+                "archived": True,
+                "metrics": metrics,
+                # chip metrics are throughputs: bigger is better
+                "kinds": {name: "rel_to_anchor" for name in metrics},
+            }
+        )
+    return out
+
+
+def backfill_history(path: str, repo: str = REPO) -> int:
+    """Prepend the archived chip-bench records to the history (before
+    every live record, ordered by round).  Idempotent: archives already
+    present (by ``git_rev``) are skipped.  Returns how many were
+    added."""
+    records = load_history(path)
+    have = {r.get("git_rev") for r in records if r.get("archived")}
+    fresh = [r for r in archive_records(repo) if r["git_rev"] not in have]
+    if not fresh:
+        return 0
+    live = [r for r in records if not r.get("archived")]
+    old = [r for r in records if r.get("archived")]
+    merged = sorted(old + fresh, key=lambda r: r["git_rev"]) + live
+    _write_history(path, merged)
+    return len(fresh)
+
+
+# ----------------------------------------------------------------------
+# the trend gate: k-run rolling medians, direction-aware drift verdicts
+# ----------------------------------------------------------------------
+def _median(vals: list) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def metric_series(records: list, name: str) -> list:
+    """The metric's numeric history, oldest first (missing/error runs
+    skipped — a run where the kernel was broken must not poison the
+    median)."""
+    out = []
+    for r in records:
+        v = (r.get("metrics") or {}).get(name)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append(float(v))
+    return out
+
+
+def metric_direction(records: list, name: str) -> int:
+    """The metric's direction of good from the newest record that
+    stamped its kind (0 = informational/unknown: never gated)."""
+    for r in reversed(records):
+        kind = (r.get("kinds") or {}).get(name)
+        if kind is not None:
+            return KIND_DIRECTION.get(kind, 0)
+    return 0
+
+
+def trend_verdict(series: list, direction: int, k: int = ROLL_K,
+                  drift_pct: float = DRIFT_PCT) -> dict:
+    """One metric's verdict: compare the median of the newest ``k``
+    runs against the median of the ``k`` runs before them.
+
+    Returns ``{"verdict", "median_now", "median_prev", "move_pct"}``
+    where verdict is ``ok`` / ``DRIFT`` / ``warming`` (fewer than
+    ``2k`` runs) / ``n/a`` (informational metric).  ``move_pct`` is
+    signed in raw units (positive = value went up)."""
+    if direction == 0:
+        return {"verdict": "n/a", "median_now": None, "median_prev": None,
+                "move_pct": None}
+    if len(series) < 2 * k:
+        med = _median(series[-k:]) if series else None
+        return {"verdict": "warming", "median_now": med, "median_prev": None,
+                "move_pct": None}
+    med_now = _median(series[-k:])
+    med_prev = _median(series[-2 * k: -k])
+    move = 100.0 * (med_now - med_prev) / abs(med_prev) if med_prev else 0.0
+    # drift = the median moved AGAINST the direction of good: ratios
+    # falling, or seconds/overhead/counts rising
+    bad = (-move if direction > 0 else move) > drift_pct
+    return {
+        "verdict": "DRIFT" if bad else "ok",
+        "median_now": med_now,
+        "median_prev": med_prev,
+        "move_pct": round(move, 2),
+    }
+
+
+def trend_verdicts(records: list, k: int = ROLL_K,
+                   drift_pct: float = DRIFT_PCT) -> dict:
+    """Every metric's trend verdict over the history (name -> verdict
+    doc, sorted)."""
+    names = sorted({n for r in records for n in (r.get("metrics") or {})})
+    out = {}
+    for name in names:
+        out[name] = trend_verdict(
+            metric_series(records, name),
+            metric_direction(records, name),
+            k=k, drift_pct=drift_pct,
+        )
+    return out
+
+
+def trend_check(history_path: str, current_metrics: dict = None,
+                current_kinds: dict = None, k: int = ROLL_K,
+                drift_pct: float = DRIFT_PCT) -> dict:
+    """The ``perf_ci.py``-embeddable hard-cap record: DRIFT verdicts
+    over the history *with the current run appended* must stay 0.
+
+    ``current_metrics``/``current_kinds`` are this run's (un-appended)
+    headline numbers — the gate judges the run being built, not the
+    last committed one.  Metrics still warming (fewer than ``2k``
+    runs) cannot fail."""
+    records = load_history(history_path)
+    if current_metrics:
+        records = records + [
+            {"metrics": dict(current_metrics), "kinds": dict(current_kinds or {})}
+        ]
+    verdicts = trend_verdicts(records, k=k, drift_pct=drift_pct)
+    drifts = {n: v for n, v in verdicts.items() if v["verdict"] == "DRIFT"}
+    return {
+        "count": len(drifts),
+        "max_count": 0,
+        "runs_recorded": len(records),
+        "roll_k": k,
+        "drift_pct": drift_pct,
+        "warming": sum(1 for v in verdicts.values() if v["verdict"] == "warming"),
+        "gated": sum(1 for v in verdicts.values() if v["verdict"] in ("ok", "DRIFT")),
+        "items": [
+            f"{n}: median {v['median_prev']:.6g} -> {v['median_now']:.6g} "
+            f"({v['move_pct']:+.1f}%) over {k}-run windows"
+            for n, v in sorted(drifts.items())
+        ],
+    }
 
 
 def _fmt(v) -> str:
@@ -112,9 +337,12 @@ def _fmt(v) -> str:
 
 def render_markdown(records: list, out_path: str) -> None:
     """One row per gate metric, one column per trailing run (newest
-    right), plus the latest-vs-previous delta."""
+    right), the latest-vs-previous delta, and the rolling-median trend
+    verdict (ROADMAP 5c)."""
     shown = records[-SHOWN_RUNS:]
-    names = sorted({n for r in shown for n in r.get("metrics", {})})
+    names = sorted({n for r in records for n in r.get("metrics", {})})
+    verdicts = trend_verdicts(records)
+    n_archived = sum(1 for r in records if r.get("archived"))
     lines = [
         "# Perf history",
         "",
@@ -122,15 +350,22 @@ def render_markdown(records: list, out_path: str) -> None:
         " — do not edit.  Each column is one BENCH_CI regeneration (the"
         " headline number of every gate metric: anchored ratio, overhead %,"
         " seconds, or count — see the gate kinds in `scripts/perf_gate.py`);"
-        " `Δ` compares the two newest runs.",
+        " `Δ` compares the two newest runs.  `trend` is the rolling-median"
+        f" verdict: the median of the newest {ROLL_K} runs vs the {ROLL_K}"
+        f" before — a move worse than {DRIFT_PCT:g}% against the metric's"
+        " direction of good is sustained **DRIFT** (enforced as the"
+        " `perf_trend` hard-cap gate in `scripts/perf_ci.py`); metrics with"
+        f" fewer than {2 * ROLL_K} runs are `warming`, anchors are `n/a`.",
         "",
-        f"{len(records)} run(s) recorded; showing the last {len(shown)}.",
+        f"{len(records)} run(s) recorded"
+        + (f" ({n_archived} backfilled from the BENCH_r0* archives)" if n_archived else "")
+        + f"; showing the last {len(shown)}.",
         "",
     ]
     header = ["metric"] + [
-        f"{r.get('git_rev', '?')}<br>{str(r.get('recorded_at', '?'))[:10]}"
+        f"{r.get('git_rev', '?')}<br>{str(r.get('recorded_at') or 'archive')[:10]}"
         for r in shown
-    ] + ["Δ"]
+    ] + ["Δ", "trend"]
     lines.append("| " + " | ".join(header) + " |")
     lines.append("|" + "---|" * len(header))
     for name in names:
@@ -146,9 +381,15 @@ def render_markdown(records: list, out_path: str) -> None:
                 delta = f"{d:+.4g}" + (
                     f" ({100.0 * d / prev:+.1f}%)" if prev else ""
                 )
+        v = verdicts.get(name) or {}
+        verdict = v.get("verdict", "—")
+        if verdict == "DRIFT":
+            verdict = f"**DRIFT** ({v['move_pct']:+.1f}%)"
+        elif verdict == "ok" and v.get("move_pct") is not None:
+            verdict = f"ok ({v['move_pct']:+.1f}%)"
         lines.append(
-            "| `" + name + "` | " + " | ".join(_fmt(v) for v in vals)
-            + f" | {delta} |"
+            "| `" + name + "` | " + " | ".join(_fmt(x) for x in vals)
+            + f" | {delta} | {verdict} |"
         )
     lines += [
         "",
@@ -170,7 +411,26 @@ def main():
         "--render-only", action="store_true",
         help="re-render the markdown from the existing history, no append",
     )
+    ap.add_argument(
+        "--backfill", action="store_true",
+        help="seed the history with the archived BENCH_r0*.json chip runs "
+             "(idempotent) before appending/rendering",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="run the rolling-median trend gate over the history and exit "
+             "1 on any DRIFT verdict",
+    )
     args = ap.parse_args()
+
+    if args.backfill:
+        n = backfill_history(args.history)
+        print(f"backfilled {n} archived run(s) -> {args.history}")
+
+    if args.check:
+        res = trend_check(args.history)
+        print(json.dumps(res, indent=1))
+        sys.exit(1 if res["count"] > 0 else 0)
 
     if not args.render_only:
         with open(args.bench) as f:

@@ -30,6 +30,9 @@ MODULES = [
     ("Overlap", "heat_tpu.utils.overlap", "async checkpointing, device prefetch + bucketed gradient-reduction counters (docs/overlap.md)"),
     ("Observability", "heat_tpu.telemetry", "unified metrics registry, structured spans, comm-volume accounting (docs/observability.md)"),
     ("Request tracing", "heat_tpu.telemetry.tracing", "request-scoped distributed tracing: trace context + handoff helpers, tail-sampled trace store, /tracez + exemplars (docs/observability.md)"),
+    ("SLO monitors", "heat_tpu.telemetry.slo", "declarative objectives with multi-window burn-rate alerting over the bounded histograms (/sloz; docs/observability.md)"),
+    ("Input-drift sketches", "heat_tpu.telemetry.sketch", "streaming per-feature moment + log-bucket sketches, PSI/KL divergence vs persisted baselines (/driftz; docs/observability.md)"),
+    ("Alerts", "heat_tpu.telemetry.alerts", "deduplicated severity-tagged fired/resolved alert events with exemplar trace ids (docs/observability.md)"),
     ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601, H701-H705) (docs/static_analysis.md)"),
     ("Concurrency sanitizer", "heat_tpu.analysis.tsan", "runtime lock-order/unguarded-access sanitizer over the central LOCK_REGISTRY (HEAT_TPU_TSAN; docs/static_analysis.md)"),
     ("Elastic", "heat_tpu.elastic", "worker-loss detection, mesh reshape + cross-world resume supervision (docs/elasticity.md)"),

@@ -679,6 +679,91 @@ def main():
 
     guarded("tracing_overhead", bench_tracing_overhead)
 
+    # quality-signals overhead (ISSUE 11): the bench_serving request
+    # stream with the FULL quality-signal layer armed — input-drift
+    # sketches folding every coalesced batch, the default SLOs
+    # registered, and the burn-rate monitor ticking at 4 Hz — vs
+    # everything off.  Rep-level pairing (150 sequential requests per
+    # side, order alternating per pair, min over 3 pairs): the sketch
+    # fold runs per BATCH on the batcher thread and the monitor on its
+    # own tick thread, so per-request alternation cannot toggle them
+    # meaningfully; the min-over-pairs keeps the one-sided environment
+    # noise out of the statistic like the tracing gate.  Hard cap: the
+    # layer that decides "is this model degrading" must stay under 3%
+    # of the request stream it judges, or production arms neither.
+    def bench_quality_signals_overhead():
+        import shutil
+        import tempfile
+
+        from heat_tpu import serving as srv
+        from heat_tpu.telemetry import alerts as talerts
+        from heat_tpu.telemetry import sketch as tsketch
+        from heat_tpu.telemetry import slo as tslo
+
+        rows = np.random.default_rng(7).standard_normal((64, f)).astype(np.float32)
+        km = fit()
+        d = tempfile.mkdtemp(prefix="heat_tpu_ci_qs_")
+        svc = None
+        prev_sketch = tsketch.sketch_enabled()
+        try:
+            srv.save_model(km, d, version=1, name="km")
+            svc = srv.InferenceService(max_batch=64)  # default MAX_DELAY_MS
+            svc.load("km", d)
+            for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+                svc.predict("km", rows[:b])
+
+            sizes = (1, 3, 7, 12, 18, 27, 33, 50, 64)  # the bench_serving mix
+
+            def one_side(armed, n=150):
+                if armed:
+                    tsketch.set_enabled(True)
+                    tslo.install_default_slos()
+                    tslo.start_monitor(0.25)
+                else:
+                    tslo.stop_monitor()
+                    tsketch.set_enabled(False)
+                lat = []
+                try:
+                    for i in range(n):
+                        t0 = time.perf_counter()
+                        svc.predict("km", rows[: sizes[i % len(sizes)]], timeout=30)
+                        lat.append(time.perf_counter() - t0)
+                finally:
+                    if armed:
+                        tslo.stop_monitor()
+                return float(np.median(lat))
+
+            pairs = []
+            on_med = off_med = None
+            for p in range(3):
+                if p % 2 == 0:
+                    on_med = one_side(True)
+                    off_med = one_side(False)
+                else:
+                    off_med = one_side(False)
+                    on_med = one_side(True)
+                if off_med > 0:
+                    pairs.append((100.0 * (on_med - off_med) / off_med, on_med, off_med))
+            overhead_pct, on_med, off_med = min(pairs)
+            results["quality_signals_overhead"] = {
+                "overhead_pct": round(overhead_pct, 2),
+                "max_overhead_pct": 3.0,
+                "request_latency_on_s": round(on_med, 6),
+                "request_latency_off_s": round(off_med, 6),
+                "pair_overheads_pct": [round(p[0], 2) for p in pairs],
+                "requests_per_side": 150,
+            }
+        finally:
+            tsketch.set_enabled(prev_sketch)
+            tslo.reset_monitors()
+            talerts.clear_alerts()
+            tsketch.SKETCHES.clear()
+            if svc is not None:
+                svc.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+    guarded("quality_signals_overhead", bench_quality_signals_overhead)
+
     # sanitized test lane: the threaded test subset (test_overlap /
     # test_introspection / test_telemetry) in a subprocess under
     # HEAT_TPU_TSAN=1 — gated as a hard-cap count: red tests or ANY
@@ -713,6 +798,33 @@ def main():
         }
 
     guarded("lint_new_violations", bench_lint_gate)
+
+    # rolling-median trend gate (ROADMAP 5c): THIS run's headline
+    # numbers appended to BENCH_HISTORY.jsonl's record, per-metric
+    # k-run medians compared window-against-window — sustained drift
+    # that single-run spread_pct hides fails the same perf_gate run.
+    # Runs LAST so every gate metric above is in the judged set.
+    def bench_perf_trend():
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_history import headline, headline_kind, trend_check
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        current_metrics = {
+            name: headline(rec)
+            for name, rec in results.items()
+            if isinstance(rec, dict)
+        }
+        current_kinds = {
+            name: headline_kind(rec)
+            for name, rec in results.items()
+            if isinstance(rec, dict) and headline_kind(rec) is not None
+        }
+        results["perf_trend"] = trend_check(
+            os.path.join(repo, "BENCH_HISTORY.jsonl"),
+            current_metrics, current_kinds,
+        )
+
+    guarded("perf_trend", bench_perf_trend)
 
     print(json.dumps(results, indent=1))
 

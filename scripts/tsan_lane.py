@@ -6,8 +6,10 @@ surface — the async-checkpoint writer and loader threads
 excepthooks (``test_introspection.py``), the shared metrics/span
 state (``test_telemetry.py``), the serving layer's coalescer/
 registry-loader/admission threads plus its HTTP routes
-(``test_serving.py``), and the request-tracing context handoffs +
-tail-store concurrency (``test_tracing.py``) — in a subprocess with the concurrency
+(``test_serving.py``), the request-tracing context handoffs +
+tail-store concurrency (``test_tracing.py``), and the quality-signal
+layer's SLO tick thread / alert table / sketch registry
+(``test_slo.py``, ``test_drift.py``) — in a subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
 sanitizer recorded **zero** findings: no lock-order cycle and no
@@ -37,6 +39,8 @@ LANE_FILES = (
     "tests/test_telemetry.py",
     "tests/test_serving.py",
     "tests/test_tracing.py",
+    "tests/test_slo.py",
+    "tests/test_drift.py",
 )
 
 

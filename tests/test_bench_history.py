@@ -1,0 +1,128 @@
+"""The rolling-median trend gate (ROADMAP 5c satellite of ISSUE 11).
+
+The contract: per-metric k-run rolling medians over BENCH_HISTORY.jsonl,
+drift flagged only when the newest k-run median moves against the
+metric's direction of good by more than the drift threshold vs the k
+runs before — sustained regressions that single-run spread_pct slack
+absorbs, without flapping on one noisy run.  Metrics with fewer than 2k
+runs warm up silently; informational metrics (anchors) never gate.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from bench_history import (  # noqa: E402
+    ROLL_K,
+    append_history,
+    backfill_history,
+    extract_record,
+    headline,
+    headline_kind,
+    load_history,
+    trend_check,
+    trend_verdict,
+    trend_verdicts,
+)
+
+
+def _records(values, kind="seconds", name="m"):
+    return [
+        {"metrics": {name: v}, "kinds": {name: kind}} for v in values
+    ]
+
+
+class TestTrendVerdict:
+    def test_sustained_regression_flags_drift(self):
+        # five healthy runs, then five 2%-per-run creeps: single-run
+        # gating absorbs each step; the window-vs-window median does not
+        series = [1.0] * 5 + [1.02, 1.05, 1.30, 1.32, 1.35]
+        v = trend_verdict(series, direction=-1, k=5, drift_pct=10)
+        assert v["verdict"] == "DRIFT"
+        assert v["move_pct"] > 10
+
+    def test_single_noisy_run_does_not_flag(self):
+        series = [1.0] * 9 + [1.5]  # one outlier cannot move the median
+        v = trend_verdict(series, direction=-1, k=5, drift_pct=10)
+        assert v["verdict"] == "ok"
+
+    def test_direction_of_good_respected(self):
+        rising = [1.0] * 5 + [1.3] * 5
+        # seconds rising = bad; anchored ratio rising = good
+        assert trend_verdict(rising, -1, k=5)["verdict"] == "DRIFT"
+        assert trend_verdict(rising, +1, k=5)["verdict"] == "ok"
+        falling = [1.3] * 5 + [1.0] * 5
+        assert trend_verdict(falling, -1, k=5)["verdict"] == "ok"
+        assert trend_verdict(falling, +1, k=5)["verdict"] == "DRIFT"
+
+    def test_warming_below_two_windows(self):
+        v = trend_verdict([1.0] * (2 * ROLL_K - 1), direction=-1)
+        assert v["verdict"] == "warming"
+
+    def test_informational_metrics_never_gate(self):
+        v = trend_verdict([1.0] * 20 + [9.0] * 20, direction=0)
+        assert v["verdict"] == "n/a"
+
+
+class TestTrendCheck:
+    def test_check_counts_drifts_with_current_run_appended(self, tmp_path):
+        # identical-metrics appends are idempotent, so stamp a tick
+        path = str(tmp_path / "hist.jsonl")
+        for i, v in enumerate([1.0] * 5 + [1.3, 1.3, 1.3, 1.3]):
+            assert append_history(
+                path, {"metrics": {"m": v, "tick": i}, "kinds": {"m": "seconds"}}
+            )
+        res = trend_check(path, {"m": 1.3, "tick": 99}, {"m": "seconds"})
+        assert res["count"] == 1
+        assert "m:" in res["items"][0]
+
+    def test_empty_history_is_green(self, tmp_path):
+        res = trend_check(str(tmp_path / "none.jsonl"), {"m": 1.0}, {"m": "seconds"})
+        assert res["count"] == 0 and res["runs_recorded"] == 1
+
+    def test_missing_runs_skipped_in_series(self):
+        recs = _records([1.0] * 10)
+        recs[3]["metrics"]["m"] = None  # a broken-kernel run
+        verdicts = trend_verdicts(recs, k=4)
+        assert verdicts["m"]["verdict"] in ("ok", "warming")
+
+
+class TestHistoryIO:
+    def test_extract_record_stamps_kinds(self):
+        bench = {
+            "hsvd": {"rel_to_anchor": 0.2, "seconds": 0.1},
+            "lane": {"count": 0, "max_count": 0},
+            "anchor": {"value": 111.0},
+            "broken": {"error": "boom"},
+        }
+        rec = extract_record(bench, rev="abc", timestamp="t")
+        assert rec["metrics"]["hsvd"] == 0.2
+        assert rec["kinds"] == {"hsvd": "rel_to_anchor", "lane": "count",
+                               "anchor": "value"}
+        assert headline(bench["broken"]) is None
+        assert headline_kind(bench["broken"]) is None
+
+    def test_append_idempotent_and_checksummed(self, tmp_path):
+        path = str(tmp_path / "h.jsonl")
+        rec = {"metrics": {"m": 1.0}, "kinds": {"m": "seconds"}}
+        assert append_history(path, rec)
+        assert not append_history(path, dict(rec))
+        assert os.path.exists(path + ".crc32")
+        assert len(load_history(path)) == 1
+
+    def test_backfill_idempotent_against_real_archives(self, tmp_path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = str(tmp_path / "h.jsonl")
+        n1 = backfill_history(path, repo)
+        n2 = backfill_history(path, repo)
+        assert n2 == 0
+        records = load_history(path)
+        assert len(records) == n1
+        assert all(r.get("archived") for r in records)
+        if n1:  # archives present in this checkout
+            assert all(r["metrics"] for r in records)

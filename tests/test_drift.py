@@ -1,0 +1,421 @@
+"""Input-drift sketches + the end-to-end quality-signal loop (ISSUE 11).
+
+The contract under test (docs/observability.md "Drift detection"):
+
+* FeatureSketch moments are exact (batch Welford merge == one-shot)
+  and the signed log-bucket tables are symmetric, zero-aware, and
+  vectorized per batch;
+* PSI reads ~0 for same-distribution traffic (above the small-sample
+  floor), large for a shifted distribution; documents round-trip;
+* the serving layer folds each coalesced batch's TRUE rows in AFTER
+  the callers are woken, a baseline persists through save_model /
+  Checkpointer / registry hot-load (and swaps on promote/rollback),
+  and a drifted model flips its ``/driftz`` score, its per-model
+  ``/healthz`` status, and a deduplicated ``drift:<model>`` alert;
+* the acceptance loop: shifted traffic + a synthetic latency injection
+  fire (then resolve) their alerts with an exemplar trace_id
+  resolvable via ``/tracez?trace_id=``, visible in a merged
+  cross-worker snapshot and a crash flight-recorder bundle;
+* every user-influenced string in the HTML renderers (/tracez /sloz
+  /driftz) is escaped — a model named ``<script>...`` renders inert.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serving, telemetry
+from heat_tpu.telemetry import aggregate
+from heat_tpu.telemetry import alerts
+from heat_tpu.telemetry import flight_recorder
+from heat_tpu.telemetry import metrics as tm
+from heat_tpu.telemetry import server as tserver
+from heat_tpu.telemetry import sketch
+from heat_tpu.telemetry import slo
+from heat_tpu.telemetry import tracing
+
+RNG = np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_quality_signals():
+    sketch.SKETCHES.clear()
+    sketch.set_enabled(True)
+    slo.reset_monitors()
+    alerts.clear_alerts()
+    yield
+    sketch.SKETCHES.clear()
+    sketch.set_enabled(True)
+    slo.reset_monitors()
+    alerts.clear_alerts()
+
+
+def _in_dist(n, d=6, rng=None):
+    return ((rng or RNG).normal(0.0, 1.0, (n, d))).astype(np.float32)
+
+
+def _shifted(n, d=6, rng=None):
+    return ((rng or RNG).normal(6.0, 4.0, (n, d))).astype(np.float32)
+
+
+# ----------------------------------------------------------------------
+# the sketch primitives
+# ----------------------------------------------------------------------
+class TestFeatureSketch:
+    def test_moments_exact_and_batch_order_free(self):
+        vals = RNG.normal(3.0, 2.0, 1000)
+        one = sketch.FeatureSketch()
+        one.update_batch(vals)
+        split = sketch.FeatureSketch()
+        for chunk in np.array_split(vals, 7):
+            split.update_batch(chunk)
+        for s in (one, split):
+            assert s.count == 1000
+            assert s.mean == pytest.approx(float(vals.mean()), rel=1e-9)
+            assert s.variance == pytest.approx(float(vals.var()), rel=1e-6)
+            assert s.min == float(vals.min()) and s.max == float(vals.max())
+
+    def test_signed_zero_aware_buckets(self):
+        s = sketch.FeatureSketch()
+        s.update_batch(np.asarray([0.0, 1e-9, 2.0, -2.0, 2000.0]))
+        b = s.buckets
+        assert b.get(0) == 2  # both zeros
+        pos = [k for k in b if k > 0]
+        neg = [k for k in b if k < 0]
+        assert len(pos) == 2 and len(neg) == 1
+        assert -min(pos) in b  # +-2.0 mirror into symmetric buckets
+
+    def test_doc_roundtrip(self):
+        s = sketch.FeatureSketch()
+        s.update_batch(RNG.normal(0, 1, 100))
+        s2 = sketch.FeatureSketch.from_doc(json.loads(json.dumps(s.doc())))
+        assert s2.count == s.count and s2.buckets == s.buckets
+        assert s2.mean == pytest.approx(s.mean)
+
+    def test_empty_batch_noop(self):
+        s = sketch.FeatureSketch()
+        s.update_batch(np.asarray([]))
+        assert s.count == 0
+        assert s.doc()["min"] is None
+
+
+class TestDivergence:
+    def test_psi_identity_and_shift(self):
+        a = sketch.FeatureSketch()
+        a.update_batch(RNG.normal(0, 1, 2000))
+        b = sketch.FeatureSketch()
+        b.update_batch(RNG.normal(0, 1, 2000))
+        c = sketch.FeatureSketch()
+        c.update_batch(RNG.normal(6, 4, 2000))
+        assert sketch.psi(a.buckets, a.buckets) == pytest.approx(0.0, abs=1e-12)
+        assert sketch.psi(a.buckets, b.buckets) < 0.1
+        assert sketch.psi(a.buckets, c.buckets) > 0.25
+        assert sketch.kl_divergence(a.buckets, c.buckets) > 0.1
+        assert sketch.psi({}, {}) == 0.0
+
+    def test_model_sketch_and_divergence_doc(self):
+        ms = sketch.ModelSketch("m", 3)
+        ms.update(_in_dist(500, 3))
+        base = ms.doc()
+        live = sketch.ModelSketch("m", 3)
+        live.update(_shifted(500, 3))
+        div = sketch.divergence(live.doc(), base)
+        assert div["score"] > 0.25
+        assert len(div["features"]) == 3
+        assert div["worst_feature"] in (0, 1, 2)
+
+    def test_model_sketch_width_mismatch_raises(self):
+        ms = sketch.ModelSketch("m", 3)
+        with pytest.raises(ValueError):
+            ms.update(_in_dist(8, 5))
+
+
+# ----------------------------------------------------------------------
+# the registry: lifecycle, floors, toggles
+# ----------------------------------------------------------------------
+class TestSketchRegistry:
+    def test_record_freeze_score(self):
+        sketch.SKETCHES.record("m", _in_dist(1000))
+        base = sketch.SKETCHES.freeze_baseline("m")
+        assert base["count"] == 1000
+        sketch.SKETCHES.record("m", _in_dist(400))
+        st = sketch.SKETCHES.status("m")
+        assert st["baseline"] and st["score"] is not None
+        assert not st["drifting"]
+        sketch.SKETCHES.reset_live("m")
+        sketch.SKETCHES.record("m", _shifted(400))
+        st = sketch.SKETCHES.status("m")
+        assert st["drifting"]
+
+    def test_small_sample_floor_reports_warming(self):
+        sketch.SKETCHES.record("m", _in_dist(1000))
+        sketch.SKETCHES.freeze_baseline("m")
+        sketch.SKETCHES.record("m", _shifted(50))  # under HEAT_TPU_DRIFT_MIN_ROWS
+        st = sketch.SKETCHES.status("m")
+        assert st["warming"] and st["score"] is None and not st["drifting"]
+
+    def test_freeze_without_traffic_raises(self):
+        with pytest.raises(ValueError):
+            sketch.SKETCHES.freeze_baseline("never_served")
+
+    def test_disabled_records_nothing(self):
+        sketch.set_enabled(False)
+        assert not sketch.SKETCHES.record("m", _in_dist(100))
+        assert sketch.SKETCHES.model_names() == []
+
+    def test_check_drift_fires_and_resolves_alert(self):
+        sketch.SKETCHES.record("m", _in_dist(1000))
+        sketch.SKETCHES.freeze_baseline("m")
+        sketch.SKETCHES.record("m", _shifted(400))
+        sketch.check_drift()
+        assert alerts.is_firing("drift:m", labels={"model": "m"})
+        # back in distribution: score drops, alert resolves
+        sketch.SKETCHES.reset_live("m")
+        sketch.SKETCHES.record("m", _in_dist(400))
+        sketch.check_drift()
+        assert not alerts.is_firing("drift:m", labels={"model": "m"})
+        ev = [e["event"] for e in alerts.alert_events() if e["name"] == "drift:m"]
+        assert ev == ["fired", "resolved"]
+
+    def test_digest_travels_in_snapshot_and_merges(self):
+        sketch.SKETCHES.record("m", _in_dist(1000))
+        sketch.SKETCHES.freeze_baseline("m")
+        sketch.SKETCHES.record("m", _shifted(400))
+        snap = aggregate.tag_snapshot()
+        assert snap["drift"][0]["model"] == "m"
+        other = dict(snap, process_index=1)
+        merged = aggregate.merge_snapshots([snap, other], publish=False)
+        assert merged["drift"]["m"]["drifting"]
+        assert set(merged["drift"]["m"]["workers"]) == {"0", "1"}
+        assert merged["drift"]["m"]["worst_score"] is not None
+
+
+# ----------------------------------------------------------------------
+# baseline persistence through the model store
+# ----------------------------------------------------------------------
+class TestBaselinePersistence:
+    def _save(self, tmp_path, version=1, baseline_rows=1000, name="km"):
+        x = ht.array(_in_dist(256), split=0)
+        km = ht.cluster.KMeans(n_clusters=3, init="random", max_iter=3,
+                               random_state=0).fit(x)
+        ms = sketch.ModelSketch(name, 6)
+        ms.update(_in_dist(baseline_rows))
+        d = str(tmp_path / f"model_v{version}")
+        serving.save_model(km, d, version=version, name=name, baseline=ms.doc())
+        return d
+
+    def test_baseline_roundtrips_through_checkpointer(self, tmp_path):
+        d = self._save(tmp_path)
+        reg = serving.ModelRegistry()
+        reg.load("km", d)
+        assert reg.record("km")["baseline"]["count"] == 1000
+        # the drift monitor got it attached on load
+        assert sketch.SKETCHES.baseline("km")["count"] == 1000
+
+    def test_save_without_baseline_still_loads(self, tmp_path):
+        x = ht.array(_in_dist(256), split=0)
+        km = ht.cluster.KMeans(n_clusters=3, init="random", max_iter=3,
+                               random_state=0).fit(x)
+        d = str(tmp_path / "plain")
+        serving.save_model(km, d, version=1, name="km")
+        reg = serving.ModelRegistry()
+        reg.load("km", d)
+        assert reg.record("km")["baseline"] is None
+
+    def test_promote_and_rollback_swap_baselines(self, tmp_path):
+        d1 = self._save(tmp_path, version=1, baseline_rows=1000)
+        d2 = self._save(tmp_path, version=2, baseline_rows=500)
+        reg = serving.ModelRegistry()
+        reg.load("km", d1)
+        reg.load("km", d2, activate=False)  # canary: baseline unattached
+        assert sketch.SKETCHES.baseline("km")["count"] == 1000
+        reg.promote("km", 2)
+        assert sketch.SKETCHES.baseline("km")["count"] == 500
+        reg.rollback("km")
+        assert sketch.SKETCHES.baseline("km")["count"] == 1000
+
+
+# ----------------------------------------------------------------------
+# renderer escaping (the XSS-shaped satellite)
+# ----------------------------------------------------------------------
+class TestRendererEscaping:
+    EVIL = '<script>alert("pwn")</script>'
+
+    def test_tracez_escapes_hostile_model_and_route(self):
+        telemetry.set_tracing(True)
+        with tracing.request_span(f"/v1/predict/{self.EVIL}", model=self.EVIL):
+            pass
+        html = tracing.render_tracez_html()
+        assert "<script>" not in html
+        assert "&lt;script&gt;" in html
+        tracing.reset_store()
+
+    def test_driftz_escapes_hostile_model_name(self):
+        sketch.SKETCHES.record(self.EVIL, _in_dist(1000))
+        sketch.SKETCHES.freeze_baseline(self.EVIL)
+        sketch.SKETCHES.record(self.EVIL, _shifted(400))
+        sketch.check_drift()  # the alert label carries the name too
+        html = sketch.render_driftz_html()
+        assert "<script>" not in html
+        assert "&lt;script&gt;" in html
+
+    def test_predict_route_with_hostile_model_name_stays_inert(self, tmp_path):
+        # the full HTTP path: a hostile model name POSTed to /v1/predict
+        # lands (as a 404) yet taints the trace store; /tracez must
+        # render it escaped
+        telemetry.set_tracing(True)
+        svc = serving.InferenceService(max_delay_ms=1.0)
+        try:
+            url = svc.serve(0)
+            body = json.dumps(
+                {"model": self.EVIL, "inputs": [[0.0] * 6]}
+            ).encode()
+            req = urllib.request.Request(
+                url + "/v1/predict", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc_info.value.code == 404
+            html = urllib.request.urlopen(url + "/tracez", timeout=5).read().decode()
+            assert "<script>" not in html
+        finally:
+            svc.close()
+            tserver.stop_server()
+            tracing.reset_store()
+
+
+# ----------------------------------------------------------------------
+# the end-to-end quality-signal loop (the ISSUE 11 acceptance test)
+# ----------------------------------------------------------------------
+class TestEndToEndQualitySignals:
+    def test_drift_flip_slo_burn_merge_and_bundle(self, tmp_path):
+        telemetry.set_tracing(True)
+        rng = np.random.default_rng(7)
+
+        # a fitted model saved WITH its training-distribution baseline
+        x = ht.array(_in_dist(512, rng=rng), split=0)
+        km = ht.cluster.KMeans(n_clusters=3, init="random", max_iter=3,
+                               random_state=0).fit(x)
+        ms = sketch.ModelSketch("km", 6)
+        ms.update(_in_dist(2000, rng=rng))
+        d = str(tmp_path / "km")
+        serving.save_model(km, d, version=1, name="km", baseline=ms.doc())
+
+        svc = serving.InferenceService(max_delay_ms=1.0, max_batch=64)
+        try:
+            svc.load("km", d)
+            url = svc.serve(0)
+
+            # -- phase 1: in-distribution traffic scores clean --------
+            for _ in range(12):
+                svc.predict("km", _in_dist(32, rng=rng))
+            deadline = time.time() + 5
+            while sketch.SKETCHES.status("km")["warming"] and time.time() < deadline:
+                time.sleep(0.01)  # the post-batch hook runs off-path
+            st = sketch.SKETCHES.status("km")
+            assert st["score"] is not None and not st["drifting"], st
+
+            # -- phase 2: deliberately shifted distribution -----------
+            for _ in range(14):
+                svc.predict("km", _shifted(32, rng=rng))
+            deadline = time.time() + 5
+            while not sketch.SKETCHES.status("km")["drifting"] and time.time() < deadline:
+                time.sleep(0.01)
+            st = sketch.SKETCHES.status("km")
+            assert st["drifting"], st
+            sketch.check_drift()
+            assert alerts.is_firing("drift:km", labels={"model": "km"})
+
+            # /driftz flips
+            rep = json.loads(
+                urllib.request.urlopen(url + "/driftz?format=json", timeout=5).read()
+            )
+            mdoc = [m for m in rep["models"] if m["model"] == "km"][0]
+            assert mdoc["drifting"] and mdoc["score"] > mdoc["threshold"]
+            # per-model /healthz flips status (liveness stays 200)
+            hb = json.loads(
+                urllib.request.urlopen(url + "/v1/models/km/healthz", timeout=5).read()
+            )
+            assert hb["status"] == "drifting" and hb["healthy"]
+            assert hb["drift"]["score"] == mdoc["score"]
+            assert any(a["name"] == "drift:km" for a in hb["alerts"])
+
+            # -- phase 3: synthetic latency injection -> fast burn ----
+            lat = tm.histogram("serving.latency_ms")
+            lat.reset()  # drop phase-1/2 exemplars: the alert must pin
+            # one of the synthetic injected traces below (and the reset
+            # itself exercises the windowed math's reset safety)
+            slo.install_default_slos()
+            t0 = time.time()
+            slo.evaluate(now=t0)
+            tids = []
+            for _ in range(150):
+                with tracing.request_span("/v1/predict/km", model="km") as req:
+                    pass
+                lat.observe(90.0, exemplar=req.trace_id)
+                tids.append(req.trace_id)
+            verdicts = {v["name"]: v for v in slo.evaluate(now=t0 + 60)}
+            assert verdicts["serving_latency"]["firing"]
+            assert alerts.is_firing("slo:serving_latency")
+            alert = [a for a in alerts.active_alerts()
+                     if a["name"] == "slo:serving_latency"][0]
+            assert alert["trace_id"] in tids
+
+            # the exemplar resolves through /tracez?trace_id=
+            tz = json.loads(
+                urllib.request.urlopen(
+                    url + f"/tracez?trace_id={alert['trace_id']}", timeout=5
+                ).read()
+            )
+            assert tz["trace_id"] == alert["trace_id"]
+            assert tz["route"] == "/v1/predict/km"
+
+            # /sloz shows the firing objective
+            sz = json.loads(
+                urllib.request.urlopen(url + "/sloz?format=json", timeout=5).read()
+            )
+            assert any(s["firing"] for s in sz["slos"])
+
+            # -- phase 4: both events in a merged cross-worker view ---
+            snap = aggregate.tag_snapshot()
+            merged = aggregate.merge_snapshots(
+                [snap, dict(snap, process_index=1)], publish=False
+            )
+            assert merged["drift"]["km"]["drifting"]
+            names = {a["name"] for a in merged["alerts"]["active"]}
+            assert {"drift:km", "slo:serving_latency"} <= names
+
+            # ...and in a crash flight-recorder bundle
+            bdir = str(tmp_path / "bundles")
+            path = flight_recorder.dump_bundle(
+                RuntimeError("boom"), reason="test", directory=bdir
+            )
+            bundle = json.load(open(path))
+            b_names = {a["name"] for a in bundle["alerts"]["active"]}
+            assert {"drift:km", "slo:serving_latency"} <= b_names
+            assert any(m["drifting"] for m in bundle["drift"]["models"])
+            from heat_tpu.telemetry.inspect import format_bundle
+
+            txt = format_bundle(bundle)
+            assert "drift:km" in txt and "slo:serving_latency" in txt
+
+            # -- phase 5: recovery resolves the burn alert ------------
+            for _ in range(3000):
+                lat.observe(2.0)
+            slo.evaluate(now=t0 + 120)
+            slo.evaluate(now=t0 + 190)
+            assert not alerts.is_firing("slo:serving_latency")
+            ev = [e["event"] for e in alerts.alert_events()
+                  if e["name"] == "slo:serving_latency"]
+            assert ev == ["fired", "resolved"]
+        finally:
+            svc.close()
+            tserver.stop_server()
+            tracing.reset_store()
+            tm.reset("serving.")

@@ -1,0 +1,496 @@
+"""SLO burn-rate monitors + alert/event subsystem (ISSUE 11 tentpole).
+
+The contract under test (docs/observability.md "SLOs & alerting"):
+
+* declarative objectives parse from the string grammar and evaluate as
+  windowed burn-rate math over the CUMULATIVE bounded structures —
+  bucket-state deltas between ticks, O(windows x buckets) memory,
+  reset-safe (a counter/histogram reset restarts the window's delta
+  from zero, never a negative phantom);
+* alerting is multi-window: an alert fires only when BOTH the fast and
+  the slow window burn above their factors, resolves once the fast
+  window drops under 1.0, and the transition carries the nearest
+  exemplar trace_id above the violated threshold;
+* alerts deduplicate by (name, labels): re-firing refreshes, only
+  fired/resolved transitions land in the bounded event ring, and
+  cross-worker merging is a pure deterministic fold;
+* the histogram edge cases the windowed math leans on: quantile at
+  q=0/1, single-bucket occupancy, exemplar survival through reset()
+  and merge_snapshots.
+"""
+
+import threading
+import time
+
+import pytest
+
+from heat_tpu import telemetry
+from heat_tpu.telemetry import aggregate
+from heat_tpu.telemetry import alerts
+from heat_tpu.telemetry import metrics as tm
+from heat_tpu.telemetry import slo
+from heat_tpu.telemetry import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_quality_signals():
+    """Every test starts with no objectives, no alerts, fresh metrics
+    under the test's own names."""
+    slo.reset_monitors()
+    alerts.clear_alerts()
+    yield
+    slo.reset_monitors()
+    alerts.clear_alerts()
+    tm.reset("slotest.")
+
+
+def _fresh_hist(name):
+    h = tm.histogram(name)
+    h.reset()
+    return h
+
+
+# ----------------------------------------------------------------------
+# histogram edge cases the windowed math leans on
+# ----------------------------------------------------------------------
+class TestHistogramEdges:
+    def test_quantile_q0_q1_clamp_to_observed_extremes(self):
+        h = _fresh_hist("slotest.h_q01")
+        for v in (3.0, 5.0, 40.0):
+            h.observe(v)
+        assert h.quantile(0.0) == 3.0
+        assert h.quantile(1.0) == 40.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        with pytest.raises(ValueError):
+            h.quantile(-0.1)
+
+    def test_quantile_empty_is_none(self):
+        h = _fresh_hist("slotest.h_empty")
+        for q in (0.0, 0.5, 1.0):
+            assert h.quantile(q) is None
+
+    def test_single_bucket_occupancy_every_quantile_inside(self):
+        # all mass in ONE geometric bucket: every quantile must land in
+        # the exact observed [min, max], not at a bucket edge outside it
+        h = _fresh_hist("slotest.h_single")
+        for _ in range(100):
+            h.observe(7.0)
+        for q in (0.0, 0.01, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == 7.0
+
+    def test_single_low_bucket_nonpositive_observations(self):
+        h = _fresh_hist("slotest.h_low")
+        for v in (0.0, -1.0, 0.0):
+            h.observe(v)
+        assert h.quantile(0.5) == -1.0  # clamped to the observed min
+        assert h.quantile(1.0) == 0.0
+
+    def test_exemplar_cleared_by_reset(self):
+        h = _fresh_hist("slotest.h_exreset")
+        h.observe(5.0, exemplar="aa11")
+        assert h.exemplars()
+        assert "exemplars" in h.snapshot()
+        h.reset()
+        assert h.exemplars() == {}
+        assert "exemplars" not in h.snapshot()
+        assert h.count == 0
+
+    def test_exemplar_survives_merge_snapshots(self):
+        h = _fresh_hist("slotest.h_exmerge")
+        h.observe(5.0, exemplar="feedc0de00000001")
+        snap = aggregate.tag_snapshot()
+        other = dict(snap, process_index=1)
+        merged = aggregate.merge_snapshots([snap, other], publish=False)
+        sub = merged["merged"]["slotest.h_exmerge"]["per_worker"]
+        for ix in ("0", "1"):
+            ex = sub[ix]["exemplars"]
+            assert any(
+                rec["trace_id"] == "feedc0de00000001" for rec in ex.values()
+            ), ex
+
+    def test_bucket_counts_is_cumulative_and_consistent(self):
+        h = _fresh_hist("slotest.h_state")
+        for v in (0.5, 0.5, 200.0):
+            h.observe(v)
+        low, buckets, count, total = h.bucket_counts()
+        assert count == 3 and low == 0
+        assert sum(buckets.values()) == 3
+        assert total == pytest.approx(201.0)
+
+
+# ----------------------------------------------------------------------
+# windowed math: deltas, rates, reset safety
+# ----------------------------------------------------------------------
+class TestWindowedMath:
+    def test_windowed_delta_subtracts_cumulative_states(self):
+        h = _fresh_hist("slotest.h_delta")
+        h.observe(5.0)
+        old = h.bucket_counts()
+        for _ in range(4):
+            h.observe(50.0)
+        delta = slo.windowed_delta(old, h.bucket_counts())
+        assert delta[2] == 4
+        assert slo.fraction_over(delta, 25.0) == pytest.approx(1.0)
+
+    def test_windowed_delta_counter_reset_restarts_from_zero(self):
+        # the reset-correctness satellite: cumulative count SHRANK
+        # between samples -> the window reports the post-reset state,
+        # never a negative phantom
+        old = (2, {10: 50}, 52, 100.0)
+        cur = (0, {10: 3}, 3, 6.0)
+        delta = slo.windowed_delta(old, cur)
+        assert delta == cur
+        assert delta[2] == 3
+
+    def test_windowed_rate_and_reset(self):
+        assert slo.windowed_rate(100.0, 160.0, 60.0) == pytest.approx(1.0)
+        # reset: cur < old -> rate counts from zero, stays >= 0
+        assert slo.windowed_rate(100.0, 30.0, 10.0) == pytest.approx(3.0)
+        assert slo.windowed_rate(0.0, 0.0, 0.0) == 0.0
+
+    def test_fraction_over_interpolates_crossing_bucket(self):
+        h = _fresh_hist("slotest.h_frac")
+        for _ in range(100):
+            h.observe(10.0)
+        delta = slo.windowed_delta((0, {}, 0, 0.0), h.bucket_counts())
+        # threshold inside the bucket: fraction strictly between 0 and 1
+        frac = slo.fraction_over(delta, 9.5)
+        assert 0.0 < frac < 1.0
+        # 10.0 is exactly the bucket's upper bound: nothing is OVER it
+        assert slo.fraction_over(delta, 10.0) == 0.0
+        assert slo.fraction_over(delta, 100.0) == 0.0
+        assert slo.fraction_over(delta, 0.001) == pytest.approx(1.0)
+
+    def test_windowed_quantile_matches_histogram_quantile_model(self):
+        h = _fresh_hist("slotest.h_wq")
+        for v in [1.0] * 90 + [100.0] * 10:
+            h.observe(v)
+        delta = slo.windowed_delta((0, {}, 0, 0.0), h.bucket_counts())
+        p50 = slo.windowed_quantile(delta, 0.5)
+        p99 = slo.windowed_quantile(delta, 0.99)
+        assert p50 < 2.0
+        assert p99 > 50.0
+        assert slo.windowed_quantile((0, {}, 0, 0.0), 0.5) is None
+
+    def test_burn_rate_is_violation_over_budget(self):
+        assert slo.burn_rate(0.14, 0.99) == pytest.approx(14.0, rel=1e-6)
+        assert slo.burn_rate(0.0, 0.99) == 0.0
+
+
+# ----------------------------------------------------------------------
+# the declarative grammar
+# ----------------------------------------------------------------------
+class TestParse:
+    def test_quantile_spec(self):
+        s = slo.parse_slo("lat", "serving.latency_ms p99 < 25 over 60s/300s")
+        assert s.kind == "quantile" and s.q == pytest.approx(0.99)
+        assert s.metric == "serving.latency_ms"
+        assert s.threshold == 25.0 and s.fast_s == 60.0 and s.slow_s == 300.0
+        assert "p99" in s.describe()
+
+    def test_rate_spec_with_summed_counters(self):
+        s = slo.parse_slo(
+            "shed",
+            "serving.shed_quota+serving.shed_queue / serving.requests "
+            "rate < 0.01 over 60s",
+        )
+        assert s.kind == "rate"
+        assert s.metrics == ("serving.shed_quota", "serving.shed_queue")
+        assert s.denominators == ("serving.requests",)
+        assert s.fast_s == 60.0
+
+    def test_freshness_spec(self):
+        s = slo.parse_slo("hb", "fit.heartbeat_ts fresh < 30s")
+        assert s.kind == "freshness" and s.threshold == 30.0
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError):
+            slo.parse_slo("x", "serving.latency_ms 25")
+        with pytest.raises(ValueError):
+            slo.parse_slo("x", "serving.latency_ms q99 < 25")
+        with pytest.raises(ValueError):
+            slo.SLO("x", "nonsense", 1.0, metric="m")
+        with pytest.raises(ValueError):
+            slo.SLO("x", "rate", 1.0)  # no counters
+
+
+# ----------------------------------------------------------------------
+# burn-rate evaluation + alert lifecycle
+# ----------------------------------------------------------------------
+class TestBurnRateAlerting:
+    def test_multiwindow_fire_and_resolve_with_exemplar(self):
+        h = _fresh_hist("slotest.lat_ms")
+        s = slo.parse_slo("lat", "slotest.lat_ms p99 < 25 over 60s/300s")
+        slo.register_slo(s)
+        t0 = 1_000_000.0
+        slo.evaluate(now=t0)
+
+        for _ in range(100):
+            h.observe(5.0)
+        r = slo.evaluate(now=t0 + 30)[0]
+        assert not r["firing"] and r["burn_fast"] == 0.0
+
+        # synthetic latency injection: violations with exemplars
+        for i in range(100):
+            h.observe(80.0, exemplar=f"{i:016x}")
+        r = slo.evaluate(now=t0 + 60)[0]
+        assert r["firing"], r
+        assert r["burn_fast"] >= s.fast_burn and r["burn_slow"] >= s.slow_burn
+        assert alerts.is_firing("slo:lat")
+        a = alerts.active_alerts()[0]
+        assert a["severity"] == "page"
+        assert a["trace_id"] is not None  # nearest exemplar above 25
+
+        # recovery: healthy traffic, fast window empties of violations
+        for _ in range(2000):
+            h.observe(2.0)
+        slo.evaluate(now=t0 + 120)
+        slo.evaluate(now=t0 + 190)
+        assert not alerts.is_firing("slo:lat")
+        ev = [e["event"] for e in alerts.alert_events() if e["name"] == "slo:lat"]
+        assert ev == ["fired", "resolved"]
+
+    def test_fast_spike_alone_does_not_page(self):
+        # slow-window guard: a short burst burns the fast window hard,
+        # but the slow window — mostly healthy history — stays under
+        # its factor, so no page (the multi-window flap suppressor)
+        h = _fresh_hist("slotest.spike_ms")
+        s = slo.SLO("spike", "quantile", 25.0, metric="slotest.spike_ms",
+                    q=0.99, fast_s=60, slow_s=300, fast_burn=14, slow_burn=6)
+        slo.register_slo(s)
+        t0 = 2_000_000.0
+        slo.evaluate(now=t0)
+        for _ in range(2000):  # 4 minutes of healthy traffic
+            h.observe(5.0)
+        slo.evaluate(now=t0 + 240)
+        for _ in range(100):  # then a one-minute spike
+            h.observe(80.0)
+        r = slo.evaluate(now=t0 + 300)[0]
+        # fast window holds only the spike; slow dilutes it under 6x
+        assert r["burn_fast"] >= 14
+        assert r["burn_slow"] < 6
+        assert not r["firing"]
+        assert not alerts.is_firing("slo:spike")
+
+    def test_rate_slo_counter_reset_safe(self):
+        shed = tm.counter("slotest.shed")
+        total = tm.counter("slotest.total")
+        shed.reset()
+        total.reset()
+        s = slo.SLO("shed", "rate", 0.01, metrics=("slotest.shed",),
+                    denominators=("slotest.total",), fast_s=60, slow_s=300,
+                    fast_burn=10, slow_burn=1)
+        slo.register_slo(s)
+        t0 = 3_000_000.0
+        slo.evaluate(now=t0)
+        total.inc(1000)
+        shed.inc(500)  # 50% shed >> 1% objective
+        r = slo.evaluate(now=t0 + 60)[0]
+        assert r["firing"], r
+        # counter RESET mid-flight: the next window must not go negative
+        shed.reset()
+        total.reset()
+        total.inc(100)
+        r = slo.evaluate(now=t0 + 120)[0]
+        assert r["windows"]["fast"]["numerator"] >= 0.0
+        r = slo.evaluate(now=t0 + 190)[0]
+        assert not r["firing"]
+
+    def test_freshness_slo(self):
+        g = tm.gauge("slotest.hb_ts")
+        g.set(0.0)
+        s = slo.SLO("hb", "freshness", 30.0, metric="slotest.hb_ts",
+                    severity="warn")
+        slo.register_slo(s)
+        now = time.time()
+        r = slo.evaluate(now=now)[0]
+        assert r["no_data"] and not r["firing"]  # never-beat: no verdict
+        g.set(now - 10)
+        r = slo.evaluate(now=now)[0]
+        assert not r["firing"] and r["age_s"] == pytest.approx(10, abs=0.1)
+        g.set(now - 120)
+        r = slo.evaluate(now=now)[0]
+        assert r["firing"]
+        assert alerts.is_firing("slo:hb")
+        a = [x for x in alerts.active_alerts() if x["name"] == "slo:hb"][0]
+        assert a["severity"] == "warn"
+
+    def test_default_slos_installed(self):
+        names = slo.install_default_slos()
+        assert "serving_latency" in names and "serving_shed" in names
+        assert set(names) <= set(slo.registered_slos())
+        # idempotent re-install keeps one instance per name
+        slo.install_default_slos()
+        assert slo.registered_slos().count("serving_latency") == 1
+
+    def test_tick_thread_start_stop(self):
+        h = _fresh_hist("slotest.tick_ms")
+        slo.register_slo(
+            slo.SLO("tick", "quantile", 25.0, metric="slotest.tick_ms", q=0.99)
+        )
+        evals0 = tm.counter("slo.evaluations").value
+        assert slo.start_monitor(0.02)
+        assert slo.start_monitor(0.02)  # idempotent
+        time.sleep(0.15)
+        slo.stop_monitor()
+        assert tm.counter("slo.evaluations").value > evals0
+        rep = slo.slo_report()
+        assert rep["slos"] and rep["slos"][0]["name"] == "tick"
+
+    def test_start_monitor_zero_tick_stays_manual(self):
+        assert not slo.start_monitor(0)
+
+
+# ----------------------------------------------------------------------
+# the alert subsystem's own contract
+# ----------------------------------------------------------------------
+class TestAlerts:
+    def test_dedup_refire_refreshes_without_new_event(self):
+        assert alerts.fire("a1", "warn", "first", value=1.0)
+        assert not alerts.fire("a1", "page", "second", value=2.0, trace_id="tt")
+        assert len(alerts.alert_events()) == 1
+        a = alerts.active_alerts()[0]
+        assert a["value"] == 2.0 and a["severity"] == "page"
+        assert a["trace_id"] == "tt"
+
+    def test_labels_distinguish_alerts(self):
+        alerts.fire("drift", labels={"model": "a"})
+        alerts.fire("drift", labels={"model": "b"})
+        assert len(alerts.active_alerts()) == 2
+        assert alerts.resolve("drift", labels={"model": "a"})
+        assert alerts.is_firing("drift", labels={"model": "b"})
+        assert not alerts.is_firing("drift", labels={"model": "a"})
+
+    def test_resolve_idempotent_and_transition_only_events(self):
+        assert not alerts.resolve("never_fired")
+        alerts.fire("flap")
+        alerts.resolve("flap")
+        alerts.fire("flap")
+        alerts.resolve("flap")
+        ev = [e["event"] for e in alerts.alert_events() if e["name"] == "flap"]
+        assert ev == ["fired", "resolved", "fired", "resolved"]
+        resolved = [e for e in alerts.alert_events() if e["event"] == "resolved"]
+        assert all("active_s" in e for e in resolved)
+
+    def test_event_ring_bounded(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_ALERT_RING", "4")
+        alerts.refresh_env()
+        try:
+            for i in range(10):
+                alerts.fire(f"e{i}")
+                alerts.resolve(f"e{i}")
+            assert len(alerts.alert_events()) == 4
+        finally:
+            monkeypatch.delenv("HEAT_TPU_ALERT_RING")
+            alerts.refresh_env()
+
+    def test_bad_severity_raises(self):
+        with pytest.raises(ValueError):
+            alerts.fire("x", severity="catastrophic")
+
+    def test_severity_ordering_in_active_table(self):
+        alerts.fire("low", severity="info")
+        alerts.fire("high", severity="page")
+        alerts.fire("mid", severity="warn")
+        sevs = [a["severity"] for a in alerts.active_alerts()]
+        assert sevs == ["page", "warn", "info"]
+
+    def test_merge_alert_snapshots_deterministic(self):
+        alerts.fire("s1", severity="page", labels={"model": "m"})
+        snap = alerts.alerts_snapshot()
+        merged_a = alerts.merge_alert_snapshots([("0", snap), ("1", snap)])
+        merged_b = alerts.merge_alert_snapshots([("1", snap), ("0", snap)])
+        assert merged_a == merged_b
+        assert merged_a["active_count"] == 2  # one per replica: both burn
+        assert merged_a["worst_severity"] == "page"
+
+    def test_concurrent_fire_resolve_threads(self):
+        # the TSAN-lane surface: alert mutations from many threads
+        def worker(i):
+            for j in range(50):
+                alerts.fire(f"t{i}", value=float(j))
+                alerts.resolve(f"t{i}")
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not alerts.active_alerts()
+
+
+# ----------------------------------------------------------------------
+# cross-worker + bundle plumbing
+# ----------------------------------------------------------------------
+class TestAggregation:
+    def test_tag_snapshot_ships_alerts_and_merge_folds_them(self):
+        alerts.fire("s1", severity="page", message="m", labels={"k": "v"})
+        snap = aggregate.tag_snapshot()
+        assert snap["alerts"]["active"]
+        other = dict(snap, process_index=1)
+        merged = aggregate.merge_snapshots([snap, other], publish=False)
+        assert merged["alerts"]["active_count"] == 2
+        workers = {a["worker"] for a in merged["alerts"]["active"]}
+        assert workers == {"0", "1"}
+
+    def test_flight_bundle_carries_alert_and_slo_sections(self):
+        from heat_tpu.telemetry import flight_recorder
+
+        h = _fresh_hist("slotest.bundle_ms")
+        slo.register_slo(
+            slo.SLO("bndl", "quantile", 25.0, metric="slotest.bundle_ms", q=0.9)
+        )
+        slo.evaluate()
+        alerts.fire("bundle_alert", severity="warn", message="hello")
+        doc = flight_recorder.build_bundle(reason="test")
+        assert doc["alerts"]["active"][0]["name"] == "bundle_alert"
+        assert any(s["name"] == "bndl" for s in doc["slo"]["slos"])
+        from heat_tpu.telemetry.inspect import format_bundle
+
+        txt = format_bundle(doc)
+        assert "bundle_alert" in txt
+        assert "slo verdicts" in txt
+
+
+# ----------------------------------------------------------------------
+# /sloz HTTP surface + escaping
+# ----------------------------------------------------------------------
+class TestSlozEndpoint:
+    def test_sloz_json_and_html(self):
+        import json as _json
+        import urllib.request
+
+        from heat_tpu.telemetry import server as tserver
+
+        h = _fresh_hist("slotest.http_ms")
+        slo.register_slo(
+            slo.SLO("http", "quantile", 25.0, metric="slotest.http_ms", q=0.99)
+        )
+        slo.evaluate()
+        tserver.stop_server()
+        srv = tserver.start_server(0)
+        try:
+            doc = _json.loads(
+                urllib.request.urlopen(srv.url + "/sloz?format=json", timeout=5).read()
+            )
+            assert any(s["name"] == "http" for s in doc["slos"])
+            html = urllib.request.urlopen(srv.url + "/sloz", timeout=5).read().decode()
+            assert "burn-rate" in html and "slotest.http_ms" in html
+            root = urllib.request.urlopen(srv.url + "/", timeout=5).read().decode()
+            assert "/sloz" in root and "/driftz" in root
+        finally:
+            tserver.stop_server()
+
+    def test_sloz_html_escapes_hostile_names(self):
+        evil = "<script>alert(1)</script>"
+        slo.register_slo(
+            slo.SLO(evil, "quantile", 25.0, metric="slotest.evil_ms", q=0.99)
+        )
+        _fresh_hist("slotest.evil_ms")
+        slo.evaluate()
+        alerts.fire(evil, severity="page", message=f"msg {evil}",
+                    labels={"model": evil})
+        html = slo.render_sloz_html()
+        assert "<script>" not in html
+        assert "&lt;script&gt;" in html
