@@ -22,6 +22,19 @@ Three cooperating analyzers (docs/static_analysis.md):
   :data:`~heat_tpu.analysis.concurrency.LOCK_REGISTRY`).  Run as
   ``python -m heat_tpu.analysis <paths>``; ``scripts/lint_gate.py``
   gates CI against ``scripts/lint_baseline.json``.
+* the precision & memory layer (ISSUE 12):
+  :mod:`~heat_tpu.analysis.dtype_flow` walks the jaxpr for precision
+  hazards (J201 silent truncation, J202 long-axis low-precision
+  accumulation, J203 unpinned low-precision contractions, J204
+  precision-policy violations);
+  :mod:`~heat_tpu.analysis.memory_model` predicts peak per-device HBM
+  from the jaxpr (liveness + donation aliasing + sharding division) and
+  emits J301 against ``HEAT_TPU_HBM_BUDGET_BYTES``;
+  :mod:`~heat_tpu.analysis.precision_policy` holds the pure-literal
+  :data:`~heat_tpu.analysis.precision_policy.POLICIES` registry of
+  per-estimator precision contracts (``bitwise`` | ``tolerance``),
+  enforced at the dispatch hook, the model registry, and the
+  ``python -m heat_tpu.analysis --rules J2,J3`` batch mode.
 * :mod:`~heat_tpu.analysis.tsan` — the runtime concurrency sanitizer
   (``HEAT_TPU_TSAN=0/1/raise``): every lock in ``LOCK_REGISTRY`` is an
   instrumented proxy feeding a global lock-order graph (cycle =
@@ -46,15 +59,19 @@ __all__ = [
     "AnalysisWarning",
     "Diagnostic",
     "LOCK_REGISTRY",
+    "POLICIES",
+    "PrecisionPolicyError",
     "ProgramLintError",
     "RULES",
     "Violation",
     "analysis_mode",
     "analyze",
     "analyze_compiled_text",
+    "analyze_dtype_flow",
     "analyze_jaxpr",
     "clear_diagnostics",
     "concurrency",
+    "estimate_peak",
     "lint_file",
     "lint_paths",
     "recent_diagnostics",
@@ -78,10 +95,23 @@ _EXPORTS = {
     "analyze": "program_lint",
     "analyze_compiled_text": "program_lint",
     "analyze_jaxpr": "program_lint",
+    "analyze_dtype_flow": "dtype_flow",
+    "estimate_peak": "memory_model",
+    "POLICIES": "precision_policy",
+    "PrecisionPolicyError": "precision_policy",
     "LOCK_REGISTRY": "concurrency",
 }
 
-_SUBMODULES = ("ast_lint", "concurrency", "diagnostics", "program_lint", "tsan")
+_SUBMODULES = (
+    "ast_lint",
+    "concurrency",
+    "diagnostics",
+    "dtype_flow",
+    "memory_model",
+    "precision_policy",
+    "program_lint",
+    "tsan",
+)
 
 
 def __getattr__(name: str):

@@ -1,14 +1,26 @@
-"""CLI for the AST-level framework-invariant linter.
+"""CLI for the AST-level framework-invariant linter and the J2/J3 batch.
 
     python -m heat_tpu.analysis heat_tpu/ [more paths...]
         [--baseline scripts/lint_baseline.json] [--no-baseline]
         [--format text|json] [--list-rules]
 
-Exit status: 0 when every violation is covered by the baseline (or none
-exist), 1 when new violations are present.  With no ``--baseline``
-argument the checked-in ``scripts/lint_baseline.json`` next to the repo
-root is used when it exists — so ``python -m heat_tpu.analysis
-heat_tpu/`` run from a checkout gates exactly like CI.
+    python -m heat_tpu.analysis --rules J2,J3 [--format text|json]
+
+The default mode runs the AST linter.  Exit status: 0 when every
+violation is covered by the baseline (or none exist), 1 when new
+violations are present.  With no ``--baseline`` argument the checked-in
+``scripts/lint_baseline.json`` next to the repo root is used when it
+exists — so ``python -m heat_tpu.analysis heat_tpu/`` run from a
+checkout gates exactly like CI.
+
+``--rules`` selects the **program batch mode** instead: every served
+estimator kind is fitted on a tiny synthetic set and its predict
+program driven through the REAL dispatch analyze hook (warn mode,
+fresh executable cache) under its precision-policy scope — the same
+choke point production hits — then the diagnostics matching the given
+rule prefixes (``J2`` = dtype flow J201-J204, ``J3`` = peak-HBM J301;
+``J1`` also accepted) are reported with each program's predicted peak
+HBM.  Exit status: 0 when no matching diagnostic fired, 1 otherwise.
 """
 
 from __future__ import annotations
@@ -24,6 +36,108 @@ from .ast_lint import (
     violations_to_json,
     _find_repo_root,
 )
+
+
+def _program_batch(rules: str, fmt: str) -> int:
+    """Fit the served estimator kinds and run their predict programs
+    through the armed dispatch hook; report rule-filtered diagnostics."""
+    import numpy as np
+
+    import heat_tpu as ht
+    from ..core import dispatch
+    from ..serving import model_io
+    from . import diagnostics, memory_model, precision_policy
+    from .program_lint import reset_dispatch_state
+
+    prefixes = tuple(p.strip() for p in rules.split(",") if p.strip())
+
+    rng = np.random.default_rng(0)
+    xf = ht.array(rng.standard_normal((64, 8)).astype(np.float32), split=None)
+    yf = ht.array((rng.standard_normal((64,)) > 0).astype(np.int32), split=None)
+    xr = ht.array(rng.standard_normal((64, 8)).astype(np.float32), split=None)
+
+    def fitted():
+        km = ht.cluster.KMeans(n_clusters=3, init="random", max_iter=3,
+                               random_state=0)
+        km.fit(xf)
+        kmed = ht.cluster.KMedians(n_clusters=3, init="random", max_iter=3,
+                                   random_state=0)
+        kmed.fit(xf)
+        kmedo = ht.cluster.KMedoids(n_clusters=3, init="random", max_iter=3,
+                                    random_state=0)
+        kmedo.fit(xf)
+        pca = ht.decomposition.PCA(n_components=3)
+        pca.fit(xf)
+        lasso = ht.regression.Lasso(max_iter=5)
+        lasso.fit(xf, ht.array(rng.standard_normal((64,)).astype(np.float32)))
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=3)
+        knn.fit(xf, yf)
+        return [km, kmed, kmedo, pca, lasso, knn]
+
+    estimators = fitted()
+    prev_mode = diagnostics.set_analysis_mode("off")
+    report = {}
+    rc = 0
+    try:
+        for est in estimators:
+            kind = type(est).__name__
+            diagnostics.clear_diagnostics()
+            reset_dispatch_state()
+            memory_model.reset_estimates()
+            dispatch.clear_cache()
+            diagnostics.set_analysis_mode("warn")
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                model_io.infer(est, xr)
+            diagnostics.set_analysis_mode("off")
+            diags = [
+                d for d in diagnostics.recent_diagnostics()
+                if any(d.rule.startswith(p) for p in prefixes)
+            ]
+            peaks = memory_model.peak_summary()["estimates"]
+            peak = max(
+                (rec["per_device_bytes"] for rec in peaks.values()), default=0
+            )
+            report[kind] = {
+                "policy": precision_policy.policy_for(kind),
+                "compute_dtype": precision_policy.compute_dtype(kind),
+                "predicted_peak_bytes": peak,
+                "diagnostics": [
+                    {"rule": d.rule, "location": d.location,
+                     "message": d.message}
+                    for d in diags
+                ],
+            }
+            if diags:
+                rc = 1
+    finally:
+        diagnostics.set_analysis_mode(prev_mode)
+        diagnostics.clear_diagnostics()
+        reset_dispatch_state()
+        dispatch.clear_cache()
+
+    if fmt == "json":
+        print(json.dumps({"rules": prefixes, "programs": report}, indent=1))
+    else:
+        for kind, rec in report.items():
+            pol = rec["policy"]
+            mode = pol["mode"] if pol else "undeclared"
+            print(
+                f"{kind}: policy={mode} compute={rec['compute_dtype']} "
+                f"predicted_peak={rec['predicted_peak_bytes']}B "
+                f"{len(rec['diagnostics'])} diagnostic(s)"
+            )
+            for d in rec["diagnostics"]:
+                print(f"  - {d['rule']} [{d['location']}]: {d['message']}")
+        total = sum(len(r["diagnostics"]) for r in report.values())
+        print(
+            f"program batch ({rules}): {total} diagnostic(s) over "
+            f"{len(report)} estimator predict program(s)",
+            file=sys.stderr,
+        )
+    return rc
 
 
 def _load_baseline(path: str):
@@ -48,12 +162,20 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--rules", default=None, metavar="J2,J3",
+                    help="program batch mode: fit the served estimators and "
+                         "run their predict programs through the armed "
+                         "dispatch hook, reporting diagnostics whose rule "
+                         "matches the given prefixes")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule, desc in sorted(RULES.items()):
             print(f"{rule}  {desc}")
         return 0
+
+    if args.rules:
+        return _program_batch(args.rules, args.format)
 
     paths = args.paths
     repo_root = _find_repo_root(paths[0] if paths else os.getcwd())

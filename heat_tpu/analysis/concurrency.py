@@ -109,6 +109,12 @@ LOCK_REGISTRY = {
         "structures": ("analysis.program_lint.key_groups",),
         "doc": "normalized-dispatch-key groups the J103 recompile-churn check accumulates; misses can compile on any thread that dispatches",
     },
+    "analysis.memory_model.estimates": {
+        "file": "heat_tpu/analysis/memory_model.py",
+        "spellings": ("_EST_LOCK",),
+        "structures": ("analysis.memory_model.estimates",),
+        "doc": "the bounded per-program peak-HBM estimate table: written by note_estimate() on whichever thread triggered the dispatch compile, read by /statusz handler threads and the crash excepthook",
+    },
     "analysis.diagnostics.ring": {
         "file": "heat_tpu/analysis/diagnostics.py",
         "spellings": ("_LOCK",),

@@ -1,0 +1,349 @@
+"""Jaxpr dtype-flow lint: precision hazards the promotion rules hide.
+
+The reference framework leans on PyTorch's *runtime* type promotion;
+here every program is a jaxpr first, so precision properties are checked
+**statically** — the walker propagates per-value dtype through every
+eqn (recursing into pjit/scan/while/cond sub-jaxprs) and emits four
+diagnostics, the J2xx family (docs/static_analysis.md):
+
+* **J201 — silent float truncation.**  A ``convert_element_type``
+  narrowing a float value (f64→f32, f32→bf16/f16) that nothing
+  sanctioned: at the jaxpr level an implicit promotion-narrowing and an
+  explicit ``astype`` are indistinguishable, so *sanctioning is the
+  declaration* — a narrowing is clean only when its target dtype is
+  allowed by the active ``tolerance`` precision policy
+  (:mod:`~heat_tpu.analysis.precision_policy`) or listed in
+  ``allowed_narrowing``.  Weak-typed sources (Python scalars) are
+  exempt (J103's domain).
+* **J202 — long-axis low-precision accumulation.**  A reduction
+  (``reduce_sum``/``reduce_prod``/``cum*``) or ``scan`` carry that
+  accumulates in bf16/f16 over an extent >= ``HEAT_TPU_J202_THRESHOLD``
+  without widening: ~8 mantissa bits swallow increments once the
+  running sum outgrows them — the classic "mean over a long axis is
+  garbage in bf16" bug.  (``jnp.sum`` upcasts internally; this catches
+  the ``lax``-level and hand-written-kernel paths that do not.)
+* **J203 — unpinned low-precision contraction.**  A ``dot_general`` /
+  ``conv_general_dilated`` over bf16/f16 operands whose accumulation is
+  not pinned wide: neither ``preferred_element_type`` nor a
+  HIGH/HIGHEST ``precision=`` requests f32 accumulation, so the MXU
+  accumulates (or XLA is free to accumulate) in the storage dtype.
+* **J204 — precision-policy violation.**  With an active policy (a
+  predict :func:`~heat_tpu.analysis.precision_policy.scope`, or an
+  explicit ``policy=``), any float compute dtype appearing in the
+  program outside the policy's ``compute_dtypes`` set.
+
+Entry points: :func:`analyze_dtype_flow` (callable or jaxpr), used by
+``program_lint.analyze`` and the ``core/dispatch.py`` compile hook, and
+the ``python -m heat_tpu.analysis --rules J2`` batch mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core import _env
+from .diagnostics import Diagnostic
+
+__all__ = ["analyze_dtype_flow", "reduction_threshold"]
+
+#: float dtypes with <= 2-byte storage: the "low precision" set of the
+#: J202/J203 accumulation rules
+_LOW_FLOATS = ("bfloat16", "float16")
+
+#: reduction primitives J202 inspects: name -> how to read the reduced
+#: extent ("axes" = product over params["axes"], "axis" = shape[axis])
+_REDUCE_PRIMS = {
+    "reduce_sum": "axes",
+    "reduce_prod": "axes",
+    "cumsum": "axis",
+    "cumprod": "axis",
+    "cumlogsumexp": "axis",
+}
+
+_CONTRACT_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+def reduction_threshold() -> int:
+    """The J202 extent threshold (``HEAT_TPU_J202_THRESHOLD``)."""
+    return _env.env_int("HEAT_TPU_J202_THRESHOLD")
+
+
+def _dtype_of(var) -> Optional[np.dtype]:
+    aval = getattr(var, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    if dt is None:
+        return None
+    try:
+        return np.dtype(dt)
+    except TypeError:  # pragma: no cover - exotic extended dtypes
+        return None
+
+
+def _is_float(dt: Optional[np.dtype]) -> bool:
+    if dt is None:
+        return False
+    try:
+        return bool(jax.numpy.issubdtype(dt, np.floating))
+    except TypeError:  # pragma: no cover
+        return False
+
+
+def _is_low_float(dt: Optional[np.dtype]) -> bool:
+    return _is_float(dt) and dt.itemsize <= 2
+
+
+def _sub_jaxprs(eqn):
+    """Inner jaxprs of a higher-order eqn (pjit/scan/while/cond/remat)."""
+    out = []
+    for name in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
+        sub = eqn.params.get(name)
+        if sub is not None:
+            out.append(sub)
+    for sub in eqn.params.get("branches", ()) or ():
+        out.append(sub)
+    return [getattr(s, "jaxpr", s) for s in out]
+
+
+def _reduced_extent(eqn) -> int:
+    """Total extent the reduction runs over (1 when unreadable)."""
+    kind = _REDUCE_PRIMS[eqn.primitive.name]
+    shape = getattr(getattr(eqn.invars[0], "aval", None), "shape", None)
+    if shape is None:
+        return 1
+    try:
+        if kind == "axes":
+            ext = 1
+            for a in eqn.params.get("axes", ()) or ():
+                ext *= int(shape[a])
+            return ext
+        return int(shape[eqn.params.get("axis", 0)])
+    except (IndexError, TypeError):  # pragma: no cover - ragged params
+        return 1
+
+
+def _walk(
+    jaxpr,
+    diags: List[Diagnostic],
+    label: str,
+    allowed: Tuple[str, ...],
+    threshold: int,
+    compute_dtypes: set,
+    invar_ids: set,
+    depth: int = 0,
+) -> None:
+    if depth > 8:  # pragma: no cover - pathological nesting
+        return
+    # narrowest float width (bytes) that contributed to each value:
+    # narrowing BACK to a source's own width (jax's internal
+    # upcast-accumulate-downcast pattern, e.g. jnp.sum over bf16) loses
+    # nothing the inputs had and is not a J201 hazard
+    minw: Dict[int, int] = {}
+
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        dt = _dtype_of(v)
+        if _is_float(dt):
+            minw[id(v)] = dt.itemsize
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+
+        in_widths = [
+            minw.get(id(v), _dtype_of(v).itemsize)
+            for v in eqn.invars
+            if _is_float(_dtype_of(v)) and not getattr(
+                getattr(v, "aval", None), "weak_type", False
+            )
+        ]
+        out_w = min(in_widths) if in_widths else None
+        for v in eqn.outvars:
+            dt = _dtype_of(v)
+            if _is_float(dt):
+                compute_dtypes.add(dt.name)
+                minw[id(v)] = min(out_w, dt.itemsize) if out_w else dt.itemsize
+
+        if name == "convert_element_type":
+            src = eqn.invars[0]
+            old = _dtype_of(src)
+            new = _dtype_of(eqn.outvars[0])
+            aval = getattr(src, "aval", None)
+            if (
+                _is_float(old)
+                and _is_float(new)
+                and new.itemsize < old.itemsize
+                and new.itemsize < minw.get(id(src), old.itemsize)
+                and not getattr(aval, "weak_type", False)
+                and new.name not in allowed
+            ):
+                diags.append(Diagnostic(
+                    rule="J201",
+                    message=(
+                        f"{old.name} value silently truncated to {new.name} "
+                        "— no precision policy or allowed_narrowing entry "
+                        "sanctions this cast; declare the low-precision "
+                        "intent (a tolerance POLICIES entry + predict "
+                        "scope) or keep the value wide"
+                    ),
+                    location=label,
+                    details={"from": old.name, "to": new.name,
+                             "is_input": id(src) in invar_ids},
+                ))
+
+        elif name in _REDUCE_PRIMS:
+            op_dt = _dtype_of(eqn.invars[0])
+            out_dt = _dtype_of(eqn.outvars[0])
+            ext = _reduced_extent(eqn)
+            if (
+                _is_low_float(op_dt)
+                and _is_low_float(out_dt)
+                and ext >= threshold
+            ):
+                diags.append(Diagnostic(
+                    rule="J202",
+                    message=(
+                        f"{name} accumulates {ext} elements in "
+                        f"{out_dt.name} (>= threshold {threshold}) — "
+                        "~8 mantissa bits swallow increments once the "
+                        "running value outgrows them; accumulate in "
+                        "float32 (cast before the reduction) and narrow "
+                        "the result if needed"
+                    ),
+                    location=label,
+                    details={"primitive": name, "extent": ext,
+                             "dtype": out_dt.name, "threshold": threshold},
+                ))
+
+        elif name == "scan":
+            nc = int(eqn.params.get("num_consts", 0) or 0)
+            ncarry = int(eqn.params.get("num_carry", 0) or 0)
+            length = int(eqn.params.get("length", 0) or 0)
+            if length >= threshold:
+                for v in eqn.invars[nc:nc + ncarry]:
+                    dt = _dtype_of(v)
+                    if _is_low_float(dt):
+                        diags.append(Diagnostic(
+                            rule="J202",
+                            message=(
+                                f"scan carries a {dt.name} accumulator "
+                                f"through {length} steps (>= threshold "
+                                f"{threshold}) — carry in float32 and "
+                                "narrow on exit"
+                            ),
+                            location=label,
+                            details={"primitive": "scan", "extent": length,
+                                     "dtype": dt.name,
+                                     "threshold": threshold},
+                        ))
+                        break
+
+        elif name in _CONTRACT_PRIMS:
+            in_dts = [_dtype_of(v) for v in eqn.invars[:2]]
+            out_dt = _dtype_of(eqn.outvars[0])
+            if any(_is_low_float(d) for d in in_dts) and _is_low_float(out_dt):
+                prec = eqn.params.get("precision")
+                prec_names = [
+                    getattr(p, "name", str(p))
+                    for p in (prec if isinstance(prec, (tuple, list)) else (prec,))
+                    if p is not None
+                ]
+                pinned = any(p in ("HIGH", "HIGHEST") for p in prec_names)
+                if not pinned:
+                    diags.append(Diagnostic(
+                        rule="J203",
+                        message=(
+                            f"{name} over {in_dts[0].name} operands "
+                            "accumulates in the storage dtype — pass "
+                            "preferred_element_type=jnp.float32 (or "
+                            "precision='highest') so the MXU accumulates "
+                            "wide and only the result narrows"
+                        ),
+                        location=label,
+                        details={
+                            "primitive": name,
+                            "operand_dtypes": [d.name for d in in_dts if d],
+                            "preferred_element_type": out_dt.name,
+                        },
+                    ))
+
+        for sub in _sub_jaxprs(eqn):
+            _walk(sub, diags, label, allowed, threshold, compute_dtypes,
+                  invar_ids, depth + 1)
+
+
+def analyze_dtype_flow(
+    fn_or_jaxpr,
+    *args,
+    policy: Optional[Dict[str, Any]] = None,
+    allowed_narrowing: Sequence[str] = (),
+    label: str = "program",
+    threshold: Optional[int] = None,
+    **kwargs,
+) -> List[Diagnostic]:
+    """Walk a program's jaxpr for the J201-J204 precision hazards;
+    returns the diagnostics without emitting them.
+
+    ``fn_or_jaxpr`` is a (Closed)Jaxpr, or a callable traced at
+    ``*args``/``**kwargs`` via ``jax.make_jaxpr``.  ``policy`` is a
+    precision-policy document (default: the active predict scope's, via
+    :func:`~heat_tpu.analysis.precision_policy.active_policy`); a
+    ``tolerance`` policy's ``compute_dtypes`` sanction J201 narrowings
+    into them and bound the J204 compute-dtype set.
+    ``allowed_narrowing`` adds explicit extra J201-sanctioned target
+    dtypes (the standalone caller's declaration)."""
+    jaxpr = fn_or_jaxpr
+    if callable(fn_or_jaxpr) and not hasattr(fn_or_jaxpr, "eqns"):
+        jaxpr = jax.make_jaxpr(fn_or_jaxpr)(*args, **kwargs)
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    # a jitted callable traces to one pjit wrapper eqn; unwrap so the
+    # invar identity set (J201's is_input detail) matches the real body
+    while (
+        len(jaxpr.eqns) == 1
+        and jaxpr.eqns[0].primitive.name == "pjit"
+        and jaxpr.eqns[0].params.get("jaxpr") is not None
+    ):
+        jaxpr = getattr(jaxpr.eqns[0].params["jaxpr"], "jaxpr",
+                        jaxpr.eqns[0].params["jaxpr"])
+
+    if policy is None:
+        from . import precision_policy as _pp
+
+        policy = _pp.active_policy()
+
+    allowed = tuple(allowed_narrowing)
+    if policy is not None and policy.get("mode") == "tolerance":
+        allowed = allowed + tuple(policy.get("compute_dtypes") or ())
+    if threshold is None:
+        threshold = reduction_threshold()
+
+    diags: List[Diagnostic] = []
+    compute_dtypes: set = set()
+    invar_ids = {id(v) for v in jaxpr.invars}
+    _walk(jaxpr, diags, label, allowed, threshold, compute_dtypes, invar_ids)
+
+    if policy is not None:
+        dtypes = tuple(policy.get("compute_dtypes") or ("float32",))
+        allowed_set = set(dtypes)
+        # the policy governs the compute-dtype CHOICE, i.e. precision
+        # lost below the native dtype; computing wider (f64 data fed to
+        # an f32-declared estimator) IS the native path at that width
+        native_size = np.dtype(dtypes[0]).itemsize
+        outside = sorted(
+            d for d in compute_dtypes - allowed_set
+            if np.dtype(d).itemsize < native_size
+        )
+        if outside:
+            diags.append(Diagnostic(
+                rule="J204",
+                message=(
+                    f"program computes in {outside} but the active "
+                    f"{policy.get('mode')} precision policy allows only "
+                    f"{sorted(allowed_set)} — fix the compute dtype or "
+                    "widen the POLICIES declaration (with a tolerance "
+                    "bench)"
+                ),
+                location=label,
+                details={"outside": outside, "policy": dict(policy)},
+            ))
+    return diags

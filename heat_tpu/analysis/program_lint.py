@@ -32,6 +32,13 @@ turns both into structured :class:`~.diagnostics.Diagnostic` records:
   usually an accidental mixed-precision operand doubling the program's
   memory traffic.
 
+The precision/memory layer (ISSUE 12) rides the same entry points: the
+jaxpr dtype-flow walker (:mod:`~heat_tpu.analysis.dtype_flow`, J201-J204)
+and the static peak-HBM estimator
+(:mod:`~heat_tpu.analysis.memory_model`, J301) run over every program
+:func:`analyze` or the dispatch hook walks, with the active precision
+policy from :mod:`~heat_tpu.analysis.precision_policy`.
+
 Entry points: :func:`analyze` (standalone — trace, lower, compile and
 check any callable) and :func:`on_dispatch_compile` /
 :func:`note_dispatch_key` (the ``core/dispatch.py`` compile-path hook,
@@ -274,10 +281,13 @@ def analyze(
     static_argnums: Sequence[int] = (),
     label: Optional[str] = None,
     emit_diags: bool = False,
+    policy=None,
+    allowed_narrowing: Sequence[str] = (),
     **kwargs,
 ) -> List[Diagnostic]:
     """Trace, lower and compile ``fn(*args, **kwargs)`` and return every
-    SPMD diagnostic (J101-J105) found in the program.
+    SPMD diagnostic (J101-J105), precision diagnostic (J201-J204) and
+    memory-budget diagnostic (J301) found in the program.
 
     ``fn`` may be a plain callable or an existing ``jax.jit`` object;
     the analysis never *executes* the program (tracing and XLA
@@ -287,7 +297,10 @@ def analyze(
     production launch wrapper therefore checks the real accounting, not
     a test double.  ``emit_diags=True`` additionally routes each finding
     through :func:`~.diagnostics.emit` (telemetry counters + ring +
-    warn/raise per the current mode)."""
+    warn/raise per the current mode).  ``policy`` is a precision-policy
+    document for the J201/J204 checks (default: the active predict
+    scope's); ``allowed_narrowing`` lists extra dtype names explicit
+    narrowing casts may target without J201."""
     if label is None:
         label = getattr(fn, "__name__", None) or type(fn).__name__
     jitted = fn
@@ -318,6 +331,30 @@ def analyze(
             jaxpr = None
     if jaxpr is not None:
         diags.extend(analyze_jaxpr(jaxpr, label=label))
+        # precision layer: dtype-flow (J201-J204) + peak-HBM (J301) over
+        # the same derived jaxpr, with the caller's (or the active
+        # predict scope's) precision policy
+        from . import dtype_flow as _dflow
+        from . import memory_model as _mmodel
+
+        diags.extend(_dflow.analyze_dtype_flow(
+            jaxpr, label=label, policy=policy,
+            allowed_narrowing=allowed_narrowing,
+        ))
+        try:
+            est = _mmodel.estimate_jaxpr_peak(
+                jaxpr, donate_argnums=donate_argnums,
+                shard_shapes=_mmodel.shard_shapes_of(
+                    jax.tree_util.tree_leaves(args)
+                ),
+                label=label,
+            )
+        except Exception:  # lint: allow H501(estimator is best-effort; the J1xx checks still run)
+            est = None
+        if est is not None:
+            budget_diag = _mmodel.check_budget(est, label)
+            if budget_diag is not None:
+                diags.append(budget_diag)
     else:
         in_avals = jax.tree_util.tree_leaves(getattr(lowered, "in_avals", ()))
         weak = [i for i, a in enumerate(in_avals)
@@ -431,8 +468,12 @@ def on_dispatch_compile(entry, leaves, key, donate_argnums: Sequence[int] = ()) 
     Re-lowers the fresh jit entry at the miss arguments and walks the
     compiled module for J101/J102/J104 (the accounting cross-check uses
     the comm counters bumped while the entry traced — explicit
-    collectives fire at trace time, which happens inside this call).
-    Costs roughly one extra trace+compile per cache miss; off mode never
+    collectives fire at trace time, which happens inside this call),
+    then derives the jaxpr for the precision layer: dtype-flow J201-J204
+    against the active predict scope's policy, and the static peak-HBM
+    estimate (recorded into :func:`~.memory_model.peak_summary` and
+    checked against ``HEAT_TPU_HBM_BUDGET_BYTES`` — J301).  Costs
+    roughly one extra trace+compile per cache miss; off mode never
     reaches this function."""
     if analysis_mode() == "off":
         return
@@ -451,3 +492,27 @@ def on_dispatch_compile(entry, leaves, key, donate_argnums: Sequence[int] = ()) 
         text, accounted=accounted, label=label, donate_argnums=donate_argnums
     ):
         emit(d)
+
+    from . import dtype_flow as _dflow
+    from . import memory_model as _mmodel
+    from . import precision_policy as _pp
+
+    try:
+        jaxpr = jax.make_jaxpr(entry)(*leaves)
+    except Exception:  # lint: allow H501(jaxpr derivation is best-effort; the HLO checks above ran)
+        return
+    for d in _dflow.analyze_dtype_flow(
+        jaxpr, label=label, policy=_pp.active_policy()
+    ):
+        emit(d)
+    try:
+        est = _mmodel.estimate_jaxpr_peak(
+            jaxpr, donate_argnums=donate_argnums,
+            shard_shapes=_mmodel.shard_shapes_of(leaves), label=label,
+        )
+    except Exception:  # lint: allow H501(estimator is best-effort; dtype flow already emitted)
+        return
+    _mmodel.note_estimate(label, est)
+    budget_diag = _mmodel.check_budget(est, label)
+    if budget_diag is not None:
+        emit(budget_diag)

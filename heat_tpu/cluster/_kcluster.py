@@ -196,7 +196,17 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         raise NotImplementedError()
 
     def predict(self, x: DNDarray) -> DNDarray:
-        """Nearest learned center for each sample (_kcluster.py:268)."""
+        """Nearest learned center for each sample (_kcluster.py:268).
+
+        Runs under this kind's precision-policy scope
+        (:mod:`heat_tpu.analysis.precision_policy`): the dispatch
+        analyze hook checks the compiled program against the declared
+        policy, and a ``tolerance`` policy + ``HEAT_TPU_PREDICT_DTYPE``
+        flips the cdist cross term to bf16 compute (KMeans; the
+        ``bitwise`` kinds always serve native f32)."""
         if not isinstance(x, DNDarray):
             raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
-        return self._assign_to_cluster(x)
+        from ..analysis import precision_policy as _pp
+
+        with _pp.scope(type(self).__name__):
+            return self._assign_to_cluster(x)

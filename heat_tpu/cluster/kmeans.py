@@ -118,7 +118,7 @@ class KMeans(_KCluster):
     ):
         if max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {max_iter}")
-        if init == "kmeans++":
+        if isinstance(init, str) and init == "kmeans++":
             init = "probability_based"
         super().__init__(
             metric=lambda x, y: distance.cdist(x, y, quadratic_expansion=True),

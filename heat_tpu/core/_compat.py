@@ -17,19 +17,44 @@ perf grid dark):
   modern shard_map's vma checker needs on scan carries); older jax has
   no vma system, so the cast resolves to identity there.
 
+``HEAT_TPU_COMPAT_FORCE`` pins one resolver branch for CI: ``legacy``
+takes the ``jax.experimental`` adapter even when the top-level API
+exists, ``native`` *requires* the top-level API (erroring instead of
+silently shimming).  ``scripts/compat_matrix.py`` runs the
+collective-wrapper test subset under BOTH settings so neither branch
+can rot while the runner's jax only exercises one of them.
+
 Keep this module dependency-light: it is imported by the lowest-level
 kernel modules.
 """
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.lax
 
-__all__ = ["HAS_NATIVE_SHARD_MAP", "pcast", "psum_scatter", "shard_map"]
+__all__ = ["COMPAT_FORCE", "HAS_NATIVE_SHARD_MAP", "pcast", "psum_scatter", "shard_map"]
+
+#: resolver override (registered knob; read directly — this module must
+#: not depend on ``_env``'s import of the full core package)
+COMPAT_FORCE = os.environ.get("HEAT_TPU_COMPAT_FORCE", "").strip().lower()
+if COMPAT_FORCE not in ("", "native", "legacy"):
+    raise ValueError(
+        f"HEAT_TPU_COMPAT_FORCE={COMPAT_FORCE!r}: expected '', 'native' or 'legacy'"
+    )
 
 #: whether this jax exposes top-level ``jax.shard_map`` (the modern API)
 HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+if COMPAT_FORCE == "native" and not HAS_NATIVE_SHARD_MAP:
+    raise RuntimeError(
+        "HEAT_TPU_COMPAT_FORCE=native but this jax has no top-level "
+        "jax.shard_map — the native resolver branch cannot be exercised here"
+    )
+if COMPAT_FORCE == "legacy":
+    HAS_NATIVE_SHARD_MAP = False
 
 if HAS_NATIVE_SHARD_MAP:
     shard_map = jax.shard_map

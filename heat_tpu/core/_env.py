@@ -59,6 +59,10 @@ KNOBS = {
     "HEAT_TPU_TSAN": ("choice", "0", "concurrency sanitizer over the registered locks: 0 = off, 1 = armed (record tsan.* diagnostics), raise = armed + ProgramLintError at the finding site"),
     "HEAT_TPU_TSAN_DUMP": ("path", "", "write the sanitizer's findings as JSON to this path at process exit (the sanitized CI lane's audit artifact)"),
     "HEAT_TPU_TSAN_STACK_DEPTH": ("int", "10", "frames captured per lock-acquisition/access stack while the sanitizer is armed"),
+    "HEAT_TPU_J202_THRESHOLD": ("int", "1024", "reduced-extent threshold of the J202 low-precision-accumulation rule: a bf16/f16 reduction or scan over this many elements or more without f32 accumulation is flagged"),
+    "HEAT_TPU_HBM_BUDGET_BYTES": ("int", "0", "per-device HBM budget for the static peak-memory estimator: a freshly compiled program whose predicted per-device peak exceeds this many bytes emits J301 (0 = budget check off)"),
+    "HEAT_TPU_PREDICT_DTYPE": ("choice", "", "low-precision predict compute dtype for tolerance-policy estimators (bfloat16; empty = native float32); kinds whose POLICIES entry is bitwise or does not list the dtype keep serving native and emit one J204"),
+    "HEAT_TPU_COMPAT_FORCE": ("choice", "", "force one branch of the core/_compat.py jax-API resolver: 'legacy' uses the jax.experimental shard_map adapter even when jax.shard_map exists, 'native' requires the top-level API; empty = auto-detect (the compat-matrix CI lane sets this)"),
     # -- telemetry (heat_tpu/telemetry, docs/observability.md) ----------
     "HEAT_TPU_TRACE": ("bool", "1", "host-side span recording (0 = span() costs two attribute reads and records nothing)"),
     "HEAT_TPU_TRACE_RING": ("int", "4096", "span ring-buffer capacity (newest spans win)"),

@@ -48,7 +48,11 @@ Environment knobs (all default-on):
   (``heat_tpu/analysis/program_lint.py``) over every freshly compiled
   executable: unaccounted implicit collectives, accidental full
   gathers, scalar-dtype recompile churn and donation misses surface as
-  structured diagnostics (default ``0`` = off, free).
+  structured diagnostics (default ``0`` = off, free).  The same hook
+  arms the precision layer (``analysis/dtype_flow.py`` — J201-J204
+  against the active predict scope's precision policy) and the static
+  peak-HBM estimator (``analysis/memory_model.py`` — J301 against
+  ``HEAT_TPU_HBM_BUDGET_BYTES``).
 * ``HEAT_TPU_COST_ANALYSIS=1`` — record XLA's per-executable cost/memory
   analysis on every cache miss (``dispatch.flops_total``,
   :func:`cost_summary`; surfaced by the introspection server's

@@ -197,11 +197,19 @@ def build_estimator(doc: Dict[str, Any], comm=None):
 
 def infer(est, x: DNDarray) -> DNDarray:
     """The estimator's inference surface: ``predict`` where it exists
-    (clustering/regression/classification), else ``transform`` (PCA)."""
-    fn = getattr(est, "predict", None)
-    if fn is None:
-        fn = est.transform
-    return fn(x)
+    (clustering/regression/classification), else ``transform`` (PCA).
+
+    Runs under the estimator kind's precision-policy scope — the
+    serving choke point of the J204 enforcement: every program the
+    coalesced batch compiles is checked against the kind's declared
+    precision contract by the dispatch analyze hook."""
+    from ..analysis import precision_policy as _pp
+
+    with _pp.scope(type(est).__name__):
+        fn = getattr(est, "predict", None)
+        if fn is None:
+            fn = est.transform
+        return fn(x)
 
 
 def save_model(
@@ -212,6 +220,7 @@ def save_model(
     checkpointer=None,
     async_: bool = False,
     baseline: Optional[Dict[str, Any]] = None,
+    policy: Optional[Dict[str, Any]] = None,
 ) -> int:
     """Export a fitted estimator as model ``version`` in ``directory``.
 
@@ -228,10 +237,19 @@ def save_model(
     training data's) persisted INSIDE the version: the model and the
     distribution it expects travel as one atomic artifact, and the
     registry re-attaches the baseline to the drift monitor on every
-    hot-load — no side-channel file to lose.  Returns the version
+    hot-load — no side-channel file to lose.
+
+    ``policy`` overrides the estimator kind's declared precision policy
+    (default: its :data:`~heat_tpu.analysis.precision_policy.POLICIES`
+    entry).  The version metadata records the policy AND the effective
+    predict compute dtype at export time;
+    :meth:`~heat_tpu.serving.registry.ModelRegistry.load` refuses to
+    activate a version whose recorded dtype (or the serving process's
+    current one) violates the recorded policy.  Returns the version
     written."""
     import json as _json
 
+    from ..analysis import precision_policy as _pp
     from ..utils.checkpoint import Checkpointer
 
     doc = export_state(est)
@@ -240,11 +258,20 @@ def save_model(
         # and (stringified) bucket tables, and a string leaf rides the
         # checkpoint codec untouched — no array-leaf shape to validate
         doc["baseline_json"] = _json.dumps(baseline, sort_keys=True)
+    pol = (
+        _pp.validate_policy(policy) if policy is not None
+        else _pp.policy_for(doc["kind"])
+    )
     meta = {
         "serving_codec": CODEC_VERSION,
         "kind": doc["kind"],
         "name": name if name is not None else doc["kind"].lower(),
         "saved_at": time.time(),
+        # the precision contract this version serves under, and the
+        # compute dtype its predictions actually use in this process —
+        # the registry's load-time refusal checks the pair
+        "policy": dict(pol) if pol is not None else None,
+        "compute_dtype": _pp.compute_dtype(doc["kind"]),
     }
     ck = checkpointer if checkpointer is not None else Checkpointer(directory)
     try:

@@ -135,6 +135,17 @@ class ModelRegistry:
             doc = ck.restore(step, template=template, comm=comm)
             est = _mio.build_estimator(doc, comm=comm)
             meta = ck.metadata(step) or {}
+        # precision-policy choke point: refuse a version whose recorded
+        # compute dtype — or this process's effective one — violates the
+        # policy it was exported under (PrecisionPolicyError).  Raises
+        # BEFORE the install below, so a refused canary leaves the
+        # registry (and the active version) untouched.
+        from ..analysis import precision_policy as _pp
+
+        _pp.check_load(
+            doc.get("kind"), meta.get("policy"), meta.get("compute_dtype"),
+            label=f"registry.load:{name}@v{step}",
+        )
         baseline = None
         bj = doc.get("baseline_json")
         if bj:
@@ -153,6 +164,7 @@ class ModelRegistry:
             "world_size_written": written_world,
             "world_size_serving": comm.size,
             "baseline": baseline,
+            "policy": meta.get("policy"),
             "meta": meta,
         }
         with self._lock:

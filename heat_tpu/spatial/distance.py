@@ -54,6 +54,39 @@ def _pairwise_euclidean(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return jnp.sqrt(_pairwise_sqeuclidean(x, y))
 
 
+def _pairwise_sqeuclidean_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Mixed-precision expanded form: the O(n*m*f) cross term runs on
+    bf16 operands with **f32 accumulation pinned** via
+    ``preferred_element_type`` (the J203 rule's own prescription), while
+    the O((n+m)*f) norms stay f32 — rounding enters only through the
+    one-time bf16 quantization of the inputs, so the distance error is
+    ~1e-2 relative (the KMeans ``tolerance`` policy's contract) for half
+    the MXU traffic.  Only reachable under a tolerance-policy predict
+    scope (see :func:`cdist`), which also sanctions the narrowing casts
+    for the J201 dtype-flow rule."""
+    xb = x.astype(jnp.bfloat16)
+    yb = y.astype(jnp.bfloat16)
+    cross = jnp.matmul(xb, yb.T, preferred_element_type=jnp.float32)
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    y_sq = jnp.sum(y * y, axis=1, keepdims=True).T
+    d = x_sq + y_sq - 2.0 * cross
+    return jnp.maximum(d, 0.0)
+
+
+def _pairwise_euclidean_bf16(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Mixed-precision expanded-form euclidean (bf16 cross term)."""
+    return jnp.sqrt(_pairwise_sqeuclidean_bf16(x, y))
+
+
+def _active_lowp_dtype():
+    """The predict scope's low-precision compute dtype name (None =
+    native).  Lazy import: the policy layer sits above core, and the
+    query is one contextvar read on the miss-free hot path."""
+    from ..analysis import precision_policy as _pp
+
+    return _pp.active_compute_dtype()
+
+
 def _pairwise_manhattan(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     """City-block tile (the reference _manhattan, distance.py:110)."""
     return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
@@ -180,6 +213,10 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
     row-sharded, never per device."""
     if _ring_eligible(X, Y):
         _prep_checks(X, Y)
+        # the distributed ring stays f32: its tile/output buffers share
+        # the operand dtype, so the mixed-precision variant below (f32
+        # accumulation over bf16 operands) applies to the eager tile
+        # path only — the one serving's replicated predict batches take
         return _ring_cdist(X, Y, "euclidean" if quadratic_expansion else "euclidean_direct")
     xd, yd = _prep(X, Y)
     # through the executable cache: repeated shapes (iterative fits, the
@@ -188,6 +225,10 @@ def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool =
     from ..core import dispatch
 
     op = _pairwise_euclidean if quadratic_expansion else _pairwise_direct
+    if quadratic_expansion and _active_lowp_dtype() == "bfloat16":
+        # a tolerance-policy predict scope (precision_policy.scope +
+        # HEAT_TPU_PREDICT_DTYPE=bfloat16) flips the cross term to bf16
+        op = _pairwise_euclidean_bf16
     d = dispatch.eager_apply(op, (xd, yd))
     split = 0 if X.split is not None else None
     return DNDarray.from_dense(d, split, X.device, X.comm)

@@ -234,6 +234,26 @@ def _drift_state() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _analysis_state() -> Optional[Dict[str, Any]]:
+    """Recent program-lint diagnostics + the static peak-HBM estimate
+    table — was the crash an OOM the J301 budget predicted?"""
+    try:
+        from ..analysis import diagnostics as _adiag
+        from ..analysis import memory_model as _amem
+
+        return {
+            "mode": _adiag.analysis_mode(),
+            "recent_diagnostics": [
+                {"rule": d.rule, "location": d.location,
+                 "message": d.message, "details": d.details}
+                for d in _adiag.recent_diagnostics()[-20:]
+            ],
+            "hbm": _amem.peak_summary(),
+        }
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
 def _elastic_state() -> Optional[Dict[str, Any]]:
     """World size + loss/reshape counters at crash time — the first
     question a preemption postmortem asks."""
@@ -277,6 +297,7 @@ def build_bundle(
             "mode": _tsan.mode(),
             "findings": _tsan.findings(),
         },
+        "analysis": _analysis_state(),
         "elastic": _elastic_state(),
         "runtime": _runtime_info(),
     }

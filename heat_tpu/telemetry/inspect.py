@@ -228,6 +228,33 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
         if len(tsan_findings) > 10:
             lines.append(f"  ... {len(tsan_findings) - 10} more")
 
+    ana = doc.get("analysis") or {}
+    ana_diags = ana.get("recent_diagnostics") or []
+    ana_hbm = (ana.get("hbm") or {}).get("estimates") or {}
+    if ana_diags or ana_hbm:
+        lines.append(_rule(
+            f"program lint ({len(ana_diags)} recent diagnostic(s), "
+            f"mode {ana.get('mode')})"
+        ))
+        for d in ana_diags[:10]:
+            lines.append(f"{d.get('rule')} [{d.get('location')}]: {d.get('message')}")
+        budget = (ana.get("hbm") or {}).get("budget_bytes") or 0
+        if ana_hbm:
+            top = sorted(
+                ana_hbm.items(),
+                key=lambda kv: kv[1].get("per_device_bytes", 0),
+                reverse=True,
+            )[:5]
+            lines.append(
+                "predicted peak HBM (per device"
+                + (f", budget {budget:,} B" if budget else "")
+                + "):"
+            )
+            for label, rec in top:
+                lines.append(
+                    f"    {rec.get('per_device_bytes', 0):>14,} B  {label}"
+                )
+
     rt = doc.get("runtime") or {}
     lines.append(_rule("runtime"))
     lines.append(

@@ -229,6 +229,20 @@ def statusz_report() -> Dict[str, Any]:
     except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
         doc["elastic"] = None
     try:
+        from ..analysis import diagnostics as _adiag
+        from ..analysis import memory_model as _amem
+
+        doc["analysis"] = {
+            "mode": _adiag.analysis_mode(),
+            "recent_diagnostics": [
+                {"rule": d.rule, "location": d.location, "message": d.message}
+                for d in _adiag.recent_diagnostics()[-20:]
+            ],
+            "hbm": _amem.peak_summary(),
+        }
+    except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
+        doc["analysis"] = None
+    try:
         doc["alerts"] = {
             "active": _alerts.active_alerts(),
             "recent_events": _alerts.alert_events(limit=10),

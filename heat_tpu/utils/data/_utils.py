@@ -50,15 +50,18 @@ def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
     Each output line is ``"<offset> <length>"`` — the format DALI consumes,
     and the natural unit for byte-range sharding a record file across hosts.
     """
+    from ...resilience.atomic import atomic_write
+
     for src_dir, idx_dir in ((train_dir, train_idx_dir), (val_dir, val_idx_dir)):
         os.makedirs(idx_dir, exist_ok=True)
         for name in sorted(os.listdir(src_dir)):
             src = os.path.join(src_dir, name)
             if not os.path.isfile(src):
                 continue
-            with open(os.path.join(idx_dir, name), "w") as idx:
-                for offset, length in tfrecord_index(src):
-                    idx.write(f"{offset} {length}\n")
+            with atomic_write(os.path.join(idx_dir, name)) as tmp:
+                with open(tmp, "w") as idx:
+                    for offset, length in tfrecord_index(src):
+                        idx.write(f"{offset} {length}\n")
 
 
 def merge_files_imagenet_tfrecord(folder_name, output_folder=None):

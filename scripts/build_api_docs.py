@@ -34,6 +34,9 @@ MODULES = [
     ("Input-drift sketches", "heat_tpu.telemetry.sketch", "streaming per-feature moment + log-bucket sketches, PSI/KL divergence vs persisted baselines (/driftz; docs/observability.md)"),
     ("Alerts", "heat_tpu.telemetry.alerts", "deduplicated severity-tagged fired/resolved alert events with exemplar trace ids (docs/observability.md)"),
     ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601, H701-H705) (docs/static_analysis.md)"),
+    ("Dtype-flow lint", "heat_tpu.analysis.dtype_flow", "jaxpr precision lint: silent truncation, low-precision accumulation, unpinned contractions, policy violations (J201-J204; docs/static_analysis.md)"),
+    ("Peak-HBM estimator", "heat_tpu.analysis.memory_model", "static per-device peak-memory prediction from the jaxpr (liveness + donation + sharding), J301 against HEAT_TPU_HBM_BUDGET_BYTES (docs/static_analysis.md)"),
+    ("Precision policies", "heat_tpu.analysis.precision_policy", "the per-estimator bitwise/tolerance POLICIES registry and its three enforcement choke points (docs/static_analysis.md)"),
     ("Concurrency sanitizer", "heat_tpu.analysis.tsan", "runtime lock-order/unguarded-access sanitizer over the central LOCK_REGISTRY (HEAT_TPU_TSAN; docs/static_analysis.md)"),
     ("Elastic", "heat_tpu.elastic", "worker-loss detection, mesh reshape + cross-world resume supervision (docs/elasticity.md)"),
     ("Serving", "heat_tpu.serving", "online inference: model registry + hot-load, request coalescing with pad-to-bucket dispatch, per-tenant admission control, /v1 HTTP endpoints (docs/serving.md)"),

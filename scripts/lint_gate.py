@@ -10,7 +10,12 @@ re-introduce a class of bug the rules exist to prevent.  Violations
 passes — run with ``--update`` to shrink the baseline).
 
     python scripts/lint_gate.py [--baseline scripts/lint_baseline.json]
-                                [--paths heat_tpu/] [--update]
+                                [--paths heat_tpu/] [--update] [--fix-stale]
+
+``--update`` rewrites the baseline to the CURRENT violation set
+(accepting new violations — a deliberate act); ``--fix-stale`` only
+PRUNES entries whose violation has been fixed, so the baseline
+monotonically shrinks toward empty without ever accepting anything new.
 
 Exit status: 0 = no new violations, 1 = new violations (printed).
 """
@@ -26,10 +31,28 @@ sys.path.insert(0, REPO)
 DEFAULT_BASELINE = os.path.join(REPO, "scripts", "lint_baseline.json")
 
 
-def run_gate(paths=None, baseline_path=DEFAULT_BASELINE, update=False, quiet=False):
+def _write_baseline(baseline_path, entries):
+    with open(baseline_path, "w") as f:
+        json.dump(
+            {
+                "comment": "accepted legacy lint violations; regenerate "
+                           "with: python scripts/lint_gate.py --update",
+                "violations": entries,
+            },
+            f, indent=1,
+        )
+        f.write("\n")
+
+
+def run_gate(paths=None, baseline_path=DEFAULT_BASELINE, update=False,
+             fix_stale=False, quiet=False):
     """Run the linter and compare to the baseline; returns a result dict
     (``new``/``fixed``/``total``/``baseline``) for embedding in CI
-    summaries (``perf_ci.py`` reports it next to the perf metrics)."""
+    summaries (``perf_ci.py`` reports it next to the perf metrics).
+
+    ``update`` rewrites the baseline to the full current set (accepts
+    new violations); ``fix_stale`` only prunes entries whose violation
+    no longer exists — the baseline can shrink, never grow."""
     from heat_tpu.analysis.ast_lint import lint_paths, violations_to_json
 
     paths = paths or [os.path.join(REPO, "heat_tpu")]
@@ -47,18 +70,21 @@ def run_gate(paths=None, baseline_path=DEFAULT_BASELINE, update=False, quiet=Fal
     fixed = sorted(k for k in baseline_keys if k not in current_keys)
 
     if update:
-        with open(baseline_path, "w") as f:
-            json.dump(
-                {
-                    "comment": "accepted legacy lint violations; regenerate "
-                               "with: python scripts/lint_gate.py --update",
-                    "violations": violations_to_json(violations),
-                },
-                f, indent=1,
-            )
-            f.write("\n")
+        _write_baseline(baseline_path, violations_to_json(violations))
         if not quiet:
             print(f"baseline updated: {len(violations)} accepted violation(s)")
+    elif fix_stale and fixed:
+        kept = [
+            e for e in baseline
+            if (e["rule"], e["file"], e["line"]) in current_keys
+        ]
+        _write_baseline(baseline_path, kept)
+        if not quiet:
+            print(
+                f"baseline pruned: {len(fixed)} fixed entr"
+                f"{'y' if len(fixed) == 1 else 'ies'} removed, "
+                f"{len(kept)} kept"
+            )
 
     return {
         "total": len(violations),
@@ -76,10 +102,14 @@ def main():
     ap.add_argument("--paths", nargs="*", default=None)
     ap.add_argument("--update", action="store_true",
                     help="rewrite the baseline to the current violation set")
+    ap.add_argument("--fix-stale", action="store_true",
+                    help="prune baseline entries whose violation has been "
+                         "fixed (the baseline shrinks; nothing new is "
+                         "accepted)")
     args = ap.parse_args()
 
     res = run_gate(paths=args.paths, baseline_path=args.baseline,
-                   update=args.update)
+                   update=args.update, fix_stale=args.fix_stale)
 
     for e in res["fixed"]:
         print(f"stale baseline entry (fixed): {e['file']}:{e['line']} {e['rule']}")

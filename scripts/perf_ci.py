@@ -764,6 +764,112 @@ def main():
 
     guarded("quality_signals_overhead", bench_quality_signals_overhead)
 
+    # precision-analyzer overhead (ISSUE 12): the SAME kmeans lloyd
+    # kernel with HEAT_TPU_ANALYZE=warn — the J2 dtype-flow walker, the
+    # J3 static peak-HBM estimator AND the J1 HLO checks armed at the
+    # dispatch hook — vs off, paired per-round median like the other
+    # overhead gates.  The analyzers only run on executable-cache
+    # MISSES, so the warmed steady state (the production shape) must
+    # measure ~0; a regression here means someone put analyzer work on
+    # the per-hit path.  Off-mode stays one dict lookup per miss by
+    # construction (dispatch._maybe_analyze).  Hard cap <3%.
+    def bench_analysis_precision_overhead():
+        import warnings as _w
+
+        from heat_tpu import analysis
+        from heat_tpu.analysis import diagnostics as adiag
+
+        def fit_analyzed():
+            adiag.set_analysis_mode("warn")
+            with _w.catch_warnings():
+                _w.simplefilter("ignore")
+                return fit()
+
+        def fit_plain():
+            adiag.set_analysis_mode("off")
+            return fit()
+
+        try:
+            fetch = lambda km: float(km.cluster_centers_.sum())
+            overhead_pct, on_per, off_per, sp = _paired_overhead_pct(
+                fit_analyzed, fit_plain, fetch
+            )
+        finally:
+            adiag.set_analysis_mode("off")
+            analysis.clear_diagnostics()
+        results["analysis_precision_overhead"] = {
+            "overhead_pct": round(overhead_pct, 2),
+            "max_overhead_pct": 3.0,
+            "enabled_s": round(on_per, 5),
+            "disabled_s": round(off_per, 5),
+            "spread_pct": sp,
+        }
+
+    guarded("analysis_precision_overhead", bench_analysis_precision_overhead)
+
+    # bf16 KMeans predict (ISSUE 12): the tolerance-policy mixed-
+    # precision predict path (HEAT_TPU_PREDICT_DTYPE=bfloat16 — bf16
+    # cross term, f32 norms + accumulation) vs the native f32 path on
+    # the same fitted model and rows.  Records the speedup and the
+    # max-abs distance error against the f32 reference (the tolerance
+    # policy's rtol budget is 0.02 of the distance scale) plus label
+    # agreement.  Informational record ("value" = speedup, trend-
+    # tracked): CPU runners have no bf16 MXU, so the time ratio here is
+    # about regression visibility, not the TPU win.
+    def bench_kmeans_predict_bf16():
+        from heat_tpu.analysis import precision_policy as pp
+        from heat_tpu.spatial import distance
+
+        km = fit()
+        rows = ht.array(
+            np.random.default_rng(11).standard_normal((4096, f)).astype(np.float32),
+            split=None,
+        )
+        fetch = lambda r: int(np.asarray(r._dense())[0])
+
+        def pred():
+            return km.predict(rows)
+
+        f32_per, f32_sp = _timeit(pred, fetch)
+        lab32 = np.asarray(pred()._dense())
+        prev = pp.set_predict_dtype("bfloat16")
+        try:
+            bf_per, bf_sp = _timeit(pred, fetch)
+            lab16 = np.asarray(pred()._dense())
+        finally:
+            pp.set_predict_dtype(prev)
+        xd = rows._dense()
+        cd = km.cluster_centers_._dense()
+        ref = np.asarray(distance._pairwise_euclidean(xd, cd))
+        lo = np.asarray(distance._pairwise_euclidean_bf16(xd, cd))
+        err = float(np.abs(ref - lo).max())
+        scale = float(np.abs(ref).max())
+        results["kmeans_predict_bf16"] = {
+            "value": round(f32_per / bf_per, 3),  # speedup_x (trend headline)
+            "f32_s": round(f32_per, 5),
+            "bf16_s": round(bf_per, 5),
+            "spread_pct": max(f32_sp, bf_sp),
+            "max_abs_err": round(err, 6),
+            "rel_err": round(err / scale, 6) if scale else 0.0,
+            "policy_rtol": 0.02,
+            "labels_agree_pct": round(100.0 * float((lab32 == lab16).mean()), 2),
+        }
+
+    guarded("kmeans_predict_bf16", bench_kmeans_predict_bf16)
+
+    # compat-matrix smoke lane (ROADMAP 5a): the collective-wrapper test
+    # subset under BOTH core/_compat.py resolver branches (legacy
+    # experimental adapter AND the native top-level API, simulated when
+    # this jax lacks it) — gated as a hard-cap count: a red branch fails
+    # the same perf_gate run that guards the kernels
+    def bench_compat_matrix():
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from compat_matrix import run_matrix
+
+        results["compat_matrix"] = run_matrix(quiet=True)
+
+    guarded("compat_matrix", bench_compat_matrix)
+
     # sanitized test lane: the threaded test subset (test_overlap /
     # test_introspection / test_telemetry) in a subprocess under
     # HEAT_TPU_TSAN=1 — gated as a hard-cap count: red tests or ANY
