@@ -33,6 +33,7 @@ from . import optim
 from . import resilience
 from . import elastic
 from . import serving
+from . import fleet
 from . import sparse
 from . import telemetry
 from . import utils

@@ -70,8 +70,8 @@ LOCK_REGISTRY = {
     "telemetry.server": {
         "file": "heat_tpu/telemetry/server.py",
         "spellings": ("_LOCK",),
-        "structures": ("telemetry.server.singleton", "telemetry.server.routes"),
-        "doc": "the process's single IntrospectionServer handle (start_server/stop_server swap it) and the registered extra-route map (register_route/unregister_route mutate, handler threads take it briefly for the prefix lookup and call the handler outside it)",
+        "structures": ("telemetry.server.singleton", "telemetry.server.routes", "telemetry.server.readiness"),
+        "doc": "the process's single IntrospectionServer handle (start_server/stop_server swap it), the registered extra-route map (register_route/unregister_route mutate, handler threads take it briefly for the prefix lookup and call the handler outside it), and the readiness-provider slot /readyz consults",
     },
     "telemetry.flight_recorder.hooks": {
         "file": "heat_tpu/telemetry/flight_recorder.py",
@@ -167,7 +167,31 @@ LOCK_REGISTRY = {
         "file": "heat_tpu/serving/service.py",
         "spellings": ("self._lock", "_SERVICE_LOCK"),
         "structures": ("serving.service.state",),
-        "doc": "InferenceService per-model batcher map + the module's default-service singleton: batchers are created lazily on first request (any handler thread), closed by close()",
+        "doc": "InferenceService per-model batcher map, lifecycle state (warming/ready/draining), the pre-warm shape ledger + the module's default-service singleton: batchers are created lazily on first request (any handler thread), closed by close()",
+    },
+    "dispatch.aot": {
+        "file": "heat_tpu/core/aot_cache.py",
+        "spellings": ("_LOCK",),
+        "structures": ("dispatch.aot.state",),
+        "doc": "AOT-cache module configuration (armed directory, save flag, fingerprint memo): configure() swaps it while lookups fire from any dispatching thread (batchers, HTTP handlers); artifact files themselves need no lock — writes are atomic renames keyed per artifact",
+    },
+    "fleet.router": {
+        "file": "heat_tpu/fleet/router.py",
+        "spellings": ("self._lock",),
+        "structures": ("fleet.router.replicas",),
+        "doc": "FleetRouter replica table (readiness, model lists, in-flight counts, circuit-breaker states), the global admission bucket and the sliding latency window: mutated by request handler threads, the health poller and add/drain/remove; proxied HTTP calls always run outside it",
+    },
+    "fleet.replicas": {
+        "file": "heat_tpu/fleet/replica.py",
+        "spellings": ("self._lock",),
+        "structures": ("fleet.replicas.table",),
+        "doc": "LocalReplicaSet url->subprocess handle table: spawn/drain/stop run from the autoscaler tick thread and close() from the owner; Popen waits run outside the lock",
+    },
+    "fleet.autoscaler": {
+        "file": "heat_tpu/fleet/autoscaler.py",
+        "spellings": ("self._lock",),
+        "structures": ("fleet.autoscaler.state",),
+        "doc": "FleetAutoscaler hysteresis counters + last-decision record: mutated by the tick thread, read by /fleet/statusz handler threads and tests",
     },
 }
 

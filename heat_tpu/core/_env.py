@@ -103,6 +103,28 @@ KNOBS = {
     "HEAT_TPU_ELASTIC_HEARTBEAT_TIMEOUT_S": ("float", "0", "declare a worker lost when its fit heartbeat is older than this many seconds (0 = liveness detection off, exit-code detection only)"),
     "HEAT_TPU_ELASTIC_POLL_S": ("float", "0.5", "polling interval of the elastic supervisor's heartbeat monitor"),
     "HEAT_TPU_HEARTBEAT_FILE": ("path", "", "touch this file at every resumable-fit chunk boundary (the cross-process liveness signal the elastic process supervisor watches)"),
+    # -- AOT executable cache (core/aot_cache.py, docs/fleet.md) --------
+    "HEAT_TPU_AOT_CACHE": ("path", "", "persistent on-disk AOT executable cache directory: dispatch cache misses load serialized compiled artifacts instead of compiling, and fresh compiles are persisted for the next process (empty = off)"),
+    "HEAT_TPU_AOT_SAVE": ("bool", "1", "whether an armed AOT cache may write artifacts (0 = read-only: replicas load the fleet's artifacts, only a designated writer populates them)"),
+    # -- fleet (heat_tpu/fleet, docs/fleet.md) --------------------------
+    "HEAT_TPU_FLEET_RETRIES": ("int", "3", "bounded failover attempts of one routed /v1/predict across healthy replicas (connect error / 5xx / timeout each consume one)"),
+    "HEAT_TPU_FLEET_TIMEOUT_S": ("float", "10", "per-replica timeout of one proxied request before the router fails over"),
+    "HEAT_TPU_FLEET_CB_FAILURES": ("int", "3", "consecutive failures after which a replica's circuit breaker ejects it from routing"),
+    "HEAT_TPU_FLEET_CB_COOLDOWN_S": ("float", "2.0", "seconds an ejected replica waits before the circuit breaker admits one half-open probe request"),
+    "HEAT_TPU_FLEET_HEALTH_PERIOD_S": ("float", "0.5", "router health-poll interval: each replica's /readyz is scraped this often for readiness, drain state and its model list"),
+    "HEAT_TPU_FLEET_RATE": ("float", "0", "fleet-global token-bucket admission refill (rows/s) at the router — one bucket for the whole replica set, not per replica; 0 = unlimited"),
+    "HEAT_TPU_FLEET_BURST": ("float", "256", "fleet-global token-bucket burst capacity (rows)"),
+    "HEAT_TPU_FLEET_LOAD_FACTOR": ("float", "1.5", "bounded-load consistent hashing factor: the hash-affine replica is skipped for the next in preference order when its in-flight count exceeds factor x the ready-replica average + 1"),
+    "HEAT_TPU_FLEET_DRAIN_TIMEOUT_S": ("float", "10", "longest a draining replica waits for in-flight work to finish before closing anyway"),
+    "HEAT_TPU_FLEET_MIN_REPLICAS": ("int", "1", "autoscaler floor on the replica count"),
+    "HEAT_TPU_FLEET_MAX_REPLICAS": ("int", "4", "autoscaler ceiling on the replica count"),
+    "HEAT_TPU_FLEET_TICK_S": ("float", "1.0", "autoscaler evaluation interval"),
+    "HEAT_TPU_FLEET_UP_TICKS": ("int", "2", "consecutive overloaded ticks required before one scale-up (hysteresis)"),
+    "HEAT_TPU_FLEET_DOWN_TICKS": ("int", "5", "consecutive underloaded ticks required before one scale-down (hysteresis)"),
+    "HEAT_TPU_FLEET_P99_UP_MS": ("float", "50", "scale-up signal: routed p99 latency (sliding window) above this many ms counts a tick overloaded"),
+    "HEAT_TPU_FLEET_P99_DOWN_MS": ("float", "10", "scale-down signal: routed p99 latency must be below this many ms for a tick to count underloaded"),
+    "HEAT_TPU_FLEET_INFLIGHT_UP": ("float", "8", "scale-up signal: mean in-flight requests per ready replica above this counts a tick overloaded"),
+    "HEAT_TPU_FLEET_INFLIGHT_DOWN": ("float", "1", "scale-down signal: mean in-flight per ready replica must be below this for a tick to count underloaded"),
     # -- serving (heat_tpu/serving, docs/serving.md) --------------------
     "HEAT_TPU_SERVE_MAX_BATCH": ("int", "64", "largest coalesced inference batch (rows) and the top pad-to-bucket shape; also the largest single request"),
     "HEAT_TPU_SERVE_MAX_DELAY_MS": ("float", "2.0", "longest a queued predict request waits for batch-mates before its coalesced dispatch (the latency/throughput dial)"),

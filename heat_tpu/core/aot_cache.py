@@ -1,0 +1,344 @@
+"""Persistent on-disk AOT executable cache: cold-start elimination.
+
+A fresh serving replica pays one XLA compile per executable-cache key
+before its first request can ride a warm cache — tens of milliseconds
+to seconds per bucket shape, multiplied by every (model, bucket)
+combination a pre-warm pass touches.  This module makes that cost a
+**one-time fleet cost** instead of a per-replica cost: compiled
+executables are serialized (``jax.experimental.serialize_executable``)
+into a directory keyed by the *same* operand-spec cache keys
+``core/dispatch.py`` already uses, so a fresh process re-loads the
+compiled artifact instead of re-compiling it.
+
+Design points:
+
+* **Same keys as the in-memory cache** — :func:`stable_key` renders a
+  dispatch cache key (op callables, operand shape/dtype/sharding specs,
+  static kwargs) into a deterministic string; the artifact filename is
+  its SHA-256.  Keys containing callables without a stable qualified
+  name (lambdas, locals, partials) are refused — two distinct lambdas
+  both stringify as ``<lambda>`` and must never alias one persistent
+  artifact.
+* **Atomic + checksummed like every other writer** — artifacts go
+  through :func:`~heat_tpu.resilience.atomic.atomic_write` (temp file,
+  fsync, CRC32 sidecar, rename), and every load verifies the sidecar
+  first: a torn or corrupted artifact is *dropped* and the caller falls
+  back to a fresh compile — corruption can cost a compile, never a
+  wrong program.
+* **Fingerprint invalidation** — every artifact records the writing
+  process's :func:`fingerprint` (jax/jaxlib version, backend, device
+  kind and count, framework version).  A mismatching artifact is
+  ignored (``aot.stale``): an upgraded jax or a different mesh size
+  recompiles instead of loading an incompatible executable.
+* **Fail-open everywhere** — any serialization/deserialization error is
+  counted (``aot.errors``) and the dispatch path continues exactly as
+  if the cache were cold.  The cache can accelerate a replica; it can
+  never take one down.
+
+Off by default.  Arm with ``HEAT_TPU_AOT_CACHE=<dir>`` (or
+:func:`configure`); ``HEAT_TPU_AOT_SAVE=0`` makes an armed cache
+read-only (replicas load the fleet's artifacts but only a designated
+writer populates them).  The pre-warm *manifest* — which (model,
+bucket) shapes to drive at startup so the cache is exercised before
+the first request — is the serving layer's side
+(:meth:`heat_tpu.serving.InferenceService.export_prewarm_manifest`);
+see ``docs/fleet.md`` for the lifecycle.
+
+Security note: artifacts embed pickled executable payloads; the cache
+directory must be trusted (same bar as the model checkpoint store —
+see SECURITY.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import pickle
+import time
+from typing import Any, Optional, Tuple
+
+from ..analysis import tsan as _tsan
+from ..resilience.atomic import atomic_write, verify_checksum
+from ..resilience.errors import ChecksumError
+from ..resilience.faults import inject as _inject
+from ..telemetry import metrics as _tm
+from . import _env
+
+__all__ = [
+    "configure",
+    "enabled",
+    "fingerprint",
+    "load",
+    "save",
+    "stable_key",
+    "stats",
+]
+
+#: artifact format version — bumped on any layout change so old caches
+#: read as stale instead of unpicklable
+FORMAT_VERSION = 1
+
+ARTIFACT_SUFFIX = ".aotx"
+
+_HITS_C = _tm.counter("aot.hits", "dispatch keys loaded from the on-disk AOT cache")
+_MISSES_C = _tm.counter("aot.misses", "armed AOT lookups that found no artifact")
+_SAVES_C = _tm.counter("aot.saves", "compiled executables serialized to the AOT cache")
+_STALE_C = _tm.counter(
+    "aot.stale", "artifacts ignored for a jax/device fingerprint mismatch"
+)
+_ERRORS_C = _tm.counter(
+    "aot.errors", "AOT artifacts dropped (corrupt, unpicklable, undeserializable)"
+)
+_UNKEYED_C = _tm.counter(
+    "aot.unkeyed", "dispatch keys refused a stable persistent form (lambda/local ops)"
+)
+
+#: guards the module configuration (directory/save flag/fingerprint
+#: memo): configure() runs on the main thread but lookups fire from any
+#: thread that dispatches (batcher threads, HTTP handlers)
+_LOCK = _tsan.register_lock("dispatch.aot")
+_DIR: Optional[str] = None
+_SAVE = True
+_ENV_READ = False
+_FP: Optional[str] = None
+
+
+def fingerprint() -> str:
+    """Compatibility fingerprint of this process's compile substrate:
+    jax/jaxlib versions, backend, device kind and count, framework
+    version.  An artifact written under a different fingerprint is
+    never loaded."""
+    global _FP
+    with _LOCK:
+        _tsan.note_access("dispatch.aot.state")
+        if _FP is not None:
+            return _FP
+    import jax
+
+    try:
+        import jaxlib
+
+        jaxlib_v = getattr(jaxlib, "__version__", "?")
+    except Exception:  # lint: allow H501(jaxlib version is advisory; jax version still pins)
+        jaxlib_v = "?"
+    try:
+        devs = jax.devices()
+        backend = jax.default_backend()
+        kind = devs[0].device_kind if devs else "?"
+        count = len(devs)
+    except Exception:  # lint: allow H501(no backend -> fingerprint still formed, never matches a real artifact)
+        backend, kind, count = "?", "?", 0
+    from .. import version
+
+    fp = (
+        f"jax={jax.__version__};jaxlib={jaxlib_v};backend={backend};"
+        f"device={kind};n={count};heat={version.__version__};fmt={FORMAT_VERSION}"
+    )
+    with _LOCK:
+        _tsan.note_access("dispatch.aot.state")
+        _FP = fp
+        return _FP
+
+
+def configure(directory: Optional[str], save: Optional[bool] = None) -> Optional[str]:
+    """Arm (or, with ``None``, disarm) the AOT cache at ``directory``;
+    returns the previously configured directory.  ``save=False`` makes
+    the cache read-only for this process."""
+    global _DIR, _SAVE, _ENV_READ
+    if directory is not None:
+        directory = os.fspath(directory)
+        os.makedirs(directory, exist_ok=True)
+    with _LOCK:
+        _tsan.note_access("dispatch.aot.state")
+        prev, _DIR = _DIR, directory
+        if save is not None:
+            _SAVE = bool(save)
+        _ENV_READ = True
+    return prev
+
+
+def _config() -> Tuple[Optional[str], bool]:
+    """(directory, save) — reading ``HEAT_TPU_AOT_CACHE`` /
+    ``HEAT_TPU_AOT_SAVE`` on first use so a replica can arm the cache
+    from its environment without any code change."""
+    global _DIR, _SAVE, _ENV_READ
+    with _LOCK:
+        _tsan.note_access("dispatch.aot.state")
+        if not _ENV_READ:
+            _ENV_READ = True
+            d = _env.env_str("HEAT_TPU_AOT_CACHE")
+            if d:
+                _DIR = d
+                try:
+                    os.makedirs(d, exist_ok=True)
+                except OSError:
+                    _DIR = None  # unwritable dir: stay disarmed
+            _SAVE = _env.env_flag("HEAT_TPU_AOT_SAVE")
+        return _DIR, _SAVE
+
+
+def enabled() -> bool:
+    """Whether the on-disk AOT cache is armed for this process."""
+    return _config()[0] is not None
+
+
+def save_enabled() -> bool:
+    """Whether this process may write artifacts (armed and not
+    read-only)."""
+    d, s = _config()
+    return d is not None and s
+
+
+# ----------------------------------------------------------------------
+# stable key rendering
+# ----------------------------------------------------------------------
+def _stable_part(obj: Any, depth: int = 0) -> str:
+    """Deterministic cross-process string form of one key element, or
+    raise ``ValueError`` when none exists (anonymous callables)."""
+    if depth > 8:
+        raise ValueError("key nesting too deep for a stable form")
+    if callable(obj) and not isinstance(obj, type):
+        mod = getattr(obj, "__module__", None)
+        # jnp ufunc objects carry __name__ but no __qualname__
+        qual = getattr(obj, "__qualname__", None) or getattr(obj, "__name__", None)
+        if not mod or not qual or "<lambda>" in qual or "<locals>" in qual:
+            raise ValueError(f"no stable name for callable {obj!r}")
+        return f"fn:{mod}.{qual}"
+    if isinstance(obj, (tuple, list, frozenset)):
+        items = sorted(obj) if isinstance(obj, frozenset) else obj
+        inner = ",".join(_stable_part(o, depth + 1) for o in items)
+        return f"{type(obj).__name__}({inner})"
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return repr(obj)
+    # dtypes, shardings, jnp scalar types: their str/repr is stable for
+    # a fixed jax version + topology, both of which the fingerprint pins
+    return f"{type(obj).__name__}:{obj}"
+
+
+def stable_key(key: Any) -> Optional[str]:
+    """Deterministic string form of a dispatch cache key, or ``None``
+    when the key has no stable cross-process identity (anonymous
+    callables)."""
+    try:
+        return _stable_part(key)
+    except Exception as e:  # lint: allow H501(unstable key -> skip persistence, in-memory path unaffected)
+        if isinstance(e, ValueError):
+            _UNKEYED_C.inc()
+        return None
+
+
+def _artifact_path(directory: str, key_str: str) -> str:
+    digest = hashlib.sha256(key_str.encode("utf-8")).hexdigest()
+    return os.path.join(directory, digest + ARTIFACT_SUFFIX)
+
+
+# ----------------------------------------------------------------------
+# load / save
+# ----------------------------------------------------------------------
+def load(key: Any) -> Optional[Any]:
+    """The deserialized compiled executable for ``key``, or ``None`` on
+    any miss (disarmed, unstable key, absent, corrupt, stale
+    fingerprint, undeserializable).  A corrupt artifact is removed so
+    the next save can heal it."""
+    directory, _ = _config()
+    if directory is None:
+        return None
+    key_str = stable_key(key)
+    if key_str is None:
+        return None
+    path = _artifact_path(directory, key_str)
+    if not os.path.exists(path):
+        _MISSES_C.inc()
+        return None
+    _inject("aot.load", path=path)
+    try:
+        verify_checksum(path)
+        with open(path, "rb") as f:
+            doc = pickle.load(f)
+        if not isinstance(doc, dict) or doc.get("format") != FORMAT_VERSION:
+            _STALE_C.inc()
+            return None
+        if doc.get("fingerprint") != fingerprint():
+            _STALE_C.inc()
+            return None
+        if doc.get("key") != key_str:
+            # SHA collision or foreign file: never run a mismatched program
+            _ERRORS_C.inc()
+            return None
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        compiled = deserialize_and_load(
+            doc["payload"], doc["in_tree"], doc["out_tree"]
+        )
+    except ChecksumError:
+        _ERRORS_C.inc()
+        _drop(path)
+        return None
+    except Exception:  # lint: allow H501(an unreadable artifact must cost a compile, never an error)
+        _ERRORS_C.inc()
+        _drop(path)
+        return None
+    _HITS_C.inc()
+    return compiled
+
+
+def save(key: Any, compiled: Any) -> bool:
+    """Serialize ``compiled`` (a jax ``Compiled``) under ``key``;
+    returns True when an artifact was written.  Never raises: a failed
+    save is counted and the in-memory entry keeps serving."""
+    directory, do_save = _config()
+    if directory is None or not do_save:
+        return False
+    key_str = stable_key(key)
+    if key_str is None:
+        return False
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        doc = {
+            "format": FORMAT_VERSION,
+            "fingerprint": fingerprint(),
+            "key": key_str,
+            "saved_at": time.time(),
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        buf = io.BytesIO()
+        pickle.dump(doc, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        path = _artifact_path(directory, key_str)
+        _inject("aot.save", path=path)
+        with atomic_write(path, fault_site="io.write") as tmp:
+            with open(tmp, "wb") as f:
+                f.write(buf.getvalue())
+    except Exception:  # lint: allow H501(a failed artifact write must never fail the dispatch that compiled)
+        _ERRORS_C.inc()
+        return False
+    _SAVES_C.inc()
+    return True
+
+
+def _drop(path: str) -> None:
+    for p in (path, path + ".crc32"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def stats() -> dict:
+    """Snapshot of the AOT-cache counters plus the armed directory — a
+    thin view over the shared telemetry registry (``aot.*``)."""
+    directory, do_save = _config()
+    return {
+        "directory": directory,
+        "save": do_save,
+        "hits": _HITS_C.value,
+        "misses": _MISSES_C.value,
+        "saves": _SAVES_C.value,
+        "stale": _STALE_C.value,
+        "errors": _ERRORS_C.value,
+        "unkeyed": _UNKEYED_C.value,
+    }

@@ -82,6 +82,7 @@ from ..resilience.faults import inject as _inject
 from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
 from . import _env as _env
+from . import aot_cache as _aot
 
 __all__ = [
     "PendingExpr",
@@ -572,11 +573,42 @@ def _maybe_analyze(entry, leaves, key, donate_argnums=()) -> None:
     on_dispatch_compile(entry, leaves, key, donate_argnums=donate_argnums)
 
 
-def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
+def _aot_entry(key, jitted, leaves):
+    """AOT-cache resolution of a fresh in-memory miss (armed caches
+    only; see ``core/aot_cache.py``).  Returns the compiled executable
+    to install — a deserialized artifact when one matches, else the
+    eagerly ``lower().compile()``-ed (and persisted) program — or
+    ``None`` to fall back to the plain lazy-jit path.  Either way the
+    compile accounting (``dispatch.compile`` span + ``compile_ms``)
+    happens HERE, so callers treat the returned entry as warm."""
+    compiled = _aot.load(key)
+    if compiled is not None:
+        return compiled
+    try:
+        t0 = time.perf_counter()
+        with _span("dispatch.compile", aot=True):
+            compiled = jitted.lower(*leaves).compile()
+        _COMPILE_MS.observe((time.perf_counter() - t0) * 1e3)
+    except Exception:  # lint: allow H501(AOT pre-compile failed; the lazy jit path re-raises any real error)
+        return None
+    _aot.save(key, compiled)
+    return compiled
+
+
+def _get_compiled(key, builder, donate_argnums=None, out_sharding=None, leaves=None):
     """Cached jitted executable for ``key``; returns ``(entry, fresh)``
     where ``fresh`` marks a miss — the first execution of a fresh entry
     pays trace+compile, which :func:`_run` times into the
-    ``dispatch.compile_ms`` histogram."""
+    ``dispatch.compile_ms`` histogram.
+
+    With the on-disk AOT cache armed (``HEAT_TPU_AOT_CACHE``) and
+    ``leaves`` provided, a miss first consults the artifact store: a
+    matching artifact installs a deserialized executable with NO
+    compile; otherwise the program is compiled eagerly and persisted.
+    Both AOT paths return ``fresh=False`` (their compile accounting is
+    internal); donated entries and armed-analyzer runs
+    (``HEAT_TPU_ANALYZE``) keep the plain lazy-jit path — the analyzer
+    must be able to re-lower the fresh entry."""
     with _CACHE_LOCK:
         _tsan.note_access("dispatch.cache")
         entry = _cache.get(key)
@@ -593,12 +625,20 @@ def _get_compiled(key, builder, donate_argnums=None, out_sharding=None):
     if donate_argnums:
         jit_kwargs["donate_argnums"] = donate_argnums
     entry = jax.jit(builder(), **jit_kwargs)
+    fresh = True
+    if leaves is not None and not donate_argnums and _aot.enabled():
+        from ..analysis.diagnostics import analysis_mode
+
+        if analysis_mode() == "off":
+            aot = _aot_entry(key, entry, leaves)
+            if aot is not None:
+                entry, fresh = aot, False
     with _CACHE_LOCK:
         _tsan.note_access("dispatch.cache")
         _cache[key] = entry
         while len(_cache) > _CACHE_MAXSIZE:
             _cache.popitem(last=False)
-    return entry, True
+    return entry, fresh
 
 
 def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = False,
@@ -647,7 +687,9 @@ def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=No
     come through here: a partially-run donated program may have
     consumed its input, making re-execution unsafe."""
     try:
-        compiled, fresh = _get_compiled(key, builder, out_sharding=out_sharding)
+        compiled, fresh = _get_compiled(
+            key, builder, out_sharding=out_sharding, leaves=leaves
+        )
         if fresh:
             _maybe_analyze(compiled, leaves, key)
         return _run(compiled, leaves, n_ops, fresh=fresh, key=key)

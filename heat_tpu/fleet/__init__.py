@@ -1,0 +1,62 @@
+"""Fleet-scale serving: replicated scale-out, cold-start elimination,
+elastic autoscaling.
+
+One :class:`~heat_tpu.serving.InferenceService` process serves one
+port; a *fleet* serves millions of users.  This package keeps heat's
+shape — explicit communication, shared-nothing workers, no hidden
+coordinator (PAPER.md) — at the serving tier, in three composable
+pieces:
+
+* :class:`~heat_tpu.fleet.router.FleetRouter` — a stdlib HTTP router
+  in front of N shared-nothing replicas: consistent-hash model affinity
+  with bounded-load spillover, readiness-keyed health (each replica's
+  ``/readyz``), fleet-global token-bucket admission, bounded-retry
+  failover of idempotent ``/v1/predict`` on connect-error/5xx/timeout
+  (a replica crash under live load costs **zero** failed client
+  requests — the gated property), per-replica circuit breakers with
+  half-open probes, and graceful drain.
+* **Cold-start elimination** — the persistent AOT executable cache
+  (:mod:`heat_tpu.core.aot_cache`, ``HEAT_TPU_AOT_CACHE``) plus the
+  pre-warm manifest exported from a live coalescer
+  (:meth:`~heat_tpu.serving.InferenceService.export_prewarm_manifest`):
+  a fresh replica replays the fleet's (model, bucket) shapes from
+  serialized compiled artifacts and reaches executable-cache hit rate
+  1.0 — zero compiles — before its first request.
+* :class:`~heat_tpu.fleet.autoscaler.FleetAutoscaler` — a hysteresis
+  controller driving the replica count from the router's serving
+  signals (sliding p99, in-flight per replica, shed rate) through the
+  :class:`~heat_tpu.fleet.replica.LocalReplicaSet` actuator (the
+  ``ProcessSupervisor`` pattern pointed at serving replicas).
+
+Quick start (one host, two replicas)::
+
+    from heat_tpu import fleet
+
+    rs = fleet.LocalReplicaSet({"km": "/models/km"}, "/tmp/fleet",
+                               aot_cache="/tmp/fleet/aot",
+                               prewarm="/models/km/prewarm.json")
+    router = fleet.FleetRouter()
+    for _ in range(2):
+        router.add_replica(rs.spawn())
+    scaler = fleet.FleetAutoscaler(router, rs)
+    scaler.start()
+    # POST http://router:port/v1/predict {"model": "km", "inputs": [...]}
+
+See ``docs/fleet.md`` for topology, failover/drain semantics, the AOT
+cache lifecycle and the autoscaler knobs.
+"""
+
+from __future__ import annotations
+
+from ..resilience.errors import NoReplicaError
+from .autoscaler import FleetAutoscaler
+from .replica import LocalReplicaSet
+from .router import FleetRouter, ReplicaFailure
+
+__all__ = [
+    "FleetAutoscaler",
+    "FleetRouter",
+    "LocalReplicaSet",
+    "NoReplicaError",
+    "ReplicaFailure",
+]

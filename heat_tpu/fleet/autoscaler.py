@@ -1,0 +1,232 @@
+"""Load-driven elastic autoscaling: serving signals in, replica count out.
+
+The elastic layer (PR 8) reacts to *loss* — a dead worker shrinks the
+mesh.  This controller reacts to *load*: it watches the router's
+serving signals (sliding-window p99, in-flight per ready replica, shed
+and no-replica counters) and drives the replica count between
+``HEAT_TPU_FLEET_MIN_REPLICAS`` and ``MAX_REPLICAS`` through the
+:class:`~heat_tpu.fleet.replica.LocalReplicaSet` actuator — the
+``ProcessSupervisor`` pattern repurposed from surviving failures to
+matching capacity.
+
+**Hysteresis**, because thrash is worse than lag: a tick is
+*overloaded* when any up-signal breaches (p99 over
+``HEAT_TPU_FLEET_P99_UP_MS``, in-flight per ready replica over
+``INFLIGHT_UP``, any shed/no-replica delta, or zero ready replicas
+below the floor) and *underloaded* only when every down-signal clears
+(p99 under ``P99_DOWN_MS``, in-flight under ``INFLIGHT_DOWN``, zero
+sheds).  Scale-up needs ``UP_TICKS`` consecutive overloaded ticks,
+scale-down ``DOWN_TICKS`` consecutive underloaded ones; any mixed tick
+resets both streaks.  One step per decision: spawn one replica (born
+warm through the AOT cache + pre-warm manifest, so added capacity is
+useful within seconds, not after a compile storm) or drain one (router
+first — no new work — then SIGTERM, so scale-down sheds **zero**
+requests).
+
+:meth:`FleetAutoscaler.evaluate` is a pure function of the signal
+snapshot — the tests drive it with synthetic signals; the tick thread
+just feeds it real ones.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..analysis import tsan as _tsan
+from ..telemetry import metrics as _tm
+
+__all__ = ["FleetAutoscaler"]
+
+_UPS_C = _tm.counter("fleet.scale_ups", "autoscaler scale-up actions")
+_DOWNS_C = _tm.counter("fleet.scale_downs", "autoscaler scale-down actions")
+
+
+def _env():
+    from ..core import _env as envmod
+
+    return envmod
+
+
+class FleetAutoscaler:
+    """Drive ``replica_set`` size from ``router`` signals.
+
+    ``router`` needs ``stats()``, ``add_replica``, ``drain_replica``,
+    ``remove_replica`` and ``replica_urls()``; ``replica_set`` needs
+    ``spawn()``, ``drain_stop(url)`` and ``urls()`` — the
+    :class:`~heat_tpu.fleet.router.FleetRouter` /
+    :class:`~heat_tpu.fleet.replica.LocalReplicaSet` surfaces, which
+    the tests stub."""
+
+    def __init__(
+        self,
+        router,
+        replica_set,
+        min_replicas: Optional[int] = None,
+        max_replicas: Optional[int] = None,
+        tick_s: Optional[float] = None,
+        up_ticks: Optional[int] = None,
+        down_ticks: Optional[int] = None,
+        p99_up_ms: Optional[float] = None,
+        p99_down_ms: Optional[float] = None,
+        inflight_up: Optional[float] = None,
+        inflight_down: Optional[float] = None,
+    ):
+        env = _env()
+        self.router = router
+        self.replica_set = replica_set
+        self.min_replicas = int(min_replicas) if min_replicas is not None else env.env_int("HEAT_TPU_FLEET_MIN_REPLICAS")
+        self.max_replicas = int(max_replicas) if max_replicas is not None else env.env_int("HEAT_TPU_FLEET_MAX_REPLICAS")
+        self.tick_s = float(tick_s) if tick_s is not None else env.env_float("HEAT_TPU_FLEET_TICK_S")
+        self.up_ticks = int(up_ticks) if up_ticks is not None else env.env_int("HEAT_TPU_FLEET_UP_TICKS")
+        self.down_ticks = int(down_ticks) if down_ticks is not None else env.env_int("HEAT_TPU_FLEET_DOWN_TICKS")
+        self.p99_up_ms = float(p99_up_ms) if p99_up_ms is not None else env.env_float("HEAT_TPU_FLEET_P99_UP_MS")
+        self.p99_down_ms = float(p99_down_ms) if p99_down_ms is not None else env.env_float("HEAT_TPU_FLEET_P99_DOWN_MS")
+        self.inflight_up = float(inflight_up) if inflight_up is not None else env.env_float("HEAT_TPU_FLEET_INFLIGHT_UP")
+        self.inflight_down = float(inflight_down) if inflight_down is not None else env.env_float("HEAT_TPU_FLEET_INFLIGHT_DOWN")
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}"
+            )
+        self._over_streak = 0
+        self._under_streak = 0
+        self._last_shed = 0
+        self._last_503 = 0
+        self._last_decision: Dict[str, Any] = {}
+        self._lock = _tsan.register_lock("fleet.autoscaler")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the decision (pure in, action out) -----------------------------
+    def evaluate(self, sig: Dict[str, Any]) -> Optional[str]:
+        """Fold one signal snapshot into the hysteresis state; returns
+        the action this tick calls for: ``"up"``, ``"down"`` or None.
+        Pure with respect to the router — tests feed synthetic
+        snapshots."""
+        with self._lock:
+            _tsan.note_access("fleet.autoscaler.state")
+            n = int(sig.get("replicas", 0))
+            shed_delta = max(0, int(sig.get("shed", 0)) - self._last_shed)
+            nr_delta = max(0, int(sig.get("no_replica_503", 0)) - self._last_503)
+            self._last_shed = int(sig.get("shed", 0))
+            self._last_503 = int(sig.get("no_replica_503", 0))
+            p99 = float(sig.get("p99_ms", 0.0))
+            per_ready = float(sig.get("inflight_per_ready", 0.0))
+            have_traffic = int(sig.get("window_requests", 0)) > 0
+            overloaded = (
+                (have_traffic and p99 > self.p99_up_ms)
+                or per_ready > self.inflight_up
+                or shed_delta > 0
+                or nr_delta > 0
+                or int(sig.get("ready", 0)) < self.min_replicas
+            )
+            underloaded = (
+                not overloaded
+                and shed_delta == 0
+                and nr_delta == 0
+                and per_ready < self.inflight_down
+                and (not have_traffic or p99 < self.p99_down_ms)
+            )
+            if overloaded:
+                self._over_streak += 1
+                self._under_streak = 0
+            elif underloaded:
+                self._under_streak += 1
+                self._over_streak = 0
+            else:
+                self._over_streak = 0
+                self._under_streak = 0
+            action = None
+            if self._over_streak >= self.up_ticks and n < self.max_replicas:
+                action = "up"
+                self._over_streak = 0
+            elif self._under_streak >= self.down_ticks and n > self.min_replicas:
+                action = "down"
+                self._under_streak = 0
+            self._last_decision = {
+                "time": time.time(),
+                "signal": dict(sig),
+                "overloaded": overloaded,
+                "underloaded": underloaded,
+                "over_streak": self._over_streak,
+                "under_streak": self._under_streak,
+                "action": action,
+            }
+            return action
+
+    # -- the actuation --------------------------------------------------
+    def scale_up(self) -> Optional[str]:
+        """Spawn one replica and register it with the router; returns
+        its URL (None when the spawn failed — the next tick retries)."""
+        try:
+            url = self.replica_set.spawn()
+        except Exception:  # lint: allow H501(a failed spawn must not kill the tick thread; the next tick retries)
+            return None
+        self.router.add_replica(url)
+        _UPS_C.inc()
+        return url
+
+    def scale_down(self) -> Optional[str]:
+        """Drain one replica (newest first — oldest replicas keep their
+        warm caches) out of the router, then stop it; returns its URL."""
+        urls = self.replica_set.urls()
+        if not urls:
+            return None
+        url = urls[-1]
+        self.router.drain_replica(url)
+        self.replica_set.drain_stop(url)
+        self.router.remove_replica(url)
+        _DOWNS_C.inc()
+        return url
+
+    def tick(self) -> Optional[str]:
+        """One evaluation + actuation cycle (the tick thread's body;
+        tests call it directly)."""
+        action = self.evaluate(self.router.stats())
+        if action == "up":
+            self.scale_up()
+        elif action == "down":
+            self.scale_down()
+        return action
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        """Start the tick thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="heat-tpu-fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # lint: allow H501(a tick error must not kill the controller; the next tick retries)
+                pass
+            self._stop.wait(self.tick_s)
+
+    def close(self) -> None:
+        """Stop the tick thread (the replica set is the owner's to
+        close).  Idempotent."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._thread = None
+
+    def state(self) -> Dict[str, Any]:
+        """The last decision record (/fleet/statusz, tests)."""
+        with self._lock:
+            _tsan.note_access("fleet.autoscaler.state", write=False)
+            return dict(self._last_decision)
+
+    def __enter__(self) -> "FleetAutoscaler":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

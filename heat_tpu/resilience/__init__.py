@@ -36,6 +36,7 @@ from __future__ import annotations
 from .errors import (
     ChecksumError,
     DivergenceError,
+    NoReplicaError,
     OverloadedError,
     PermanentFault,
     ReshapeError,
@@ -74,6 +75,7 @@ __all__ = [
     "DivergenceError",
     "FaultInjector",
     "PermanentFault",
+    "NoReplicaError",
     "OverloadedError",
     "ReshapeError",
     "ResilienceError",

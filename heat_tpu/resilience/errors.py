@@ -31,6 +31,7 @@ __all__ = [
     "PermanentFault",
     "ChecksumError",
     "DivergenceError",
+    "NoReplicaError",
     "OverloadedError",
     "ReshapeError",
     "WorkerLostError",
@@ -143,6 +144,29 @@ class OverloadedError(ResilienceError, RuntimeError):
         super().__init__(message)
         self.tenant = tenant
         self.cause = cause
+        self.retry_after_s = retry_after_s
+
+
+class NoReplicaError(ResilienceError, RuntimeError):
+    """The fleet router found no replica able to take a request: every
+    replica hosting the model is unready (warming, draining, ejected by
+    its circuit breaker) or unreachable, and bounded failover exhausted
+    its attempts.  The HTTP surface maps it to a typed 503 with a
+    ``Retry-After`` (the router's health-poll period: by then a probe
+    or a recovered replica may have changed the verdict).  Never
+    retried by the resilience machinery — the router already performed
+    the bounded retry this error reports the failure of."""
+
+    def __init__(
+        self,
+        message: str = "no replica available",
+        model: Optional[str] = None,
+        attempts: int = 0,
+        retry_after_s: Optional[float] = None,
+    ):
+        super().__init__(message)
+        self.model = model
+        self.attempts = int(attempts)
         self.retry_after_s = retry_after_s
 
 

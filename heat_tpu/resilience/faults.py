@@ -107,6 +107,10 @@ KNOWN_SITES = (
     "serve.load",
     "serve.predict",
     "serve.batch",
+    "aot.load",
+    "aot.save",
+    "fleet.route",
+    "fleet.spawn",
 )
 
 #: process-lifetime totals (survive injector deactivation) — registered

@@ -31,6 +31,7 @@ from typing import Callable, List, Optional, Tuple, Type
 from .errors import (
     ChecksumError,
     DivergenceError,
+    NoReplicaError,
     OverloadedError,
     PermanentFault,
     ReshapeError,
@@ -78,7 +79,10 @@ class RetryTimeout(TransientFault):
 #: exception types retrying can never fix — checked before the
 #: retryable filter, so even a filter of ``(Exception,)`` cannot loop
 #: on them
-NON_RETRYABLE = (PermanentFault, ChecksumError, DivergenceError, ReshapeError, OverloadedError)
+NON_RETRYABLE = (
+    PermanentFault, ChecksumError, DivergenceError, ReshapeError,
+    OverloadedError, NoReplicaError,
+)
 
 
 class RetryPolicy:

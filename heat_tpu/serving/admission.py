@@ -16,7 +16,12 @@ queue:
 * **bounded admission depth** — at most ``HEAT_TPU_SERVE_QUEUE_DEPTH``
   rows may be queued-or-in-flight across the service; past it every
   tenant is shed (``cause="queue"``) instead of the queue growing
-  without bound and collapsing tail latency for everyone.
+  without bound and collapsing tail latency for everyone.  The shed's
+  ``Retry-After`` is computed from the **measured drain rate** (rows
+  released over a sliding window): ``excess_rows / drain_rate``,
+  clamped to [1 ms, 30 s] — so the fleet router and clients back off
+  proportionally to how fast the queue actually moves, not by a coarse
+  constant (``None`` before any drain has been observed).
 
 Every decision is accounted in the metrics registry:
 ``serving.requests`` / ``serving.shed_quota`` / ``serving.shed_queue``
@@ -27,6 +32,7 @@ balancer or autoscaler watches on ``/metrics``.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Dict, Optional
 
 from ..analysis import tsan as _tsan
@@ -84,6 +90,10 @@ class AdmissionController:
     :class:`OverloadedError`; unknown tenants get a bucket at the
     default rate/burst on first sight."""
 
+    #: sliding window (seconds) over which the queue drain rate is
+    #: estimated for queue-shed Retry-After computation
+    DRAIN_WINDOW_S = 5.0
+
     def __init__(
         self,
         max_depth: int,
@@ -95,6 +105,10 @@ class AdmissionController:
         self.default_burst = float(default_burst)
         self._buckets: Dict[str, TokenBucket] = {}
         self._depth = 0
+        #: (monotonic, rows) per release inside the sliding window — the
+        #: measured service drain rate a queue-caused shed's Retry-After
+        #: is computed from (rows ahead / rows-per-second drained)
+        self._drained: deque = deque()
         self._lock = _tsan.register_lock("serving.admission")
 
     def set_quota(self, tenant: str, rate: float, burst: Optional[float] = None) -> None:
@@ -116,11 +130,13 @@ class AdmissionController:
             _tsan.note_access("serving.admission.buckets")
             if self._depth + rows > self.max_depth:
                 _SHED_QUEUE_C.inc()
+                retry_after = self._queue_retry_after(rows)
                 raise OverloadedError(
                     f"admission queue full ({self._depth}/{self.max_depth} rows "
                     f"in flight); request of {rows} rows shed",
                     tenant=tenant,
                     cause="queue",
+                    retry_after_s=retry_after,
                 )
             bucket = self._buckets.get(tenant)
             if bucket is None:
@@ -143,12 +159,54 @@ class AdmissionController:
 
     def release(self, rows: int = 1) -> None:
         """Return ``rows`` previously admitted (request answered or
-        failed)."""
+        failed); each release feeds the drain-rate window queue-shed
+        Retry-After estimates are computed from."""
         rows = max(1, int(rows))
+        now = time.monotonic()
         with self._lock:
             _tsan.note_access("serving.admission.buckets")
             self._depth = max(0, self._depth - rows)
             _DEPTH_G.set(self._depth)
+            self._drained.append((now, rows))
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.DRAIN_WINDOW_S
+        while self._drained and self._drained[0][0] < cutoff:
+            self._drained.popleft()
+
+    def drain_rate(self) -> float:
+        """Measured service drain rate (rows released per second over
+        the sliding window), 0.0 before any release."""
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets", write=False)
+            now = time.monotonic()
+            self._prune(now)
+            if not self._drained:
+                return 0.0
+            rows = sum(r for _, r in self._drained)
+            # span floor: a single just-now release must not read as an
+            # (effectively infinite) instantaneous rate
+            span = max(now - self._drained[0][0], 0.1)
+            return rows / span
+
+    def _queue_retry_after(self, rows: int) -> Optional[float]:
+        """Retry-After for a queue-caused shed: how long until the queue
+        has drained enough headroom for ``rows``, at the measured drain
+        rate (caller holds the lock).  ``None`` before any drain has
+        been observed — a cold process has no basis for an estimate and
+        the coarse constant it would fabricate mis-paces every client."""
+        now = time.monotonic()
+        self._prune(now)
+        if not self._drained:
+            return None
+        drained_rows = sum(r for _, r in self._drained)
+        span = max(now - self._drained[0][0], 0.1)
+        rate = drained_rows / span
+        if rate <= 0.0:
+            return None
+        excess = self._depth + rows - self.max_depth
+        return min(max(excess / rate, 0.001), 30.0)
 
     def depth(self) -> int:
         with self._lock:

@@ -138,6 +138,16 @@ class InferenceService:
         self._batchers: Dict[str, ModelBatcher] = {}
         self._open = True
         self._started_monitor = False
+        #: lifecycle state the /readyz readiness verdict keys off:
+        #: "warming" (up, pre-warming the executable cache — not ready),
+        #: "ready" (routable), "draining" (finishing in-flight work —
+        #: not ready).  Liveness (/healthz) is unaffected by any of it.
+        self._state = "ready"
+        #: (model, bucket_rows, features, dtype) per coalesced-batch
+        #: shape this service has dispatched — the pre-warm manifest a
+        #: fresh replica replays to reach hit rate 1.0 before its first
+        #: request (export_prewarm_manifest/prewarm)
+        self._seen_shapes: set = set()
         self._lock = _tsan.register_lock("serving.service")
 
     # -- model lifecycle (thin registry delegates) ----------------------
@@ -181,6 +191,11 @@ class InferenceService:
         from ..core import factories
 
         est = self.registry.get(name)
+        with self._lock:
+            _tsan.note_access("serving.service.state")
+            self._seen_shapes.add(
+                (name, int(rows.shape[0]), int(rows.shape[1]), str(rows.dtype))
+            )
         tid = _tracing.current_trace_id()
         t0 = time.perf_counter_ns()
         # the ambient trace context is live here, so a cold bucket's
@@ -218,19 +233,25 @@ class InferenceService:
         rows,
         tenant: str = "default",
         timeout: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ):
         """The traced predict path: returns ``(out, info)`` where
         ``info`` carries the request's ``trace_id`` and its measured
         ``latency_ms`` — the ONE timing source both the
         ``serving.latency_ms`` histogram and the HTTP response report
-        (the route must never re-time the request independently)."""
+        (the route must never re-time the request independently).
+
+        ``trace_id`` adopts an inbound id (the fleet router stamps its
+        own into the forwarded body), so one routed request's spans
+        stitch across router and replica by the existing trace_id
+        merge."""
         rows = np.asarray(rows)
         if rows.ndim == 1:
             rows = rows[None, :]
         _inject("serve.predict", model=name, rows=int(rows.shape[0]))
         n = int(rows.shape[0])
         req = _tracing.request_span(
-            f"/v1/predict/{name}", model=name, tenant=tenant, rows=n
+            f"/v1/predict/{name}", trace_id=trace_id, model=name, tenant=tenant, rows=n
         )
         with req:
             t0 = time.perf_counter_ns()
@@ -253,6 +274,171 @@ class InferenceService:
             else None,
         )
         return out, {"trace_id": req.trace_id, "latency_ms": req.duration_ms}
+
+    # -- lifecycle state + readiness ------------------------------------
+    _STATES = ("warming", "ready", "draining")
+
+    @property
+    def state(self) -> str:
+        """Lifecycle state: "warming" / "ready" / "draining"."""
+        with self._lock:
+            _tsan.note_access("serving.service.state", write=False)
+            return self._state
+
+    def set_state(self, state: str) -> str:
+        """Set the lifecycle state (readiness flips with it); returns
+        the previous state."""
+        if state not in self._STATES:
+            raise ValueError(
+                f"unknown service state {state!r}; expected one of {self._STATES}"
+            )
+        with self._lock:
+            _tsan.note_access("serving.service.state")
+            prev, self._state = self._state, state
+        return prev
+
+    def readiness(self):
+        """``(ready, doc)`` for the introspection server's ``/readyz``:
+        ready iff the service is in state "ready".  The doc carries the
+        state, the loaded model names (the router's placement map), the
+        queue/in-flight picture, and the dispatch-cache counters at
+        scrape time (the cold-start gate reads the miss count at
+        ready-time from here)."""
+        from ..core import aot_cache as _aot
+        from ..core import dispatch as _dispatch
+
+        with self._lock:
+            _tsan.note_access("serving.service.state", write=False)
+            state = self._state
+            batchers = list(self._batchers.values())
+        stats = _dispatch.cache_stats()
+        doc: Dict[str, Any] = {
+            "ready": state == "ready",
+            "state": state,
+            "models": self.registry.model_names(),
+            "queued_rows": sum(b.queued_rows() for b in batchers),
+            "admitted_rows_in_flight": self.admission.depth(),
+            "dispatch": {
+                "misses": stats["misses"],
+                "hits": stats["hits"],
+                "hit_rate": stats["hit_rate"],
+            },
+            "aot": {
+                k: v for k, v in _aot.stats().items() if k in ("hits", "saves", "errors")
+            },
+        }
+        return doc["ready"], doc
+
+    # -- pre-warm manifest ----------------------------------------------
+    def export_prewarm_manifest(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """The (model, bucket, features, dtype) shapes this live service
+        has dispatched, as a manifest document a fresh replica replays
+        before taking traffic.  ``path`` writes it atomically with a
+        CRC32 sidecar like every other artifact."""
+        with self._lock:
+            _tsan.note_access("serving.service.state", write=False)
+            shapes = sorted(self._seen_shapes)
+        doc = {
+            "version": 1,
+            "exported_at": time.time(),
+            "entries": [
+                {"model": m, "bucket": b, "features": f, "dtype": dt}
+                for (m, b, f, dt) in shapes
+            ],
+        }
+        if path is not None:
+            from ..resilience.atomic import atomic_write
+
+            with atomic_write(path, fault_site="io.write") as tmp:
+                with open(tmp, "w") as fh:
+                    json.dump(doc, fh, indent=1, sort_keys=True)
+        return doc
+
+    @staticmethod
+    def load_prewarm_manifest(path: str) -> Dict[str, Any]:
+        """Read (and checksum-verify) a manifest written by
+        :meth:`export_prewarm_manifest`."""
+        from ..resilience.atomic import verify_checksum
+
+        verify_checksum(path)
+        with open(path) as fh:
+            return json.load(fh)
+
+    def prewarm(
+        self,
+        manifest: Optional[Dict[str, Any]] = None,
+        path: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Drive one synthetic coalesced batch per manifest entry so
+        every (model, bucket) executable is resident — loaded from the
+        AOT cache when armed, compiled otherwise — BEFORE the first real
+        request.  Entries naming models this service has not loaded are
+        skipped (counted).  Returns ``{"warmed", "skipped",
+        "new_compiles", "aot_hits"}`` where ``new_compiles`` is actual
+        compiles (in-memory misses minus AOT artifact loads): with a
+        populated AOT cache it is 0 — the cold-start elimination the
+        fleet gate enforces."""
+        from ..core import aot_cache as _aot
+        from ..core import dispatch as _dispatch
+
+        if manifest is None:
+            if path is None:
+                raise ValueError("prewarm needs a manifest document or a path")
+            manifest = self.load_prewarm_manifest(path)
+        s0 = _dispatch.cache_stats()
+        a0 = _aot.stats()
+        warmed = skipped = 0
+        for entry in manifest.get("entries", ()):
+            name = str(entry["model"])
+            try:
+                self.registry.record(name)
+            except KeyError:
+                skipped += 1
+                continue
+            rows = np.zeros(
+                (int(entry["bucket"]), int(entry["features"])),
+                dtype=np.dtype(str(entry.get("dtype", "float32"))),
+            )
+            self._batcher(name)  # the batcher thread exists before traffic
+            self._infer_batch(name, rows)  # the exact coalesced-batch program
+            warmed += 1
+        s1 = _dispatch.cache_stats()
+        a1 = _aot.stats()
+        aot_hits = a1["hits"] - a0["hits"]
+        return {
+            "warmed": warmed,
+            "skipped": skipped,
+            "new_compiles": (s1["misses"] - s0["misses"]) - aot_hits,
+            "aot_hits": aot_hits,
+        }
+
+    # -- graceful drain -------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: flip to "draining" (readiness goes 503 so
+        a router stops sending new work), keep serving until every
+        admitted row is answered and every queue is empty (bounded by
+        ``timeout``, default ``HEAT_TPU_FLEET_DRAIN_TIMEOUT_S``), then
+        :meth:`close`.  Returns True when the drain completed with zero
+        abandoned requests.  The SIGTERM path of a fleet replica."""
+        if timeout is None:
+            timeout = _env().env_float("HEAT_TPU_FLEET_DRAIN_TIMEOUT_S")
+        self.set_state("draining")
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        drained = False
+        while True:
+            with self._lock:
+                _tsan.note_access("serving.service.state", write=False)
+                batchers = list(self._batchers.values())
+            if self.admission.depth() == 0 and all(
+                b.queued_rows() == 0 for b in batchers
+            ):
+                drained = True
+                break
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        self.close()
+        return drained
 
     # -- per-model health ----------------------------------------------
     def model_health(self, name: str) -> Dict[str, Any]:
@@ -293,6 +479,14 @@ class InferenceService:
         elif not b.alive():
             doc["status"] = "dead"
             doc["healthy"] = False
+        # lifecycle state rides along so "idle" (no traffic yet) and
+        # "warming" (pre-warm still running) are distinguishable, and a
+        # draining replica's models say so; liveness is unaffected —
+        # readiness is /readyz's verdict, not this route's
+        state = self.state
+        doc["state"] = state
+        if state != "ready" and doc["status"] in ("ok", "idle"):
+            doc["status"] = state
         # quality signals: the model's drift score and any alert that
         # names it — liveness (healthy/503) is unaffected, but the
         # status string flips so a canary driver or operator sees a
@@ -331,6 +525,9 @@ class InferenceService:
         itself without configuration); returns the server URL."""
         srv = _tserver.start_server(port)
         _tserver.register_route(ROUTE_PREFIX, self._handle_http)
+        # readiness (/readyz) now reflects THIS service's lifecycle
+        # state — a fleet router keys routing off it (docs/fleet.md)
+        _tserver.set_readiness(self.readiness)
         _slo.install_default_slos()
         tick = _env().env_float("HEAT_TPU_SLO_TICK_S")
         self._started_monitor = _slo.start_monitor(tick if tick > 0 else 1.0)
@@ -390,8 +587,10 @@ class InferenceService:
         # one timing source: the latency (and trace id) the response
         # reports IS the measurement serving.latency_ms observed — the
         # route never re-times the request independently
+        trace_id = doc.get("trace_id")
         out, info = self._predict(
-            name, rows, tenant=tenant, timeout=doc.get("timeout")
+            name, rows, tenant=tenant, timeout=doc.get("timeout"),
+            trace_id=str(trace_id) if trace_id else None,
         )
         version = self.registry.active_version(name)
         return 200, "application/json", json.dumps(
@@ -410,6 +609,7 @@ class InferenceService:
         """Unmount the routes, drain and join every batcher, drain the
         registry's background loader.  Idempotent."""
         _tserver.unregister_route(ROUTE_PREFIX)
+        _tserver.clear_readiness(self.readiness)
         if self._started_monitor:
             self._started_monitor = False
             _slo.stop_monitor()

@@ -12,6 +12,10 @@ route     payload
 /varz     full registry snapshot as JSON (:func:`metrics.snapshot`)
 /healthz  liveness: fit-heartbeat age + last checkpoint step; HTTP 503
           when the heartbeat is stale (``HEAT_TPU_HEALTH_MAX_AGE_S``)
+/readyz   readiness: should a router send this process traffic?  503
+          with a ``state`` field ("warming"/"draining") while the
+          serving layer is pre-warming or draining — liveness and
+          readiness are distinct verdicts (:func:`set_readiness`)
 /trace    Chrome trace-event JSON of the span ring (load the response
           body in chrome://tracing or https://ui.perfetto.dev) — spans
           carrying a request trace_id draw as connected flow arrows
@@ -64,11 +68,14 @@ from . import tracing as _tracing
 
 __all__ = [
     "IntrospectionServer",
+    "clear_readiness",
     "health_report",
     "maybe_start_from_env",
+    "readiness_report",
     "register_route",
     "registered_routes",
     "server_running",
+    "set_readiness",
     "start_server",
     "statusz_report",
     "stop_server",
@@ -122,6 +129,66 @@ def registered_routes() -> list:
     with _LOCK:
         _tsan.note_access("telemetry.server.routes", write=False)
         return sorted(_ROUTES, key=len, reverse=True)
+
+
+#: readiness provider the /readyz route consults: ``() -> (ready, doc)``.
+#: Liveness (/healthz: is the process making progress) and readiness
+#: (/readyz: should a router send this process traffic) are distinct
+#: verdicts — a replica that is pre-warming its executable cache or
+#: draining for shutdown is perfectly *live* but must not receive new
+#: requests.  The serving layer installs its provider when the /v1
+#: routes mount; without one the process reports ready ("idle": up, no
+#: serving state to gate on).
+_READINESS = None
+
+
+def set_readiness(provider) -> None:
+    """Install the process's readiness provider (``() -> (ready: bool,
+    doc: dict)``); the doc must carry a ``state`` string ("warming" /
+    "ready" / "draining" / ...).  One provider per process — the last
+    installer wins (one serving surface per replica)."""
+    global _READINESS
+    with _LOCK:
+        _tsan.note_access("telemetry.server.readiness")
+        _READINESS = provider
+
+
+def clear_readiness(provider=None) -> None:
+    """Remove the readiness provider (``provider`` given: only if it is
+    the installed one — a closed service must not clobber its
+    successor's provider)."""
+    global _READINESS
+    with _LOCK:
+        _tsan.note_access("telemetry.server.readiness")
+        # equality, not identity: a bound method like ``svc.readiness``
+        # is a fresh object on every attribute access
+        if provider is None or _READINESS == provider:
+            _READINESS = None
+
+
+def readiness_report() -> Tuple[bool, Dict[str, Any]]:
+    """``(ready, doc)`` from the installed provider, or the idle
+    default.  A provider exception reports not-ready ("error") rather
+    than raising — a broken readiness hook must read as unroutable, not
+    crash the scrape."""
+    with _LOCK:
+        _tsan.note_access("telemetry.server.readiness", write=False)
+        provider = _READINESS
+    if provider is None:
+        return True, {"ready": True, "state": "idle", "timestamp": time.time()}
+    try:
+        ready, doc = provider()
+    except Exception as e:  # lint: allow H501(a readiness-hook bug must read as not-ready, never kill the scrape)
+        return False, {
+            "ready": False,
+            "state": "error",
+            "error": f"{type(e).__name__}: {e}",
+            "timestamp": time.time(),
+        }
+    doc = dict(doc)
+    doc.setdefault("ready", bool(ready))
+    doc.setdefault("timestamp", time.time())
+    return bool(ready), doc
 
 
 def _route_for(path: str):
@@ -212,6 +279,8 @@ def statusz_report() -> Dict[str, Any]:
     try:
         from ..core import dispatch
 
+        from ..core import aot_cache
+
         stats = dispatch.cache_stats()
         doc["dispatch"] = {
             "hit_rate": stats["hit_rate"],
@@ -219,6 +288,7 @@ def statusz_report() -> Dict[str, Any]:
             "compile_fallbacks": stats["compile_fallbacks"],
             "cache_keys": dispatch.cache_keys(),
             "cost": dispatch.cost_summary(),
+            "aot": aot_cache.stats(),
         }
     except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
         doc["dispatch"] = None
@@ -342,6 +412,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/healthz":
                 healthy, doc = health_report()
                 self._send_json(doc, 200 if healthy else 503)
+            elif path == "/readyz":
+                ready, doc = readiness_report()
+                self._send_json(doc, 200 if ready else 503)
             elif path == "/trace":
                 self._send_json(_spans.chrome_trace_doc())
             elif path == "/tracez":
@@ -376,7 +449,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200,
                     "heat_tpu runtime introspection: "
-                    "/metrics /varz /healthz /trace /tracez /sloz /driftz /statusz"
+                    "/metrics /varz /healthz /readyz /trace /tracez /sloz /driftz /statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",

@@ -68,6 +68,11 @@ KIND_DIRECTION = {
     "seconds": -1,
     "count": -1,
     "value": 0,
+    # floored/capped values (perf_gate min_value / max_value kinds):
+    # the fleet scale-out ratio is better bigger, the cold-start ratio
+    # better smaller — unlike bare informational "value" records
+    "value_min": +1,
+    "value_max": -1,
 }
 
 
@@ -96,11 +101,17 @@ def headline(rec: dict):
 
 def headline_kind(rec: dict):
     """Which gate-record key :func:`headline` reported (drives the
-    trend gate's direction of good); None for error entries."""
+    trend gate's direction of good); None for error entries.  A
+    ``value`` under a perf_gate floor/cap reports as ``value_min`` /
+    ``value_max`` so the trend layer knows its direction of good."""
     if not isinstance(rec, dict):
         return None
     for key in ("rel_to_anchor", "overhead_pct", "count", "value", "seconds"):
         if key in rec:
+            if key == "value" and "min_value" in rec:
+                return "value_min"
+            if key == "value" and "max_value" in rec:
+                return "value_max"
             return key
     return None
 

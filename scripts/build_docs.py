@@ -47,6 +47,7 @@ NAV = [
         ("Resilience", "docs/resilience.md"),
         ("Elasticity", "docs/elasticity.md"),
         ("Serving", "docs/serving.md"),
+        ("Fleet serving", "docs/fleet.md"),
         ("Overlap layer", "docs/overlap.md"),
         ("Observability", "docs/observability.md"),
         ("Static analysis", "docs/static_analysis.md"),

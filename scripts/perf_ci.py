@@ -592,6 +592,57 @@ def main():
 
     guarded("serving_p99", bench_serving_gates)
 
+    # fleet-scale serving gates (ISSUE 13): real replica subprocesses
+    # behind the fleet router (bench.fleet_scenario).  Three gates:
+    # fleet_scaleout — aggregate routed req/s at 4 replicas over 1
+    # replica, min 3x.  Each replica's capacity is its bounded admission
+    # queue over the coalescing residency (sleep-shaped, NOT core-count-
+    # shaped), so the ratio measures the router's bounded-load spillover
+    # + queue-shed failover: a router that stops spreading pins it to
+    # ~1x on any hardware.  fleet_kill_failed_requests — SIGKILL the
+    # rendezvous-favorite replica under live load; bounded-retry
+    # failover must absorb every in-flight loss (hard cap 0 failed).
+    # fleet_cold_start / fleet_cold_compiles — a fresh replica boots
+    # from the AOT executable cache + pre-warm manifest: first request
+    # within 2x its own steady p99, and ZERO compiles after ready
+    # (executable-cache hit rate 1.0 from request one).
+    def bench_fleet_gates():
+        import bench as bench_mod
+
+        raw = bench_mod.fleet_scenario(
+            scale_window_s=3.0, clients=12, kill_window_s=3.0
+        )
+        assert raw["drain_rc"] == 0, f"drain exited {raw['drain_rc']}: {raw}"
+        assert raw["failed_1_replica"] + raw["failed_4_replicas"] == 0, raw
+        results["fleet_scaleout"] = {
+            "value": raw["scaleout_ratio"],
+            "min_value": 3.0,
+            "rate_1_replica": raw["rate_1_replica"],
+            "rate_4_replicas": raw["rate_4_replicas"],
+            "shed_1_replica": raw["shed_1_replica"],
+            "shed_4_replicas": raw["shed_4_replicas"],
+        }
+        results["fleet_kill_failed_requests"] = {
+            "count": raw["kill_failed_requests"],
+            "max_count": 0,
+            "requests_ok": raw["kill_requests_ok"],
+            "failovers": raw["kill_failovers"],
+        }
+        results["fleet_cold_start"] = {
+            "value": raw["cold_vs_steady_p99"],
+            "max_value": 2.0,
+            "first_request_ms": raw["cold_first_request_ms"],
+            "steady_p99_ms": raw["steady_p99_ms"],
+            "spawn_cold_s": raw["spawn_cold_s"],
+        }
+        results["fleet_cold_compiles"] = {
+            "count": raw["cold_compiles_after_ready"],
+            "max_count": 0,
+            "aot_hits": raw["cold_aot_hits"],
+        }
+
+    guarded("fleet_scaleout", bench_fleet_gates)
+
     # request-tracing overhead (ISSUE 10): a sustained request stream
     # through the bench_serving service (same model, same size mix,
     # registry-default coalescing delay) with the FULL tracing stack

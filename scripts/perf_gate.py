@@ -43,6 +43,38 @@ def gate(committed: dict, current: dict, margin_pct: float) -> int:
                 for item in cur.get("items", [])[:20]:
                     failures.append(f"{name}:   {item}")
             continue
+        # floor metrics (``min_value``): the measured value must stay AT
+        # OR ABOVE the committed floor — e.g. the fleet 1->4 replica
+        # scale-out ratio must stay >= 3x
+        if "min_value" in rec:
+            cur = current.get(name)
+            if cur is None or "value" not in cur:
+                failures.append(f"{name}: missing from current run")
+                continue
+            floor = float(rec["min_value"])
+            got = float(cur["value"])
+            failed = got < floor
+            status = "FAIL" if failed else "ok"
+            print(f"{name}: current {got:.2f} floor {floor:.2f} [{status}]")
+            if failed:
+                failures.append(f"{name}: {got:.2f} < floor {floor:.2f}")
+            continue
+        # ceiling metrics (``max_value``): dimensionless ratio bound —
+        # e.g. a fresh replica's first-request latency over its steady
+        # p99 must stay <= 2x
+        if "max_value" in rec:
+            cur = current.get(name)
+            if cur is None or "value" not in cur:
+                failures.append(f"{name}: missing from current run")
+                continue
+            cap = float(rec["max_value"])
+            got = float(cur["value"])
+            failed = got > cap
+            status = "FAIL" if failed else "ok"
+            print(f"{name}: current {got:.2f} cap {cap:.2f} [{status}]")
+            if failed:
+                failures.append(f"{name}: {got:.2f} > cap {cap:.2f}")
+            continue
         # hard-cap latency metrics (``max_seconds``): absolute wall-time
         # bound, e.g. the elastic worker-loss recovery (loss detection
         # -> resumed worker's first heartbeat) must stay under its cap

@@ -7,9 +7,11 @@ excepthooks (``test_introspection.py``), the shared metrics/span
 state (``test_telemetry.py``), the serving layer's coalescer/
 registry-loader/admission threads plus its HTTP routes
 (``test_serving.py``), the request-tracing context handoffs +
-tail-store concurrency (``test_tracing.py``), and the quality-signal
+tail-store concurrency (``test_tracing.py``), the quality-signal
 layer's SLO tick thread / alert table / sketch registry
-(``test_slo.py``, ``test_drift.py``) — in a subprocess with the concurrency
+(``test_slo.py``, ``test_drift.py``), and the fleet layer's router
+handler/health-poller threads, circuit breakers, AOT-cache config and
+autoscaler tick (``test_fleet.py``) — in a subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
 sanitizer recorded **zero** findings: no lock-order cycle and no
@@ -41,6 +43,7 @@ LANE_FILES = (
     "tests/test_tracing.py",
     "tests/test_slo.py",
     "tests/test_drift.py",
+    "tests/test_fleet.py",
 )
 
 
