@@ -50,7 +50,12 @@ Run in-process (tests, the autoscaler harness) or as its own process::
 
 Routes: ``/v1/*`` proxied with failover; ``/fleet/statusz`` (replica
 table, breaker states, counters), ``/fleet/healthz`` (200 iff >= 1
-ready replica), ``/metrics`` (the router process's own registry).
+ready replica), ``/fleetz`` (fleet-wide roofline rollup: the health
+poller collects each ready replica's ``/rooflinez`` observatory
+snapshot and this route renders the merged per-kernel utilization +
+watermark table, slowest replica per key highlighted via the PR 6
+straggler score; ``?format=json`` for the machine form), ``/metrics``
+(the router process's own registry).
 """
 
 from __future__ import annotations
@@ -107,6 +112,7 @@ class _Replica:
     __slots__ = (
         "url", "ready", "state", "models", "not_models", "inflight", "fails",
         "cb_open", "cb_open_until", "probing", "last_poll_ok", "added_at",
+        "observatory", "observatory_ts",
     )
 
     def __init__(self, url: str):
@@ -122,6 +128,11 @@ class _Replica:
         self.probing = False
         self.last_poll_ok = 0.0
         self.added_at = time.time()
+        #: last /rooflinez?format=json snapshot the health poller pulled
+        #: (None until the replica answers one) — the /fleetz rollup's
+        #: per-replica half
+        self.observatory: Optional[Dict[str, Any]] = None
+        self.observatory_ts = 0.0
 
     def doc(self) -> Dict[str, Any]:
         return {
@@ -316,13 +327,32 @@ class FleetRouter:
         with self._lock:
             _tsan.note_access("fleet.router.replicas", write=False)
             urls = list(self._replicas)
+            obs_ts = {u: self._replicas[u].observatory_ts for u in urls}
+        now = time.time()
+        # the observatory sweep runs on its own (slower) cadence: the
+        # readiness poll can tick sub-second, but re-pulling a ledger
+        # snapshot that fast buys nothing and the replica's first
+        # /rooflinez answer may include its one-shot peak calibration
+        obs_period = max(self.health_period_s, 2.0)
         for url in urls:
             ready, state, models = self._probe_readyz(url)
+            # the same sweep collects the replica's roofline-observatory
+            # snapshot (bounded: the slowest 64 keys) — the per-replica
+            # half of the /fleetz fleet rollup.  Only ready replicas are
+            # asked: a warming/draining replica's ledger is noise.
+            obs = (
+                self._probe_rooflinez(url)
+                if ready and now - obs_ts.get(url, 0.0) >= obs_period
+                else None
+            )
             with self._lock:
                 _tsan.note_access("fleet.router.replicas")
                 r = self._replicas.get(url)
                 if r is None:
                     continue
+                if obs is not None:
+                    r.observatory = obs
+                    r.observatory_ts = time.time()
                 if r.state == "draining" and state not in ("ready",):
                     # a locally initiated drain sticks until the replica
                     # itself reports ready again (a cancelled drain)
@@ -354,6 +384,18 @@ class FleetRouter:
         models = doc.get("models")
         models = frozenset(str(m) for m in models) if isinstance(models, list) else None
         return code == 200 and bool(doc.get("ready", code == 200)), state, models
+
+    def _probe_rooflinez(self, url: str) -> Optional[Dict[str, Any]]:
+        """One replica's observatory snapshot, or None (replica without
+        the route, unreachable, or malformed — never raises)."""
+        try:
+            with urllib.request.urlopen(
+                url + "/rooflinez?format=json&limit=64", timeout=2.0
+            ) as resp:
+                doc = json.load(resp)
+            return doc if isinstance(doc, dict) else None
+        except Exception:  # lint: allow H501(an observatory-less replica is a rollup gap, not an error)
+            return None
 
     # -- routing policy -------------------------------------------------
     def _preference(self, model: str, replicas: List[_Replica]) -> List[_Replica]:
@@ -554,8 +596,10 @@ class FleetRouter:
         headers)``.  The in-process entry point the HTTP handlers and
         the tests share."""
         bare = path.split("?", 1)[0]
-        if bare.startswith("/fleet/") or bare == "/metrics":
-            return self._handle_local(bare)
+        if bare.startswith("/fleet/") or bare in ("/metrics", "/fleetz"):
+            query = path.split("?", 1)[1] if "?" in path else ""
+            params = dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+            return self._handle_local(bare, params)
         if not bare.startswith("/v1/"):
             return 404, json.dumps({"error": f"unknown route {bare!r}"}), "application/json", {}
         t0 = time.perf_counter()
@@ -636,16 +680,158 @@ class FleetRouter:
             body = json.dumps(doc).encode("utf-8")
         return self._route(model, "POST", "/v1/predict", body)
 
-    def _handle_local(self, path: str):
+    def _handle_local(self, path: str, params: Optional[Dict[str, str]] = None):
+        params = params or {}
         if path == "/fleet/healthz":
             n = self._count_ready()
             doc = {"ready_replicas": n, "replicas": len(self.replica_urls())}
             return (200 if n else 503), json.dumps(doc), "application/json", {}
         if path == "/fleet/statusz":
             return 200, json.dumps(self.statusz(), indent=1, default=str), "application/json", {}
+        if path == "/fleetz":
+            if params.get("format") == "json":
+                return 200, json.dumps(self.fleetz_report(), indent=1, default=str), "application/json", {}
+            return 200, self.render_fleetz_html(), "text/html", {}
         if path == "/metrics":
-            return 200, _tm.expose(), "text/plain; version=0.0.4", {}
+            from ..telemetry.server import OPENMETRICS_CONTENT_TYPE
+
+            return 200, _tm.expose(), OPENMETRICS_CONTENT_TYPE, {}
         return 404, json.dumps({"error": f"unknown route {path!r}"}), "application/json", {}
+
+    # -- fleet-wide roofline rollup (/fleetz) ---------------------------
+    def fleetz_report(self) -> Dict[str, Any]:
+        """The fleet-wide observatory rollup: every polled replica's
+        watermark + calibration provenance, and each dispatch key's
+        per-replica roofline rows merged into one record with the
+        slowest replica named and its relative excess scored by the
+        PR 6 straggler machinery (``aggregate.straggler_score`` over
+        the per-replica fenced means — ``0`` balanced, ``1`` = the
+        slowest replica takes 2x the median)."""
+        from ..telemetry.aggregate import straggler_score
+
+        with self._lock:
+            _tsan.note_access("fleet.router.replicas", write=False)
+            snaps = {
+                r.url: (dict(r.observatory), r.observatory_ts)
+                for r in self._replicas.values()
+                if r.observatory is not None
+            }
+        replicas: Dict[str, Any] = {}
+        kernels: Dict[str, Dict[str, Any]] = {}
+        now = time.time()
+        for url in sorted(snaps):
+            obs, ts = snaps[url]
+            replicas[url] = {
+                "watermark": obs.get("watermark"),
+                "peaks": obs.get("peaks"),
+                "ledger_rows": obs.get("ledger_total", len(obs.get("ledger") or [])),
+                "snapshot_age_s": round(now - ts, 3),
+            }
+            for row in obs.get("ledger") or []:
+                key = row.get("key")
+                if not key:
+                    continue
+                kernels.setdefault(key, {"replicas": {}})["replicas"][url] = {
+                    "calls": row.get("calls"),
+                    "mean_ms": row.get("mean_ms"),
+                    "timing": row.get("timing"),
+                    "gflops_per_s": row.get("gflops_per_s"),
+                    "gbytes_per_s": row.get("gbytes_per_s"),
+                    "utilization": row.get("utilization"),
+                    "bound": row.get("bound"),
+                }
+        for key, entry in kernels.items():
+            per = entry["replicas"]
+            means = [(u, per[u].get("mean_ms")) for u in sorted(per)]
+            known = [(u, m) for u, m in means if m is not None]
+            entry["slowest"] = max(known, key=lambda um: um[1])[0] if known else None
+            entry["straggler_score"] = round(
+                straggler_score([m for _u, m in means]), 4
+            )
+        return {
+            "timestamp": now,
+            "ready_replicas": self._count_ready(),
+            "replicas": replicas,
+            "kernels": dict(sorted(kernels.items())),
+        }
+
+    def render_fleetz_html(self) -> str:
+        """The human form of ``/fleetz``: per-replica watermark header +
+        the fleet-wide per-kernel utilization table, the slowest replica
+        per key highlighted."""
+        import html as _html
+
+        doc = self.fleetz_report()
+        parts = [
+            "<html><head><title>/fleetz</title></head><body>",
+            "<h1>/fleetz — fleet roofline rollup</h1>",
+            f"<p>{doc['ready_replicas']} ready replica(s), "
+            f"{len(doc['replicas'])} with observatory snapshots</p>",
+            "<table border=1 cellpadding=3><tr><th>replica</th><th>in use MiB</th>"
+            "<th>predicted MiB</th><th>budget MiB</th><th>peaks</th>"
+            "<th>ledger rows</th><th>age s</th></tr>",
+        ]
+        for url, rep in doc["replicas"].items():
+            wm = rep.get("watermark") or {}
+            peaks = rep.get("peaks")
+            peaks_s = (
+                f"{float(peaks['flops']) / 1e9:.0f} GF/s · "
+                f"{float(peaks['bytes_per_s']) / 1e9:.0f} GB/s ({peaks['source']})"
+                if peaks
+                else "—"
+            )
+            parts.append(
+                "<tr>"
+                f"<td>{_html.escape(url)}</td>"
+                f"<td>{float(wm.get('bytes_in_use') or 0) / 2**20:.1f}</td>"
+                f"<td>{float(wm.get('predicted_peak_bytes') or 0) / 2**20:.1f}</td>"
+                f"<td>{float(wm.get('budget_bytes') or 0) / 2**20:.1f}</td>"
+                f"<td>{_html.escape(peaks_s)}</td>"
+                f"<td>{rep.get('ledger_rows')}</td>"
+                f"<td>{rep.get('snapshot_age_s')}</td>"
+                "</tr>"
+            )
+        parts.append("</table><h2>per-kernel utilization</h2>")
+        parts.append(
+            "<table border=1 cellpadding=3><tr><th>executable</th><th>replica</th>"
+            "<th>calls</th><th>mean ms</th><th>GFLOP/s</th><th>GB/s</th>"
+            "<th>util</th><th>bound</th><th>straggler</th></tr>"
+        )
+        for key, entry in doc["kernels"].items():
+            per = entry["replicas"]
+            first = True
+            for url in sorted(per):
+                row = per[url]
+                slow = url == entry.get("slowest") and len(per) > 1
+                cell = _html.escape(url)
+                if slow:
+                    cell = f"<b style='color:#b00'>{cell} ⟵ slowest</b>"
+                parts.append(
+                    "<tr>"
+                    + (
+                        f"<td rowspan={len(per)}>{_html.escape(str(key))}</td>"
+                        if first
+                        else ""
+                    )
+                    + f"<td>{cell}</td>"
+                    f"<td>{row.get('calls')}</td><td>{row.get('mean_ms')}</td>"
+                    f"<td>{row.get('gflops_per_s') if row.get('gflops_per_s') is not None else '—'}</td>"
+                    f"<td>{row.get('gbytes_per_s') if row.get('gbytes_per_s') is not None else '—'}</td>"
+                    f"<td>{row.get('utilization') if row.get('utilization') is not None else '—'}</td>"
+                    f"<td>{_html.escape(str(row.get('bound')))}</td>"
+                    + (
+                        f"<td rowspan={len(per)}>{entry.get('straggler_score')}</td>"
+                        if first
+                        else ""
+                    )
+                    + "</tr>"
+                )
+                first = False
+        parts.append("</table>")
+        if not doc["kernels"]:
+            parts.append("<p>no per-kernel snapshots collected yet</p>")
+        parts.append("</body></html>")
+        return "".join(parts)
 
     # -- introspection / autoscaler signals ----------------------------
     def statusz(self) -> Dict[str, Any]:

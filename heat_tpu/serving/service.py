@@ -149,6 +149,17 @@ class InferenceService:
         #: request (export_prewarm_manifest/prewarm)
         self._seen_shapes: set = set()
         self._lock = _tsan.register_lock("serving.service")
+        # roofline join: with the observatory armed, every predict
+        # bucket's compile records its XLA flops/bytes so /rooflinez can
+        # pair them with measured time.  Serving compiles are bounded
+        # (one per (model, bucket)), so the per-miss accounting cost is
+        # a warmup-only tax; processes with the observatory disabled
+        # keep cost accounting at its knob default.
+        from ..core import dispatch as _dispatch
+        from ..telemetry import observatory as _observatory
+
+        if _observatory.armed() and not _dispatch.cost_accounting_enabled():
+            _dispatch.set_cost_accounting(True)
 
     # -- model lifecycle (thin registry delegates) ----------------------
     def load(self, name: str, directory: str, **kwargs) -> int:

@@ -68,6 +68,7 @@ from . import slo
 from . import sketch
 from . import aggregate
 from . import flight_recorder
+from . import observatory
 from . import server
 from .metrics import (
     Counter,
@@ -110,6 +111,13 @@ from .aggregate import (
     write_worker_snapshot,
 )
 from .flight_recorder import dump_bundle
+from .observatory import (
+    device_peaks,
+    rooflinez_report,
+    start_capture,
+    stop_capture,
+    watermark_tick,
+)
 from .server import start_server, stop_server
 from .alerts import active_alerts, alert_events, alerts_snapshot
 from .slo import (
@@ -152,6 +160,7 @@ __all__ = [
     "counter",
     "current_context",
     "current_trace_id",
+    "device_peaks",
     "dump_bundle",
     "dump_json",
     "expose",
@@ -165,11 +174,14 @@ __all__ = [
     "record_span",
     "request_span",
     "reset_all",
+    "rooflinez_report",
     "set_tracing",
     "snapshot",
     "span",
+    "start_capture",
     "start_server",
     "start_trace",
+    "stop_capture",
     "stop_server",
     "stop_trace",
     "summary_line",
@@ -178,6 +190,7 @@ __all__ = [
     "tracez_report",
     "tracing_enabled",
     "use_context",
+    "watermark_tick",
     "write_worker_snapshot",
 ]
 
@@ -198,8 +211,9 @@ _DOMAIN_PREFIXES = {
     "alerts": ("alerts.",),
     "slo": ("slo.",),
     "drift": ("drift.",),
+    "observatory": ("observatory.",),
     "telemetry": ("spans.", "tracing.", "fit.", "telemetry.", "flight.",
-                  "checkpoint.", "alerts.", "slo.", "drift."),
+                  "checkpoint.", "alerts.", "slo.", "drift.", "observatory."),
 }
 
 
@@ -221,6 +235,7 @@ def reset_all(domain: Optional[str] = None) -> None:
         alerts.clear_alerts()
         slo.reset_monitors()
         sketch.SKETCHES.clear()
+        observatory.reset()
         return
     prefixes = _DOMAIN_PREFIXES.get(domain)
     if prefixes is None:
@@ -239,6 +254,8 @@ def reset_all(domain: Optional[str] = None) -> None:
         slo.reset_monitors()
     if domain in ("drift", "telemetry"):
         sketch.SKETCHES.clear()
+    if domain in ("observatory", "telemetry"):
+        observatory.reset()
 
 
 def summary_line(iter_rate: Optional[float] = None) -> str:

@@ -22,6 +22,8 @@ One bundle carries everything the post-mortem needs::
                 (was an SLO burning or a model drifting when it died?)
     slo         every registered objective's last burn-rate verdict
     drift       per-model input-drift scores vs their baselines
+    observatory the roofline execution ledger + the last HBM watermark
+                sample vs the static prediction + calibration provenance
     knobs       every registered HEAT_TPU_* knob's effective value
     dispatch    cache stats + keys + per-executable cost accounting
     checkpoint  last durable step (where a resume would restart)
@@ -254,6 +256,20 @@ def _analysis_state() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _observatory_state() -> Optional[Dict[str, Any]]:
+    """The roofline observatory at crash time: execution ledger (was a
+    kernel suddenly slow?), the last HBM watermark sample vs the static
+    prediction (was this an OOM the watermark saw coming?), and the
+    calibration provenance.  Never calibrates — a crash dump must not
+    run device kernels."""
+    try:
+        from . import observatory as _observatory
+
+        return _observatory.snapshot(calibrate=False)
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
 def _elastic_state() -> Optional[Dict[str, Any]]:
     """World size + loss/reshape counters at crash time — the first
     question a preemption postmortem asks."""
@@ -298,6 +314,7 @@ def build_bundle(
             "findings": _tsan.findings(),
         },
         "analysis": _analysis_state(),
+        "observatory": _observatory_state(),
         "elastic": _elastic_state(),
         "runtime": _runtime_info(),
     }

@@ -54,11 +54,24 @@ __all__ = [
     "counter",
     "gauge",
     "histogram",
+    "register_dump_section",
     "snapshot",
     "reset",
     "dump_json",
     "expose",
 ]
+
+#: extra named sections embedded in the ``HEAT_TPU_METRICS_DUMP``
+#: atexit JSON beside the metrics snapshot: name -> zero-arg provider
+#: (the observatory registers its ledger/watermark/calibration section
+#: here).  Registered at import time on the main thread, read only at
+#: dump time; a provider failure drops its section, never the dump.
+_DUMP_SECTIONS: "Dict[str, Callable[[], Any]]" = {}
+
+
+def register_dump_section(name: str, provider: Callable[[], Any]) -> None:
+    """Attach a named section to every metrics dump (last wins)."""
+    _DUMP_SECTIONS[str(name)] = provider
 
 Number = Union[int, float]
 
@@ -390,6 +403,11 @@ class MetricsRegistry:
         from ..resilience.atomic import atomic_write
 
         doc = {"timestamp": time.time(), "pid": os.getpid(), "metrics": self.snapshot()}
+        for name, provider in _DUMP_SECTIONS.items():
+            try:
+                doc[name] = provider()
+            except Exception:  # lint: allow H501(a section provider bug drops its section, never the dump)
+                doc[name] = None
         with atomic_write(path) as tmp:
             with open(tmp, "w") as f:
                 json.dump(doc, f, indent=1, default=str)
@@ -406,7 +424,13 @@ class MetricsRegistry:
         trace that landed in it), so a scraper can jump from a latency
         bucket straight to the retained trace in ``/tracez``.  Metric
         names are sanitized to the Prometheus charset with a
-        ``heat_tpu_`` namespace prefix."""
+        ``heat_tpu_`` namespace prefix.
+
+        The payload ends with the OpenMetrics ``# EOF`` terminator and
+        the serving routes send it as ``application/openmetrics-text``:
+        exemplar syntax is OpenMetrics, not Prometheus-text 0.0.4, and a
+        spec-compliant scraper treats a payload without the terminator
+        as torn (exposition hygiene, docs/observability.md)."""
         lines: List[str] = []
         with self._lock:
             _tsan.note_access("telemetry.metrics.registry", write=False)
@@ -441,6 +465,7 @@ class MetricsRegistry:
                         lines.append(f'{pname}{{quantile="{q}"}} {v}')
                 lines.append(f"{pname}_sum {m.sum}")
                 lines.append(f"{pname}_count {m.count}")
+        lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

@@ -29,6 +29,14 @@ route     payload
 /driftz   input-drift sketches: per served model, the live-vs-baseline
           PSI score and per-feature breakdown; HTML by default,
           ``?format=json`` for the machine form
+/rooflinez  kernel roofline observatory: per-executable measured time
+          joined with cost-accounting FLOPs/bytes — achieved GFLOP/s,
+          GB/s, intensity and bound-class vs the device peaks, plus the
+          live HBM watermark; HTML by default, ``?format=json``
+/profilez on-demand bounded ``jax.profiler`` capture: POST
+          ``/profilez/start[?duration_s=]`` / ``/profilez/stop``
+          (single in-flight, 409 on conflict), GET lists completed
+          captures with downloadable artifacts
 /statusz  build/runtime info: every registered env knob's effective
           value, dispatch cache keys + hit rate + per-executable cost
           accounting, jax/device/version info, active alerts
@@ -61,10 +69,17 @@ from typing import Any, Dict, Optional, Tuple
 from ..analysis import tsan as _tsan
 from . import alerts as _alerts
 from . import metrics as _metrics
+from . import observatory as _observatory
 from . import sketch as _sketch
 from . import slo as _slo
 from . import spans as _spans
 from . import tracing as _tracing
+
+#: /metrics content type: the payload carries OpenMetrics exemplar
+#: syntax and the ``# EOF`` terminator, so it must be declared as
+#: OpenMetrics — a Prometheus-text 0.0.4 label on exemplar'd buckets is
+#: a spec violation scrapers reject (exposition hygiene, PR 14)
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 __all__ = [
     "IntrospectionServer",
@@ -313,6 +328,11 @@ def statusz_report() -> Dict[str, Any]:
     except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
         doc["analysis"] = None
     try:
+        # compact embed: never calibrates or runs device work from a scrape
+        doc["observatory"] = _observatory.snapshot(calibrate=False, max_rows=20)
+    except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
+        doc["observatory"] = None
+    try:
         doc["alerts"] = {
             "active": _alerts.active_alerts(),
             "recent_events": _alerts.alert_events(limit=10),
@@ -400,7 +420,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
             if path == "/metrics":
-                self._send(200, _metrics.expose(), "text/plain; version=0.0.4")
+                self._send(200, _metrics.expose(), OPENMETRICS_CONTENT_TYPE)
             elif path == "/varz":
                 self._send_json(
                     {
@@ -442,6 +462,39 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(_sketch.drift_report())
                 else:
                     self._send(200, _sketch.render_driftz_html(), "text/html")
+            elif path == "/rooflinez":
+                params = self._query_params()
+                if params.get("format") == "json":
+                    try:
+                        limit = int(params["limit"]) if "limit" in params else None
+                    except ValueError:
+                        limit = None
+                    self._send_json(_observatory.rooflinez_report(limit=limit))
+                else:
+                    self._send(200, _observatory.render_rooflinez_html(), "text/html")
+            elif path == "/profilez":
+                if self._query_params().get("format") == "json":
+                    self._send_json(_observatory.capture_status())
+                else:
+                    self._send(200, _observatory.render_profilez_html(), "text/html")
+            elif path == "/profilez/artifact":
+                name = self._query_params().get("name", "")
+                try:
+                    p = _observatory.artifact_path(name)
+                except (FileNotFoundError, PermissionError) as e:
+                    self._send_json({"error": str(e)}, 404)
+                else:
+                    with open(p, "rb") as f:
+                        data = f.read()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.send_header(
+                        "Content-Disposition",
+                        f'attachment; filename="{os.path.basename(p)}"',
+                    )
+                    self.end_headers()
+                    self.wfile.write(data)
             elif path == "/statusz":
                 self._send_json(statusz_report())
             elif path == "/":
@@ -449,7 +502,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200,
                     "heat_tpu runtime introspection: "
-                    "/metrics /varz /healthz /readyz /trace /tracez /sloz /driftz /statusz"
+                    "/metrics /varz /healthz /readyz /trace /tracez /sloz /driftz "
+                    "/rooflinez /profilez /statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",
@@ -468,6 +522,24 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802 - http.server API
         try:
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path in ("/profilez/start", "/profilez/stop"):
+                try:
+                    if path.endswith("start"):
+                        raw = self._query_params().get("duration_s")
+                        doc = _observatory.start_capture(
+                            float(raw) if raw is not None else None
+                        )
+                    else:
+                        doc = _observatory.stop_capture()
+                    self._send_json(doc)
+                except RuntimeError as e:
+                    # single in-flight / nothing running: a state
+                    # conflict, not a server error
+                    self._send_json({"error": str(e)}, 409)
+                except ValueError as e:
+                    self._send_json({"error": str(e)}, 400)
+                return
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             if not self._dispatch_route("POST", self.path.split("?", 1)[0], body):

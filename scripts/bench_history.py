@@ -404,6 +404,31 @@ def render_markdown(records: list, out_path: str) -> None:
         )
     lines += [
         "",
+        "## Regime anchors",
+        "",
+        "The anchored kernels publish `rel_to_anchor` ="
+        " bytes-moved-model / time / stream-anchor — a dimensionless"
+        " fraction of the kernel's *minimal regime traffic* at the"
+        " runner's own measured bandwidth, not a bare one-pass ratio"
+        " (ROADMAP 5b).  The models (validated against the roofline"
+        " observatory's per-key bytes×time ledger, `/rooflinez`):",
+        "",
+        "| kernel | bytes-moved model |",
+        "|---|---|",
+        "| `fft3d_64` | 48 B/el — planar 3-D FFT: per-axis pass read +"
+        " (re, im) write over f32 input |",
+        "| `sort_psrs` | 28 B/el — PSRS touches every f32 key ~7×:"
+        " local sort r+w, pivot partition r, all-to-all exchange r+w,"
+        " final merge r+w |",
+        "| `sparse_spmm_ring` | p·X + 12 B/nnz + out — the ring"
+        " circulates the dense operand past every shard (p reads of X),"
+        " each CSR block streams once (f64 value + int32 column), the"
+        " f64 output writes once |",
+        "",
+        "Each record also carries `model_gbytes_per_s` (the model over"
+        " the measured time) so the anchored ratio is auditable against"
+        " the observatory's achieved-GB/s numbers.",
+        "",
         "See also: [observability](observability.md), the committed gate"
         " record `BENCH_CI.json`, and `scripts/perf_gate.py` for the"
         " regression rules.",

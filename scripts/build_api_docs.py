@@ -33,6 +33,7 @@ MODULES = [
     ("SLO monitors", "heat_tpu.telemetry.slo", "declarative objectives with multi-window burn-rate alerting over the bounded histograms (/sloz; docs/observability.md)"),
     ("Input-drift sketches", "heat_tpu.telemetry.sketch", "streaming per-feature moment + log-bucket sketches, PSI/KL divergence vs persisted baselines (/driftz; docs/observability.md)"),
     ("Alerts", "heat_tpu.telemetry.alerts", "deduplicated severity-tagged fired/resolved alert events with exemplar trace ids (docs/observability.md)"),
+    ("Roofline observatory", "heat_tpu.telemetry.observatory", "per-executable runtime attribution: sampled execution ledger, device-peak calibration, live HBM watermarks, on-demand profiler capture (/rooflinez + /profilez; docs/observability.md)"),
     ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601, H701-H705) (docs/static_analysis.md)"),
     ("Dtype-flow lint", "heat_tpu.analysis.dtype_flow", "jaxpr precision lint: silent truncation, low-precision accumulation, unpinned contractions, policy violations (J201-J204; docs/static_analysis.md)"),
     ("Peak-HBM estimator", "heat_tpu.analysis.memory_model", "static per-device peak-memory prediction from the jaxpr (liveness + donation + sharding), J301 against HEAT_TPU_HBM_BUDGET_BYTES (docs/static_analysis.md)"),

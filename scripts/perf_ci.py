@@ -210,16 +210,33 @@ def main():
     guarded("fft3d_64", bench_fft)
 
     # distributed sort (stream-anchored; 2^18 keeps the CI job under a
-    # minute — the PSRS program is the same shape at any extent)
+    # minute — the PSRS program is the same shape at any extent).
+    # Regime anchor (ROADMAP 5b): a bytes-moved bandwidth model like
+    # fft's 48 B/el instead of the former bare one-pass 4 B/el ratio —
+    # PSRS touches every f32 key ~7 times (local sort read+write, pivot
+    # partition read, all-to-all exchange read+write, final merge
+    # read+write), so the ratio now reads as "fraction of the minimal
+    # PSRS traffic the kernel sustains vs the stream anchor" (the same
+    # quantity /rooflinez reports per dispatch key from its bytes×time
+    # ledger; docs/perf_history.md "Regime anchors").
     def bench_sort():
-        xs = ht.random.randn(1 << 18, split=0).astype(ht.float32)
+        n_el = 1 << 18
+        xs = ht.random.randn(n_el, split=0).astype(ht.float32)
         float(xs.sum())
         per, sp = _timeit(lambda: ht.sort(xs)[0], lambda r: float(r[0]), n_iter=1, windows=3)
-        record("sort_psrs", per, sp, 4.0 * (1 << 18), anchor_bw)
+        bytes_moved = 28.0 * n_el  # 7 passes x 4 B/el
+        record("sort_psrs", per, sp, bytes_moved, anchor_bw)
+        results["sort_psrs"]["bytes_model"] = "psrs-7pass-28B/el"
+        results["sort_psrs"]["model_gbytes_per_s"] = round(bytes_moved / per / 1e9, 4)
 
     guarded("sort_psrs", bench_sort)
 
-    # sparse CSR ring SpMM (stream-anchored on the dense operand)
+    # sparse CSR ring SpMM (stream-anchored on the dense operand).
+    # Regime anchor (ROADMAP 5b): the ring circulates the whole dense
+    # operand past every one of the p shards (p reads of X), each shard
+    # streams its CSR block once (12 B per nnz: f64 value + int32
+    # column), and the f64 output is written once — vs the former bare
+    # one-read-of-X model that undercounted the ring by ~10x.
     def bench_sparse():
         import scipy.sparse as sp_m
 
@@ -228,7 +245,16 @@ def main():
         xd = ht.random.randn(4096, 64, split=0).astype(ht.float64)
         float(xd.sum())
         per, spd = _timeit(lambda: sa @ xd, lambda r: float(r[0, 0]), n_iter=2)
-        record("sparse_spmm_ring", per, spd, 8.0 * 4096 * 64, anchor_bw)
+        p = xd.comm.size
+        x_bytes = 8.0 * 4096 * 64
+        bytes_moved = p * x_bytes + 12.0 * A.nnz + x_bytes
+        record("sparse_spmm_ring", per, spd, bytes_moved, anchor_bw)
+        results["sparse_spmm_ring"]["bytes_model"] = (
+            f"ring-p{p}: p*X + 12B/nnz + out"
+        )
+        results["sparse_spmm_ring"]["model_gbytes_per_s"] = round(
+            bytes_moved / per / 1e9, 4
+        )
 
     guarded("sparse_spmm_ring", bench_sparse)
 
@@ -907,6 +933,86 @@ def main():
         }
 
     guarded("kmeans_predict_bf16", bench_kmeans_predict_bf16)
+
+    # roofline-observatory overhead (ISSUE 14): the SAME kmeans lloyd
+    # kernel with the execution ledger + fenced sampling + watermark
+    # cross-checks armed (HEAT_TPU_PERF_SYNC_EVERY at its default 16)
+    # vs the observatory disarmed — paired per-round median like the
+    # other overhead gates.  Hard cap <3%: the observatory is ON BY
+    # DEFAULT in production, so its per-dispatch tax must be noise.
+    def bench_observatory_overhead():
+        from heat_tpu.telemetry import observatory as obsv
+
+        prev_sync = obsv.set_sync_every(16)
+
+        def fit_observed():
+            obsv.set_enabled(True)
+            return fit()
+
+        def fit_plain():
+            obsv.set_enabled(False)
+            return fit()
+
+        try:
+            fetch = lambda km: float(km.cluster_centers_.sum())
+            overhead_pct, on_per, off_per, sp = _paired_overhead_pct(
+                fit_observed, fit_plain, fetch
+            )
+        finally:
+            obsv.set_enabled(True)
+            obsv.set_sync_every(prev_sync)
+            obsv.reset()
+        results["observatory_overhead"] = {
+            "overhead_pct": round(overhead_pct, 2),
+            "max_overhead_pct": 3.0,
+            "enabled_s": round(on_per, 5),
+            "disabled_s": round(off_per, 5),
+            "spread_pct": sp,
+        }
+
+    guarded("observatory_overhead", bench_observatory_overhead)
+
+    # roofline sanity (ISSUE 14): the calibrated matmul kernel driven
+    # through the dispatch cache with every call fenced must report at
+    # least 20% of this runner's own measured peak — the end-to-end
+    # proof that the ledger's time, the cost join's FLOPs and the
+    # calibration all describe the same machine.  A broken fence (enqueue
+    # time mistaken for device time), a dropped cost join, or a
+    # miscalibrated peak all push the utilization off this floor.
+    def bench_roofline_sanity():
+        from heat_tpu.core import dispatch as disp
+        from heat_tpu.telemetry import observatory as obsv
+
+        obsv.reset_peaks()
+        peaks = obsv.device_peaks(calibrate=True)
+        prev_cost = disp.set_cost_accounting(True)
+        prev_sync = obsv.set_sync_every(1)
+        obsv.reset()
+        try:
+            side = 512
+            buf = jax.device_put(np.ones((side, side), np.float32))
+            for _ in range(12):
+                disp.eager_apply(jnp.matmul, (buf, buf))
+            rows = [
+                r for r in obsv.ledger_report(peaks)
+                if "matmul" in r["key"] and r.get("utilization") is not None
+            ]
+            assert rows, "the matmul must land in the ledger with a cost join"
+            best = max(rows, key=lambda r: r["utilization"])
+            results["roofline_sanity"] = {
+                "value": round(best["utilization"], 4),
+                "min_value": 0.2,
+                "gflops_per_s": best["gflops_per_s"],
+                "peak_gflops": round(peaks["flops"] / 1e9, 1),
+                "bound": best["bound"],
+                "calibration_source": peaks["source"],
+            }
+        finally:
+            disp.set_cost_accounting(prev_cost)
+            obsv.set_sync_every(prev_sync)
+            obsv.reset()
+
+    guarded("roofline_sanity", bench_roofline_sanity)
 
     # compat-matrix smoke lane (ROADMAP 5a): the collective-wrapper test
     # subset under BOTH core/_compat.py resolver branches (legacy

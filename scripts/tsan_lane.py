@@ -9,9 +9,12 @@ registry-loader/admission threads plus its HTTP routes
 (``test_serving.py``), the request-tracing context handoffs +
 tail-store concurrency (``test_tracing.py``), the quality-signal
 layer's SLO tick thread / alert table / sketch registry
-(``test_slo.py``, ``test_drift.py``), and the fleet layer's router
+(``test_slo.py``, ``test_drift.py``), the fleet layer's router
 handler/health-poller threads, circuit breakers, AOT-cache config and
-autoscaler tick (``test_fleet.py``) — in a subprocess with the concurrency
+autoscaler tick (``test_fleet.py``), and the roofline observatory's
+dispatch-thread ledger vs /rooflinez scrapes plus the /profilez
+capture slot vs its auto-stop timer (``test_observatory.py``) — in a
+subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
 sanitizer recorded **zero** findings: no lock-order cycle and no
@@ -44,6 +47,7 @@ LANE_FILES = (
     "tests/test_slo.py",
     "tests/test_drift.py",
     "tests/test_fleet.py",
+    "tests/test_observatory.py",
 )
 
 

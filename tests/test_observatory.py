@@ -1,0 +1,593 @@
+"""Kernel roofline observatory tests (ISSUE 14 tentpole).
+
+The contract under test (docs/observability.md):
+
+* ``core/dispatch.py`` notes every cached-executable call into the
+  execution ledger — monotonic timing on every call, every Nth call per
+  key (``HEAT_TPU_PERF_SYNC_EVERY``) ``block_until_ready``-fenced so the
+  sample measures device time;
+* the ledger joins measured time with cost-accounting FLOPs/bytes into
+  achieved GFLOP/s, GB/s, arithmetic intensity and a compute-vs-
+  bandwidth bound verdict against device peaks (env knobs, an atomic+CRC
+  calibration cache, or a one-shot matmul/copy micro-calibration);
+* live HBM watermark gauges cross-check the measured bytes against the
+  static estimator's predicted peak and the armed budget, firing the
+  ``hbm:watermark`` alert end to end;
+* ``/rooflinez`` serves the per-executable table, ``/profilez``
+  starts/stops a bounded single-in-flight jax.profiler capture with
+  downloadable artifacts, ``/metrics`` is OpenMetrics-clean
+  (content-type + ``# EOF``);
+* crash flight-recorder bundles and the ``HEAT_TPU_METRICS_DUMP``
+  atexit JSON both carry the ``observatory`` section, rendered by the
+  inspect CLI;
+* the fleet router's health poller collects each replica's observatory
+  snapshot and ``/fleetz`` renders the merged per-kernel table across
+  real replica subprocesses, slowest replica per key highlighted.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serving, telemetry
+from heat_tpu.core import dispatch
+from heat_tpu.telemetry import alerts as talerts
+from heat_tpu.telemetry import inspect as tinspect
+from heat_tpu.telemetry import observatory as obs
+from heat_tpu.telemetry import server as tserver
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_observatory():
+    prev_enabled = obs.set_enabled(True)
+    prev_sync = obs.set_sync_every(4)
+    prev_cost = dispatch.cost_accounting_enabled()
+    obs.reset()
+    obs.set_memory_stats_provider(None)
+    yield
+    obs.set_enabled(prev_enabled)
+    obs.set_sync_every(prev_sync)
+    dispatch.set_cost_accounting(prev_cost)
+    obs.set_memory_stats_provider(None)
+    obs.reset()
+    talerts.clear_alerts()
+
+
+@pytest.fixture
+def live_server():
+    srv = tserver.start_server(0)
+    yield srv
+    tserver.stop_server()
+
+
+def _get(srv, route):
+    with urllib.request.urlopen(f"{srv.url}{route}", timeout=10) as r:
+        return r.status, r.read().decode("utf-8"), dict(r.headers)
+
+
+def _post(srv, route):
+    req = urllib.request.Request(f"{srv.url}{route}", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _dispatch_some(n=8, rows=128):
+    """Drive n identical cached dispatches; returns the forced scalar.
+
+    ``rows`` picks the dispatch key (shape enters the key; scalar values
+    do not) — tests that must observe a FRESH compile (the cost join
+    records on the miss) pass a shape no earlier test used."""
+    x = ht.random.randn(rows, 4, split=0).astype(ht.float32)
+    out = 0.0
+    for _ in range(n):
+        out = float((x * 2.0 + 1.0).sum())
+    return out
+
+
+# ----------------------------------------------------------------------
+# the execution ledger
+# ----------------------------------------------------------------------
+class TestLedger:
+    def test_records_calls_and_fenced_samples(self):
+        obs.set_sync_every(2)
+        _dispatch_some(n=9, rows=112)
+        rows = obs.ledger_report()
+        assert rows, "dispatches must land in the ledger"
+        top = rows[0]
+        assert top["calls"] >= 8
+        assert top["mean_ms"] > 0
+        # every 2nd call is block_until_ready-fenced
+        assert top["sync_samples"] >= 3
+        assert top["timing"] == "fenced"
+        assert top["sync_min_ms"] is not None
+
+    def test_sync_every_zero_never_fences(self):
+        obs.set_sync_every(0)
+        _dispatch_some(n=6, rows=96)
+        rows = obs.ledger_report()
+        assert rows and all(r["sync_samples"] == 0 for r in rows)
+        assert rows[0]["timing"] == "enqueue"
+
+    def test_disarmed_records_nothing(self):
+        obs.set_enabled(False)
+        _dispatch_some(n=4, rows=80)
+        assert obs.ledger_report() == []
+
+    def test_reset_all_clears_ledger(self):
+        _dispatch_some(n=4, rows=72)
+        assert obs.ledger_report()
+        telemetry.reset_all("observatory")
+        assert obs.ledger_report() == []
+
+    def test_roofline_join_bandwidth_verdict(self):
+        """An elementwise chain is bandwidth-bound against any sane
+        peak pair (intensity well under the ridge)."""
+        dispatch.set_cost_accounting(True)
+        _dispatch_some(n=6, rows=144)
+        peaks = {"flops": 1e12, "bytes_per_s": 1e10}  # ridge = 100 FLOP/B
+        rows = [r for r in obs.ledger_report(peaks) if r["flops"]]
+        assert rows, "cost accounting must join flops onto the ledger"
+        top = rows[0]
+        assert top["bound"] == "bandwidth"
+        assert top["gbytes_per_s"] > 0
+        assert top["intensity"] is not None and top["intensity"] < 100
+        assert top["utilization"] is not None
+
+    def test_roofline_join_compute_verdict(self):
+        """A matmul's intensity sits far above a low ridge -> compute."""
+        import jax.numpy as jnp
+
+        dispatch.set_cost_accounting(True)
+        a = np.ones((256, 256), np.float32)
+        import jax
+
+        buf = jax.device_put(a)
+        for _ in range(5):
+            dispatch.eager_apply(jnp.matmul, (buf, buf))
+        peaks = {"flops": 1e12, "bytes_per_s": 1e11}  # ridge = 10 FLOP/B
+        rows = [
+            r for r in obs.ledger_report(peaks)
+            if "matmul" in r["key"] and r["flops"]
+        ]
+        assert rows
+        # 2*256^3 flops over ~3*256*256*4 bytes ≈ 43 FLOP/B > ridge 10
+        assert rows[0]["bound"] == "compute"
+        assert rows[0]["intensity"] > 10
+
+
+# ----------------------------------------------------------------------
+# device peaks: knobs -> cache -> calibration
+# ----------------------------------------------------------------------
+class TestPeaks:
+    def test_env_knobs_win(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_PEAK_FLOPS", "2e12")
+        monkeypatch.setenv("HEAT_TPU_PEAK_GBPS", "100")
+        obs.reset_peaks()
+        peaks = obs.device_peaks(calibrate=False)
+        assert peaks["source"] == "env"
+        assert peaks["flops"] == pytest.approx(2e12)
+        assert peaks["bytes_per_s"] == pytest.approx(1e11)
+        obs.reset_peaks()
+
+    def test_no_cheap_source_returns_none_without_calibration(self, monkeypatch):
+        monkeypatch.delenv("HEAT_TPU_PEAK_FLOPS", raising=False)
+        monkeypatch.delenv("HEAT_TPU_PEAK_GBPS", raising=False)
+        monkeypatch.delenv("HEAT_TPU_PEAK_CACHE", raising=False)
+        obs.reset_peaks()
+        assert obs.device_peaks(calibrate=False) is None
+        obs.reset_peaks()
+
+    def test_calibration_persists_and_reloads(self, tmp_path, monkeypatch):
+        cache = str(tmp_path / "peaks.json")
+        monkeypatch.delenv("HEAT_TPU_PEAK_FLOPS", raising=False)
+        monkeypatch.delenv("HEAT_TPU_PEAK_GBPS", raising=False)
+        monkeypatch.setenv("HEAT_TPU_PEAK_CACHE", cache)
+        obs.reset_peaks()
+        peaks = obs.device_peaks(calibrate=True)
+        assert peaks["source"] == "calibrated"
+        assert peaks["flops"] > 0 and peaks["bytes_per_s"] > 0
+        # atomic + CRC sidecar, like every other artifact
+        assert os.path.exists(cache) and os.path.exists(cache + ".crc32")
+        obs.reset_peaks()
+        again = obs.device_peaks(calibrate=False)
+        assert again["source"] == "cache"
+        assert again["flops"] == pytest.approx(peaks["flops"])
+        obs.reset_peaks()
+
+    def test_corrupt_cache_recalibrates(self, tmp_path, monkeypatch):
+        cache = str(tmp_path / "peaks.json")
+        monkeypatch.setenv("HEAT_TPU_PEAK_CACHE", cache)
+        with open(cache, "w") as f:
+            f.write("{torn")
+        obs.reset_peaks()
+        peaks = obs.device_peaks(calibrate=True)
+        assert peaks["source"] == "calibrated"  # never crashed on the torn file
+        obs.reset_peaks()
+
+    def test_fingerprint_mismatch_misses_cache(self, tmp_path, monkeypatch):
+        cache = str(tmp_path / "peaks.json")
+        monkeypatch.setenv("HEAT_TPU_PEAK_CACHE", cache)
+        obs.reset_peaks()
+        obs.device_peaks(calibrate=True)
+        with open(cache) as f:
+            doc = json.load(f)
+        assert doc["fingerprint"] == obs._device_fingerprint()
+        doc["fingerprint"] = "jax=9.9|backend=tpu|kind=v9|n=4096"
+        from heat_tpu.resilience.atomic import atomic_write
+
+        with atomic_write(cache) as tmp:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+        obs.reset_peaks()
+        assert obs.device_peaks(calibrate=False) is None  # stale artifact refused
+        obs.reset_peaks()
+
+
+# ----------------------------------------------------------------------
+# HBM watermarks + the measured-vs-predicted alert
+# ----------------------------------------------------------------------
+class TestWatermark:
+    def test_probe_reports_some_source(self):
+        doc = obs.watermark_tick(force=True)
+        assert doc is not None
+        assert doc["source"] in ("device", "host_rss")
+        assert doc["bytes_in_use"] > 0
+
+    def test_budget_alert_fires_and_resolves(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET_BYTES", "1024")
+        doc = obs.watermark_tick(force=True)
+        assert doc["bytes_in_use"] > 1024
+        budget_alerts = [
+            a for a in talerts.active_alerts()
+            if a["name"] == "hbm:watermark" and a["labels"]["cause"] == "budget"
+        ]
+        assert budget_alerts and budget_alerts[0]["severity"] == "page"
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET_BYTES", "0")
+        obs.watermark_tick(force=True)
+        assert not any(a["name"] == "hbm:watermark" for a in talerts.active_alerts())
+
+    def test_predicted_margin_alert(self, monkeypatch):
+        from heat_tpu.analysis import memory_model as mm
+
+        # budget armed (but not exceeded): the predicted cross-check
+        # only runs on budget-armed processes — a process-wide in-use
+        # number always dwarfs one program's predicted peak, so the
+        # check would be pure noise unarmed
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET_BYTES", "1000000")
+        monkeypatch.setenv("HEAT_TPU_HBM_ALERT_MARGIN", "1.5")
+        mm.reset_estimates()
+        mm.note_estimate("prog", mm.PeakEstimate(per_device_bytes=1000, peak_bytes=1000))
+        assert mm.predicted_peak_bytes() == 1000
+        obs.set_memory_stats_provider(lambda: (2000.0, 2000.0, "test"))
+        obs.watermark_tick(force=True)  # 2000 > 1000 * 1.5, under budget
+        assert any(
+            a["name"] == "hbm:watermark" and a["labels"]["cause"] == "predicted"
+            for a in talerts.active_alerts()
+        )
+        obs.set_memory_stats_provider(lambda: (1200.0, 2000.0, "test"))
+        obs.watermark_tick(force=True)  # 1200 < 1500: resolved
+        assert not any(a["name"] == "hbm:watermark" for a in talerts.active_alerts())
+        mm.reset_estimates()
+
+    def test_undersized_budget_alert_end_to_end_on_live_service(
+        self, live_server, tmp_path, monkeypatch
+    ):
+        """The acceptance scenario: a serving process with a deliberately
+        undersized HEAT_TPU_HBM_BUDGET_BYTES raises the watermark alert
+        through the fenced-dispatch tick and surfaces it on /statusz."""
+        monkeypatch.setenv("HEAT_TPU_HBM_BUDGET_BYTES", "4096")
+        obs.set_sync_every(1)  # every predict dispatch fences + cross-checks
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((96, 5)).astype(np.float32)
+        km = ht.cluster.KMeans(
+            n_clusters=3, init="random", max_iter=4, random_state=0
+        ).fit(ht.array(pts, split=0))
+        d = str(tmp_path / "m")
+        serving.save_model(km, d, version=1, name="km")
+        svc = serving.InferenceService(max_delay_ms=1.0, max_batch=8)
+        try:
+            svc.load("km", d)
+            for _ in range(4):
+                svc.predict("km", pts[:4], timeout=30)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if talerts.is_firing("hbm:watermark", labels={"cause": "budget"}):
+                    break
+                obs.watermark_tick(force=True)
+                time.sleep(0.05)
+            active = {a["name"]: a for a in talerts.active_alerts()}
+            assert "hbm:watermark" in active
+            status, body, _ = _get(live_server, "/statusz")
+            statusz = json.loads(body)
+            assert any(
+                a["name"] == "hbm:watermark" for a in statusz["alerts"]["active"]
+            )
+            # the serving process auto-armed the cost join, so the
+            # acceptance table has GFLOP/s for the steady-state keys
+            status, body, _ = _get(live_server, "/rooflinez?format=json")
+            doc = json.loads(body)
+            assert doc["ledger"], "a live serving process must show its keys"
+            assert any(r["gflops_per_s"] is not None for r in doc["ledger"])
+        finally:
+            svc.close()
+
+
+# ----------------------------------------------------------------------
+# HTTP surfaces
+# ----------------------------------------------------------------------
+class TestRooflinezRoute:
+    def test_html_and_json_forms(self, live_server):
+        dispatch.set_cost_accounting(True)
+        _dispatch_some(n=6, rows=176)
+        status, body, headers = _get(live_server, "/rooflinez")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/html")
+        assert "roofline observatory" in body and "<table" in body
+        status, body, _ = _get(live_server, "/rooflinez?format=json")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["ledger"] and doc["ledger"][0]["calls"] >= 1
+        for field in ("calls", "mean_ms", "gflops_per_s", "gbytes_per_s", "bound"):
+            assert field in doc["ledger"][0]
+        assert doc["peaks"] is not None  # json form may calibrate
+
+    def test_limit_param_bounds_the_payload(self, live_server):
+        x = ht.random.randn(64, 3, split=0).astype(ht.float32)
+        # three distinct keys: the op identity enters the key
+        for op in (lambda a: a * 2.0, lambda a: a + 2.0, lambda a: a - 2.0):
+            for _ in range(2):
+                float(op(x).sum())
+        status, body, _ = _get(live_server, "/rooflinez?format=json&limit=1")
+        doc = json.loads(body)
+        assert len(doc["ledger"]) == 1
+        assert doc["ledger_total"] >= 2 and doc["truncated"] is True
+
+    def test_metrics_exposition_hygiene(self, live_server):
+        """PR 14 satellite: /metrics must declare OpenMetrics (the
+        payload carries exemplar syntax) and terminate with # EOF."""
+        _dispatch_some(n=2)
+        status, body, headers = _get(live_server, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"] == (
+            "application/openmetrics-text; version=1.0.0; charset=utf-8"
+        )
+        assert body.rstrip("\n").endswith("# EOF")
+        # observatory gauges ride in the same payload
+        assert "heat_tpu_observatory_ledger_size" in body
+
+    def test_root_index_lists_new_routes(self, live_server):
+        status, body, _ = _get(live_server, "/")
+        assert "/rooflinez" in body and "/profilez" in body
+
+
+class TestProfilez:
+    def test_capture_roundtrip_single_inflight_and_download(
+        self, live_server, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("HEAT_TPU_PROFILE_DIR", str(tmp_path / "prof"))
+        status, body = _post(live_server, "/profilez/start?duration_s=10")
+        start_doc = json.loads(body)
+        assert status == 200 and start_doc["dir"]
+        # single in-flight: a second start is a 409 conflict
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(live_server, "/profilez/start")
+        assert ei.value.code == 409
+        _dispatch_some(n=3, rows=192)
+        status, body = _post(live_server, "/profilez/stop")
+        stop_doc = json.loads(body)
+        assert status == 200
+        assert stop_doc["artifacts"], "a capture must leave artifacts"
+        # stopping again: nothing in flight -> 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(live_server, "/profilez/stop")
+        assert ei.value.code == 409
+        status, body, _ = _get(live_server, "/profilez?format=json")
+        st = json.loads(body)
+        assert st["active"] is False and len(st["captures"]) >= 1
+        name = urllib.parse.quote(stop_doc["artifacts"][0]["name"])
+        with urllib.request.urlopen(
+            f"{live_server.url}/profilez/artifact?name={name}", timeout=10
+        ) as r:
+            assert r.status == 200 and len(r.read()) > 0
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{live_server.url}/profilez/artifact?name=../../../etc/passwd",
+                timeout=10,
+            )
+        assert ei.value.code == 404  # traversal refused
+
+    def test_duration_capped_and_auto_stopped(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_PROFILE_DIR", str(tmp_path / "prof"))
+        monkeypatch.setenv("HEAT_TPU_PROFILE_MAX_S", "0.3")
+        doc = obs.start_capture(duration_s=9999)
+        assert doc["duration_s"] == pytest.approx(0.3)
+        # wait for the deadline record itself: stop_capture clears the
+        # in-flight flag BEFORE it appends the capture record (the
+        # profiler stop runs between the two lock sections), so polling
+        # `active` alone can observe the gap
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = obs.capture_status()
+            if not st["active"] and st["captures"] and (
+                st["captures"][-1]["reason"] == "deadline"
+            ):
+                break
+            time.sleep(0.05)
+        st = obs.capture_status()
+        assert st["active"] is False
+        assert st["captures"][-1]["reason"] == "deadline"
+
+
+# ----------------------------------------------------------------------
+# crash bundles + the atexit metrics dump (PR 14 satellite)
+# ----------------------------------------------------------------------
+class TestCrashSurfaces:
+    def test_bundle_and_metrics_dump_carry_observatory(self, tmp_path):
+        """A crashed subprocess leaves BOTH a flight-recorder bundle and
+        the HEAT_TPU_METRICS_DUMP atexit JSON carrying the observatory
+        section (ledger + watermark + calibration provenance), and the
+        inspect CLI renders it."""
+        bundles = tmp_path / "bundles"
+        dump = tmp_path / "metrics.json"
+        child = (
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import heat_tpu as ht\n"
+            "from heat_tpu.core import dispatch\n"
+            "from heat_tpu.telemetry import observatory as obs\n"
+            "dispatch.set_cost_accounting(True)\n"
+            "obs.set_sync_every(2)\n"
+            "obs.set_peaks(1e12, 1e10, source='spec-sheet')\n"
+            "x = ht.random.randn(64, 4, split=0).astype(ht.float32)\n"
+            "for _ in range(6):\n"
+            "    float((x * 2.0 + 1.0).sum())\n"
+            "obs.watermark_tick(force=True)\n"
+            "from heat_tpu.resilience.errors import PermanentFault\n"
+            "raise PermanentFault('boom')\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HEAT_TPU_FLIGHT_RECORDER"] = str(bundles)
+        env["HEAT_TPU_METRICS_DUMP"] = str(dump)
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=env, capture_output=True,
+            cwd=REPO_ROOT, timeout=300,
+        )
+        assert proc.returncode != 0
+        assert b"PermanentFault" in proc.stderr
+
+        paths = sorted(bundles.glob("flight_*.json"))
+        assert len(paths) == 1
+        doc = tinspect.load_bundle(str(paths[0]))
+        section = doc["observatory"]
+        assert section is not None
+        assert section["ledger"], "the crash bundle must carry the ledger"
+        assert section["ledger"][0]["calls"] >= 5
+        assert section["ledger"][0]["bound"] in ("bandwidth", "compute")
+        assert section["watermark"]["source"] in ("device", "host_rss")
+        assert section["peaks"]["source"] == "spec-sheet"
+
+        # the atexit metrics dump carries the same section (CRC-verified)
+        from heat_tpu.resilience.atomic import verify_checksum
+
+        verify_checksum(str(dump))
+        with open(dump) as f:
+            dumped = json.load(f)
+        assert dumped["observatory"]["ledger"]
+        assert dumped["observatory"]["peaks"]["source"] == "spec-sheet"
+
+        res = subprocess.run(
+            [sys.executable, "-m", "heat_tpu.telemetry.inspect", str(paths[0])],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, cwd=REPO_ROOT, timeout=300,
+        )
+        assert res.returncode == 0, res.stderr.decode()[-2000:]
+        out = res.stdout.decode()
+        assert "observatory" in out
+        assert "spec-sheet" in out and "watermark" in out
+
+
+# ----------------------------------------------------------------------
+# fleet rollup: /fleetz across real replica subprocesses
+# ----------------------------------------------------------------------
+class TestFleetz:
+    @pytest.mark.slow
+    def test_fleetz_merges_two_real_replicas(self, tmp_path):
+        """The acceptance scenario: >= 2 real replica subprocesses, the
+        router's poller collects each one's observatory snapshot, and
+        /fleetz shows the merged per-kernel table with the slowest
+        replica named."""
+        from heat_tpu.fleet import FleetRouter, LocalReplicaSet
+
+        rng = np.random.default_rng(5)
+        pts = rng.standard_normal((128, 6)).astype(np.float32)
+        km = ht.cluster.KMeans(
+            n_clusters=3, init="random", max_iter=5, random_state=0
+        ).fit(ht.array(pts, split=0))
+        mdir = str(tmp_path / "km")
+        serving.save_model(km, mdir, version=1, name="km")
+        rs = LocalReplicaSet(
+            {"km": mdir}, str(tmp_path / "fleet"),
+            max_batch=8, max_delay_ms=1.0,
+            env=dict(os.environ, HEAT_TPU_PERF_SYNC_EVERY="2"),
+        )
+        router = FleetRouter(health_period_s=30.0)  # poll explicitly
+        try:
+            urls = [rs.spawn(), rs.spawn()]
+            for url in urls:
+                router.add_replica(url)
+            # drive steady-state traffic at each replica directly so both
+            # ledgers fill with the same (model, bucket) dispatch keys
+            body = json.dumps({"model": "km", "inputs": pts[:4].tolist()}).encode()
+            for url in urls:
+                for _ in range(6):
+                    req = urllib.request.Request(
+                        url + "/v1/predict", data=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        assert r.status == 200
+            router.poll_health()
+            doc = router.fleetz_report()
+            assert set(doc["replicas"]) == {u.rstrip("/") for u in urls}
+            for rep in doc["replicas"].values():
+                assert rep["watermark"]["bytes_in_use"] > 0
+            assert doc["kernels"], "steady-state keys must merge into /fleetz"
+            merged = [
+                e for e in doc["kernels"].values() if len(e["replicas"]) == 2
+            ]
+            assert merged, "the same dispatch key must appear on both replicas"
+            entry = merged[0]
+            assert entry["slowest"] in {u.rstrip("/") for u in urls}
+            assert entry["straggler_score"] >= 0.0
+            # serving replicas auto-arm the cost join -> utilization known
+            assert any(
+                row["gflops_per_s"] is not None or row["gbytes_per_s"] is not None
+                for e in merged for row in e["replicas"].values()
+            )
+            status, html, ctype, _ = router.handle("GET", "/fleetz", None)
+            assert status == 200 and ctype.startswith("text/html")
+            assert "per-kernel utilization" in html
+            assert "slowest" in html
+            status, body2, _, _ = router.handle("GET", "/fleetz?format=json", None)
+            assert json.loads(body2)["kernels"]
+        finally:
+            router.close()
+            rs.close()
+
+
+# ----------------------------------------------------------------------
+# hygiene: every new knob is registered (H201-clean by construction)
+# ----------------------------------------------------------------------
+class TestKnobs:
+    def test_new_knobs_registered(self):
+        from heat_tpu.core import _env
+
+        for name in (
+            "HEAT_TPU_OBSERVATORY",
+            "HEAT_TPU_PERF_SYNC_EVERY",
+            "HEAT_TPU_PEAK_FLOPS",
+            "HEAT_TPU_PEAK_GBPS",
+            "HEAT_TPU_PEAK_CACHE",
+            "HEAT_TPU_HBM_ALERT_MARGIN",
+            "HEAT_TPU_PROFILE_DIR",
+            "HEAT_TPU_PROFILE_MAX_S",
+        ):
+            assert name in _env.KNOBS, name
+
+    def test_new_locks_registered(self):
+        from heat_tpu.analysis.concurrency import LOCK_REGISTRY
+
+        assert "telemetry.observatory" in LOCK_REGISTRY
+        assert "telemetry.observatory.profiler" in LOCK_REGISTRY
