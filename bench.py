@@ -1054,6 +1054,92 @@ def bench_serving(ht, sync_floor, roofline=None):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_canary(ht, sync_floor, roofline=None):
+    """Config 11b: the canary decision plane under a sustained stream
+    (ISSUE 15).
+
+    An identical canary (v2 == v1) is hot-loaded ``activate=False`` with
+    ``HEAT_TPU_SHADOW_FRACTION`` at 1.0 while client requests stream at
+    varied sizes.  Reported: the **time-to-verdict** — how long the
+    decision engine takes to accumulate ``HEAT_TPU_CANARY_MIN_ROWS``
+    shadow rows and auto-promote under this stream (the operational
+    question: "how long does a canary bake?"), the shadow lane's
+    batch/drop counters, the canary-vs-primary latency ratio measured on
+    the same mirrored batches, and the steady-state compile count (must
+    be 0: the shadow path rides the primary's bucket keys)."""
+    import shutil
+    import tempfile
+
+    from heat_tpu import serving as srv
+    from heat_tpu.core import dispatch
+    from heat_tpu.serving import canary as cnry
+    from heat_tpu.telemetry import metrics as tmet
+
+    rng = np.random.default_rng(3)
+    pts = rng.standard_normal((1 << 12, 16)).astype(np.float32)
+    x = ht.array(pts, split=0)
+    km = ht.cluster.KMeans(n_clusters=8, init="random", max_iter=5, random_state=0).fit(x)
+
+    sizes = [1, 3, 7, 12, 18, 27, 33, 50, 64]
+    d = tempfile.mkdtemp(prefix="heat_tpu_bench_canary_")
+    try:
+        srv.save_model(km, d, version=1, name="km")
+        srv.save_model(km, d, version=2, name="km")
+        svc = srv.InferenceService(max_delay_ms=1.0, max_batch=64)
+        svc.load("km", d, version=1)
+        for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+            svc.predict("km", pts[:b])
+
+        s0 = dispatch.cache_stats()
+        c0 = {
+            k: tmet.counter(f"canary.{k}").value
+            for k in ("sampled", "sampled_rows", "dropped", "comparisons")
+        }
+        svc.load("km", d, version=2, activate=False)  # the canary
+        svc.canary.fraction = 1.0
+        svc.canary.min_rows = 256
+        t0 = time.perf_counter()
+        deadline = t0 + 60.0
+        i = 0
+        while time.perf_counter() < deadline:
+            n = sizes[i % len(sizes)]
+            svc.predict("km", pts[(i * 7) % 64 : (i * 7) % 64 + n])
+            i += 1
+            st = cnry.status("km")
+            if st is not None and st["decision"] is not None:
+                break
+        decision_s = time.perf_counter() - t0
+        svc.canary.wait_idle(30)
+        st = cnry.status("km") or {}
+        s1 = dispatch.cache_stats()
+        c1 = {
+            k: tmet.counter(f"canary.{k}").value
+            for k in ("sampled", "sampled_rows", "dropped", "comparisons")
+        }
+        dec = st.get("decision") or {}
+        svc.close()
+        return {
+            "metric": "canary_decision_s",
+            "value": round(decision_s, 3),
+            "unit": "s",
+            "vs_baseline": 0.0,
+            "vs_baseline_kind": "time_to_verdict_at_min_rows_256",
+            "verdict": dec.get("verdict"),
+            "action": dec.get("action"),
+            "requests_to_verdict": i,
+            "shadow_batches": c1["sampled"] - c0["sampled"],
+            "shadow_rows": c1["sampled_rows"] - c0["sampled_rows"],
+            "shadow_dropped": c1["dropped"] - c0["dropped"],
+            "comparisons": c1["comparisons"] - c0["comparisons"],
+            "mismatch_pct": st.get("mismatch_pct"),
+            "canary_latency_ratio": st.get("latency_ratio"),
+            "steady_state_new_compiles": s1["misses"] - s0["misses"],
+        }
+    finally:
+        cnry.reset_canary_state()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def fleet_scenario(
     scale_window_s=4.0,
     clients=12,
@@ -1529,7 +1615,7 @@ def main() -> None:
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
                   bench_dispatch, bench_resilience, bench_overlap, bench_telemetry,
-                  bench_analysis, bench_serving, bench_fleet):
+                  bench_analysis, bench_serving, bench_canary, bench_fleet):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

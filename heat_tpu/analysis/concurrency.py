@@ -175,6 +175,12 @@ LOCK_REGISTRY = {
         "structures": ("serving.admission.buckets",),
         "doc": "AdmissionController per-tenant token buckets + in-flight row count: admit/release fire on every request thread",
     },
+    "serving.canary": {
+        "file": "heat_tpu/serving/canary.py",
+        "spellings": ("_LOCK", "self._cond", "self._lock"),
+        "structures": ("serving.canary.state",),
+        "doc": "the canary decision plane's per-model evidence windows + retained event ring + every controller's bounded shadow queue (ONE module lock instance): batcher threads offer mirrored batches, the shadow thread compares and decides, /canaryz + /statusz handler threads and the crash excepthook read; the canary inference itself always runs outside it",
+    },
     "serving.service": {
         "file": "heat_tpu/serving/service.py",
         "spellings": ("self._lock", "_SERVICE_LOCK"),

@@ -135,6 +135,13 @@ KNOBS = {
     "HEAT_TPU_FLEET_INFLIGHT_UP": ("float", "8", "scale-up signal: mean in-flight requests per ready replica above this counts a tick overloaded"),
     "HEAT_TPU_FLEET_INFLIGHT_DOWN": ("float", "1", "scale-down signal: mean in-flight per ready replica must be below this for a tick to count underloaded"),
     # -- serving (heat_tpu/serving, docs/serving.md) --------------------
+    "HEAT_TPU_SHADOW_FRACTION": ("float", "0", "fraction of admitted coalesced predict batches shadow-mirrored to the loaded canary version (systematic per-batch sampling, off the caller's latency path; 0 = shadowing off)"),
+    "HEAT_TPU_SHADOW_QUEUE": ("int", "8", "bounded depth (batches) of the shadow-mirror queue; a full queue drops the mirrored batch (counted in canary.dropped) so shadowing can never back-pressure the primary path"),
+    "HEAT_TPU_CANARY_MIN_ROWS": ("int", "256", "shadow rows the canary comparator must accumulate before the decision engine renders its first verdict"),
+    "HEAT_TPU_CANARY_MAX_MISMATCH_PCT": ("float", "1", "mismatched-row budget (percent) for tolerance-policy kinds before a canary fails; bitwise kinds allow zero mismatches regardless"),
+    "HEAT_TPU_CANARY_LATENCY_X": ("float", "3", "canary per-row inference-latency budget as a multiple of the primary's measured time on the same mirrored batches; exceeding it fails the canary"),
+    "HEAT_TPU_CANARY_AUTO": ("bool", "1", "whether the canary decision engine may mutate the registry (auto-promote on pass, auto-rollback on fail); 0 = observe-only (verdicts and events still recorded)"),
+    "HEAT_TPU_CANARY_RING": ("int", "128", "capacity of the retained canary comparison/decision event ring (/canaryz, /statusz, snapshots, crash bundles)"),
     "HEAT_TPU_SERVE_MAX_BATCH": ("int", "64", "largest coalesced inference batch (rows) and the top pad-to-bucket shape; also the largest single request"),
     "HEAT_TPU_SERVE_MAX_DELAY_MS": ("float", "2.0", "longest a queued predict request waits for batch-mates before its coalesced dispatch (the latency/throughput dial)"),
     "HEAT_TPU_SERVE_QUEUE_DEPTH": ("int", "256", "admission bound: rows queued-or-in-flight across the service before requests shed with OverloadedError/429"),

@@ -54,8 +54,10 @@ ready replica), ``/fleetz`` (fleet-wide roofline rollup: the health
 poller collects each ready replica's ``/rooflinez`` observatory
 snapshot and this route renders the merged per-kernel utilization +
 watermark table, slowest replica per key highlighted via the PR 6
-straggler score; ``?format=json`` for the machine form), ``/metrics``
-(the router process's own registry).
+straggler score, plus each ready replica's ``/canaryz`` canary
+decision-plane snapshot rolled into a fleet-wide per-model verdict
+table with divergent-replica highlighting; ``?format=json`` for the
+machine form), ``/metrics`` (the router process's own registry).
 """
 
 from __future__ import annotations
@@ -112,7 +114,7 @@ class _Replica:
     __slots__ = (
         "url", "ready", "state", "models", "not_models", "inflight", "fails",
         "cb_open", "cb_open_until", "probing", "last_poll_ok", "added_at",
-        "observatory", "observatory_ts",
+        "observatory", "observatory_ts", "canary", "canary_ts",
     )
 
     def __init__(self, url: str):
@@ -133,6 +135,10 @@ class _Replica:
         #: per-replica half
         self.observatory: Optional[Dict[str, Any]] = None
         self.observatory_ts = 0.0
+        #: last /canaryz?format=json snapshot (same throttled cadence) —
+        #: the fleet-wide canary rollup's per-replica half
+        self.canary: Optional[Dict[str, Any]] = None
+        self.canary_ts = 0.0
 
     def doc(self) -> Dict[str, Any]:
         return {
@@ -337,14 +343,13 @@ class FleetRouter:
         for url in urls:
             ready, state, models = self._probe_readyz(url)
             # the same sweep collects the replica's roofline-observatory
-            # snapshot (bounded: the slowest 64 keys) — the per-replica
-            # half of the /fleetz fleet rollup.  Only ready replicas are
-            # asked: a warming/draining replica's ledger is noise.
-            obs = (
-                self._probe_rooflinez(url)
-                if ready and now - obs_ts.get(url, 0.0) >= obs_period
-                else None
-            )
+            # and canary-decision-plane snapshots (the per-replica halves
+            # of the /fleetz fleet rollup) on the throttled cadence.
+            # Only ready replicas are asked: a warming/draining replica's
+            # ledger and windows are noise.
+            due = ready and now - obs_ts.get(url, 0.0) >= obs_period
+            obs = self._probe_rooflinez(url) if due else None
+            can = self._probe_canaryz(url) if due else None
             with self._lock:
                 _tsan.note_access("fleet.router.replicas")
                 r = self._replicas.get(url)
@@ -353,6 +358,9 @@ class FleetRouter:
                 if obs is not None:
                     r.observatory = obs
                     r.observatory_ts = time.time()
+                if can is not None:
+                    r.canary = can
+                    r.canary_ts = time.time()
                 if r.state == "draining" and state not in ("ready",):
                     # a locally initiated drain sticks until the replica
                     # itself reports ready again (a cancelled drain)
@@ -395,6 +403,19 @@ class FleetRouter:
                 doc = json.load(resp)
             return doc if isinstance(doc, dict) else None
         except Exception:  # lint: allow H501(an observatory-less replica is a rollup gap, not an error)
+            return None
+
+    def _probe_canaryz(self, url: str) -> Optional[Dict[str, Any]]:
+        """One replica's canary decision-plane snapshot, or None
+        (replica without the route, unreachable, or malformed — never
+        raises)."""
+        try:
+            with urllib.request.urlopen(
+                url + "/canaryz?format=json", timeout=2.0
+            ) as resp:
+                doc = json.load(resp)
+            return doc if isinstance(doc, dict) else None
+        except Exception:  # lint: allow H501(a canary-less replica is a rollup gap, not an error)
             return None
 
     # -- routing policy -------------------------------------------------
@@ -716,6 +737,11 @@ class FleetRouter:
                 for r in self._replicas.values()
                 if r.observatory is not None
             }
+            canary_snaps = {
+                r.url: dict(r.canary)
+                for r in self._replicas.values()
+                if r.canary is not None
+            }
         replicas: Dict[str, Any] = {}
         kernels: Dict[str, Dict[str, Any]] = {}
         now = time.time()
@@ -748,11 +774,41 @@ class FleetRouter:
             entry["straggler_score"] = round(
                 straggler_score([m for _u, m in means]), 4
             )
+        # fleet-wide canary rollup: each replica runs its own decision
+        # plane over its own shadow traffic — a model whose replicas
+        # disagree on the canary version or verdict is DIVERGENT, the
+        # state an operator must resolve before trusting any promotion
+        canary_models: Dict[str, Dict[str, Any]] = {}
+        for url in sorted(canary_snaps):
+            for name, st in sorted((canary_snaps[url].get("models") or {}).items()):
+                e = canary_models.setdefault(
+                    name,
+                    {"replicas": {}, "verdicts": [], "canary_versions": [],
+                     "divergent": False},
+                )
+                e["replicas"][url] = {
+                    "canary_version": st.get("canary_version"),
+                    "verdict": st.get("verdict"),
+                    "rows": st.get("rows"),
+                    "mismatch_pct": st.get("mismatch_pct"),
+                    "latency_ratio": st.get("latency_ratio"),
+                    "decision": (st.get("decision") or {}).get("action"),
+                    "last_trace_id": st.get("last_trace_id"),
+                }
+                if st.get("verdict") not in e["verdicts"]:
+                    e["verdicts"].append(st.get("verdict"))
+                if st.get("canary_version") not in e["canary_versions"]:
+                    e["canary_versions"].append(st.get("canary_version"))
+        for e in canary_models.values():
+            e["divergent"] = (
+                len(e["verdicts"]) > 1 or len(e["canary_versions"]) > 1
+            )
         return {
             "timestamp": now,
             "ready_replicas": self._count_ready(),
             "replicas": replicas,
             "kernels": dict(sorted(kernels.items())),
+            "canary": dict(sorted(canary_models.items())),
         }
 
     def render_fleetz_html(self) -> str:
@@ -830,6 +886,42 @@ class FleetRouter:
         parts.append("</table>")
         if not doc["kernels"]:
             parts.append("<p>no per-kernel snapshots collected yet</p>")
+        parts.append("<h2>fleet canary state</h2>")
+        canary = doc.get("canary") or {}
+        if canary:
+            parts.append(
+                "<table border=1 cellpadding=3><tr><th>model</th>"
+                "<th>replica</th><th>canary</th><th>verdict</th>"
+                "<th>rows</th><th>mismatch %</th><th>latency x</th>"
+                "<th>decision</th></tr>"
+            )
+            for name, entry in canary.items():
+                per = entry["replicas"]
+                first = True
+                label = _html.escape(name)
+                if entry.get("divergent"):
+                    label = (
+                        f"<b style='color:#b00'>{label} ⟵ divergent "
+                        f"({'/'.join(str(v) for v in entry['verdicts'])})</b>"
+                    )
+                for url in sorted(per):
+                    row = per[url]
+                    parts.append(
+                        "<tr>"
+                        + (f"<td rowspan={len(per)}>{label}</td>" if first else "")
+                        + f"<td>{_html.escape(url)}</td>"
+                        f"<td>v{_html.escape(str(row.get('canary_version')))}</td>"
+                        f"<td>{_html.escape(str(row.get('verdict')))}</td>"
+                        f"<td>{row.get('rows')}</td>"
+                        f"<td>{row.get('mismatch_pct')}</td>"
+                        f"<td>{row.get('latency_ratio')}</td>"
+                        f"<td>{_html.escape(str(row.get('decision') or '—'))}</td>"
+                        "</tr>"
+                    )
+                    first = False
+            parts.append("</table>")
+        else:
+            parts.append("<p>no canary snapshots collected yet</p>")
         parts.append("</body></html>")
         return "".join(parts)
 

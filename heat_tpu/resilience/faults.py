@@ -107,6 +107,7 @@ KNOWN_SITES = (
     "serve.load",
     "serve.predict",
     "serve.batch",
+    "serve.shadow",
     "aot.load",
     "aot.save",
     "fleet.route",

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 from ..resilience.errors import OverloadedError
 from .admission import AdmissionController, TokenBucket
+from .canary import CanaryController
 from .coalescer import ModelBatcher
 from .model_io import (
     SUPPORTED_KINDS,
@@ -64,6 +65,7 @@ from .service import (
 
 __all__ = [
     "AdmissionController",
+    "CanaryController",
     "InferenceService",
     "ModelBatcher",
     "ModelRegistry",

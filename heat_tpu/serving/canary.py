@@ -1,0 +1,858 @@
+"""Canary decision plane: shadow traffic, online comparison, evented verdicts.
+
+The registry can hold a **canary** version (``ModelRegistry.load(...,
+activate=False)``) and swap it live with one pointer — but nothing yet
+*observes a canary under real traffic and decides*.  This module is
+that control loop, in three parts:
+
+* **Shadow traffic mirroring** — the coalescer's scatter path offers
+  every admitted batch's TRUE rows + primary outputs to
+  :meth:`CanaryController.offer` *after* the waiting callers are woken
+  (the ``on_mirror`` hook, same placement as the drift-sketch fold):
+  a configurable fraction (``HEAT_TPU_SHADOW_FRACTION``, systematic
+  per-batch sampling) is copied into a **bounded** queue a dedicated
+  shadow thread drains — a full queue drops the batch (counted), so
+  mirroring can never back-pressure the primary path.  The shadow
+  inference pads to the SAME power-of-two buckets as the primary
+  (:func:`heat_tpu.core.dispatch.batch_bucket`), so the executable-cache
+  key set stays finite and steady-state shadowing compiles **nothing**
+  (cache keys are shapes, not weights).
+
+* **Online comparison** — each mirrored batch's canary outputs are
+  scored against the primary's per the estimator kind's
+  :data:`~heat_tpu.analysis.precision_policy.POLICIES` contract:
+  ``bitwise`` kinds must match exactly (any differing row is a
+  mismatch), ``tolerance`` kinds may diverge within the declared
+  ``rtol`` (float outputs: element excess over ``rtol`` x the batch's
+  magnitude scale; integer labels: plain disagreement) with a mismatch
+  budget (``HEAT_TPU_CANARY_MAX_MISMATCH_PCT``).  Latency rides along:
+  the canary's per-row inference time is compared to the primary's own
+  measured time *on the same batch* (``HEAT_TPU_CANARY_LATENCY_X``),
+  and the shadow drop rate is reported as the canary lane's shed rate.
+
+* **The decision engine** — evidence accumulates per model until
+  ``HEAT_TPU_CANARY_MIN_ROWS`` rows have been compared, then every
+  further batch re-evaluates the verdict:
+
+  - **fail** (contract violated, latency blown, or the canary
+    *raised*) → auto-rollback: the canary version is discarded (or, if
+    it had been promoted mid-window, ``registry.rollback``), a
+    page-severity ``canary:<model>`` alert fires, and — when the
+    flight recorder is armed — a crash bundle records the failed
+    comparison for the post-mortem;
+  - **pass** → promotion is first offered to the **veto gate**: an
+    active ``drift:<model>`` alert, any firing ``slo:*`` burn alert, or
+    any page-severity alert holds the promotion (verdict ``held``,
+    reasons retained) until the signal clears;
+  - **pass + no veto** → auto-promote (one registry pointer swap).
+
+  ``HEAT_TPU_CANARY_AUTO=0`` keeps the engine observe-only: verdicts
+  and events are recorded but the registry is never touched.
+
+Every comparison summary and every decision is a **severity-tagged
+retained event** carrying the nearest exemplar ``trace_id`` (the
+mirrored batch's primary trace), rendered on ``/canaryz`` (HTML +
+``?format=json``), embedded in ``/statusz``, shipped in cross-worker
+snapshots (``aggregate.tag_snapshot``/``merge_snapshots`` — a model
+whose replicas disagree is *divergent*), and written into crash
+flight-recorder bundles — the full audit trail of why a version went
+live (or didn't).
+
+Thread-safety: module-level state (per-model windows, the event ring)
+and each controller's queue are only touched under the registered
+``serving.canary`` lock; the shadow inference itself always runs
+outside it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import tsan as _tsan
+from ..analysis.precision_policy import POLICIES
+from ..resilience.faults import inject as _inject
+from ..telemetry import alerts as _alerts
+from ..telemetry import metrics as _tm
+
+__all__ = [
+    "CanaryController",
+    "canary_events",
+    "canary_snapshot",
+    "canaryz_report",
+    "compare_batch",
+    "record_event",
+    "render_canaryz_html",
+    "reset_canary_state",
+    "status",
+]
+
+_OFFERED_C = _tm.counter("canary.offered", "batches offered to the shadow sampler")
+_SAMPLED_C = _tm.counter("canary.sampled", "batches mirrored to a canary version")
+_SAMPLED_ROWS_C = _tm.counter("canary.sampled_rows", "true rows mirrored to a canary")
+_DROPPED_C = _tm.counter(
+    "canary.dropped", "mirrored batches dropped at the bounded shadow queue"
+)
+_COMPARISONS_C = _tm.counter("canary.comparisons", "primary-vs-canary batch comparisons")
+_PROMOTIONS_C = _tm.counter("canary.promotions", "canary versions auto-promoted")
+_ROLLBACKS_C = _tm.counter("canary.rollbacks", "canary versions auto-rolled-back")
+_ERRORS_C = _tm.counter("canary.errors", "canary shadow inferences that raised")
+
+
+def _env():
+    from ..core import _env as envmod
+
+    return envmod
+
+
+# ----------------------------------------------------------------------
+# module-level state: per-model evidence windows + the retained event
+# ring (what /canaryz, /statusz, snapshots and crash bundles read)
+# ----------------------------------------------------------------------
+_LOCK = _tsan.register_lock("serving.canary")
+_STATE: Dict[str, Dict[str, Any]] = {}
+_EVENTS: "deque[Dict[str, Any]]" = deque(maxlen=128)
+#: bounded per-model decision history (the inspect CLI's audit trail)
+_HISTORY_KEEP = 8
+
+
+def _ring_size() -> int:
+    try:
+        return max(1, _env().env_int("HEAT_TPU_CANARY_RING"))
+    except Exception:  # lint: allow H501(pre-env-import readers fall back to the default)
+        return 128
+
+
+def refresh_env() -> None:
+    """Re-read ``HEAT_TPU_CANARY_RING`` (tests that flip the env
+    mid-process); resizes the event ring keeping the newest events."""
+    global _EVENTS
+    with _LOCK:
+        _tsan.note_access("serving.canary.state")
+        _EVENTS = deque(_EVENTS, maxlen=_ring_size())
+
+
+def record_event(
+    model: str,
+    kind: str,
+    severity: str,
+    message: str,
+    trace_id: Optional[str] = None,
+    **stats,
+) -> Dict[str, Any]:
+    """Append one retained canary event (``kind`` is ``comparison`` /
+    ``decision`` / ``error``); returns the event document."""
+    ev = {
+        "ts": time.time(),
+        "model": model,
+        "kind": kind,
+        "severity": severity,
+        "message": message,
+        "trace_id": trace_id,
+    }
+    ev.update(stats)
+    with _LOCK:
+        _tsan.note_access("serving.canary.state")
+        _EVENTS.append(ev)
+    return ev
+
+
+def canary_events(limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The retained event ring, oldest first (``limit`` trims to the
+    newest)."""
+    with _LOCK:
+        _tsan.note_access("serving.canary.state", write=False)
+        events = list(_EVENTS)
+    return events[-limit:] if limit else events
+
+
+def status(model: str) -> Optional[Dict[str, Any]]:
+    """One model's canary state document (None when no canary has ever
+    been observed for it) — the per-model ``/healthz`` fields read this."""
+    with _LOCK:
+        _tsan.note_access("serving.canary.state", write=False)
+        st = _STATE.get(model)
+        return _state_doc(st) if st is not None else None
+
+
+def reset_canary_state() -> None:
+    """Drop every model window and retained event (tests)."""
+    with _LOCK:
+        _tsan.note_access("serving.canary.state")
+        _STATE.clear()
+        _EVENTS.clear()
+
+
+def _state_doc(st: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe view of one model's evidence window (caller holds the
+    lock)."""
+    rows = st["rows"]
+    p_ms, c_ms = st["primary_ms"], st["canary_ms"]
+    return {
+        "model": st["model"],
+        "kind": st["kind"],
+        "mode": st["mode"],
+        "canary_version": st["canary_version"],
+        "active_version": st["active_version"],
+        "started_ts": st["started_ts"],
+        "batches": st["batches"],
+        "rows": rows,
+        "min_rows": st["min_rows"],
+        "mismatched_rows": st["mismatched"],
+        "mismatch_pct": round(100.0 * st["mismatched"] / rows, 4) if rows else 0.0,
+        "max_rel_err": round(st["max_rel_err"], 6),
+        "primary_ms_per_row": round(p_ms / rows, 6) if rows else None,
+        "canary_ms_per_row": round(c_ms / rows, 6) if rows else None,
+        "latency_ratio": round(c_ms / p_ms, 4) if p_ms > 0 else None,
+        "shadow_dropped": st["dropped"],
+        "shed_rate": round(
+            st["dropped"] / (st["dropped"] + st["batches"]), 4
+        ) if (st["dropped"] + st["batches"]) else 0.0,
+        "errors": st["errors"],
+        "verdict": st["verdict"],
+        "vetoes": list(st["vetoes"]),
+        "last_trace_id": st["last_trace_id"],
+        "decision": dict(st["decision"]) if st["decision"] else None,
+        "history": [dict(d) for d in st["history"]],
+    }
+
+
+def _new_state(model: str, kind: str, canary_version: int,
+               active_version: Optional[int], min_rows: int) -> Dict[str, Any]:
+    pol = POLICIES.get(kind)
+    return {
+        "model": model,
+        "kind": kind,
+        "mode": pol["mode"] if pol else "bitwise",
+        "rtol": float(pol.get("rtol", 0.0)) if pol else 0.0,
+        "canary_version": canary_version,
+        "active_version": active_version,
+        "started_ts": time.time(),
+        "min_rows": min_rows,
+        "batches": 0,
+        "rows": 0,
+        "mismatched": 0,
+        "max_rel_err": 0.0,
+        "primary_ms": 0.0,
+        "canary_ms": 0.0,
+        "dropped": 0,
+        "errors": 0,
+        "acc": 0.0,  # systematic-sampling accumulator
+        "verdict": "collecting",
+        "vetoes": [],
+        "last_trace_id": None,
+        "decision": None,
+        "history": [],
+    }
+
+
+# ----------------------------------------------------------------------
+# the comparator
+# ----------------------------------------------------------------------
+#: incomparable outputs (shape/dtype change, label flips) score this
+#: instead of inf: finite, JSON-safe, unmistakable (the aggregate
+#: layer's _SCORE_CAP convention)
+_ERR_CAP = 1e9
+
+
+def compare_batch(
+    kind: str,
+    primary: np.ndarray,
+    canary: np.ndarray,
+    rtol: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Score one batch of canary outputs against the primary's, per the
+    kind's :data:`POLICIES` contract.
+
+    Returns ``{rows, mismatched, max_rel_err, mode}`` where
+    ``mismatched`` counts the rows outside the contract: for a
+    ``bitwise`` kind any row with a differing element (or a dtype
+    change — bitwise means *bytes*); for a ``tolerance`` kind a float
+    row whose worst element exceeds ``rtol`` x the batch's magnitude
+    scale, or an integer (label) row that simply disagrees."""
+    pol = POLICIES.get(kind)
+    mode = pol["mode"] if pol else "bitwise"
+    if rtol is None:
+        rtol = float(pol.get("rtol", 0.0)) if pol else 0.0
+    p = np.asarray(primary)
+    c = np.asarray(canary)
+    n = int(p.shape[0])
+    out = {"rows": n, "mismatched": 0, "max_rel_err": 0.0, "mode": mode}
+    if c.shape != p.shape or (mode == "bitwise" and c.dtype != p.dtype):
+        out["mismatched"] = n
+        out["max_rel_err"] = _ERR_CAP
+        return out
+    p2 = p.reshape(n, -1)
+    c2 = c.reshape(n, -1).astype(p2.dtype, copy=False)
+    if mode == "tolerance" and np.issubdtype(p2.dtype, np.floating):
+        diff = np.abs(p2.astype(np.float64) - c2.astype(np.float64))
+        scale = float(np.abs(p2).max()) or 1.0
+        rel = diff / scale
+        out["max_rel_err"] = float(rel.max()) if rel.size else 0.0
+        out["mismatched"] = int((rel > rtol).any(axis=1).sum())
+    else:
+        # bitwise kinds, and tolerance kinds whose predictions are
+        # discrete labels: equality is the contract (NaN counts as a
+        # mismatch — a NaN prediction is never "equal enough")
+        eq = p2 == c2
+        out["mismatched"] = int((~eq.all(axis=1)).sum())
+        if np.issubdtype(p2.dtype, np.floating) and out["mismatched"]:
+            diff = np.abs(p2.astype(np.float64) - c2.astype(np.float64))
+            scale = float(np.abs(p2).max()) or 1.0
+            out["max_rel_err"] = float((diff / scale).max())
+        elif out["mismatched"]:
+            out["max_rel_err"] = _ERR_CAP
+    return out
+
+
+def _collect_vetoes(model: str) -> List[str]:
+    """Quality signals that veto a promotion right now: an active drift
+    alert for THIS model, any firing SLO burn alert, any page-severity
+    alert at all (an HBM watermark page is not the moment to go live)."""
+    vetoes: List[str] = []
+    for a in _alerts.active_alerts():
+        name = a.get("name", "")
+        if name == f"drift:{model}":
+            vetoes.append(f"drift alert firing for {model!r} (score {a.get('value')})")
+        elif name.startswith("slo:"):
+            vetoes.append(f"SLO burn alert {name} firing (value {a.get('value')})")
+        elif a.get("severity") == "page" and not name.startswith("canary:"):
+            vetoes.append(f"page-severity alert {name} active")
+    return vetoes
+
+
+# ----------------------------------------------------------------------
+# the controller
+# ----------------------------------------------------------------------
+class _Mirror:
+    """One queued shadow job: a batch's true rows + primary outputs."""
+
+    __slots__ = ("model", "version", "rows", "out", "trace_id", "primary_ms")
+
+    def __init__(self, model, version, rows, out, trace_id, primary_ms):
+        self.model = model
+        self.version = version
+        self.rows = rows
+        self.out = out
+        self.trace_id = trace_id
+        self.primary_ms = primary_ms
+
+
+class CanaryController:
+    """The per-service shadow-traffic decision actor.
+
+    ``offer`` runs on the batcher thread (cheap: one canary-version
+    lookup, the sampling accumulator, one bounded enqueue); the shadow
+    thread — started lazily on the first sampled batch — does the
+    inference, comparison and decisions.  Knobs default from the
+    registry (``HEAT_TPU_SHADOW_FRACTION`` / ``HEAT_TPU_CANARY_*``);
+    tests override the public attributes directly."""
+
+    def __init__(self, service):
+        env = _env()
+        self.service = service
+        #: fraction of admitted batches mirrored (0 = shadowing off)
+        self.fraction = env.env_float("HEAT_TPU_SHADOW_FRACTION")
+        #: bounded shadow-queue depth (batches); full queue drops
+        self.queue_depth = max(1, env.env_int("HEAT_TPU_SHADOW_QUEUE"))
+        #: rows compared before the first verdict
+        self.min_rows = max(1, env.env_int("HEAT_TPU_CANARY_MIN_ROWS"))
+        #: mismatch budget (%) for tolerance kinds (bitwise allows none)
+        self.max_mismatch_pct = env.env_float("HEAT_TPU_CANARY_MAX_MISMATCH_PCT")
+        #: canary per-row latency budget as a multiple of the primary's
+        self.latency_x = env.env_float("HEAT_TPU_CANARY_LATENCY_X")
+        #: False = observe-only (verdicts recorded, registry untouched)
+        self.auto = env.env_flag("HEAT_TPU_CANARY_AUTO")
+        self._queue: List[_Mirror] = []
+        self._open = True
+        self._busy = False
+        # ONE lock instance guards the module state (_STATE/_EVENTS) and
+        # every controller's queue: the /canaryz readers, the batcher
+        # threads offering and the shadow thread deciding all serialize
+        # on the same registered ``serving.canary`` lock
+        self._lock = _LOCK
+        self._cond = threading.Condition(_LOCK)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- batcher-thread side -------------------------------------------
+    def offer(
+        self,
+        model: str,
+        rows: np.ndarray,
+        out: np.ndarray,
+        trace_id: Optional[str],
+        primary_ms: float,
+    ) -> bool:
+        """Offer one completed primary batch for mirroring; returns True
+        when it was enqueued.  Runs on the batcher thread AFTER the
+        callers were woken — never on any caller's latency path."""
+        if self.fraction <= 0.0 or not self._open:
+            return False
+        try:
+            version = self.service.registry.canary_version(model)
+        except KeyError:
+            return False
+        if version is None:
+            return False
+        _OFFERED_C.inc()
+        # version metadata fetched BEFORE the canary lock (the registry
+        # has its own; no nesting) — only needed on a window reset
+        try:
+            kind = self.service.registry.record(model, version).get("kind") or "?"
+            active = self.service.registry.active_version(model)
+        except KeyError:
+            return False
+        with self._cond:
+            _tsan.note_access("serving.canary.state")
+            st = self._window(model, version, kind, active)
+            if st["decision"] is not None:
+                return False  # this canary version is already judged
+            st["acc"] += self.fraction
+            if st["acc"] < 1.0:
+                return False
+            st["acc"] -= 1.0
+            if len(self._queue) >= self.queue_depth:
+                st["dropped"] += 1
+                _DROPPED_C.inc()
+                return False
+            self._queue.append(
+                _Mirror(model, version, rows, out, trace_id, primary_ms)
+            )
+            self._cond.notify_all()
+            started = self._thread is not None
+        _SAMPLED_C.inc()
+        _SAMPLED_ROWS_C.inc(int(rows.shape[0]))
+        if not started:
+            self._start()
+        return True
+
+    def _window(self, model: str, version: int, kind: str,
+                active: Optional[int]) -> Dict[str, Any]:
+        """The model's evidence window in the module state, reset when a
+        NEW canary version appears (caller holds the lock — module state
+        and the queue share the registered ``serving.canary`` lock)."""
+        st = _STATE.get(model)
+        if st is None or st["canary_version"] != version:
+            history = st["history"] if st is not None else []
+            st = _new_state(model, kind, version, active, self.min_rows)
+            st["history"] = history
+            _STATE[model] = st
+        return st
+
+    def _start(self) -> None:
+        with self._cond:
+            _tsan.note_access("serving.canary.state")
+            if self._thread is not None or not self._open:
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="heat-tpu-canary-shadow", daemon=True
+            )
+            self._thread.start()
+
+    # -- shadow-thread side --------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                _tsan.note_access("serving.canary.state")
+                self._busy = False
+                self._cond.notify_all()  # wait_idle barriers wake here
+                while self._open and not self._queue:
+                    self._cond.wait()
+                if not self._open and not self._queue:
+                    return
+                job = self._queue.pop(0)
+                self._busy = True
+            try:
+                self._shadow_one(job)  # inference outside the lock
+            except Exception as e:  # lint: allow H501(a canary bug must never kill the shadow thread; the failure IS the verdict)
+                self._record_error(job, e)
+
+    def _shadow_infer(self, job: _Mirror) -> Tuple[np.ndarray, float]:
+        """One canary inference over the mirrored batch, padded to the
+        SAME bucket shape the primary dispatched — the finite-key-set
+        property shadowing inherits; returns ``(outputs, elapsed_ms)``
+        for the TRUE rows only."""
+        from ..core import dispatch as _dispatch
+        from ..core import factories
+        from .model_io import infer as _infer
+
+        _inject("serve.shadow", model=job.model, version=job.version)
+        est = self.service.registry.get(job.model, job.version)
+        rows = job.rows
+        n = int(rows.shape[0])
+        bucket = _dispatch.batch_bucket(n, self.service.max_batch)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + rows.shape[1:], rows.dtype)
+            rows = np.concatenate([rows, pad], axis=0)
+        t0 = time.perf_counter_ns()
+        x = factories.array(
+            rows, split=self.service.split, comm=self.service.registry.comm
+        )
+        y = np.asarray(_infer(est, x).numpy())
+        elapsed_ms = (time.perf_counter_ns() - t0) / 1e6
+        return y[:n], elapsed_ms
+
+    def _shadow_one(self, job: _Mirror) -> None:
+        canary_out, canary_ms = self._shadow_infer(job)
+        with self._lock:
+            _tsan.note_access("serving.canary.state", write=False)
+            st = _STATE.get(job.model)
+        if st is None or st["canary_version"] != job.version or st["decision"]:
+            return  # the window moved on (new canary, or already decided)
+        cmp = compare_batch(st["kind"], job.out, canary_out, rtol=st["rtol"])
+        _COMPARISONS_C.inc()
+        with self._lock:
+            _tsan.note_access("serving.canary.state")
+            st["batches"] += 1
+            st["rows"] += cmp["rows"]
+            st["mismatched"] += cmp["mismatched"]
+            if cmp["max_rel_err"] > st["max_rel_err"]:
+                st["max_rel_err"] = cmp["max_rel_err"]
+            st["primary_ms"] += float(job.primary_ms)
+            st["canary_ms"] += canary_ms
+            if job.trace_id:
+                st["last_trace_id"] = job.trace_id
+        record_event(
+            job.model, "comparison",
+            "warn" if cmp["mismatched"] else "info",
+            f"batch of {cmp['rows']} rows vs canary v{job.version}: "
+            f"{cmp['mismatched']} outside the {cmp['mode']} contract",
+            trace_id=job.trace_id,
+            canary_version=job.version,
+            rows=cmp["rows"],
+            mismatched=cmp["mismatched"],
+            max_rel_err=round(cmp["max_rel_err"], 6),
+            canary_ms=round(canary_ms, 3),
+            primary_ms=round(float(job.primary_ms), 3),
+        )
+        self._maybe_decide(job.model)
+
+    def _record_error(self, job: _Mirror, exc: BaseException) -> None:
+        """A canary inference that raises is itself a terminal verdict:
+        the version cannot serve this traffic."""
+        _ERRORS_C.inc()
+        with self._lock:
+            _tsan.note_access("serving.canary.state")
+            st = _STATE.get(job.model)
+            if st is None or st["canary_version"] != job.version or st["decision"]:
+                return
+            st["errors"] += 1
+            if job.trace_id:
+                st["last_trace_id"] = job.trace_id
+        record_event(
+            job.model, "error", "page",
+            f"canary v{job.version} inference raised "
+            f"{type(exc).__name__}: {exc}",
+            trace_id=job.trace_id, canary_version=job.version,
+        )
+        self._decide(job.model, "fail", [f"canary inference raised {type(exc).__name__}: {exc}"])
+
+    # -- the decision engine -------------------------------------------
+    def _evaluate(self, st: Dict[str, Any]) -> Tuple[str, List[str]]:
+        """(verdict, reasons) over the accumulated window: ``collecting``
+        below min_rows, else ``fail`` with every violated clause, else
+        ``pass``."""
+        if st["rows"] < st["min_rows"]:
+            return "collecting", []
+        reasons: List[str] = []
+        if st["mode"] == "bitwise":
+            if st["mismatched"] > 0:
+                reasons.append(
+                    f"{st['mismatched']}/{st['rows']} rows differ on a "
+                    f"bitwise-contract kind ({st['kind']})"
+                )
+        else:
+            pct = 100.0 * st["mismatched"] / st["rows"]
+            if pct > self.max_mismatch_pct:
+                reasons.append(
+                    f"{pct:.2f}% of rows outside rtol={st['rtol']:g} "
+                    f"(budget {self.max_mismatch_pct:g}%)"
+                )
+        if st["primary_ms"] > 0 and st["canary_ms"] > self.latency_x * st["primary_ms"]:
+            reasons.append(
+                f"canary latency {st['canary_ms'] / st['primary_ms']:.2f}x the "
+                f"primary's on the same batches (budget {self.latency_x:g}x)"
+            )
+        return ("fail", reasons) if reasons else ("pass", [])
+
+    def _maybe_decide(self, model: str) -> None:
+        with self._lock:
+            _tsan.note_access("serving.canary.state", write=False)
+            st = _STATE.get(model)
+            if st is None or st["decision"]:
+                return
+            verdict, reasons = self._evaluate(st)
+        if verdict == "collecting":
+            return
+        if verdict == "fail":
+            self._decide(model, "fail", reasons)
+            return
+        vetoes = _collect_vetoes(model)
+        if vetoes:
+            with self._lock:
+                _tsan.note_access("serving.canary.state")
+                st = _STATE.get(model)
+                if st is None or st["decision"]:
+                    return
+                first_hold = st["verdict"] != "held"
+                st["verdict"] = "held"
+                st["vetoes"] = vetoes
+                tid = st["last_trace_id"]
+            if first_hold:
+                record_event(
+                    model, "decision", "warn",
+                    "promotion held by veto: " + "; ".join(vetoes),
+                    trace_id=tid, action="held", vetoes=vetoes,
+                )
+            return
+        self._decide(model, "pass", [])
+
+    def _decide(self, model: str, verdict: str, reasons: List[str]) -> None:
+        """Commit one decision: mutate the registry (when ``auto``),
+        record the retained decision event + per-model history, fire or
+        resolve the ``canary:<model>`` alert, and — on a rollback — dump
+        a flight-recorder bundle so the failed comparison survives."""
+        with self._lock:
+            _tsan.note_access("serving.canary.state")
+            st = _STATE.get(model)
+            if st is None or st["decision"]:
+                return
+            st["verdict"] = verdict
+            version = st["canary_version"]
+            tid = st["last_trace_id"]
+            summary = _state_doc(st)
+        action = "observed"
+        registry = self.service.registry
+        if verdict == "pass":
+            if self.auto:
+                try:
+                    registry.promote(model, version)
+                    action = "promoted"
+                    _PROMOTIONS_C.inc()
+                except (KeyError, ValueError) as e:
+                    action = "observed"
+                    reasons = [f"promote failed: {e}"]
+            _alerts.resolve(f"canary:{model}", labels={"model": model})
+            severity, msg = "info", (
+                f"canary v{version} passed over {summary['rows']} shadow rows "
+                f"({summary['mismatch_pct']}% mismatch, "
+                f"latency {summary['latency_ratio']}x)"
+            )
+        else:
+            if self.auto:
+                action = "rolled_back"
+                _ROLLBACKS_C.inc()
+                try:
+                    if registry.active_version(model) == version:
+                        # the canary had been promoted mid-window (an
+                        # operator jumped the gun): real rollback
+                        registry.rollback(model)
+                    else:
+                        registry.unload(model, version)
+                except (KeyError, ValueError):
+                    pass  # version already gone; the verdict still stands
+            severity, msg = "page", (
+                f"canary v{version} FAILED over {summary['rows']} shadow rows: "
+                + "; ".join(reasons)
+            )
+            _alerts.fire(
+                f"canary:{model}", severity="page", message=msg,
+                value=summary["mismatch_pct"], threshold=self.max_mismatch_pct,
+                trace_id=tid, labels={"model": model},
+            )
+        decision = {
+            "ts": time.time(),
+            "model": model,
+            "canary_version": version,
+            "verdict": verdict,
+            "action": action,
+            "reasons": reasons,
+            "trace_id": tid,
+            "rows": summary["rows"],
+            "mismatch_pct": summary["mismatch_pct"],
+            "max_rel_err": summary["max_rel_err"],
+            "latency_ratio": summary["latency_ratio"],
+        }
+        with self._lock:
+            _tsan.note_access("serving.canary.state")
+            st = _STATE.get(model)
+            if st is not None:
+                st["decision"] = decision
+                st["history"].append(decision)
+                del st["history"][:-_HISTORY_KEEP]
+        record_event(model, "decision", severity, msg, trace_id=tid, **{
+            k: decision[k] for k in (
+                "canary_version", "verdict", "action", "reasons",
+                "rows", "mismatch_pct", "latency_ratio",
+            )
+        })
+        if verdict == "fail":
+            self._dump_bundle(model, decision)
+
+    def _dump_bundle(self, model: str, decision: Dict[str, Any]) -> None:
+        """Best-effort flight-recorder bundle on a rollback: the failed
+        comparison stats ride in the bundle's canary section (the module
+        state the recorder snapshots) — a rollback must be explainable
+        after the process is gone."""
+        from ..telemetry import flight_recorder as _fr
+
+        if not _fr.installed():
+            return
+        try:
+            _fr.dump_bundle(reason=f"canary_rollback:{model}")
+        except Exception:  # lint: allow H501(a bundle-write failure must never mask the rollback itself)
+            pass
+
+    # -- shutdown -------------------------------------------------------
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop mirroring, drain the queue, join the shadow thread.
+        Idempotent."""
+        with self._cond:
+            _tsan.note_access("serving.canary.state")
+            self._open = False
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until the shadow queue is drained AND no job is in
+        flight (tests: a deterministic 'every mirrored batch has been
+        judged' barrier)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            _tsan.note_access("serving.canary.state", write=False)
+            while self._queue or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+
+# ----------------------------------------------------------------------
+# reports: /canaryz, snapshots, crash bundles
+# ----------------------------------------------------------------------
+def canaryz_report() -> Dict[str, Any]:
+    """The machine form of ``/canaryz``: every model's evidence window
+    + decision, the retained event ring, and the shadow-lane counters."""
+    with _LOCK:
+        _tsan.note_access("serving.canary.state", write=False)
+        models = {name: _state_doc(st) for name, st in sorted(_STATE.items())}
+    return {
+        "timestamp": time.time(),
+        "shadow": {
+            "offered": _OFFERED_C.value,
+            "sampled": _SAMPLED_C.value,
+            "sampled_rows": _SAMPLED_ROWS_C.value,
+            "dropped": _DROPPED_C.value,
+            "comparisons": _COMPARISONS_C.value,
+            "errors": _ERRORS_C.value,
+            "promotions": _PROMOTIONS_C.value,
+            "rollbacks": _ROLLBACKS_C.value,
+        },
+        "models": models,
+        "events": canary_events(),
+    }
+
+
+def canary_snapshot() -> Dict[str, Any]:
+    """Compact canary state for cross-worker snapshots and crash
+    bundles: the model windows + the newest retained events."""
+    with _LOCK:
+        _tsan.note_access("serving.canary.state", write=False)
+        models = {name: _state_doc(st) for name, st in sorted(_STATE.items())}
+    return {"models": models, "events": canary_events(limit=32)}
+
+
+_SEV_COLOR = {"page": "#ffd6d6", "warn": "#ffe9c6", "info": ""}
+
+
+def render_canaryz_html() -> str:
+    """The human form of ``/canaryz``: per-model verdict table + the
+    retained event timeline (severity-tinted, exemplar trace_id linked
+    to ``/tracez``)."""
+    import html as _html
+
+    def esc(v) -> str:
+        return _html.escape(str(v), quote=True)
+
+    rep = canaryz_report()
+    sh = rep["shadow"]
+    parts = [
+        "<html><head><title>/canaryz</title><style>"
+        "table{border-collapse:collapse}td,th{border:1px solid #999;"
+        "padding:3px 6px;font:12px monospace}</style></head><body>",
+        "<h1>/canaryz — canary decision plane</h1>",
+        f"<p>shadow lane: {sh['sampled']} of {sh['offered']} batches mirrored "
+        f"({sh['sampled_rows']} rows), {sh['dropped']} dropped at the bounded "
+        f"queue, {sh['comparisons']} comparisons, {sh['errors']} canary "
+        f"errors — {sh['promotions']} promoted / {sh['rollbacks']} rolled "
+        "back</p>",
+    ]
+    if rep["models"]:
+        parts.append(
+            "<table><tr><th>model</th><th>canary</th><th>active</th>"
+            "<th>mode</th><th>verdict</th><th>rows</th><th>mismatch %</th>"
+            "<th>max rel err</th><th>latency x</th><th>shed</th>"
+            "<th>decision</th><th>exemplar</th></tr>"
+        )
+        for name, st in rep["models"].items():
+            dec = st.get("decision") or {}
+            verdict = st.get("verdict")
+            color = (
+                "#ffd6d6" if verdict == "fail"
+                else "#ffe9c6" if verdict == "held"
+                else "#d8f5d8" if verdict == "pass"
+                else ""
+            )
+            tid = st.get("last_trace_id")
+            parts.append(
+                f"<tr style='background:{color}'>"
+                f"<td>{esc(name)}</td><td>v{esc(st['canary_version'])}</td>"
+                f"<td>v{esc(st['active_version'])}</td><td>{esc(st['mode'])}</td>"
+                f"<td><b>{esc(verdict)}</b></td>"
+                f"<td>{esc(st['rows'])}/{esc(st['min_rows'])}</td>"
+                f"<td>{esc(st['mismatch_pct'])}</td>"
+                f"<td>{esc(st['max_rel_err'])}</td>"
+                f"<td>{esc(st['latency_ratio'])}</td>"
+                f"<td>{esc(st['shed_rate'])}</td>"
+                f"<td>{esc(dec.get('action', '—'))}"
+                + (f": {esc('; '.join(dec.get('reasons') or []))}" if dec.get("reasons") else "")
+                + (f"<br>vetoes: {esc('; '.join(st['vetoes']))}" if st.get("vetoes") else "")
+                + "</td>"
+                f"<td>{f'<a href=/tracez?trace_id={esc(tid)}>{esc(tid)}</a>' if tid else '—'}</td>"
+                "</tr>"
+            )
+        parts.append("</table>")
+    else:
+        parts.append("<p>no canary has been observed yet "
+                     "(load one with activate=False and arm "
+                     "HEAT_TPU_SHADOW_FRACTION)</p>")
+    parts.append("<h2>retained events</h2>")
+    events = rep["events"]
+    if events:
+        parts.append(
+            "<table><tr><th>ts</th><th>model</th><th>kind</th><th>sev</th>"
+            "<th>message</th><th>exemplar</th></tr>"
+        )
+        for ev in reversed(events):
+            tid = ev.get("trace_id")
+            parts.append(
+                f"<tr style='background:{_SEV_COLOR.get(ev.get('severity'), '')}'>"
+                f"<td>{esc(round(ev.get('ts', 0), 3))}</td>"
+                f"<td>{esc(ev.get('model'))}</td><td>{esc(ev.get('kind'))}</td>"
+                f"<td>{esc(ev.get('severity'))}</td>"
+                f"<td>{esc(ev.get('message'))}</td>"
+                f"<td>{f'<a href=/tracez?trace_id={esc(tid)}>{esc(tid)}</a>' if tid else '—'}</td>"
+                "</tr>"
+            )
+        parts.append("</table>")
+    else:
+        parts.append("<p>(no events retained)</p>")
+    parts.append("</body></html>")
+    return "".join(parts)

@@ -131,6 +131,7 @@ class ModelBatcher:
         max_batch: int,
         max_delay_s: float,
         on_batch: Optional[Callable[[np.ndarray], None]] = None,
+        on_mirror: Optional[Callable[..., Any]] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -141,6 +142,11 @@ class ModelBatcher:
         #: drift sketches) is off every caller's latency path by
         #: construction, the data analogue of the deferred stage notes
         self._on_batch = on_batch
+        #: shadow-mirror hook: called with ``(true_rows, true_outputs,
+        #: primary_trace_id, infer_ms)`` after the callers are woken —
+        #: the canary decision plane's tap into the scatter path, same
+        #: off-the-latency-path placement as ``on_batch``
+        self._on_mirror = on_mirror
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
         self._queue: List[_Request] = []
@@ -283,7 +289,9 @@ class ModelBatcher:
                 t1 = time.perf_counter_ns()
                 _stage_note("serve.pad", tb0, t1 - tb0, rows=n, bucket=bucket)
                 observe_stage("pad", (t1 - tb0) / 1e6, ptid)
+                ti0 = time.perf_counter_ns()
                 out = np.asarray(self._infer_fn(rows))
+                infer_ms = (time.perf_counter_ns() - ti0) / 1e6
                 t0 = time.perf_counter_ns()
                 off = 0
                 for r in batch:
@@ -313,6 +321,13 @@ class ModelBatcher:
                 try:
                     self._on_batch(rows[:n])
                 except Exception:  # lint: allow H501(a sketch bug must never fail served requests)
+                    pass
+            if self._on_mirror is not None:
+                # shadow mirroring: the hook only samples + enqueues (a
+                # bounded queue another thread drains) — same contract
+                try:
+                    self._on_mirror(rows[:n], out[:n], ptid, infer_ms)
+                except Exception:  # lint: allow H501(a canary bug must never fail served requests)
                     pass
         except BaseException as e:  # lint: allow H501(per-request error delivery; the batcher thread must survive)
             _clear_notes()  # a failed batch must not leak notes into the next

@@ -170,13 +170,21 @@ class ModelRegistry:
         with self._lock:
             _tsan.note_access("serving.registry.models")
             entry = self._models.setdefault(
-                name, {"versions": {}, "active": None, "history": []}
+                name, {"versions": {}, "active": None, "history": [],
+                       "canary": None}
             )
             entry["versions"][step] = record
             if activate or entry["active"] is None:
                 if entry["active"] is not None and entry["active"] != step:
                     entry["history"].append(entry["active"])
                 entry["active"] = step
+                if entry.get("canary") == step:
+                    entry["canary"] = None
+            else:
+                # loaded-but-not-activated IS the canary slot: the
+                # decision plane (serving/canary.py) mirrors shadow
+                # traffic to this version until a verdict lands
+                entry["canary"] = step
             activated = entry["active"] == step
             _MODELS_G.set(len(self._models))
         if baseline is not None and activated:
@@ -272,6 +280,8 @@ class ModelRegistry:
             if entry["active"] is not None and entry["active"] != version:
                 entry["history"].append(entry["active"])
             entry["active"] = version
+            if entry.get("canary") == version:
+                entry["canary"] = None  # the canary went live
             baseline = entry["versions"][version].get("baseline")
         self._attach_baseline(name, baseline)
 
@@ -321,6 +331,8 @@ class ModelRegistry:
                     )
                 entry["versions"].pop(version, None)
                 entry["history"] = [v for v in entry["history"] if v != version]
+                if entry.get("canary") == version:
+                    entry["canary"] = None
             _MODELS_G.set(len(self._models))
 
     # -- reading --------------------------------------------------------
@@ -343,6 +355,14 @@ class ModelRegistry:
             _tsan.note_access("serving.registry.models", write=False)
             return self._entry(name)["active"]
 
+    def canary_version(self, name: str) -> Optional[int]:
+        """The resident-but-not-active version under shadow evaluation
+        (set by ``load(activate=False)``, cleared by ``promote`` /
+        ``unload`` of that version); None when no canary is loaded."""
+        with self._lock:
+            _tsan.note_access("serving.registry.models", write=False)
+            return self._entry(name).get("canary")
+
     def model_names(self) -> List[str]:
         with self._lock:
             _tsan.note_access("serving.registry.models", write=False)
@@ -358,6 +378,7 @@ class ModelRegistry:
             for name, entry in self._models.items():
                 out[name] = {
                     "active": entry["active"],
+                    "canary": entry.get("canary"),
                     "history": list(entry["history"]),
                     "versions": {
                         str(v): {

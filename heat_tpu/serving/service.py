@@ -60,6 +60,7 @@ from ..telemetry import sketch as _sketch
 from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
 from ..telemetry.spans import stage_note as _stage_note
+from . import canary as _canary
 from .admission import AdmissionController
 from .coalescer import ModelBatcher, observe_stage
 from .model_io import infer as _infer
@@ -149,6 +150,11 @@ class InferenceService:
         #: request (export_prewarm_manifest/prewarm)
         self._seen_shapes: set = set()
         self._lock = _tsan.register_lock("serving.service")
+        #: the canary decision plane: shadow-mirrors a fraction of every
+        #: coalesced batch to the loaded canary version (registry
+        #: ``load(activate=False)``), compares online, auto-promotes /
+        #: auto-rolls-back — see serving/canary.py and /canaryz
+        self.canary = _canary.CanaryController(self)
         # roofline join: with the observatory armed, every predict
         # bucket's compile records its XLA flops/bytes so /rooflinez can
         # pair them with measured time.  Serving compiles are bounded
@@ -190,6 +196,12 @@ class InferenceService:
                     # drift sketches fold each batch's TRUE rows in
                     # after the callers are woken (HEAT_TPU_SKETCH)
                     on_batch=lambda rows, _n=name: _sketch.record_batch(_n, rows),
+                    # shadow mirroring to the loaded canary version —
+                    # sampling + a bounded enqueue only; the canary
+                    # inference runs on the controller's shadow thread
+                    on_mirror=lambda rows, out, tid, ms, _n=name: (
+                        self.canary.offer(_n, rows, out, tid, ms)
+                    ),
                 )
             return b
 
@@ -516,6 +528,15 @@ class InferenceService:
         ]
         if drift["drifting"] and doc["status"] in ("ok", "idle"):
             doc["status"] = "drifting"
+        # canary state rides along so an operator sees "a canary is
+        # under evaluation / its last verdict" without scraping /canaryz
+        cstate = _canary.status(name)
+        doc["canary_version"] = self.registry.canary_version(name)
+        doc["shadow_sampled_rows"] = cstate["rows"] if cstate else 0
+        doc["last_canary_verdict"] = (
+            (cstate.get("decision") or {}).get("verdict") or cstate.get("verdict")
+            if cstate else None
+        )
         return doc
 
     def freeze_baseline(self, name: str) -> Dict[str, Any]:
@@ -630,6 +651,7 @@ class InferenceService:
             batchers, self._batchers = dict(self._batchers), {}
         for b in batchers.values():
             b.close()
+        self.canary.close()
         self.registry.close()
 
     def __enter__(self) -> "InferenceService":

@@ -124,7 +124,23 @@ def tag_snapshot() -> Dict[str, Any]:
         "traces": _tracing.trace_digest(),
         "alerts": _alerts.alerts_snapshot(),
         "drift": _sketch.SKETCHES.digest(),
+        "canary": _canary_state(),
     }
+
+
+def _canary_state():
+    """This worker's canary decision-plane snapshot, or None on a
+    process that never imported the serving layer (a telemetry-only
+    worker must not pull the serving stack in for a snapshot)."""
+    import sys
+
+    cmod = sys.modules.get("heat_tpu.serving.canary")
+    if cmod is None:
+        return None
+    try:
+        return cmod.canary_snapshot()
+    except Exception:  # lint: allow H501(snapshot section degrades, the gather must land)
+        return None
 
 
 # ----------------------------------------------------------------------
@@ -327,6 +343,45 @@ def _merge_drift(snaps: Sequence[Dict]) -> Dict[str, Any]:
     return dict(sorted(models.items()))
 
 
+def _merge_canary(snaps: Sequence[Dict]) -> Dict[str, Any]:
+    """Per-model canary state folded across workers: every worker's
+    verdict/version kept per model plus a ``divergent`` flag when the
+    replicas disagree — two replicas judging the same canary
+    differently (or shadowing different versions) is exactly the signal
+    a fleet operator must see before trusting an auto-promotion.  Pure
+    and deterministic like the rest of the merge."""
+    models: Dict[str, Dict[str, Any]] = {}
+    events: List[Dict[str, Any]] = []
+    for s in sorted(snaps, key=lambda s: int(s.get("process_index", 0))):
+        ix = str(int(s.get("process_index", 0)))
+        c = s.get("canary") or {}
+        for name in sorted(c.get("models") or {}):
+            d = c["models"][name]
+            e = models.setdefault(
+                name,
+                {"model": name, "workers": {}, "divergent": False,
+                 "verdicts": [], "canary_versions": []},
+            )
+            e["workers"][ix] = {
+                "canary_version": d.get("canary_version"),
+                "verdict": d.get("verdict"),
+                "rows": d.get("rows"),
+                "mismatch_pct": d.get("mismatch_pct"),
+                "decision": (d.get("decision") or {}).get("action"),
+            }
+            if d.get("verdict") not in e["verdicts"]:
+                e["verdicts"].append(d.get("verdict"))
+            if d.get("canary_version") not in e["canary_versions"]:
+                e["canary_versions"].append(d.get("canary_version"))
+        for ev in c.get("events") or []:
+            events.append(dict(ev, worker=ix))
+    for e in models.values():
+        e["divergent"] = len(e["verdicts"]) > 1 or len(e["canary_versions"]) > 1
+    events.sort(key=lambda ev: (ev.get("ts", 0.0), ev.get("worker", ""),
+                                ev.get("model", "")))
+    return {"models": dict(sorted(models.items())), "events": events}
+
+
 def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str, Any]:
     """Fold worker-tagged snapshots into one deterministic labeled view.
 
@@ -344,7 +399,10 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
       merge_alert_snapshots`: the same SLO firing on two replicas stays
       two rows — it IS two replicas burning budget);
     * ``drift`` — per-model drift scores per worker plus the
-      fleet-worst score (:func:`_merge_drift`).
+      fleet-worst score (:func:`_merge_drift`);
+    * ``canary`` — per-model canary verdicts per worker with a
+      ``divergent`` flag when replicas disagree, plus every worker's
+      retained canary events in one timeline (:func:`_merge_canary`).
 
     Determinism: output depends only on the input snapshots; workers are
     ordered by ``process_index`` and every dict is key-sorted."""
@@ -440,4 +498,5 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
             ]
         ),
         "drift": _merge_drift(snaps),
+        "canary": _merge_canary(snaps),
     }

@@ -22,6 +22,8 @@ One bundle carries everything the post-mortem needs::
                 (was an SLO burning or a model drifting when it died?)
     slo         every registered objective's last burn-rate verdict
     drift       per-model input-drift scores vs their baselines
+    canary      the canary decision plane: per-model shadow evidence
+                windows, decision history, veto reasons, retained events
     observatory the roofline execution ledger + the last HBM watermark
                 sample vs the static prediction + calibration provenance
     knobs       every registered HEAT_TPU_* knob's effective value
@@ -236,6 +238,19 @@ def _drift_state() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _canary_state() -> Optional[Dict[str, Any]]:
+    """The canary decision plane at crash time — decision history, the
+    live evidence window and veto reasons: whether a version swap was in
+    flight (or just landed) when the process died.  Only read when the
+    serving layer is already resident; a fit-only crash must not import
+    the serving stack mid-crash."""
+    try:
+        cmod = sys.modules.get("heat_tpu.serving.canary")
+        return cmod.canary_snapshot() if cmod is not None else None
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
 def _analysis_state() -> Optional[Dict[str, Any]]:
     """Recent program-lint diagnostics + the static peak-HBM estimate
     table — was the crash an OOM the J301 budget predicted?"""
@@ -302,6 +317,7 @@ def build_bundle(
         "alerts": _alerts_state(),
         "slo": _slo_state(),
         "drift": _drift_state(),
+        "canary": _canary_state(),
         "dispatch": _dispatch_state(),
         "checkpoint": {
             "last_step": int(_metrics.gauge("checkpoint.last_step").value)

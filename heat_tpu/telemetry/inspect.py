@@ -170,6 +170,42 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
                 f"over {m.get('sketched_rows')} rows [{state}]"
             )
 
+    canary_doc = doc.get("canary") or {}
+    c_models = canary_doc.get("models") or {}
+    c_events = canary_doc.get("events") or []
+    if c_models or c_events:
+        lines.append(_rule(
+            f"canary decision plane ({len(c_models)} model(s), "
+            f"{len(c_events)} retained event(s))"
+        ))
+        for name in sorted(c_models):
+            m = c_models[name]
+            dec = m.get("decision") or {}
+            lines.append(
+                f"{name}: canary v{m.get('canary_version')} vs active "
+                f"v{m.get('active_version')} [{m.get('mode')}] — "
+                f"{m.get('rows')} rows, {m.get('mismatch_pct')}% mismatch, "
+                f"latency {m.get('latency_ratio')}x -> "
+                f"{str(m.get('verdict', '?')).upper()}"
+                + (f" ({dec.get('action')})" if dec else "")
+            )
+            for r in dec.get("reasons") or []:
+                lines.append(f"    reason: {r}")
+            for v in m.get("vetoes") or []:
+                lines.append(f"    veto: {v}")
+            for h in (m.get("history") or [])[-5:]:
+                lines.append(
+                    f"    history: v{h.get('canary_version')} "
+                    f"{h.get('verdict')} -> {h.get('action')} "
+                    f"({h.get('rows')} rows, {h.get('mismatch_pct')}%)"
+                )
+        for ev in c_events[-8:]:
+            lines.append(
+                f"  {str(ev.get('severity', '?')).upper():5s} "
+                f"[{ev.get('kind')}] {ev.get('model')}: {ev.get('message')}"
+                + (f" (trace {ev.get('trace_id')})" if ev.get("trace_id") else "")
+            )
+
     metrics = doc.get("metrics") or {}
     nonzero = {
         k: v

@@ -29,6 +29,11 @@ route     payload
 /driftz   input-drift sketches: per served model, the live-vs-baseline
           PSI score and per-feature breakdown; HTML by default,
           ``?format=json`` for the machine form
+/canaryz  canary decision plane: per served model, the shadow-traffic
+          evidence window (rows compared, mismatch rate, latency ratio),
+          the verdict + veto reasons, and the retained comparison/
+          decision event timeline with exemplar trace_ids; HTML by
+          default, ``?format=json`` for the machine form
 /rooflinez  kernel roofline observatory: per-executable measured time
           joined with cost-accounting FLOPs/bytes — achieved GFLOP/s,
           GB/s, intensity and bound-class vs the device peaks, plus the
@@ -341,6 +346,15 @@ def statusz_report() -> Dict[str, Any]:
         }
     except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
         doc["alerts"] = None
+    try:
+        # only when the serving layer is already resident: a fit-only
+        # process's /statusz scrape must not import the serving stack
+        import sys as _sys
+
+        cmod = _sys.modules.get("heat_tpu.serving.canary")
+        doc["canary"] = cmod.canary_snapshot() if cmod is not None else None
+    except Exception:  # lint: allow H501(introspection page degrades, never breaks the process)
+        doc["canary"] = None
     return doc
 
 
@@ -462,6 +476,16 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(_sketch.drift_report())
                 else:
                     self._send(200, _sketch.render_driftz_html(), "text/html")
+            elif path == "/canaryz":
+                # lazy: the canary decision plane lives in the serving
+                # layer; importing it from a handler thread is the same
+                # one-time cost every serving process already paid
+                from ..serving import canary as _canary
+
+                if self._query_params().get("format") == "json":
+                    self._send_json(_canary.canaryz_report())
+                else:
+                    self._send(200, _canary.render_canaryz_html(), "text/html")
             elif path == "/rooflinez":
                 params = self._query_params()
                 if params.get("format") == "json":
@@ -503,7 +527,7 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     "heat_tpu runtime introspection: "
                     "/metrics /varz /healthz /readyz /trace /tracez /sloz /driftz "
-                    "/rooflinez /profilez /statusz"
+                    "/canaryz /rooflinez /profilez /statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",

@@ -6,7 +6,9 @@ surface — the async-checkpoint writer and loader threads
 excepthooks (``test_introspection.py``), the shared metrics/span
 state (``test_telemetry.py``), the serving layer's coalescer/
 registry-loader/admission threads plus its HTTP routes
-(``test_serving.py``), the request-tracing context handoffs +
+(``test_serving.py``), the canary decision plane's shadow thread vs
+batcher offers vs /canaryz scrapes (``test_canary.py``), the
+request-tracing context handoffs +
 tail-store concurrency (``test_tracing.py``), the quality-signal
 layer's SLO tick thread / alert table / sketch registry
 (``test_slo.py``, ``test_drift.py``), the fleet layer's router
@@ -43,6 +45,7 @@ LANE_FILES = (
     "tests/test_introspection.py",
     "tests/test_telemetry.py",
     "tests/test_serving.py",
+    "tests/test_canary.py",
     "tests/test_tracing.py",
     "tests/test_slo.py",
     "tests/test_drift.py",
