@@ -248,8 +248,16 @@ def bench_kmeans(ht, sync_floor, roofline=None):
     only a property refactor), and the r2 harness subtracted the link
     sync floor from a 2-fit window without requiring floor dominance —
     a systematic inflation.  From r4 on, the window list in ``timing``
-    settles regression-vs-noise questions directly."""
-    n, f, k = 1 << 22, 16, 8
+    settles regression-vs-noise questions directly.
+
+    Honest scale (ISSUE 16): on an accelerator the point set fills HBM —
+    2^27 x 16 f32 = 8 GiB, the reference's config-2 regime (the former
+    2^22 probe measured 1/250th of it) — while CPU smoke sessions keep
+    the 2^22 size so the grid stays runnable; the metric name carries
+    the size, so the two regimes never mix in one trend series."""
+    big = jax.default_backend() == "tpu"
+    log_n = 27 if big else 22
+    n, f, k = 1 << log_n, 16, 8
     ht.random.seed(1)
     x = ht.random.randn(n, f, split=0)
     x = x.astype(ht.float32)
@@ -368,7 +376,7 @@ def bench_kmeans(ht, sync_floor, roofline=None):
         best = min(best, time.perf_counter() - t0)
     base_pts = nb / best
     rec = {
-        "metric": "kmeans_2^22x16_k8_pts_per_s",
+        "metric": f"kmeans_2^{log_n}x16_k8_pts_per_s",
         "value": round(pts_per_s / 1e9, 3),
         "unit": "Gpts/s",
         "vs_baseline": round(pts_per_s / base_pts, 2),
@@ -437,6 +445,44 @@ def bench_hsvd(ht, sync_floor, roofline=None):
         "vs_baseline": round(gflops / base, 2),
         "timing": meta,
     }
+
+    # Multi-level merge tree (ISSUE 16): its first measured number.  The
+    # split=0 probe above runs p=1 — one truncated-Gram leaf, merge tree
+    # never touched.  split=1 spreads the columns over the mesh, so the
+    # factorization runs ``comm.size`` leaf blocks plus ceil(log) merge
+    # levels; the A/B toggles HEAT_TPU_HSVD_BATCHED, which stacks the
+    # equal-shape blocks of each level through ONE batched
+    # gram+eigh+project instead of a sequential per-block loop
+    # (numerically identical per block — svdtools._truncated_us_stacked).
+    import os
+
+    nm = 1 << 20
+    xm = ht.random.randn(nm, f, split=1)
+    float(xm.sum())
+
+    def fact_tree():
+        ut, st, vt, errt = ht.linalg.hsvd_rank(xm, rank, compute_sv=True, safetyshift=5)
+        return st
+
+    tree = {"leaves": int(xm.comm.size)}
+    for label, flag in (("sequential", "0"), ("batched", "1")):
+        os.environ["HEAT_TPU_HSVD_BATCHED"] = flag
+        try:
+            float(fact_tree().sum())  # retrace under the knob
+            per_t, meta_t = _time_amortized(
+                fact_tree, lambda st: float(st.sum()), n_iter, sync_floor
+            )
+        finally:
+            os.environ.pop("HEAT_TPU_HSVD_BATCHED", None)
+        tree[label] = {
+            "gflops": round(2.0 * nm * f * f / per_t / 1e9, 1),
+            "timing": meta_t,
+        }
+    seq_g = tree["sequential"]["gflops"]
+    tree["batched_speedup"] = (
+        round(tree["batched"]["gflops"] / seq_g, 3) if seq_g else None
+    )
+    rec["merge_tree_2^20x128_split1"] = tree
     if roofline:
         rec["pct_of_peak_f32"] = round(100.0 * gflops / roofline["peak_f32_matmul_gflops"], 1)
         # hsvd forces HIGHEST for f32 accuracy: the like-for-like ceiling
@@ -596,6 +642,23 @@ def bench_fft3d(ht, sync_floor, roofline=None):
     per, meta = _time_amortized(fft, _fft_scalar, 2, sync_floor)
     gflops = 5.0 * n * np.log2(n) / per / 1e9
 
+    # Complex-input transform (ISSUE 16): fftn of the spectrum r — a full
+    # complex 512^3 with nonzero planes — drives the pair-block leading
+    # engine, which moves both planes through ONE relayout per stage
+    # instead of two per-plane passes.  The acceptance yardstick is the
+    # ratio to the real-input time above (was ~2.1x with the per-plane
+    # stages; the pair-block path targets <= 1.3x).
+    def fft_c():
+        return ht.fft.fftn(r)
+
+    float(_fft_scalar(fft_c()))
+    per_c, meta_c = _time_amortized(fft_c, _fft_scalar, 2, sync_floor)
+    complex_rec = {
+        "gflops": round(5.0 * n * np.log2(n) / per_c / 1e9, 1),
+        "ratio_vs_real": round(per_c / per, 3),
+        "timing": meta_c,
+    }
+
     import torch
 
     # GFLOP/s-normalized rates compare across sizes: the 128^3 subset
@@ -618,6 +681,7 @@ def bench_fft3d(ht, sync_floor, roofline=None):
         "on_chip": on_chip,
         "parseval_err": round(parseval, 6),
         "timing": meta,
+        "complex_input_512^3": complex_rec,
     }
     if roofline:
         # a 3-axis transform must touch both f32 planes at least once per

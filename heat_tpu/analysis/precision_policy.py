@@ -81,11 +81,18 @@ POLICIES = {
     # precision can flip a tie permanently -> bitwise only
     "KMedians": {"mode": "bitwise", "compute_dtypes": ("float32",)},
     "KMedoids": {"mode": "bitwise", "compute_dtypes": ("float32",)},
-    "PCA": {"mode": "bitwise", "compute_dtypes": ("float32",)},
+    # PCA transform is one projection matmul: bf16 operands with f32
+    # accumulation keep the coordinates within rtol of the native path
+    # (tests/test_precision.py measures the bound on fitted components)
+    "PCA": {"mode": "tolerance", "rtol": 0.02, "compute_dtypes": ("float32", "bfloat16")},
     "Lasso": {"mode": "bitwise", "compute_dtypes": ("float32",)},
-    # KNN votes are argmax over discrete counts; distance rounding can
-    # flip the k-th neighbor -> bitwise until a tolerance bench exists
-    "KNeighborsClassifier": {"mode": "bitwise", "compute_dtypes": ("float32",)},
+    # KNN serves under a tolerance contract on the DISTANCE stage only
+    # (same bf16 cross-term core as KMeans); the predicted labels stay
+    # bitwise — votes are argmax over discrete counts, and the tests
+    # assert exact label agreement on margin-separated data, so a bf16
+    # rounding that flips the k-th neighbor set is a test failure, not
+    # an accepted tolerance
+    "KNeighborsClassifier": {"mode": "tolerance", "rtol": 0.02, "compute_dtypes": ("float32", "bfloat16")},
 }
 
 _MODES = ("bitwise", "tolerance")

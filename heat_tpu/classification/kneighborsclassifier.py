@@ -55,10 +55,18 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         The neighbor search is the ring-fused distance->top-k program
         (spatial.distance.cdist_topk): the (n_test, n_train) matrix is
         never materialized — peak memory is O(n_test * k) plus one
-        circulating train block (reference materializes the matrix)."""
+        circulating train block (reference materializes the matrix).
+
+        Runs under the KNeighborsClassifier precision scope: a
+        tolerance-policy bf16 request narrows the distance cross term
+        only (f32 accumulation); the vote/argmax stage — and thus the
+        predicted labels — stays native."""
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
-        _, idx_arr = distance.cdist_topk(x, self.x, self.n_neighbors)
+        from ..analysis import precision_policy as _pp
+
+        with _pp.scope("KNeighborsClassifier"):
+            _, idx_arr = distance.cdist_topk(x, self.x, self.n_neighbors)
         idx = idx_arr._dense()
         labels_oh = self.y._dense()
         votes = jnp.sum(labels_oh[idx], axis=1)

@@ -155,7 +155,10 @@ KNOBS = {
     "HEAT_TPU_LLOYD_KERNEL": ("bool", "0", "opt-in fused Pallas Lloyd iteration (VPU-bound on v5e; see core/kernels.py)"),
     "HEAT_TPU_HSVD_PRECISION": ("choice", "high", "hsvd Gram-pass matmul precision: default | high | highest"),
     "HEAT_TPU_HSVD_SYRK": ("bool", "1", "one-HBM-read syrk kernel for hsvd Gram passes when supported"),
+    "HEAT_TPU_HSVD_BATCHED": ("bool", "0", "opt-in batched (vmapped) leaf factorizations in the hsvd merge tree: one stacked gram+eigh over the equal-shape leaf blocks instead of the sequential per-leaf loop (the 'can't fuse eigh' A/B, scripts/bench.py hsvd)"),
     "HEAT_TPU_COMPLEX": ("bool", "", "override the complex-on-TPU support probe (unset = probe per device kind)"),
+    # -- sparse (heat_tpu/sparse) ---------------------------------------
+    "HEAT_TPU_SPGEMM_DENSE_DENSITY": ("float", "0.5", "estimated-output-density threshold at which sparse@sparse matmul falls back from the output-sparse triplet ring to the GEMM-style dense route (estimate: 1 - exp(-nnz_A*nnz_B/(m*k*n)); 1.0 = always ring, 0.0 = always dense)"),
     # -- fft (docs/fft_roofline.md) -------------------------------------
     "HEAT_TPU_PLANAR": ("bool", "", "planar (re, im) complex representation (unset = auto: TPU without complex support)"),
     "HEAT_TPU_FFT_PRECISION": ("choice", "highest", "FFT matmul precision: default | high | highest"),

@@ -251,15 +251,30 @@ class PCA(BaseEstimator, TransformMixin):
                         pass
 
     def transform(self, X: DNDarray) -> DNDarray:
-        """Project onto the principal axes (pca.py:380)."""
+        """Project onto the principal axes (pca.py:380).
+
+        Runs under the PCA precision scope: with a tolerance-policy bf16
+        request active (``HEAT_TPU_PREDICT_DTYPE=bfloat16``), the
+        projection matmul takes bf16 operands with f32 accumulation
+        pinned — rounding enters only through the one-time quantization
+        of the centered data and the fitted axes, keeping the projected
+        coordinates within the declared rtol of the native path."""
         if self.components_ is None:
             raise RuntimeError("fit needs to be called before transform")
         if not isinstance(X, DNDarray):
             raise TypeError(f"X must be a DNDarray, got {type(X)}")
+        from ..analysis import precision_policy as _pp
         from ..core.linalg import basics
 
-        centered = X - self.mean_
-        return basics.matmul(centered, self.components_.T)
+        with _pp.scope("PCA"):
+            centered = X - self.mean_
+            if _pp.active_compute_dtype() == "bfloat16":
+                xd = centered._dense().astype(jnp.bfloat16)
+                w = self.components_._dense().T.astype(jnp.bfloat16)
+                proj = jnp.matmul(xd, w, preferred_element_type=jnp.float32)
+                split = 0 if X.split == 0 else None
+                return DNDarray.from_dense(proj, split, X.device, X.comm)
+            return basics.matmul(centered, self.components_.T)
 
     def inverse_transform(self, X: DNDarray) -> DNDarray:
         """Back-project to the original space (pca.py:430)."""

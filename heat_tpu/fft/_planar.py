@@ -658,10 +658,13 @@ def _interleaved_eligible(re: jax.Array, axes) -> bool:
     if os.environ.get("HEAT_TPU_FFT_INTERLEAVED", "1") != "1":
         return False
     nd = re.ndim
+    # every engine below builds its weights from a dtype string, so f64
+    # rides the same dots (native on CPU/GPU, hi/lo split in _leading on
+    # TPU); other dtypes keep the per-axis fallback
     return (
         nd in (2, 3)
         and len(axes) == nd
-        and re.dtype == jnp.float32
+        and re.dtype in (jnp.float32, jnp.float64)
         and sorted(a % nd for a in axes) == list(range(nd))
         and all(int(s) >= 2 for s in re.shape)
     )
@@ -678,11 +681,13 @@ def real_fftn(re: jax.Array, axes: Sequence[int], norm) -> Tuple[jax.Array, jax.
     scheduled bytes, measured; axis order is irrelevant for a separable
     full-length transform); the 2-D all-axes case its two-stage variant."""
     if _interleaved_eligible(re, axes):
-        if re.ndim == 3:
-            from . import _leading
+        from . import _leading
 
-            if _leading.leading_eligible(re, axes, False):
+        if _leading.leading_eligible(re, axes, False):
+            if re.ndim == 3:
                 return _leading.rfft3_leading(re, norm)
+            return _leading.rfft2_leading(re, norm)
+        if re.ndim == 3:
             return _rfft3_interleaved(re, norm)
         return rfft2_full_interleaved(re, norm)
     axes = [a % re.ndim for a in axes]

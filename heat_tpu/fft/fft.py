@@ -211,14 +211,15 @@ def _planar_prog_cached(kind: str, norm, axes_ns, _cfg):
             if len(axes_ns) in (2, 3) and all(n is None for _, n in axes_ns):
                 axes_l = [a for a, _ in axes_ns]
                 if im is not None and _pl._interleaved_eligible(re, axes_l):
-                    # complex input, full lengths: the interleaved one-
-                    # dot-per-stage engine (fftn -> filter -> ifftn chains
-                    # stay on the fast path, not just the first transform)
-                    if re.ndim == 3:
-                        from . import _leading
+                    # complex input, full lengths: the pair-block leading
+                    # engine when eligible (fftn -> filter -> ifftn chains
+                    # stay on the fast path, not just the first transform),
+                    # else the interleaved one-dot-per-stage engine
+                    from . import _leading
 
-                        if _leading.leading_eligible(re, axes_l, True):
-                            return _leading.cfft3_leading(re, im, inv, norm)
+                    if _leading.leading_eligible(re, axes_l, True):
+                        return _leading.cfftn_leading(re, im, inv, norm)
+                    if re.ndim == 3:
                         return _pl.cfft3_interleaved(re, im, inv, norm)
                     return _pl.cfft2_interleaved(re, im, inv, norm)
                 if im is None and inv and _pl._interleaved_eligible(re, axes_l):

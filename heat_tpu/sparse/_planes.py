@@ -568,6 +568,190 @@ def _spmm_comp_inner_prog(comm, P: int, C: int, comp_pad: int, m_pad: int, n: in
     )
 
 
+# ----------------------------------------------------------------------
+# SpGEMM: sparse @ sparse -> sparse, OUTPUT-SPARSE (ISSUE 16 tentpole 1).
+#
+# The GEMM-style route densified B per ring chunk and re-packed a dense
+# (m/P, n) output block — which cannot even be allocated when the result
+# is sparse but n is large.  Here each ring step contracts the local CSR
+# chunk of A against the ARRIVING (comp, other, val) triplet chunk of B
+# and merges the canonical partial products through ``merge_planes``:
+# nothing dense ever materializes, and peak per-device memory is
+# O(Ca * r_max) partial triplets (r_max = B's max nnz per row).
+# ----------------------------------------------------------------------
+@_functools.lru_cache(maxsize=128)
+def _row_occupancy_prog(comm, P: int, C: int, comp_pad: int, dist: bool):
+    """Per-shard max nnz of any compressed index -> (P,) int32 (the
+    static ELL width the SpGEMM step needs; padding rows count 0)."""
+
+    def body(comp):
+        bounds = jnp.searchsorted(comp, jnp.arange(comp_pad + 1, dtype=comp.dtype))
+        return jnp.max(jnp.diff(bounds)).astype(jnp.int32)[None]
+
+    if not dist:
+        return jax.jit(body)
+    pl = _shard_spec((comm.axis_name,))
+    return _smap(comm, body, (pl,), pl)
+
+
+def max_row_occupancy(comp, P, C, comp_pad, dist, comm) -> int:
+    """Global max nnz per compressed index — one (P,) host pull, like the
+    standard nnz re-sync."""
+    occ = fetch_host(_row_occupancy_prog(comm, P, C, comp_pad, dist)(comp))
+    return max(1, int(np.max(occ)))
+
+
+@_functools.lru_cache(maxsize=64)
+def _spgemm_step_prog(
+    comm, P: int, Ca: int, Cb: int, comp_pad_a: int, chunk_b: int, r_max: int,
+    res_dt: str, dist: bool,
+):
+    """One ring step of the output-sparse SpGEMM.
+
+    The resident B triplet chunk (rows of owner ``(s+t) % P``) is ELL-ized
+    in registers — (chunk_b, r_max) col/val/mask planes via one scatter —
+    then every A entry (i, j, v) with j in the owner's row range expands to
+    the r_max partial products v * B[j, :].  The raw partials are
+    CANONICALIZED here (two-key sort + run-head segment-sum: ``_merge_prog``
+    only collapses duplicate runs of length <= 2, which canonical operands
+    guarantee and raw partials do not), so the accumulator merge upstream
+    is an ordinary ``merge_planes("add", ...)``.  Returns the canonical
+    partial planes plus B's planes shifted one step around the ring."""
+    Cp = Ca * r_max
+    dt = jnp.dtype(res_dt)
+    name = comm.axis_name
+    perm = [(i, (i - 1) % P) for i in range(P)]
+
+    def body(ac, ao, av, bc, bo, bv, t):
+        if dist:
+            owner = (jax.lax.axis_index(name) + t) % jnp.asarray(P, jnp.int32)
+        else:
+            owner = jnp.asarray(0, jnp.int32)
+        # ELL-ize the resident B chunk (padding bc == chunk_b drops out)
+        row_starts = jnp.searchsorted(
+            bc, jnp.arange(chunk_b + 1, dtype=bc.dtype)
+        ).astype(jnp.int32)
+        pos = jnp.arange(Cb, dtype=jnp.int32) - jnp.take(
+            row_starts, jnp.clip(bc, 0, chunk_b)
+        )
+        ell_col = jnp.zeros((chunk_b, r_max), bo.dtype).at[bc, pos].set(bo, mode="drop")
+        ell_val = jnp.zeros((chunk_b, r_max), dt).at[bc, pos].set(
+            bv.astype(dt), mode="drop"
+        )
+        ell_ok = jnp.zeros((chunk_b, r_max), bool).at[bc, pos].set(True, mode="drop")
+        # expand A entries hitting the chunk to (Ca, r_max) partials
+        rel = ao - owner * chunk_b
+        hit = (rel >= 0) & (rel < chunk_b) & (ac < comp_pad_a)
+        relc = jnp.clip(rel, 0, chunk_b - 1)
+        ok = jnp.take(ell_ok, relc, axis=0) & hit[:, None]
+        comp = jnp.where(ok, ac[:, None], comp_pad_a).reshape(-1)
+        other = jnp.where(ok, jnp.take(ell_col, relc, axis=0), 0).reshape(-1)
+        val = jnp.where(
+            ok, av.astype(dt)[:, None] * jnp.take(ell_val, relc, axis=0),
+            jnp.zeros((), dt),
+        ).reshape(-1)
+        # canonicalize: sort by (comp, other), collapse each duplicate run
+        # into its head via a run-id segment-sum, push the rest to padding
+        comp, other, val = jax.lax.sort((comp, other, val), num_keys=2)
+        head = jnp.concatenate(
+            [
+                jnp.ones((1,), bool),
+                (comp[1:] != comp[:-1]) | (other[1:] != other[:-1]),
+            ]
+        )
+        seg = jnp.cumsum(head.astype(jnp.int32)) - 1
+        summed = jax.ops.segment_sum(val, seg, num_segments=Cp)
+        keep = head & (comp < comp_pad_a)
+        val = jnp.where(keep, jnp.take(summed, seg), jnp.zeros((), dt))
+        comp = jnp.where(keep, comp, comp_pad_a)
+        other = jnp.where(keep, other, 0)
+        comp, other, val = jax.lax.sort((comp, other, val), num_keys=2)
+        ln = jnp.searchsorted(comp, jnp.asarray(comp_pad_a, comp.dtype)).astype(
+            jnp.int32
+        )[None]
+        if dist:
+            bc = jax.lax.ppermute(bc, name, perm)
+            bo = jax.lax.ppermute(bo, name, perm)
+            bv = jax.lax.ppermute(bv, name, perm)
+        return comp, other, val, ln, bc, bo, bv
+
+    if not dist:
+        return jax.jit(body)
+    pl = _shard_spec((name,))
+    rep = _shard_spec(())
+    return _smap(comm, body, (pl,) * 6 + (rep,), (pl,) * 7)
+
+
+def spgemm_planes(
+    a_planes, b_planes, P, Ca, Cb, comp_pad_a, chunk_b, r_max, res_dt, dist, comm
+):
+    """Output-sparse SpGEMM driver: P ring steps, each producing canonical
+    partial triplets that fold into the accumulator through
+    ``merge_planes("add", ...)`` — the per-step compaction is the usual
+    (P,)-int nnz re-sync, and no dense buffer exists at any point.
+
+    Returns (comp, other, val, lnnz_dev, lnnz_host, C)."""
+    from ..resilience.faults import inject
+
+    prog = _spgemm_step_prog(
+        comm, P, Ca, Cb, comp_pad_a, chunk_b, r_max, str(jnp.dtype(res_dt)), dist
+    )
+    Cp = Ca * r_max
+    bc, bo, bv = b_planes
+    acc = None
+    for t in range(P):
+        tj = jnp.asarray(t, jnp.int32)
+        pc, po, pv, pln, bc, bo, bv = prog(*a_planes, bc, bo, bv, tj)
+        # the per-step nnz re-sync is a host allgather — the ring's one
+        # collective choke point, so the comm.collective fault site fires
+        # here; the loop holds no mutable operand state, so a failed step
+        # aborts the whole matmul cleanly and a retry recomputes it
+        inject("comm.collective", op="spgemm.nnz_resync", step=t)
+        pln_host = tuple(int(v) for v in fetch_host(pln))
+        tight = max(max(pln_host), 1)
+        if tight < Cp:
+            pc, po, pv = _slice_planes_prog(comm, P, Cp, tight, dist)(pc, po, pv)
+        if acc is None:
+            acc = (pc, po, pv, pln, pln_host, tight)
+            continue
+        comp, other, val, lnnz_dev, lnnz_host, out_C = merge_planes(
+            "add", acc[:3], (pc, po, pv), P, acc[5], tight, comp_pad_a, dist, comm
+        )
+        acc = (comp, other, val, lnnz_dev, lnnz_host, out_C)
+    return acc
+
+
+# ----------------------------------------------------------------------
+# triplet-preserving re-compression (CSR <-> CSC without densifying):
+# replicated global planes sorted by the OLD compressed axis are re-keyed
+# and re-sorted by the OTHER axis — O(gnnz) plane traffic, never an
+# (m, n) dense buffer (ISSUE 16 satellite: SpGEMM inputs keep triplets).
+# ----------------------------------------------------------------------
+@_functools.lru_cache(maxsize=128)
+def _recompress_prog(comm, C: int, extent_old: int, extent_new: int):
+    def run(comp_g, other, val):
+        real = comp_g < extent_old
+        nc = jnp.where(real, other, extent_new).astype(comp_g.dtype)
+        no = jnp.where(real, comp_g, 0).astype(other.dtype)
+        nv = jnp.where(real, val, jnp.zeros((), val.dtype))
+        nc, no, nv = jax.lax.sort((nc, no, nv), num_keys=2)
+        rep = _plane_sharding(comm, False)
+        return tuple(
+            jax.lax.with_sharding_constraint(x, rep) for x in (nc, no, nv)
+        )
+
+    return jax.jit(run)
+
+
+def recompress_planes(comp_g, other, val, extent_old, extent_new, comm):
+    """Swap compression axes of replicated global triplets (sorted by the
+    old comp axis in, sorted by the new one out; pad sentinel re-keyed to
+    ``extent_new``)."""
+    return _recompress_prog(comm, int(comp_g.shape[0]), extent_old, extent_new)(
+        comp_g, other, val
+    )
+
+
 @_functools.lru_cache(maxsize=256)
 def _dense_times_comp_rows_prog(comm, P: int, C: int, comp_pad: int, q: int, n_out: int, dist: bool):
     """E @ A with A row-compressed: shard s owns A's row block, i.e. a

@@ -233,17 +233,99 @@ def _dense_sp(a, b: DCSX_matrix) -> DNDarray:
 def _spgemm(a: DCSX_matrix, b: DCSX_matrix):
     """sparse @ sparse -> sparse of a's format.
 
-    B densifies only per-chunk (``todense`` keeps B's rows sharded over
-    the mesh), the product runs through the CSR X-ring / CSC psum_scatter
-    SpMM programs (never a full dense replica of either operand), and the
-    dense OUTPUT row block — O((m/P)*n) per device, the GEMM-style spgemm
-    trade — is re-packed on device.  Scale bound: the *result's* dense
-    chunk must fit per device; operands only need their sparse planes
-    plus one (extent/P, n) dense chunk."""
+    Default route (ISSUE 16 tentpole 1): an OUTPUT-SPARSE triplet ring —
+    each ring step contracts the local CSR chunk of A against the arriving
+    (comp, other, val) chunk of B and merges canonical partial products
+    through ``merge_planes``, so a sparse result succeeds (and is fast)
+    where the dense (m/P, n) block cannot even be allocated.  Column-
+    compressed operands route through the metadata transpose
+    (A @ B = (Bᵀ @ Aᵀ)ᵀ) and mixed formats through the triplet-preserving
+    conversion — ``todense()`` is never called on either operand.
+
+    When the ESTIMATED output density (independent-pattern model
+    1 - exp(-nnz_A * nnz_B / (m*k*n))) reaches
+    ``HEAT_TPU_SPGEMM_DENSE_DENSITY``, the GEMM-style dense route is the
+    better trade (the ring's partial-triplet traffic exceeds the dense
+    block) and is kept as the fallback."""
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"shape mismatch for matmul: {a.shape} @ {b.shape}")
+    from ..core._env import env_float
+
+    m, k = (int(s) for s in a.shape)
+    n = int(b.shape[1])
+    cells = float(m) * float(k) * float(n)
+    lam = (float(a.gnnz) * float(b.gnnz) / cells) if cells else 0.0
+    est_density = 1.0 - float(np.exp(-lam))
+    if est_density >= env_float("HEAT_TPU_SPGEMM_DENSE_DENSITY"):
+        return _spgemm_dense(a, b)
+    from .manipulations import to_sparse_csr
+
+    if a._compressed_axis == 1:
+        # column-compressed result: run the row-compressed ring on the
+        # metadata transposes and flip back — no data movement beyond the
+        # (possible) triplet-preserving re-compression of Bᵀ
+        at = a.T
+        bt = b.T
+        if bt._compressed_axis == 1:
+            bt = to_sparse_csr(bt)
+        return _spgemm_csr(bt, at).T
+    b_csr = b if b._compressed_axis == 0 else to_sparse_csr(b)
+    return _spgemm_csr(a, b_csr)
+
+
+def _spgemm_csr(a: DCSR_matrix, b: DCSR_matrix) -> DCSR_matrix:
+    """Row-compressed output-sparse ring product (both operands CSR)."""
+    from ..core import types
+
+    if b.split != a.split:
+        b = _align_split(b, a.split)
+    m = int(a.shape[0])
+    n = int(b.shape[1])
+    res_jt = jnp.promote_types(a.dtype.jax_type(), b.dtype.jax_type())
+    r_max = _pl.max_row_occupancy(
+        b._comp, b._nshards, b._capacity, b._comp_pad, b._dist, b.comm
+    )
+    comp, other, val, lnnz_dev, lnnz_host, C = _pl.spgemm_planes(
+        (a._comp, a._other, a._val),
+        (b._comp, b._other, b._val),
+        a._nshards, a._capacity, b._capacity, a._comp_pad, b._comp_pad,
+        r_max, res_jt, a._dist, a.comm,
+    )
+    return DCSR_matrix(
+        (comp, other, val), lnnz_dev, lnnz_host, C, a._comp_pad,
+        (m, n), types.canonical_heat_type(res_jt), a.split, a.device, a.comm,
+    )
+
+
+def _spgemm_dense(a: DCSX_matrix, b: DCSX_matrix):
+    """GEMM-style fallback for dense-regime outputs: B densifies only
+    per-chunk (``todense`` keeps B's rows sharded over the mesh), the
+    product runs through the CSR X-ring / CSC psum_scatter SpMM programs,
+    and the dense OUTPUT row block — O((m/P)*n) per device — is re-packed
+    on device.  Scale bound: the *result's* dense chunk must fit; with
+    ``HEAT_TPU_HBM_BUDGET_BYTES`` armed, a chunk that cannot fit raises
+    :class:`MemoryError` up front instead of an opaque allocator failure
+    mid-program (the OOM regime the output-sparse ring exists for)."""
+    from ..core._env import env_int
     from .manipulations import to_sparse_csc, to_sparse_csr
 
+    budget = env_int("HEAT_TPU_HBM_BUDGET_BYTES")
+    if budget > 0:
+        m, k = (int(s) for s in a.shape)
+        n = int(b.shape[1])
+        p = a.comm.size if a._dist else 1
+        item = jnp.dtype(
+            jnp.promote_types(a.dtype.jax_type(), b.dtype.jax_type())
+        ).itemsize
+        # per device: B's densified row chunk + the dense output row block
+        per_dev = (-(-k // p) + -(-m // p)) * n * item
+        if per_dev > budget:
+            raise MemoryError(
+                f"dense SpGEMM fallback needs ~{per_dev} bytes/device for a "
+                f"({m}x{n}) dense block (budget {budget}); the output-sparse "
+                "ring route (density below HEAT_TPU_SPGEMM_DENSE_DENSITY) "
+                "has no dense intermediate"
+            )
     dense = _sp_dense(a, b.todense())
     if isinstance(a, DCSR_matrix):
         return to_sparse_csr(dense)

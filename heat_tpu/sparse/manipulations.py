@@ -1,9 +1,18 @@
 """Sparse<->dense conversions, analog of heat/sparse/manipulations.py
-(to_dense :105, to_sparse_csr/csc :51-104)."""
+(to_dense :105, to_sparse_csr/csc :51-104).
+
+Sparse->sparse format changes take a TRIPLET-PRESERVING path (gather the
+planes to replicated global COO, re-key by the other axis, re-chunk) —
+O(gnnz) plane traffic, never a dense (m, n) buffer, so SpGEMM inputs
+never densify on entry (ISSUE 16 satellite)."""
 
 from __future__ import annotations
 
+import jax
+import numpy as np
+
 from ..core.dndarray import DNDarray
+from . import _planes as _pl
 from .dcsx_matrix import DCSC_matrix, DCSR_matrix, DCSX_matrix
 from .factories import sparse_csc_matrix, sparse_csr_matrix
 
@@ -21,17 +30,59 @@ def to_dense(sparse_matrix: DCSX_matrix, order=None, out=None) -> DNDarray:
     return res
 
 
-def to_sparse_csr(array: DNDarray) -> DCSR_matrix:
-    """DCSR from a dense DNDarray (sparse/manipulations.py:51)."""
+def _convert_format(s: DCSX_matrix, cls, split):
+    """CSR<->CSC re-compression without densifying: replicate the global
+    triplets on device (``rechunk_planes``), swap the key roles and re-sort
+    by the new compressed axis (``recompress_planes``), then re-chunk to
+    the target split.  The only host traffic is the standard (P,)-int
+    capacity re-sync."""
+    from .arithmetics import _align_split
+
+    extent_old = s.shape[s._compressed_axis]
+    if s._dist:
+        comp, other, val, _, _, _, _ = _pl.rechunk_planes(
+            s._comp, s._other, s._val, s._lnnz_dev, s._lnnz_host,
+            extent_old, False, s._nshards, s._capacity, s._comp_pad, s.comm,
+        )
+    else:
+        comp, other, val = s._comp, s._other, s._val
+    extent_new = s.shape[1 - s._compressed_axis]
+    comp, other, val = _pl.recompress_planes(
+        comp, other, val, extent_old, extent_new, s.comm
+    )
+    gnnz = s.gnnz
+    lnnz_dev = jax.device_put(np.asarray([gnnz], np.int32), s.comm.sharding(None))
+    mat = cls(
+        (comp, other, val), lnnz_dev, (gnnz,), max(gnnz, 1), max(extent_new, 1),
+        s.shape, s.dtype, None, s.device, s.comm,
+    )
+    if split is not None:
+        mat = _align_split(mat, split)
+    return mat
+
+
+def to_sparse_csr(array) -> DCSR_matrix:
+    """DCSR from a dense DNDarray (sparse/manipulations.py:51) or from a
+    DCSC (triplet-preserving — the planes never round-trip a dense
+    buffer)."""
+    if isinstance(array, DCSR_matrix):
+        return array
+    if isinstance(array, DCSC_matrix):
+        return _convert_format(array, DCSR_matrix, 0 if array.split is not None else None)
     if not isinstance(array, DNDarray):
-        raise TypeError(f"expected a DNDarray, got {type(array)}")
+        raise TypeError(f"expected a DNDarray or sparse matrix, got {type(array)}")
     return sparse_csr_matrix(array, split=0 if array.split == 0 else None, comm=array.comm)
 
 
-def to_sparse_csc(array: DNDarray) -> DCSC_matrix:
-    """DCSC from a dense DNDarray (sparse/manipulations.py:78)."""
+def to_sparse_csc(array) -> DCSC_matrix:
+    """DCSC from a dense DNDarray (sparse/manipulations.py:78) or from a
+    DCSR (triplet-preserving)."""
+    if isinstance(array, DCSC_matrix):
+        return array
+    if isinstance(array, DCSR_matrix):
+        return _convert_format(array, DCSC_matrix, 1 if array.split is not None else None)
     if not isinstance(array, DNDarray):
-        raise TypeError(f"expected a DNDarray, got {type(array)}")
+        raise TypeError(f"expected a DNDarray or sparse matrix, got {type(array)}")
     return sparse_csc_matrix(array, split=1 if array.split == 1 else None, comm=array.comm)
 
 

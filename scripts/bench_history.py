@@ -424,6 +424,17 @@ def render_markdown(records: list, out_path: str) -> None:
         " circulates the dense operand past every shard (p reads of X),"
         " each CSR block streams once (f64 value + int32 column), the"
         " f64 output writes once |",
+        "| `spgemm_ring` | p·B_planes + r_max·(16 B/nnz_A) + 16 B/nnz_C"
+        " — B's (comp, other, val) triplet planes circulate past every"
+        " shard, each A entry expands to r_max partial triplets"
+        " (int32 keys + f32/f64 value) that sort/merge locally, and"
+        " only the canonical output triplets write back; no dense"
+        " (m/P, n) block ever exists (ISSUE 16 tentpole 1) |",
+        "| `fftn_2d` / `fftn_f64` | 2-D: 32 B/el — two axis passes"
+        " read + (re, im) write over f32 input; f64 doubles the element"
+        " size but NOT the pass count — the hi/lo split contraction"
+        " (three f32 dots per f64 dot) raises flops, not minimal bytes,"
+        " so the bytes model stays per-axis-pass · 2 · elsize |",
         "",
         "Each record also carries `model_gbytes_per_s` (the model over"
         " the measured time) so the anchored ratio is auditable against"

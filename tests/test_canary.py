@@ -111,14 +111,14 @@ def _drive(svc, n=40, rows=8):
 class TestComparator:
     def test_bitwise_exact_pass(self):
         a = np.arange(12, dtype=np.float32).reshape(4, 3)
-        out = cn.compare_batch("PCA", a, a.copy())
+        out = cn.compare_batch("Lasso", a, a.copy())
         assert out == {"rows": 4, "mismatched": 0, "max_rel_err": 0.0, "mode": "bitwise"}
 
     def test_bitwise_single_row_mismatch(self):
         a = np.arange(12, dtype=np.float32).reshape(4, 3)
         b = a.copy()
         b[2, 1] += 1e-6  # one ULP-ish wiggle is already a violation
-        out = cn.compare_batch("PCA", a, b)
+        out = cn.compare_batch("Lasso", a, b)
         assert out["mismatched"] == 1 and out["max_rel_err"] > 0.0
 
     def test_bitwise_dtype_change_fails_every_row(self):
@@ -156,7 +156,7 @@ class TestComparator:
         a = np.zeros((3, 2), np.float32)
         b = a.copy()
         b[1, 0] = np.nan
-        out = cn.compare_batch("PCA", a, b)
+        out = cn.compare_batch("Lasso", a, b)
         assert out["mismatched"] == 1
 
 
@@ -323,7 +323,7 @@ class TestDecisions:
 
     def test_bitwise_window_allows_zero_mismatches(self, make_service):
         svc = make_service(canary_version=2, min_rows=10_000)
-        st = cn._new_state("pca", "PCA", 2, 1, min_rows=10)
+        st = cn._new_state("lasso", "Lasso", 2, 1, min_rows=10)
         st["rows"], st["mismatched"] = 100, 1
         verdict, reasons = svc.canary._evaluate(st)
         assert verdict == "fail" and "bitwise" in reasons[0]

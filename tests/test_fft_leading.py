@@ -73,10 +73,15 @@ def test_eligibility_gates():
     re_odd = jax.numpy.zeros((7, 8, 8), jnp.float32)
     assert not _leading.leading_eligible(re_odd, [0, 1, 2], False)
     assert _leading.leading_eligible(re_odd, [0, 1, 2], True)
-    # wrong rank / dtype / partial axes
-    assert not _leading.leading_eligible(jnp.zeros((8, 8), jnp.float32), [0, 1], False)
-    assert not _leading.leading_eligible(
+    # 2-D and f64 ARE eligible since the round-3 generalization
+    assert _leading.leading_eligible(jnp.zeros((8, 8), jnp.float32), [0, 1], False)
+    assert _leading.leading_eligible(
         jnp.zeros((8, 8, 8), jnp.float64), [0, 1, 2], False
+    )
+    # wrong rank / dtype / partial axes
+    assert not _leading.leading_eligible(jnp.zeros((8,), jnp.float32), [0], True)
+    assert not _leading.leading_eligible(
+        jnp.zeros((8, 8, 8), jnp.int32), [0, 1, 2], True
     )
     assert not _leading.leading_eligible(re3, [0, 1], False)
 
@@ -229,3 +234,74 @@ def test_weight_cache_values_unchanged_by_eviction(monkeypatch):
             )
     finally:
         _leading.weight_cache_clear()
+
+# ----------------------------------------------------------------------
+# Round-3 generalization: 2-D, f64, pair-block complex stages
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("shape", [(8, 12), (16, 16), (2, 5), (32, 8)])
+@pytest.mark.parametrize("norm", [None, "ortho", "forward"])
+def test_rfft2_leading_matches_numpy(shape, norm):
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal(shape).astype(np.float32)
+    re, im = _leading.rfft2_leading(np.asarray(x), norm)
+    ref = np.fft.fftn(x.astype(np.float64), norm=norm)
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4
+
+
+@pytest.mark.parametrize("inverse", [False, True])
+@pytest.mark.parametrize("shape", [(8, 12), (6, 10, 14)])
+def test_cfftn_leading_matches_numpy(shape, inverse):
+    rng = np.random.default_rng(17)
+    xr = rng.standard_normal(shape).astype(np.float32)
+    xi = rng.standard_normal(shape).astype(np.float32)
+    re, im = _leading.cfftn_leading(np.asarray(xr), np.asarray(xi), inverse, None)
+    z = xr.astype(np.float64) + 1j * xi.astype(np.float64)
+    ref = np.fft.ifftn(z) if inverse else np.fft.fftn(z)
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert _rel(got, ref) < 5e-4
+
+
+@pytest.mark.parametrize("shape", [(8, 12), (6, 10, 14)])
+def test_leading_f64(shape):
+    """f64 runs the leading engine (native dots off-TPU) to ~1e-11."""
+    rng = np.random.default_rng(19)
+    xr = rng.standard_normal(shape)
+    xi = rng.standard_normal(shape)
+    re, im = _leading.cfftn_leading(np.asarray(xr), np.asarray(xi), False, None)
+    ref = np.fft.fftn(xr + 1j * xi)
+    assert _rel(np.asarray(re) + 1j * np.asarray(im), ref) < 1e-10
+    xe = rng.standard_normal((shape[0] - shape[0] % 2, shape[-1]))
+    re, im = _leading.rfft2_leading(np.asarray(xe), None)
+    assert _rel(np.asarray(re) + 1j * np.asarray(im), np.fft.fftn(xe)) < 1e-10
+
+
+def test_pair_stage_fused_matches_xla():
+    """The cat-output fused pair kernel (interpret mode off-TPU) agrees
+    with the XLA pair-block dot within the bf16x3 error class."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(23)
+    z = jnp.asarray(rng.standard_normal((128, 2, 2, 256)).astype(np.float32))
+    ref = _leading._stage_pair(z, 128, False, 1.0, jax.lax.Precision.HIGHEST)
+    got = _leading._stage_pair_fused(z, 128, False, 1.0)
+    assert _rel(np.asarray(got), np.asarray(ref)) < 1e-4
+    re = jnp.asarray(rng.standard_normal((128, 8, 32)).astype(np.float32))
+    im = jnp.asarray(rng.standard_normal((128, 8, 32)).astype(np.float32))
+    ze = _leading._entry_pair_fused(re, im, 128, False)
+    zx = _leading._dg0(re, _leading._w_cat(128, "float32", False, 1.0),
+                       jax.lax.Precision.HIGHEST) + \
+        _leading._dg0(im, _leading._w_cat_im(128, "float32", False, 1.0),
+                      jax.lax.Precision.HIGHEST)
+    assert _rel(np.asarray(ze), np.asarray(zx).reshape(8, 32, 2, 128)) < 1e-4
+
+
+def test_fft2_user_path_rides_leading():
+    """ht.fft 2-D and f64 inputs take the leading engine (no fallback)."""
+    rng = np.random.default_rng(29)
+    x = rng.standard_normal((8, 12)).astype(np.float32)
+    assert _rel(ht.fft.fft2(ht.array(x)).numpy(),
+                np.fft.fft2(x.astype(np.float64))) < 5e-4
+    z64 = rng.standard_normal((6, 10, 14)) + 1j * rng.standard_normal((6, 10, 14))
+    assert _rel(ht.fft.fftn(ht.array(z64)).numpy(), np.fft.fftn(z64)) < 1e-10

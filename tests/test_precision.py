@@ -250,8 +250,8 @@ class TestJ204:
         pp.set_predict_dtype("bfloat16")
         before = len([d for d in analysis.recent_diagnostics()
                       if d.rule == "J204"])
-        assert pp.compute_dtype("PCA") == "float32"  # bitwise: knob ignored
-        assert pp.compute_dtype("PCA") == "float32"
+        assert pp.compute_dtype("Lasso") == "float32"  # bitwise: knob ignored
+        assert pp.compute_dtype("Lasso") == "float32"
         after = [d for d in analysis.recent_diagnostics() if d.rule == "J204"]
         assert len(after) == before + 1  # warned once, not per call
         assert pp.compute_dtype("KMeans") == "bfloat16"  # tolerance: honored
@@ -386,7 +386,7 @@ class TestPoliciesRegistry:
         assert pp.active_policy() is None
         with pp.scope("KMeans"):
             assert pp.active_policy()["mode"] == "tolerance"
-            with pp.scope("PCA"):
+            with pp.scope("Lasso"):
                 assert pp.active_policy()["mode"] == "bitwise"
             assert pp.active_policy()["mode"] == "tolerance"
         assert pp.active_policy() is None
@@ -452,6 +452,59 @@ class TestBf16Predict:
         pp.set_predict_dtype("bfloat16")
         again = np.asarray(kmed.predict(x)._dense())
         np.testing.assert_array_equal(ref, again)  # bitwise: knob is inert
+
+    @staticmethod
+    def _labeled_blobs(n, k=4, f=8, spread=16.0, seed=7):
+        # labels = blob membership: every k-neighborhood is label-pure,
+        # so a bf16 near-tie that permutes WHICH same-blob neighbors are
+        # kept cannot change the vote — the label-bitwise contract is a
+        # statement about margins, not about exact neighbor identity
+        rng = np.random.default_rng(seed)
+        centers = rng.standard_normal((k, f)) * spread
+        assign = rng.integers(0, k, n)
+        x = centers[assign] + rng.standard_normal((n, f))
+        return x.astype(np.float32), assign.astype(np.int32)
+
+    def test_knn_bf16_labels_bitwise(self):
+        # the KNN tolerance contract covers the distance stage only: on
+        # margin-separated blobs the bf16 neighbor search must
+        # reproduce the predicted labels EXACTLY (ISSUE 16 satellite)
+        xd, lab_d = self._labeled_blobs(160)
+        x = ht.array(xd, split=None)
+        lab = ht.array(lab_d, split=None)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(x, lab)
+        ref = np.asarray(knn.predict(x)._dense())
+        np.testing.assert_array_equal(ref, lab_d)  # sane reference
+        pp.set_predict_dtype("bfloat16")
+        low = np.asarray(knn.predict(x)._dense())
+        np.testing.assert_array_equal(ref, low)
+
+    def test_knn_bf16_distributed_ring_labels_bitwise(self):
+        # split inputs take the ring-fused top-k; the lowp tile swap is
+        # part of its cache key, so both variants coexist compiled
+        if ht.WORLD.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        xd, lab_d = self._labeled_blobs(192, seed=13)
+        xs = ht.array(xd, split=0)
+        lab = ht.array(lab_d, split=0)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(xs, lab)
+        ref = np.asarray(knn.predict(xs)._dense())
+        pp.set_predict_dtype("bfloat16")
+        low = np.asarray(knn.predict(xs)._dense())
+        np.testing.assert_array_equal(ref, low)
+
+    def test_pca_transform_bf16_within_rtol(self):
+        x, _ = _blobs(n=256, f=8)
+        pca = ht.decomposition.PCA(n_components=4, svd_solver="full")
+        pca.fit(x)
+        ref = np.asarray(pca.transform(x)._dense())
+        pp.set_predict_dtype("bfloat16")
+        low = np.asarray(pca.transform(x)._dense())
+        assert low.dtype == np.float32  # accumulation stayed pinned f32
+        scale = np.abs(ref).max()
+        assert np.abs(ref - low).max() / scale < POLICIES["PCA"]["rtol"]
 
 
 # ----------------------------------------------------------------------
@@ -552,7 +605,7 @@ class TestDispatchHookPrecision:
         xb = jnp.ones((16, 8), jnp.bfloat16)
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
-            with pp.scope("PCA"):  # bitwise f32
+            with pp.scope("Lasso"):  # bitwise f32
                 dispatch.eager_apply(jnp.matmul, (xb, xb.T))
         got = rules(analysis.recent_diagnostics())
         assert "J203" in got and "J204" in got

@@ -1,0 +1,159 @@
+"""Output-sparse SpGEMM tests (ISSUE 16 tentpole 1 + test satellite).
+
+The contract under test (sparse/arithmetics.py ``_spgemm``):
+
+* sparse @ sparse values match the scipy reference across a
+  density x split x world-size grid, for CSR and CSC compressions and
+  mixed formats, through the triplet ring (``todense()`` never touches
+  a full operand);
+* route selection follows the estimated output density: below
+  ``HEAT_TPU_SPGEMM_DENSE_DENSITY`` the ring runs (the dense fallback is
+  never called), at dense-regime densities the GEMM-style fallback is;
+* the OOM regime: a product whose dense row block cannot fit the armed
+  ``HEAT_TPU_HBM_BUDGET_BYTES`` raises MemoryError on the dense route
+  while the output-sparse ring completes it — the allocation asymmetry
+  the tentpole exists for;
+* resumability: a transient fault at the ring's ``comm.collective``
+  nnz re-sync aborts the matmul cleanly (operands unmutated), and a
+  plain retry reproduces the reference values exactly.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.sparse import arithmetics as sa
+
+
+def _pair(m, k, n, da, db, seed, fmt="csr"):
+    a = sp.random(m, k, density=da, random_state=seed, format=fmt, dtype=np.float64)
+    b = sp.random(k, n, density=db, random_state=seed + 1, format=fmt, dtype=np.float64)
+    return a, b
+
+
+def _as_ht(mat, split):
+    if mat.format == "csc":
+        return ht.sparse.sparse_csc_matrix(mat, split=split)
+    return ht.sparse.sparse_csr_matrix(mat, split=split)
+
+
+def _est_density(a, b):
+    cells = float(a.shape[0]) * float(a.shape[1]) * float(b.shape[1])
+    return 1.0 - float(np.exp(-float(a.nnz) * float(b.nnz) / cells))
+
+
+# ----------------------------------------------------------------------
+# value grid: density x split (world-size P vs 1) x format
+# ----------------------------------------------------------------------
+class TestValueGrid:
+    # split=0 runs the full P-device ring (world size = the conftest
+    # mesh), split=None the single-shard program — the two world sizes
+    # a virtual-device session can drive
+    @pytest.mark.parametrize("split", [0, None])
+    @pytest.mark.parametrize("density", [0.001, 0.02, 0.2])
+    def test_csr_csr(self, density, split, monkeypatch):
+        a, b = _pair(240, 168, 200, density, density, seed=int(density * 1000))
+        if _est_density(a, b) < 0.5:
+            # sub-threshold: the ring must carry it alone
+            monkeypatch.setattr(sa, "_spgemm_dense", _forbidden_dense)
+        c = _as_ht(a, split) @ _as_ht(b, split)
+        assert isinstance(c, ht.sparse.DCSR_matrix)
+        np.testing.assert_allclose(c.toarray(), (a @ b).toarray(), rtol=1e-10, atol=1e-12)
+
+    @pytest.mark.parametrize("density", [0.002, 0.05])
+    def test_csc_csc(self, density):
+        a, b = _pair(150, 200, 120, density, density, seed=29, fmt="csc")
+        c = _as_ht(a, 1) @ _as_ht(b, 1)
+        assert isinstance(c, ht.sparse.DCSC_matrix)
+        np.testing.assert_allclose(c.toarray(), (a @ b).toarray(), rtol=1e-10, atol=1e-12)
+
+    def test_mixed_formats(self):
+        a = sp.random(130, 170, density=0.02, random_state=5, format="csr", dtype=np.float64)
+        b = sp.random(170, 90, density=0.02, random_state=6, format="csc", dtype=np.float64)
+        ref = (a @ b).toarray()
+        got = _as_ht(a, 0) @ _as_ht(b, 1)
+        np.testing.assert_allclose(got.toarray(), ref, rtol=1e-10, atol=1e-12)
+        got2 = _as_ht(b.tocsc().T.tocsr(), 0)  # sanity: transpose identity
+        np.testing.assert_allclose(got2.toarray(), b.toarray().T, rtol=1e-12)
+
+    def test_dense_regime_takes_fallback(self, monkeypatch):
+        # 0.3 x 0.3 on a small cube -> estimated density ~1: the ring's
+        # partial-triplet traffic loses and the GEMM route must run
+        a, b = _pair(64, 64, 64, 0.3, 0.3, seed=41)
+        assert _est_density(a, b) >= 0.5
+        calls = []
+        orig = sa._spgemm_dense
+        monkeypatch.setattr(
+            sa, "_spgemm_dense", lambda x, y: calls.append(1) or orig(x, y)
+        )
+        c = _as_ht(a, 0) @ _as_ht(b, 0)
+        assert calls == [1]
+        np.testing.assert_allclose(c.toarray(), (a @ b).toarray(), rtol=1e-10, atol=1e-12)
+
+
+def _forbidden_dense(a, b):  # pragma: no cover - failure path
+    raise AssertionError("dense fallback reached below the density threshold")
+
+
+# ----------------------------------------------------------------------
+# the OOM regime (acceptance: dense raises, output-sparse succeeds)
+# ----------------------------------------------------------------------
+def test_oom_regime_dense_raises_output_sparse_succeeds(monkeypatch):
+    # 2^20 x 2^20 output: the dense fallback's per-device row block is
+    # ~2 TiB -- unallocatable under any real budget -- while the ring's
+    # peak is O(Ca * r_max) partial triplets
+    m = k = n = 1 << 20
+
+    def _rand_coo(rows, cols, nnz, seed):
+        # sp.random can't sample 2^40 cells without replacement; explicit
+        # deduped COO triplets sidestep the dense index permutation
+        rng = np.random.default_rng(seed)
+        r = rng.integers(0, rows, nnz)
+        c = rng.integers(0, cols, nnz)
+        keep = np.unique(np.stack([r, c], 1), axis=0)
+        v = rng.standard_normal(len(keep))
+        return sp.coo_matrix(
+            (v, (keep[:, 0], keep[:, 1])), shape=(rows, cols)
+        ).tocsr()
+
+    a = _rand_coo(m, k, 2000, seed=3)
+    # share index space so the product has nonzeros to check
+    b = sp.csr_matrix(
+        (np.abs(a.tocoo().data) + 0.5, (a.tocoo().col, a.tocoo().row)),
+        shape=(k, n),
+    )
+    A, B = _as_ht(a, 0), _as_ht(b, 0)
+    monkeypatch.setenv("HEAT_TPU_HBM_BUDGET_BYTES", str(1 << 30))  # 1 GiB
+    with pytest.raises(MemoryError, match="dense SpGEMM fallback"):
+        sa._spgemm_dense(A, B)
+    C = A @ B  # the auto route: est density ~0 -> ring, budget still armed
+    ref = (a @ b).tocsr()
+    ref.sum_duplicates()
+    np.testing.assert_array_equal(np.asarray(C.indptr), ref.indptr)
+    np.testing.assert_array_equal(np.asarray(C.indices), ref.indices)
+    np.testing.assert_allclose(np.asarray(C.data), ref.data, rtol=1e-10)
+
+
+# ----------------------------------------------------------------------
+# resumability under the existing fault sites
+# ----------------------------------------------------------------------
+def test_spgemm_resumes_after_transient_collective_fault():
+    a, b = _pair(200, 160, 180, 0.02, 0.02, seed=17)
+    A, B = _as_ht(a, 0), _as_ht(b, 0)
+    ref = (a @ b).toarray()
+    with rz.fault_plan(
+        {"comm.collective": [{"at": 1, "kind": "transient"}]}
+    ) as inj:
+        with pytest.raises(rz.TransientFault) as e:
+            A @ B
+        assert e.value.site == "comm.collective"
+        # the ring loop holds no mutable operand state: the same call
+        # retried inside the same plan reproduces the reference exactly
+        c = A @ B
+        np.testing.assert_allclose(c.toarray(), ref, rtol=1e-10, atol=1e-12)
+    assert inj.injected["comm.collective"] == [(1, "transient")]
+    # operands survived the abort untouched
+    np.testing.assert_allclose(A.toarray(), a.toarray(), rtol=1e-12)
+    np.testing.assert_allclose((A @ B).toarray(), ref, rtol=1e-10, atol=1e-12)
