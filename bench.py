@@ -1665,6 +1665,128 @@ def bench_analysis(ht, sync_floor, roofline=None):
     }
 
 
+def bench_streaming(ht, sync_floor, roofline=None):
+    """Config 12b: streaming continuous learning (ISSUE 17).
+
+    Two operational numbers.  **Sustained ingest** — a producer thread
+    appends to a durable :class:`FileSegmentLog` while a streaming
+    KMeans consumes full windows through the prefetched consumer with
+    exactly-once offset commits riding every 8th window; reported MB/s
+    is bytes folded into the model over the whole concurrent run
+    (append + atomic segment commits + checksum-verified reads + device
+    staging + minibatch update + offset checkpoints, end to end).
+    **Model staleness** — how stale a served model gets before the
+    continuous-learning loop replaces it: covariate drift is injected
+    under live traffic and the clock runs from the first drifted batch
+    served to the refreshed canary auto-promoting (drift detection +
+    online re-fit + save with fresh baseline + shadow compare + promote).
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from heat_tpu import serving as srv
+    from heat_tpu.serving import canary as cnry
+    from heat_tpu.streaming import FileSegmentLog, RefreshDriver, StreamingKMeans
+    from heat_tpu.telemetry import alerts as _al
+    from heat_tpu.telemetry import sketch as _sk
+
+    # -- sustained ingest ------------------------------------------------
+    window, feat, n_windows = 256, 16, 160
+    total_bytes = n_windows * window * feat * 4
+    d = tempfile.mkdtemp(prefix="heat_tpu_bench_streaming_")
+    try:
+        log = FileSegmentLog(os.path.join(d, "log"), segment_rows=2048)
+
+        def produce():
+            rng = np.random.default_rng(0)
+            for _ in range(n_windows // 8):
+                log.append(rng.standard_normal((window * 8, feat)).astype(np.float32))
+
+        producer = threading.Thread(target=produce, daemon=True)
+        ck = os.path.join(d, "ck")
+        km = StreamingKMeans(n_clusters=8, window_rows=window, commit_every=8,
+                             checkpoint_dir=ck, resume_from=ck)
+        t0 = time.perf_counter()
+        producer.start()
+        while log.size < window:
+            time.sleep(0.001)  # seed window: the init state peeks it
+        while km.n_windows_ < n_windows:  # dry head pauses the fit; resume it
+            before = km.n_windows_
+            km.fit_stream(log, max_windows=n_windows)
+            if km.n_windows_ == before:
+                time.sleep(0.001)  # producer hasn't landed a full window yet
+        ingest_s = time.perf_counter() - t0
+        producer.join(timeout=30)
+        ingest_mbs = total_bytes / 1e6 / ingest_s
+
+        # -- model staleness ---------------------------------------------
+        centers = np.array([[0.0] * feat, [40.0] * feat, [80.0] * feat], np.float32)
+
+        def rows_of(n, rng, shift=0.0):
+            labels = np.arange(n) % 3
+            return (centers[labels]
+                    + rng.standard_normal((n, feat)).astype(np.float32) * 0.5
+                    + np.float32(shift)).astype(np.float32)
+
+        log2 = FileSegmentLog(os.path.join(d, "log2"), segment_rows=1024)
+        log2.append(rows_of(64 * 8, np.random.default_rng(1)))
+        ck2 = os.path.join(d, "ck2")
+        km2 = StreamingKMeans(n_clusters=3, window_rows=64, commit_every=1,
+                              checkpoint_dir=ck2, resume_from=ck2)
+        km2.fit_stream(log2)
+        sk = _sk.ModelSketch("stream_km", feat)
+        sk.update(km2.recent_window_)
+        md = os.path.join(d, "models")
+        srv.save_model(km2.to_estimator(), md, version=1, name="stream_km",
+                       baseline=sk.doc())
+        svc = srv.InferenceService(max_delay_ms=1.0, max_batch=64)
+        svc.load("stream_km", md, version=1)
+        svc.canary.fraction = 1.0
+        svc.canary.min_rows = 48
+
+        def fitter():
+            log2.append(rows_of(64 * 4, np.random.default_rng(2), shift=4.0))
+            fresh = StreamingKMeans(n_clusters=3, window_rows=64, commit_every=1,
+                                    checkpoint_dir=ck2, resume_from=ck2)
+            return fresh.fit_stream(log2)
+
+        drv = RefreshDriver(svc, "stream_km", md, fitter)
+        rng = np.random.default_rng(9)
+        t1 = time.perf_counter()
+        deadline = t1 + 120.0
+        refreshed_at = None
+        while time.perf_counter() < deadline:
+            svc.predict("stream_km", rows_of(8, rng, shift=4.0))
+            out = drv.check()
+            if out == "refreshed" and refreshed_at is None:
+                refreshed_at = time.perf_counter() - t1
+            if svc.registry.active_version("stream_km") == 2:
+                break
+        staleness_s = time.perf_counter() - t1
+        promoted = svc.registry.active_version("stream_km") == 2
+        svc.close()
+        return {
+            "metric": "streaming_ingest_mbs",
+            "value": round(ingest_mbs, 2),
+            "unit": "MB/s",
+            "vs_baseline": 0.0,
+            "vs_baseline_kind": "durable_log_to_model_sustained",
+            "ingest_windows": n_windows,
+            "ingest_bytes": total_bytes,
+            "ingest_s": round(ingest_s, 3),
+            "staleness_s": round(staleness_s, 3),
+            "refresh_s": round(refreshed_at, 3) if refreshed_at is not None else None,
+            "staleness_promoted": promoted,
+        }
+    finally:
+        cnry.reset_canary_state()
+        _al.clear_alerts()
+        _sk.SKETCHES.clear()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -1679,7 +1801,8 @@ def main() -> None:
         print(json.dumps({"metric": "roofline", "error": f"{type(e).__name__}: {e}"[:200]}), flush=True)
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
                   bench_dispatch, bench_resilience, bench_overlap, bench_telemetry,
-                  bench_analysis, bench_serving, bench_canary, bench_fleet):
+                  bench_analysis, bench_serving, bench_canary, bench_streaming,
+                  bench_fleet):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

@@ -38,6 +38,7 @@ from . import sparse
 from . import telemetry
 from . import utils
 from . import datasets
+from . import streaming
 
 communication = parallel  # API-parity alias for heat.core.communication
 

@@ -211,6 +211,18 @@ LOCK_REGISTRY = {
         "structures": ("fleet.autoscaler.state",),
         "doc": "FleetAutoscaler hysteresis counters + last-decision record: mutated by the tick thread, read by /fleet/statusz handler threads and tests",
     },
+    "streaming.segment_log": {
+        "file": "heat_tpu/streaming/source.py",
+        "spellings": ("self._lock",),
+        "structures": ("streaming.segment_log.index",),
+        "doc": "FileSegmentLog in-memory segment index (start offset -> file) + cached end offset: append() runs on producer threads (bench ingest, refresh drivers) while read()/size rescan from consumer threads; segment files themselves are immutable once atomically renamed in, so reads outside the lock see only committed bytes",
+    },
+    "streaming.refresh": {
+        "file": "heat_tpu/streaming/refresh.py",
+        "spellings": ("self._lock",),
+        "structures": ("streaming.refresh.state",),
+        "doc": "RefreshDriver lifecycle + last-refresh record (cooldown clock, saved versions, in-flight flag): check() fires from the poll thread or any caller, close() from the owner; the fit/save/load work itself always runs outside it",
+    },
 }
 
 

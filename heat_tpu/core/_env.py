@@ -147,6 +147,13 @@ KNOBS = {
     "HEAT_TPU_SERVE_QUEUE_DEPTH": ("int", "256", "admission bound: rows queued-or-in-flight across the service before requests shed with OverloadedError/429"),
     "HEAT_TPU_SERVE_RATE": ("float", "0", "default per-tenant token-bucket refill (rows/s); 0 = unlimited (tenants without an explicit set_quota are not rate-limited)"),
     "HEAT_TPU_SERVE_BURST": ("float", "64", "default per-tenant token-bucket burst capacity (rows)"),
+    # -- streaming (heat_tpu/streaming, docs/streaming.md) --------------
+    "HEAT_TPU_STREAM_WINDOW": ("int", "256", "rows per stream fit window (the resumable-fit chunk unit of the online estimators); windows are fixed-size so a resumed consumer replays the identical window sequence from its committed offset"),
+    "HEAT_TPU_STREAM_SEGMENT_ROWS": ("int", "4096", "rows per segment file of the file-backed stream log (FileSegmentLog append granularity; reads may span segments)"),
+    "HEAT_TPU_STREAM_PREFETCH": ("int", "2", "device-staging look-ahead depth (windows) of the stream consumer's prefetch_to_device pipeline from the stream head"),
+    "HEAT_TPU_STREAM_COMMIT_EVERY": ("int", "1", "stream windows per atomic offset+model checkpoint commit of an online fit (the kill+resume replay granularity)"),
+    "HEAT_TPU_STREAM_RESHARD_PSI": ("float", "0.25", "PSI of the incoming key distribution (rolling recent windows vs the accumulated stable reference) above which the consumer triggers a windowed reshard of split-axis staging"),
+    "HEAT_TPU_STREAM_REFRESH_MIN_S": ("float", "0", "cooldown (seconds) between drift-triggered model refreshes of the same model; 0 = refresh on every firing drift alert check"),
     # -- overlap / nn (docs/overlap.md) ---------------------------------
     "HEAT_TPU_ASYNC_CKPT": ("bool", "1", "asynchronous checkpoint writes in resumable fits (0 = fully synchronous saves)"),
     "HEAT_TPU_GRAD_BUCKET_MB": ("float", "4", "byte bound (MiB) of one bucketed gradient-reduction psum"),

@@ -55,6 +55,7 @@ def resumable_fit_loop(
     site: str = "estimator.iter",
     what: str = "iterate",
     converged_when: Optional[Callable[[float, float], bool]] = None,
+    exhausted_converges: bool = True,
 ) -> Tuple[object, int]:
     """Drive an on-device fit loop in resumable, divergence-guarded chunks.
 
@@ -88,6 +89,16 @@ def resumable_fit_loop(
     finds chunk *k* durable, exactly like the synchronous loop) and the
     loop never returns before its final checkpoint is committed.
     ``HEAT_TPU_ASYNC_CKPT=0`` restores fully synchronous saves.
+
+    ``exhausted_converges`` controls what a short chunk (``iters_run <
+    n``) means.  For the finite fits it means the device loop's own stop
+    test fired inside the chunk — converged (the default).  The online
+    estimators (heat_tpu/streaming, chunk = stream window) set it False:
+    a short chunk there means the stream head ran dry, so the loop
+    checkpoints ``converged=False`` and returns — a later call with the
+    same directory resumes and keeps consuming where the committed
+    offset (inside ``state``) left off, instead of early-returning on a
+    fit that never actually converged.
     """
     import os as _os
     import sys as _sys
@@ -180,12 +191,19 @@ def resumable_fit_loop(
                     f"non-finite values in {what} at iteration {total} — the fit "
                     f"has diverged; last finite {what} is at iteration {last_good[1]}",
                     iteration=total,
-                    last_good=np.asarray(last_good[0]),
+                    # dict (pytree) states pass through structured; array
+                    # states convert like before
+                    last_good=(
+                        last_good[0]
+                        if isinstance(last_good[0], dict)
+                        else np.asarray(last_good[0])
+                    ),
                     last_good_iteration=last_good[1],
                 )
             state = new_state
             stop_test = converged_when if converged_when is not None else (lambda s, t: s <= t)
-            converged = stop_test(shift, tol) or iters < n
+            short_chunk = iters < n
+            converged = stop_test(shift, tol) or (exhausted_converges and short_chunk)
             if ckpt is not None:
                 ckpt.save(
                     total,
@@ -196,7 +214,11 @@ def resumable_fit_loop(
                         "converged": bool(converged),
                     },
                 )
-            if converged:
+            if converged or short_chunk:
+                # a short chunk always ends the loop; with
+                # exhausted_converges=False it ends it PAUSED (the
+                # checkpoint above committed converged=False, so a
+                # resume keeps going when more stream data arrives)
                 break
             last_good = (state, total)
     finally:

@@ -112,6 +112,9 @@ KNOWN_SITES = (
     "aot.save",
     "fleet.route",
     "fleet.spawn",
+    "stream.read",
+    "stream.commit",
+    "stream.refresh",
 )
 
 #: process-lifetime totals (survive injector deactivation) — registered

@@ -31,9 +31,16 @@ def _as_array(x):
 
 
 def all_finite(x) -> bool:
-    """Host bool: every element of ``x`` (array / DNDarray / pytree leaf
-    list) is finite.  Forces a device sync — call at checkpoint cadence,
-    not per iteration."""
+    """Host bool: every element of ``x`` (array / DNDarray / dict /
+    list / tuple pytree) is finite.  Containers recurse leaf-wise — the
+    streaming fits carry dict states (model arrays + the committed
+    stream offset) through :func:`resumable_fit_loop`, and a NaN in any
+    leaf must trip the divergence guard.  Forces a device sync — call at
+    checkpoint cadence, not per iteration."""
+    if isinstance(x, dict):
+        return all(all_finite(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return all(all_finite(v) for v in x)
     arr = _as_array(x)
     if not hasattr(arr, "dtype"):
         arr = np.asarray(arr)

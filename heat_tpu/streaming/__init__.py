@@ -1,0 +1,23 @@
+"""Streaming continuous learning (docs/streaming.md).
+
+Exactly-once ingest over replayable sources, online estimator fits with
+bitwise kill+resume (offset committed atomically with model state), and
+the drift-triggered refresh driver that feeds the serving decision
+plane a freshly trained canary.
+"""
+
+from .consumer import StreamConsumer
+from .online import StreamingKMeans, StreamingLasso, StreamingPCA
+from .refresh import RefreshDriver
+from .source import FileSegmentLog, StreamSource, SyntheticStream
+
+__all__ = [
+    "FileSegmentLog",
+    "RefreshDriver",
+    "StreamConsumer",
+    "StreamSource",
+    "StreamingKMeans",
+    "StreamingLasso",
+    "StreamingPCA",
+    "SyntheticStream",
+]

@@ -1,0 +1,271 @@
+"""Stream consumer: fixed-size windows, device staging, key-drift
+resharding (docs/streaming.md).
+
+The consumer is the bridge between a replayable :class:`StreamSource`
+and the online fits: it cuts the stream into FIXED-SIZE windows (the
+resumable-fit chunk unit — fixed size is what makes the window sequence
+a pure function of the committed offset), stages them shard-aware
+through :func:`~heat_tpu.utils.data.prefetch.prefetch_to_device` from
+the stream head, and watches the key-column distribution across windows
+— when it drifts past ``HEAT_TPU_STREAM_RESHARD_PSI``, the next
+``maybe_reshard`` call rebalances the caller's persistent split-axis
+state (``balance_`` within the mesh, ``reshard_`` across meshes).
+
+Reads run under the io retry policy with the ``stream.read`` fault site
+evaluated per attempt, so a scripted transient is absorbed exactly like
+an io transient.  The consumer is single-threaded by contract (like the
+data loaders): one fit drives it; producers append to the source from
+any thread/process.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.faults import inject
+from ..resilience.retry import default_io_policy
+from ..telemetry import metrics as _tm
+from ..telemetry.spans import span as _span
+from .source import StreamSource
+
+__all__ = ["StreamConsumer"]
+
+_WINDOWS = _tm.counter("stream.windows")
+_ROWS = _tm.counter("stream.rows")
+_SEEKS = _tm.counter("stream.seeks")
+_RESHARDS = _tm.counter("stream.reshards")
+
+
+def _key_hist(vals: np.ndarray) -> Dict[int, int]:
+    """Signed full-decade magnitude buckets of the key column.
+
+    Deliberately COARSER than the drift sketches' half-decade ladder:
+    this histogram scores window-size samples (hundreds of rows, not
+    the sketch monitor's 200+ row floor over whole traffic), and the
+    reshard trigger wants robustness against sampling noise, not
+    resolution — a key shift worth redistributing the split axis for
+    moves whole decades."""
+    v = np.asarray(vals, dtype=np.float64).ravel()
+    v = v[np.isfinite(v)]  # non-finite keys are the divergence guard's problem
+    out: Dict[int, int] = {}
+    tiny = np.abs(v) < 1e-9
+    n_tiny = int(tiny.sum())
+    if n_tiny:
+        out[0] = n_tiny
+    v = v[~tiny]
+    if v.size:
+        mag = np.clip(np.floor(np.log10(np.abs(v))), -8, 8).astype(np.int64)
+        signed = np.where(v >= 0, mag + 10, -(mag + 10))
+        keys, counts = np.unique(signed, return_counts=True)
+        for k, c in zip(keys.tolist(), counts.tolist()):
+            out[int(k)] = out.get(int(k), 0) + int(c)
+    return out
+
+
+def _psi(ref: Dict[int, int], cur: Dict[int, int]) -> float:
+    """Population stability index between two bucket histograms."""
+    eps = 1e-4
+    ref_n = max(sum(ref.values()), 1)
+    cur_n = max(sum(cur.values()), 1)
+    score = 0.0
+    for k in set(ref) | set(cur):
+        p = max(ref.get(k, 0) / ref_n, eps)
+        q = max(cur.get(k, 0) / cur_n, eps)
+        score += (q - p) * np.log(q / p)
+    return float(score)
+
+
+class StreamConsumer:
+    """Windowed, prefetched, replayable view over a stream source.
+
+    ``next_window(offset)`` returns ``(offset, staged_rows)`` for the
+    full window starting at ``offset`` or ``None`` while the head holds
+    fewer than ``window_rows`` committed rows (partial windows are never
+    consumed — they would make the window sequence depend on arrival
+    timing and break bitwise replay).  Sequential offsets ride the
+    prefetch pipeline; a non-sequential offset (a resume) reseeks it.
+    """
+
+    def __init__(
+        self,
+        source: StreamSource,
+        window_rows: Optional[int] = None,
+        comm=None,
+        key_col: int = 0,
+        prefetch: Optional[int] = None,
+        reshard_psi: Optional[float] = None,
+        reshard_check: bool = True,
+        reshard_window: int = 4,
+    ):
+        from ..core._env import env_float, env_int
+        from ..parallel.comm import sanitize_comm
+
+        self.source = source
+        self.window_rows = int(window_rows if window_rows is not None
+                               else env_int("HEAT_TPU_STREAM_WINDOW", 256))
+        if self.window_rows < 1:
+            raise ValueError(f"window_rows must be >= 1, got {self.window_rows}")
+        self.comm = sanitize_comm(comm)
+        self.key_col = int(key_col)
+        self.prefetch = int(prefetch if prefetch is not None
+                            else env_int("HEAT_TPU_STREAM_PREFETCH", 2))
+        self.reshard_psi = float(reshard_psi if reshard_psi is not None
+                                 else env_float("HEAT_TPU_STREAM_RESHARD_PSI", 0.25))
+        self.reshard_check = bool(reshard_check)
+        self.reshard_window = int(reshard_window)
+        if self.reshard_window < 1:
+            raise ValueError(f"reshard_window must be >= 1, got {self.reshard_window}")
+        self.reshard_events = 0
+        self.last_key_psi: Optional[float] = None
+        # drift monitor state: an ACCUMULATED reference histogram of the
+        # confirmed-stable history vs a ROLLING current one of the last
+        # ``reshard_window`` windows — single-window PSI at typical
+        # window sizes is dominated by sampling noise, the rolling form
+        # is not (same smoothing the sketch-based model monitor gets
+        # from its much larger live sample)
+        self._key_ref: Dict[int, int] = {}
+        self._ref_windows = 0
+        self._key_recent: "deque" = deque()
+        self._needs_reshard = False
+        self._pipe: Optional[Iterator] = None
+        self._pipe_offset: Optional[int] = None
+
+    @property
+    def n_features(self) -> Optional[int]:
+        return self.source.n_features
+
+    # -- raw reads ------------------------------------------------------
+    def _read_full_window(self, offset: int) -> Optional[np.ndarray]:
+        """One full window at ``offset`` through retry + fault site, or
+        None while the committed head holds fewer rows."""
+        need = self.window_rows
+
+        def attempt():
+            inject("stream.read", offset=offset)
+            return self.source.read(offset, need)
+
+        rows = default_io_policy().call(attempt)
+        if rows.shape[0] < need:
+            return None
+        return rows
+
+    def peek(self, offset: int) -> Optional[np.ndarray]:
+        """A full window at ``offset`` WITHOUT consuming it (no pipeline
+        advance, no key-hist fold) — the online estimators' state
+        initializers read their seed window through this."""
+        return self._read_full_window(offset)
+
+    # -- key-distribution drift across the split axis -------------------
+    @staticmethod
+    def _merge_hist(into: Dict[int, int], hist: Dict[int, int]) -> None:
+        for k, c in hist.items():
+            into[k] = into.get(k, 0) + c
+
+    def _fold_keys(self, rows: np.ndarray) -> None:
+        if not self.reshard_check:
+            return
+        hist = _key_hist(rows[:, self.key_col])
+        r = self.reshard_window
+        if self._ref_windows < r:
+            # warm-up: the first windows ARE the reference
+            self._merge_hist(self._key_ref, hist)
+            self._ref_windows += 1
+            return
+        self._key_recent.append(hist)
+        if len(self._key_recent) > r:
+            # the window falling out of the rolling view was stable:
+            # graduate it into the accumulated reference
+            self._merge_hist(self._key_ref, self._key_recent.popleft())
+            self._ref_windows += 1
+        if len(self._key_recent) < r:
+            return
+        cur: Dict[int, int] = {}
+        for h in self._key_recent:
+            self._merge_hist(cur, h)
+        score = _psi(self._key_ref, cur)
+        self.last_key_psi = score
+        if score > self.reshard_psi:
+            # re-anchor by re-entering warm-up: the rolling view that
+            # tripped straddles the transition, so the NEXT windows
+            # (fully post-shift for a step change) become the new
+            # reference — one sustained shift triggers exactly one
+            # reshard, not one per window
+            self._key_ref = {}
+            self._ref_windows = 0
+            self._key_recent.clear()
+            self.reshard_events += 1
+            self._needs_reshard = True
+            _RESHARDS.inc()
+
+    def maybe_reshard(self, dnd=None) -> bool:
+        """Apply a pending key-drift reshard to the caller's persistent
+        split-axis array (in place): ``balance_`` re-levels the canonical
+        split distribution within the mesh; when the array lives on a
+        different comm (an elastic reshape happened under the fit),
+        ``reshard_`` moves it first.  Returns True when a reshard was
+        pending (whether or not an array was passed)."""
+        if not self._needs_reshard:
+            return False
+        self._needs_reshard = False
+        if dnd is not None:
+            with _span("stream.reshard", rows=int(dnd.shape[0])):
+                if dnd.comm is not self.comm:
+                    dnd.reshard_(self.comm)
+                dnd.balance_()
+        return True
+
+    # -- the prefetched window pipeline ---------------------------------
+    def _raw_windows(self, offset: int) -> Iterator[Tuple[int, np.ndarray]]:
+        off = offset
+        while True:
+            rows = self._read_full_window(off)
+            if rows is None:
+                return
+            self._fold_keys(rows)
+            _WINDOWS.inc()
+            _ROWS.inc(rows.shape[0])
+            yield off, rows
+            off += self.window_rows
+
+    def _reseek(self, offset: int) -> None:
+        from ..utils.data.prefetch import prefetch_to_device, sharding_for_batch
+
+        self.close()
+        sharding = sharding_for_batch(self.window_rows, self.comm)
+        self._pipe = prefetch_to_device(
+            self._raw_windows(offset), size=self.prefetch, sharding=sharding
+        )
+        self._pipe_offset = offset
+        _SEEKS.inc()
+
+    def next_window(self, offset: int):
+        """``(offset, device_staged_rows)`` for the full window at
+        ``offset``, or None while the stream head is short of one."""
+        if self._pipe is None or self._pipe_offset != offset:
+            self._reseek(offset)
+        try:
+            out = next(self._pipe)
+        except StopIteration:
+            # head ran dry mid-pipeline; drop it so a later call (after
+            # the producer appended more) rebuilds from this offset
+            self.close()
+            return None
+        self._pipe_offset = offset + self.window_rows
+        return out
+
+    def close(self) -> None:
+        """Release the prefetch pipeline (never drains an unbounded
+        head — see ``_DevicePrefetcher.close``).  Idempotent."""
+        pipe, self._pipe = self._pipe, None
+        self._pipe_offset = None
+        if pipe is not None:
+            pipe.close()
+
+    def __enter__(self) -> "StreamConsumer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,205 @@
+"""Drift-triggered live model refresh (docs/streaming.md).
+
+The last leg of the continuous-learning loop: when the drift monitor
+fires ``drift:<model>`` (live traffic no longer matches the model's
+training distribution), the refresh driver re-fits from the recent
+stream, saves the result as the next model version **carrying a fresh
+input baseline built from its own recent training window**, and loads
+it as a canary (``activate=False``).  From there the PR 15 decision
+plane takes over: shadow comparison runs under the live traffic, the
+firing drift alert *vetoes* promotion (holds the verdict), and once the
+re-warmed live sketch scores clean against the fresh baseline the alert
+resolves, the held verdict re-evaluates, and the canary auto-promotes —
+``promote`` re-attaches the same persisted baseline, so the alert stays
+resolved instead of re-firing against the stale distribution.
+
+Nothing here blocks serving: the fit/save/load work runs outside the
+driver's lock, the canary loads hot, and promotion is the registry's
+atomic pointer swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from ..analysis import tsan as _tsan
+from ..resilience.faults import inject
+from ..telemetry import alerts as _alerts
+from ..telemetry import metrics as _tm
+from ..telemetry.sketch import SKETCHES, ModelSketch, check_drift
+from ..telemetry.spans import span as _span
+from ..utils.checkpoint import Checkpointer
+
+__all__ = ["RefreshDriver"]
+
+_REFRESHES = _tm.counter("stream.refreshes")
+
+
+class RefreshDriver:
+    """Watches ``drift:<model>`` and answers it with a canary refresh.
+
+    ``fitter`` is the caller's re-fit recipe: a zero-argument callable
+    returning either a fitted streaming estimator (anything with
+    ``to_estimator()`` and ``recent_window_`` — the online estimators)
+    or an explicit ``(servable_estimator, recent_rows)`` pair.  The
+    driver never owns the stream: the fitter decides what "recent"
+    means (typically: resume the online fit to the head and hand back
+    its last window).
+
+    ``check()`` is the whole state machine and is safe to call from
+    anywhere (the serving poll loop, a test, the built-in background
+    thread started by :meth:`start`):
+
+    * no firing drift alert -> ``"idle"``
+    * a canary already resident, or inside the refresh cooldown
+      (``HEAT_TPU_STREAM_REFRESH_MIN_S``) -> ``"pending"`` (the decision
+      plane / clock owns the next transition)
+    * otherwise -> re-fit, ``save_model(..., baseline=fresh)``, swap the
+      live drift baseline to the fresh one, reset the live sketch (the
+      alert resolves once re-warmed traffic scores clean), hot-load the
+      canary -> ``"refreshed"``
+    """
+
+    def __init__(
+        self,
+        service,
+        model: str,
+        directory: str,
+        fitter: Callable,
+        min_interval_s: Optional[float] = None,
+        comm=None,
+    ):
+        from ..core._env import env_float
+
+        self.service = service
+        self.model = str(model)
+        self.directory = str(directory)
+        self.fitter = fitter
+        self.min_interval_s = float(
+            min_interval_s if min_interval_s is not None
+            else env_float("HEAT_TPU_STREAM_REFRESH_MIN_S", 0.0)
+        )
+        self.comm = comm
+        self._lock = _tsan.register_lock("streaming.refresh")
+        self._last_refresh_mono: Optional[float] = None
+        self._in_flight = False
+        self.last_version: Optional[int] = None
+        self.refreshes = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the state machine ----------------------------------------------
+    def check(self) -> str:
+        """One drift->refresh evaluation; returns what happened."""
+        check_drift()  # refresh alert state from the live sketches first
+        if not _alerts.is_firing(f"drift:{self.model}", labels={"model": self.model}):
+            return "idle"
+        now = time.monotonic()
+        with self._lock:
+            _tsan.note_access("streaming.refresh.state")
+            if self._in_flight:
+                return "pending"
+            if self.service.registry.canary_version(self.model) is not None:
+                return "pending"  # decision plane owns the next transition
+            if (
+                self._last_refresh_mono is not None
+                and self.min_interval_s > 0
+                and now - self._last_refresh_mono < self.min_interval_s
+            ):
+                return "pending"
+            self._in_flight = True
+        try:
+            self._refresh()
+        finally:
+            with self._lock:
+                _tsan.note_access("streaming.refresh.state")
+                self._in_flight = False
+                self._last_refresh_mono = time.monotonic()
+        return "refreshed"
+
+    def _next_version(self) -> int:
+        saved = Checkpointer(self.directory).all_steps()
+        reg = self.service.registry
+        try:
+            active = reg.active_version(self.model) or 0
+        except KeyError:
+            active = 0
+        return max(max(saved, default=0), active, self.last_version or 0) + 1
+
+    def _refresh(self) -> None:
+        from ..serving.model_io import save_model
+
+        with _span("stream.refresh", model=self.model) as sp:
+            inject("stream.refresh", model=self.model)
+            fitted = self.fitter()
+            if isinstance(fitted, tuple):
+                est, recent = fitted
+            else:
+                est = fitted.to_estimator(self.comm)
+                recent = fitted.recent_window_
+            if recent is None:
+                raise ValueError(
+                    "refresh fitter produced no recent window; the fresh "
+                    "drift baseline must come from the refreshed model's "
+                    "own training data"
+                )
+            # the fresh baseline: the refreshed model's OWN recent
+            # training distribution, persisted with the version so a
+            # later promote (or rollback) re-attaches exactly it
+            sk = ModelSketch(self.model, recent.shape[1])
+            sk.update(recent)
+            fresh = sk.doc()
+            version = self._next_version()
+            save_model(est, self.directory, version=version,
+                       name=self.model, baseline=fresh)
+            # swap the live monitor onto the fresh distribution NOW (not
+            # at promote): the firing alert resolves as soon as the
+            # reset live sketch re-warms and scores clean, which is what
+            # releases the decision plane's drift veto
+            SKETCHES.set_baseline(self.model, fresh)
+            SKETCHES.reset_live(self.model)
+            self.service.load(
+                self.model, self.directory, version=version, activate=False
+            )
+            with self._lock:
+                _tsan.note_access("streaming.refresh.state")
+                self.last_version = version
+                self.refreshes += 1
+            _REFRESHES.inc()
+            sp.attrs.update(version=version)
+
+    # -- optional background poller -------------------------------------
+    def start(self, poll_s: float = 1.0) -> "RefreshDriver":
+        """Run :meth:`check` every ``poll_s`` seconds on a daemon thread
+        until :meth:`close`."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _loop() -> None:
+            while not self._stop.wait(poll_s):
+                try:
+                    self.check()
+                except Exception:  # lint: allow H501(poller survives a failed refresh; next tick retries)
+                    pass
+
+        self._thread = threading.Thread(
+            target=_loop, name=f"refresh-{self.model}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the background poller (if running).  Idempotent."""
+        t, self._thread = self._thread, None
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "RefreshDriver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,258 @@
+"""Durable stream sources (docs/streaming.md).
+
+The continuous-learning loop needs an input the fits can *replay*: the
+exactly-once guarantee (a killed fit resumed from its committed offset
+reproduces the uninterrupted fit bitwise) only holds if reading rows
+``[k, k+n)`` returns the same bytes every time.  Two sources provide
+that property:
+
+* :class:`FileSegmentLog` — an append-only directory of immutable
+  ``.npy`` segments (atomic-rename committed, CRC32 sidecars).  Rows
+  are addressed by a monotone offset; a read spanning segments
+  reassembles exactly the appended bytes.  This is the durable source
+  the tests, the kill+resume scenarios and the ingest bench use.
+* :class:`SyntheticStream` — a deterministic generator whose row ``i``
+  is a pure function of ``(seed, i)``, optionally shifting its
+  distribution after ``drift_at`` rows.  Unbounded by default; the
+  MULTICHIP scenarios and the drift e2e tests use it because it needs
+  no disk and replays identically from any offset.
+
+Both speak the same two-method protocol: ``read(offset, max_rows)``
+returns up to ``max_rows`` rows starting at ``offset`` (possibly zero
+at the stream head) and ``size`` reports the rows currently available
+(``None`` = unbounded).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import tsan as _tsan
+from ..resilience.atomic import atomic_write, verify_checksum
+
+__all__ = ["StreamSource", "FileSegmentLog", "SyntheticStream"]
+
+_SEGMENT_RE = re.compile(r"^seg-(\d{12})-(\d{8})\.npy$")
+
+
+class StreamSource:
+    """Protocol of a replayable row stream.
+
+    ``read(offset, max_rows)`` must be a pure function of its arguments
+    and the committed log contents: the streaming fits commit their
+    offset atomically with model state and rely on replay returning the
+    identical window bytes."""
+
+    @property
+    def n_features(self) -> Optional[int]:
+        raise NotImplementedError
+
+    @property
+    def size(self) -> Optional[int]:
+        """Rows currently readable; ``None`` = unbounded."""
+        raise NotImplementedError
+
+    def read(self, offset: int, max_rows: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class FileSegmentLog(StreamSource):
+    """Append-only segment log over a directory of immutable ``.npy`` files.
+
+    Layout: ``seg-<start:012d>-<count:08d>.npy`` (+ ``.crc`` sidecars
+    from the atomic-write layer).  Appends are chunked to
+    ``segment_rows`` and committed by atomic rename, so a concurrent or
+    crashed producer can never expose a torn segment: a reader's scan
+    sees only fully committed files, and the log's end offset is derived
+    from the committed file names alone (no separate metadata file to
+    desynchronize).
+    """
+
+    def __init__(self, directory: str, segment_rows: Optional[int] = None):
+        from ..core._env import env_int
+
+        self._dir = os.fspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self.segment_rows = int(segment_rows if segment_rows is not None
+                                else env_int("HEAT_TPU_STREAM_SEGMENT_ROWS", 4096))
+        if self.segment_rows < 1:
+            raise ValueError(f"segment_rows must be >= 1, got {self.segment_rows}")
+        self._lock = _tsan.register_lock("streaming.segment_log")
+        #: sorted committed segments: (start_offset, rows, path)
+        self._segments: List[Tuple[int, int, str]] = []
+        self._n_features: Optional[int] = None
+        with self._lock:
+            _tsan.note_access("streaming.segment_log.index")
+            self._rescan_locked()
+
+    # -- index ----------------------------------------------------------
+    def _rescan_locked(self) -> None:
+        segs: List[Tuple[int, int, str]] = []
+        for name in os.listdir(self._dir):
+            m = _SEGMENT_RE.match(name)
+            if m:
+                segs.append((int(m.group(1)), int(m.group(2)),
+                             os.path.join(self._dir, name)))
+        segs.sort()
+        self._segments = segs
+
+    def _snapshot(self, want_end: Optional[int] = None) -> List[Tuple[int, int, str]]:
+        """Committed segment list; rescans when another process may have
+        appended past our cached view (cross-process tail)."""
+        with self._lock:
+            _tsan.note_access("streaming.segment_log.index")
+            if want_end is not None and self._end_locked() < want_end:
+                self._rescan_locked()
+            return list(self._segments)
+
+    def _end_locked(self) -> int:
+        if not self._segments:
+            return 0
+        start, count, _ = self._segments[-1]
+        return start + count
+
+    # -- protocol -------------------------------------------------------
+    @property
+    def n_features(self) -> Optional[int]:
+        if self._n_features is None:
+            segs = self._snapshot()
+            if segs:
+                self._n_features = int(np.load(segs[0][2], mmap_mode="r").shape[1])
+        return self._n_features
+
+    @property
+    def size(self) -> int:
+        with self._lock:
+            _tsan.note_access("streaming.segment_log.index", write=False)
+            end = self._end_locked()
+        if end == 0:
+            # a producer in another process may have committed segments
+            # we have never scanned
+            with self._lock:
+                _tsan.note_access("streaming.segment_log.index")
+                self._rescan_locked()
+                end = self._end_locked()
+        return end
+
+    def append(self, rows: np.ndarray) -> int:
+        """Durably append ``rows`` ((n, f) array); returns the new end
+        offset.  Each written segment is fsynced, CRC-sidecarred and
+        atomically renamed in before the index (and therefore any
+        reader) can see it."""
+        rows = np.ascontiguousarray(rows)
+        if rows.ndim != 2:
+            raise ValueError(f"rows must be 2D (n, features), got {rows.ndim}D")
+        if rows.shape[0] == 0:
+            return self.size
+        with self._lock:
+            _tsan.note_access("streaming.segment_log.index")
+            end = self._end_locked()
+            cursor = 0
+            while cursor < rows.shape[0]:
+                part = rows[cursor:cursor + self.segment_rows]
+                path = os.path.join(
+                    self._dir, f"seg-{end:012d}-{part.shape[0]:08d}.npy"
+                )
+                with atomic_write(path, fault_site="io.write") as tmp:
+                    with open(tmp, "wb") as fh:
+                        np.save(fh, part)
+                self._segments.append((end, part.shape[0], path))
+                end += part.shape[0]
+                cursor += part.shape[0]
+            return end
+
+    def read(self, offset: int, max_rows: int) -> np.ndarray:
+        """Rows ``[offset, offset + max_rows)`` clipped to the committed
+        end; returns fewer (possibly zero) rows at the head."""
+        if offset < 0 or max_rows < 0:
+            raise ValueError(f"offset/max_rows must be >= 0, got {offset}/{max_rows}")
+        segs = self._snapshot(want_end=offset + max_rows)
+        parts: List[np.ndarray] = []
+        need = max_rows
+        for start, count, path in segs:
+            if need <= 0 or start + count <= offset:
+                continue
+            if start >= offset + max_rows:
+                break
+            verify_checksum(path)
+            arr = np.load(path)
+            lo = max(offset - start, 0)
+            hi = min(lo + need, count)
+            parts.append(arr[lo:hi])
+            need -= hi - lo
+            offset = start + hi
+        if not parts:
+            f = self.n_features
+            return np.empty((0, f if f is not None else 0), dtype=np.float32)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+
+class SyntheticStream(StreamSource):
+    """Deterministic synthetic stream: block ``j`` of ``block_rows``
+    rows is drawn from ``np.random.default_rng((seed, j))``, so any
+    ``read(offset, n)`` replays identically regardless of window size or
+    read order.  Rows with global index >= ``drift_at`` shift by
+    ``drift_shift`` — the covariate-drift injection the refresh
+    scenarios use."""
+
+    def __init__(
+        self,
+        n_features: int = 8,
+        seed: int = 0,
+        block_rows: int = 256,
+        total_rows: Optional[int] = None,
+        drift_at: Optional[int] = None,
+        drift_shift: float = 3.0,
+        scale: float = 1.0,
+        center: float = 0.0,
+    ):
+        if n_features < 1 or block_rows < 1:
+            raise ValueError("n_features and block_rows must be >= 1")
+        self._f = int(n_features)
+        self.seed = int(seed)
+        self.block_rows = int(block_rows)
+        self.total_rows = None if total_rows is None else int(total_rows)
+        self.drift_at = None if drift_at is None else int(drift_at)
+        self.drift_shift = float(drift_shift)
+        self.scale = float(scale)
+        self.center = float(center)
+
+    @property
+    def n_features(self) -> int:
+        return self._f
+
+    @property
+    def size(self) -> Optional[int]:
+        return self.total_rows
+
+    def _block(self, j: int) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, j))
+        arr = rng.standard_normal((self.block_rows, self._f)).astype(np.float32)
+        arr = arr * np.float32(self.scale) + np.float32(self.center)
+        if self.drift_at is not None:
+            start = j * self.block_rows
+            idx = np.arange(start, start + self.block_rows)
+            arr = arr + np.float32(self.drift_shift) * (idx >= self.drift_at)[:, None].astype(np.float32)
+        return arr
+
+    def read(self, offset: int, max_rows: int) -> np.ndarray:
+        if offset < 0 or max_rows < 0:
+            raise ValueError(f"offset/max_rows must be >= 0, got {offset}/{max_rows}")
+        if self.total_rows is not None:
+            max_rows = min(max_rows, max(self.total_rows - offset, 0))
+        if max_rows == 0:
+            return np.empty((0, self._f), dtype=np.float32)
+        parts: List[np.ndarray] = []
+        pos = offset
+        remaining = max_rows
+        while remaining > 0:
+            j, lo = divmod(pos, self.block_rows)
+            hi = min(lo + remaining, self.block_rows)
+            parts.append(self._block(j)[lo:hi])
+            remaining -= hi - lo
+            pos += hi - lo
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)

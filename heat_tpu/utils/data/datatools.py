@@ -86,6 +86,7 @@ class DataLoader:
         self.ishuffle = ishuffle
         self.prefetch = int(prefetch)
         self._epoch = 0
+        self._live_prefetcher = None
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -97,8 +98,24 @@ class DataLoader:
         if self.prefetch > 0:
             from .prefetch import prefetch_to_device
 
-            return prefetch_to_device(self._batches(), size=self.prefetch)
+            # remember the live wrapper so close() can release a
+            # partially consumed (or unbounded, for stream-backed
+            # datasets) epoch without draining it
+            self._live_prefetcher = prefetch_to_device(self._batches(), size=self.prefetch)
+            return self._live_prefetcher
         return self._batches()
+
+    def close(self) -> None:
+        """Release the most recent prefetched epoch's iterator.
+
+        With ``prefetch=N`` the look-ahead holds the epoch generator
+        (and any stream head behind the dataset) open; close() drops
+        the staged buffer and closes that generator without consuming
+        it — required for unbounded sources, harmless (idempotent) for
+        finite epochs already exhausted."""
+        p, self._live_prefetcher = self._live_prefetcher, None
+        if p is not None:
+            p.close()
 
     def _batches(self) -> Iterator:
         if self.ishuffle or getattr(self.dataset, "ishuffle", False):

@@ -93,6 +93,28 @@ class _DevicePrefetcher:
         self._fill()  # restart the look-ahead immediately
         return out
 
+    def close(self) -> None:
+        """Release the underlying iterator WITHOUT draining it.
+
+        A stream head is unbounded — iterating to exhaustion never
+        terminates — so shutdown drops the staged look-ahead buffer and
+        closes the source generator (``GeneratorExit`` runs its
+        ``finally`` blocks) instead of consuming it.  Idempotent; the
+        prefetcher raises ``StopIteration`` afterwards."""
+        it, self._it = self._it, None
+        self._buf.clear()
+        closer = getattr(it, "close", None)
+        if callable(closer):
+            closer()
+
+    # with-statement support: ``with prefetch_to_device(stream) as it:``
+    # guarantees the stream head is released on any exit path
+    def __enter__(self) -> "_DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
 
 def prefetch_to_device(it: Iterable, size: int = 2, sharding=None) -> Iterator:
     """Wrap ``it`` so batches are staged on device ``size`` steps ahead.
@@ -114,6 +136,11 @@ def prefetch_to_device(it: Iterable, size: int = 2, sharding=None) -> Iterator:
 
     Ordering is preserved exactly; ``StopIteration`` propagates after
     the last buffered batch is handed out.
+
+    The returned iterator is closeable (and usable as a context
+    manager): ``close()`` releases an *unbounded* source — a live
+    stream head (docs/streaming.md) — by dropping the staged buffer and
+    closing the underlying generator, never by draining it.
     """
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")

@@ -42,6 +42,7 @@ MODULES = [
     ("Elastic", "heat_tpu.elastic", "worker-loss detection, mesh reshape + cross-world resume supervision (docs/elasticity.md)"),
     ("Serving", "heat_tpu.serving", "online inference: model registry + hot-load, request coalescing with pad-to-bucket dispatch, per-tenant admission control, /v1 HTTP endpoints (docs/serving.md)"),
     ("Fleet", "heat_tpu.fleet", "fleet-scale serving: fault-tolerant replica router (consistent-hash affinity, circuit breakers, bounded-retry failover), replica process management, load-driven elastic autoscaling (docs/fleet.md)"),
+    ("Streaming", "heat_tpu.streaming", "streaming continuous learning: replayable sources (durable segment log), windowed exactly-once consumer, online fits with bitwise kill+resume, drift-triggered refresh driver (docs/streaming.md)"),
     ("AOT cache", "heat_tpu.core.aot_cache", "persistent on-disk AOT executable cache: serialized compiled artifacts keyed by the dispatch operand-spec keys, fingerprint-invalidated (docs/fleet.md)"),
     ("Lock registry", "heat_tpu.analysis.concurrency", "central registry of cross-thread locks and the structures they guard (the H7xx rules and the sanitizer share it)"),
     ("Communication", "heat_tpu.parallel.comm", "mesh/communication layer"),

@@ -48,6 +48,7 @@ NAV = [
         ("Elasticity", "docs/elasticity.md"),
         ("Serving", "docs/serving.md"),
         ("Fleet serving", "docs/fleet.md"),
+        ("Streaming", "docs/streaming.md"),
         ("Overlap layer", "docs/overlap.md"),
         ("Observability", "docs/observability.md"),
         ("Static analysis", "docs/static_analysis.md"),

@@ -947,6 +947,161 @@ def main():
 
     guarded("shadow_overhead", bench_shadow_overhead)
 
+    # streaming kill+resume recovery (ISSUE 17): a real subprocess
+    # streaming-KMeans fit over a durable segment log, os._exit-killed by
+    # the fault plan at the 5th ``stream.commit`` window boundary, then
+    # resumed in-process from the surviving checkpoint directory over the
+    # same log.  The gated quantity is the resume latency — restore of
+    # the committed {model state, offset} pair plus the replay of every
+    # window from that offset to the stream end — as an absolute cap: a
+    # resume path that re-reads the whole log from offset 0, loses the
+    # committed offset (and silently re-trains), or hangs on a torn
+    # segment blows the cap.  The record also asserts exactly-once
+    # semantics: the resumed offset must land on the stream end.
+    def bench_streaming_kill_resume():
+        import shutil
+        import subprocess
+        import tempfile
+
+        from heat_tpu.streaming import FileSegmentLog, StreamingKMeans
+        from heat_tpu.utils.checkpoint import Checkpointer
+
+        d = tempfile.mkdtemp(prefix="heat_tpu_ci_stream_kill_")
+        window, feat, n_windows = 64, 16, 12
+        child = (
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import sys\n"
+            "from heat_tpu.streaming import FileSegmentLog, StreamingKMeans\n"
+            "StreamingKMeans(n_clusters=8, window_rows=%d, commit_every=1,\n"
+            "                checkpoint_dir=sys.argv[1], resume_from=sys.argv[1]\n"
+            "                ).fit_stream(FileSegmentLog(sys.argv[2]))\n" % window
+        )
+        try:
+            log_dir = os.path.join(d, "log")
+            rows = np.random.default_rng(21).standard_normal(
+                (window * n_windows, feat)).astype(np.float32)
+            FileSegmentLog(log_dir, segment_rows=512).append(rows)
+            ck = os.path.join(d, "ck")
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["HEAT_TPU_FAULT_PLAN"] = json.dumps(
+                {"plan": {"stream.commit": [
+                    {"at": 5, "kind": "kill", "exit_code": 137}]}}
+            )
+            proc = subprocess.run(
+                [sys.executable, "-c", child, ck, log_dir],
+                env=env, capture_output=True, timeout=280,
+            )
+            assert proc.returncode == 137, proc.stderr.decode()[-500:]
+            step = Checkpointer(ck).latest_step()
+            assert step is not None and step < n_windows, step
+
+            t0 = time.perf_counter()
+            resumed = StreamingKMeans(
+                n_clusters=8, window_rows=window, commit_every=1,
+                checkpoint_dir=ck, resume_from=ck,
+            ).fit_stream(FileSegmentLog(log_dir))
+            resume_s = time.perf_counter() - t0
+            assert resumed.offset_ == window * n_windows, resumed.offset_
+            results["streaming_kill_resume"] = {
+                "seconds": round(resume_s, 3),
+                "max_seconds": 60.0,
+                "killed_at_window": step,
+                "windows_replayed": n_windows - step,
+                "child_exit": proc.returncode,
+            }
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    guarded("streaming_kill_resume", bench_streaming_kill_resume)
+
+    # streaming model staleness (ISSUE 17): how stale a served model gets
+    # before the continuous-learning loop replaces it.  A streamed KMeans
+    # is served with a drift baseline, covariate-shifted traffic is
+    # driven through it, and the clock runs from the first drifted batch
+    # to the refreshed canary AUTO-promoting — drift detection (sketch
+    # PSI over the live window) + online re-fit from the warm checkpoint
+    # + save with a FRESH baseline + shadow compare + promote, end to
+    # end.  An absolute cap: a refresh driver that never fires, a
+    # baseline that keeps the alert latched (vetoing promotion), or a
+    # canary that never collects comparisons all show up as a blown cap,
+    # not a silent stale model.
+    def bench_streaming_staleness():
+        import shutil
+        import tempfile
+
+        from heat_tpu import serving as srv
+        from heat_tpu.serving import canary as cnry
+        from heat_tpu.streaming import FileSegmentLog, RefreshDriver, StreamingKMeans
+        from heat_tpu.telemetry import alerts as _al
+        from heat_tpu.telemetry import sketch as _sk
+
+        feat = 16
+        centers = np.array([[0.0] * feat, [40.0] * feat, [80.0] * feat], np.float32)
+
+        def rows_of(n, rng, shift=0.0):
+            labels = np.arange(n) % 3
+            return (centers[labels]
+                    + rng.standard_normal((n, feat)).astype(np.float32) * 0.5
+                    + np.float32(shift)).astype(np.float32)
+
+        d = tempfile.mkdtemp(prefix="heat_tpu_ci_stream_stale_")
+        svc = None
+        try:
+            log = FileSegmentLog(os.path.join(d, "log"), segment_rows=1024)
+            log.append(rows_of(64 * 8, np.random.default_rng(1)))
+            ck = os.path.join(d, "ck")
+            km = StreamingKMeans(n_clusters=3, window_rows=64, commit_every=1,
+                                 checkpoint_dir=ck, resume_from=ck)
+            km.fit_stream(log)
+            sk = _sk.ModelSketch("stream_km", feat)
+            sk.update(km.recent_window_)
+            md = os.path.join(d, "models")
+            srv.save_model(km.to_estimator(), md, version=1, name="stream_km",
+                           baseline=sk.doc())
+            svc = srv.InferenceService(max_delay_ms=1.0, max_batch=64)
+            svc.load("stream_km", md, version=1)
+            svc.canary.fraction = 1.0
+            svc.canary.min_rows = 48
+
+            def fitter():
+                log.append(rows_of(64 * 4, np.random.default_rng(2), shift=4.0))
+                fresh = StreamingKMeans(n_clusters=3, window_rows=64,
+                                        commit_every=1, checkpoint_dir=ck,
+                                        resume_from=ck)
+                return fresh.fit_stream(log)
+
+            drv = RefreshDriver(svc, "stream_km", md, fitter)
+            rng = np.random.default_rng(9)
+            t0 = time.perf_counter()
+            deadline = t0 + 120.0
+            while time.perf_counter() < deadline:
+                svc.predict("stream_km", rows_of(8, rng, shift=4.0))
+                drv.check()
+                if svc.registry.active_version("stream_km") == 2:
+                    break
+            staleness_s = time.perf_counter() - t0
+            assert svc.registry.active_version("stream_km") == 2, \
+                "refresh never promoted"
+            assert not _al.is_firing("drift:stream_km",
+                                     labels={"model": "stream_km"})
+            results["streaming_staleness"] = {
+                "seconds": round(staleness_s, 3),
+                "max_seconds": 30.0,
+                "refreshes": drv.refreshes,
+                "promoted_version": 2,
+            }
+        finally:
+            if svc is not None:
+                svc.close()
+            cnry.reset_canary_state()
+            _al.clear_alerts()
+            _sk.SKETCHES.clear()
+            shutil.rmtree(d, ignore_errors=True)
+
+    guarded("streaming_staleness", bench_streaming_staleness)
+
     # precision-analyzer overhead (ISSUE 12): the SAME kmeans lloyd
     # kernel with HEAT_TPU_ANALYZE=warn — the J2 dtype-flow walker, the
     # J3 static peak-HBM estimator AND the J1 HLO checks armed at the

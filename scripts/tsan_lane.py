@@ -13,9 +13,12 @@ tail-store concurrency (``test_tracing.py``), the quality-signal
 layer's SLO tick thread / alert table / sketch registry
 (``test_slo.py``, ``test_drift.py``), the fleet layer's router
 handler/health-poller threads, circuit breakers, AOT-cache config and
-autoscaler tick (``test_fleet.py``), and the roofline observatory's
+autoscaler tick (``test_fleet.py``), the roofline observatory's
 dispatch-thread ledger vs /rooflinez scrapes plus the /profilez
-capture slot vs its auto-stop timer (``test_observatory.py``) — in a
+capture slot vs its auto-stop timer (``test_observatory.py``), and the
+streaming layer's segment-log producer/consumer split, refresh-driver
+poll thread and 4-thread live-traffic e2e (``test_streaming.py``,
+``test_streaming_resume.py``) — in a
 subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
@@ -51,6 +54,8 @@ LANE_FILES = (
     "tests/test_drift.py",
     "tests/test_fleet.py",
     "tests/test_observatory.py",
+    "tests/test_streaming.py",
+    "tests/test_streaming_resume.py",
 )
 
 

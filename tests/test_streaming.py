@@ -1,0 +1,548 @@
+"""Streaming continuous learning (ISSUE 17).
+
+The acceptance properties: stream sources replay bitwise from any
+offset (segment log durable + crash-safe, synthetic pure), the consumer
+cuts fixed-size windows whose sequence is a function of the committed
+offset alone (transient read faults absorbed, key-distribution drift
+triggers a windowed rebalance), the prefetch pipeline releases an
+UNBOUNDED stream head without draining it, the online fits are
+deterministic and pause (not converge) on a dry head, and the
+drift-triggered refresh driver closes the loop: alert fires -> re-fit
+-> canary with a fresh baseline -> alert resolves -> decision plane
+auto-promotes, with zero failed client requests under live traffic.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.resilience.errors import ChecksumError, DivergenceError
+from heat_tpu.resilience.faults import fault_plan
+from heat_tpu.serving import canary as cn
+from heat_tpu.streaming import (
+    FileSegmentLog,
+    RefreshDriver,
+    StreamConsumer,
+    StreamingKMeans,
+    StreamingLasso,
+    StreamingPCA,
+    SyntheticStream,
+)
+from heat_tpu.telemetry import alerts as talerts
+from heat_tpu.telemetry import sketch as tsketch
+from heat_tpu.utils.data import DataLoader
+from heat_tpu.utils.data.prefetch import prefetch_to_device
+
+
+@pytest.fixture(autouse=True)
+def _clean_serving_state():
+    cn.reset_canary_state()
+    talerts.clear_alerts()
+    tsketch.SKETCHES.clear()
+    yield
+    cn.reset_canary_state()
+    talerts.clear_alerts()
+    tsketch.SKETCHES.clear()
+
+
+# ----------------------------------------------------------------------
+# sources
+# ----------------------------------------------------------------------
+class TestFileSegmentLog:
+    def test_append_read_replay(self, tmp_path):
+        log = FileSegmentLog(str(tmp_path), segment_rows=100)
+        rows = np.random.default_rng(0).standard_normal((350, 4)).astype(np.float32)
+        assert log.append(rows) == 350
+        assert log.size == 350 and log.n_features == 4
+        # reads span segment boundaries and replay bitwise
+        for off, n in ((0, 350), (50, 200), (99, 2), (340, 100)):
+            got = log.read(off, n)
+            want = rows[off : off + n]
+            assert np.array_equal(got, want)
+        assert log.read(350, 64).shape == (0, 4)  # at the head: empty
+
+    def test_cross_instance_tail(self, tmp_path):
+        """A reader in another process (modeled: another instance) sees
+        segments committed after its first scan — the producer/consumer
+        split the refresh driver and bench rely on."""
+        writer = FileSegmentLog(str(tmp_path), segment_rows=64)
+        reader = FileSegmentLog(str(tmp_path), segment_rows=64)
+        a = np.full((64, 3), 1.0, np.float32)
+        b = np.full((64, 3), 2.0, np.float32)
+        writer.append(a)
+        assert np.array_equal(reader.read(0, 64), a)
+        writer.append(b)  # committed AFTER the reader's scan
+        assert np.array_equal(reader.read(64, 64), b)
+        assert reader.size == 128
+
+    def test_torn_segment_never_visible(self, tmp_path):
+        """A file without the atomic-rename commit (a crashed producer's
+        temp) is invisible; a corrupted committed segment raises instead
+        of returning garbage."""
+        log = FileSegmentLog(str(tmp_path), segment_rows=64)
+        log.append(np.zeros((64, 2), np.float32))
+        # a crashed producer's staging file: wrong name pattern -> ignored
+        (tmp_path / "seg-000000000064-00000064.npy.tmp-x").write_bytes(b"torn")
+        assert log.size == 64
+        # corrupt the committed segment payload -> checksum mismatch
+        seg = next(p for p in tmp_path.iterdir() if p.name.endswith(".npy"))
+        data = bytearray(seg.read_bytes())
+        data[-1] ^= 0xFF
+        seg.write_bytes(bytes(data))
+        with pytest.raises(ChecksumError):
+            FileSegmentLog(str(tmp_path)).read(0, 64)
+
+    def test_validation(self, tmp_path):
+        log = FileSegmentLog(str(tmp_path))
+        with pytest.raises(ValueError):
+            log.append(np.zeros(8, np.float32))  # 1-D
+        with pytest.raises(ValueError):
+            log.read(-1, 8)
+        with pytest.raises(ValueError):
+            FileSegmentLog(str(tmp_path), segment_rows=0)
+
+
+class TestSyntheticStream:
+    def test_replay_any_offset(self):
+        syn = SyntheticStream(n_features=3, seed=7, block_rows=64)
+        assert np.array_equal(syn.read(100, 300), syn.read(100, 300))
+        # window size / read order never changes the bytes
+        whole = syn.read(0, 512)
+        parts = np.concatenate([syn.read(o, 128) for o in (0, 128, 256, 384)])
+        assert np.array_equal(whole, parts)
+
+    def test_drift_at_shifts_the_tail(self):
+        syn = SyntheticStream(n_features=2, seed=1, block_rows=32, drift_at=100,
+                              drift_shift=5.0)
+        clean = SyntheticStream(n_features=2, seed=1, block_rows=32)
+        rows = syn.read(0, 200)
+        base = clean.read(0, 200)
+        assert np.array_equal(rows[:100], base[:100])
+        assert np.allclose(rows[100:], base[100:] + 5.0)
+
+    def test_total_rows_bounds_the_head(self):
+        syn = SyntheticStream(n_features=2, total_rows=100, block_rows=32)
+        assert syn.size == 100
+        assert syn.read(80, 64).shape == (20, 2)
+        assert syn.read(100, 64).shape == (0, 2)
+
+
+# ----------------------------------------------------------------------
+# the consumer
+# ----------------------------------------------------------------------
+class TestStreamConsumer:
+    def test_fixed_windows_and_head(self, tmp_path):
+        log = FileSegmentLog(str(tmp_path), segment_rows=50)
+        rows = np.random.default_rng(3).standard_normal((150, 3)).astype(np.float32)
+        log.append(rows)
+        with StreamConsumer(log, window_rows=64, prefetch=2) as cons:
+            off0, w0 = cons.next_window(0)
+            off1, w1 = cons.next_window(64)
+            assert (off0, off1) == (0, 64)
+            assert np.array_equal(np.asarray(w0), rows[:64])
+            assert np.array_equal(np.asarray(w1), rows[64:128])
+            # 22 rows at the head: a partial window is NEVER consumed
+            assert cons.next_window(128) is None
+            # the producer lands more rows; the same offset now yields
+            log.append(np.ones((64, 3), np.float32))
+            off2, w2 = cons.next_window(128)
+            assert off2 == 128 and np.asarray(w2).shape == (64, 3)
+
+    def test_reseek_replays_bitwise(self):
+        syn = SyntheticStream(n_features=4, seed=5, block_rows=32, total_rows=320)
+        with StreamConsumer(syn, window_rows=32) as cons:
+            seq = [np.asarray(cons.next_window(32 * i)[1]) for i in range(4)]
+            # a resume-style seek back to offset 64 replays window 2 bitwise
+            _, again = cons.next_window(64)
+            assert np.array_equal(np.asarray(again), seq[2])
+
+    def test_transient_read_fault_absorbed(self):
+        """A scripted transient at ``stream.read`` retries inside the io
+        policy — the window arrives, bitwise-identical."""
+        syn = SyntheticStream(n_features=2, seed=9, block_rows=64, total_rows=128)
+        want = syn.read(0, 64)
+        with fault_plan({"stream.read": [{"at": 1, "kind": "transient"}]}) as inj:
+            with StreamConsumer(syn, window_rows=64, prefetch=1) as cons:
+                _, w = cons.next_window(0)
+        assert np.array_equal(np.asarray(w), want)
+        assert inj.injected.get("stream.read"), "the fault must have fired"
+
+    def test_key_drift_triggers_reshard(self):
+        """A sustained key-distribution shift past the PSI knob flags
+        exactly one reshard; ``maybe_reshard`` rebalances the caller's
+        split array and clears the flag."""
+        syn = SyntheticStream(n_features=3, seed=2, block_rows=64, total_rows=640,
+                              drift_at=320, drift_shift=100.0)
+        with StreamConsumer(syn, window_rows=64, reshard_psi=0.25) as cons:
+            seen = 0
+            for i in range(10):
+                assert cons.next_window(64 * i) is not None
+                seen += 1
+            assert cons.reshard_events == 1, "one sustained shift = one reshard"
+            x = ht.array(np.random.default_rng(0).standard_normal((64, 3)), split=0)
+            assert cons.maybe_reshard(x) is True
+            assert cons.maybe_reshard(x) is False  # flag cleared
+            assert seen == 10
+
+    def test_no_reshard_on_stationary_keys(self):
+        syn = SyntheticStream(n_features=3, seed=2, block_rows=64, total_rows=640)
+        with StreamConsumer(syn, window_rows=64) as cons:
+            for i in range(10):
+                cons.next_window(64 * i)
+            assert cons.reshard_events == 0
+            assert cons.maybe_reshard() is False
+
+
+# ----------------------------------------------------------------------
+# prefetch shutdown on unbounded iterators (satellite: DataLoader/
+# prefetch_to_device close must not drain an infinite stream head)
+# ----------------------------------------------------------------------
+class TestPrefetchClose:
+    def test_close_releases_never_ending_generator(self):
+        pulled = {"n": 0}
+        closed = threading.Event()
+
+        def forever():
+            try:
+                i = 0
+                while True:  # a live stream head: iterating never ends
+                    pulled["n"] += 1
+                    yield np.full((4, 2), i, np.float32)
+                    i += 1
+            finally:
+                closed.set()
+
+        it = prefetch_to_device(forever(), size=3)
+        first = next(it)
+        assert np.asarray(first).shape == (4, 2)
+        t0 = time.monotonic()
+        it.close()  # must return promptly, NOT drain the stream
+        assert time.monotonic() - t0 < 1.0
+        assert closed.is_set(), "close() must release the generator (finally ran)"
+        # bounded look-ahead, not a drain: first + at most size staged
+        assert pulled["n"] <= 1 + 3 + 1
+        with pytest.raises(StopIteration):
+            next(it)
+        it.close()  # idempotent
+
+    def test_context_manager_releases_on_exit(self):
+        closed = threading.Event()
+
+        def forever():
+            try:
+                while True:
+                    yield np.zeros((2, 2), np.float32)
+            finally:
+                closed.set()
+
+        with prefetch_to_device(forever(), size=2) as it:
+            next(it)
+        assert closed.is_set()
+
+    def test_dataloader_close_releases_prefetched_epoch(self):
+        x = ht.array(np.random.default_rng(1).standard_normal((64, 3)).astype(np.float32))
+        dl = DataLoader(x, batch_size=8, shuffle=False, prefetch=2)
+        it = iter(dl)
+        next(it)
+        dl.close()  # mid-epoch release: no drain, no error
+        dl.close()  # idempotent
+        # a fresh epoch still works after close
+        batches = list(iter(dl))
+        assert len(batches) == 8
+
+
+# ----------------------------------------------------------------------
+# online fits
+# ----------------------------------------------------------------------
+def _clustered_rows(n, rng, shift=0.0, centers=None):
+    """Well-separated clusters with CYCLING labels, so the first k rows
+    cover every cluster (first-k-rows seeding lands one center each)."""
+    centers = centers if centers is not None else np.array(
+        [[0.0] * 4, [40.0] * 4, [80.0] * 4], np.float32
+    )
+    labels = np.arange(n) % len(centers)
+    noise = rng.standard_normal((n, 4)).astype(np.float32) * 0.5
+    return (centers[labels] + noise + np.float32(shift)).astype(np.float32)
+
+
+class TestOnlineFits:
+    def test_kmeans_deterministic(self):
+        def fit():
+            syn = SyntheticStream(n_features=4, seed=1, block_rows=64, total_rows=640)
+            return StreamingKMeans(n_clusters=4, window_rows=64).fit_stream(syn)
+
+        a, b = fit(), fit()
+        assert np.array_equal(a.cluster_centers_, b.cluster_centers_)
+        assert a.n_windows_ == 10 and a.offset_ == 640
+
+    def test_pca_deterministic_and_sensible(self):
+        def fit():
+            syn = SyntheticStream(n_features=5, seed=2, block_rows=32, total_rows=256)
+            return StreamingPCA(n_components=2, window_rows=32).fit_stream(syn)
+
+        a, b = fit(), fit()
+        assert np.array_equal(a.components_, b.components_)
+        est = a.to_estimator()
+        evr = np.asarray(est.explained_variance_ratio_._dense())
+        assert evr.shape == (2,) and 0.0 < float(evr.sum()) <= 1.0 + 1e-5
+
+    def test_lasso_deterministic(self):
+        def fit():
+            syn = SyntheticStream(n_features=4, seed=3, block_rows=64, total_rows=640)
+            return StreamingLasso(lam=0.01, lr=0.1, window_rows=64).fit_stream(syn)
+
+        a, b = fit(), fit()
+        assert np.array_equal(a.theta_, b.theta_)
+
+    def test_pause_resume_bitwise(self, tmp_path):
+        """An in-process split fit (4 windows, then resume to the end)
+        reproduces the uninterrupted fit bitwise — the offset rides the
+        checkpoint, so the window sequence replays identically."""
+        def fit(**kw):
+            syn = SyntheticStream(n_features=4, seed=1, block_rows=64, total_rows=640)
+            km = StreamingKMeans(n_clusters=4, window_rows=64, **kw)
+            return km.fit_stream(syn, max_windows=kw.pop("cap", None) if "cap" in kw else None)
+
+        ref = fit()
+        d = str(tmp_path / "ck")
+        part = StreamingKMeans(n_clusters=4, window_rows=64, commit_every=1,
+                               checkpoint_dir=d)
+        part.fit_stream(SyntheticStream(n_features=4, seed=1, block_rows=64,
+                                        total_rows=640), max_windows=4)
+        assert part.n_windows_ == 4
+        done = StreamingKMeans(n_clusters=4, window_rows=64, commit_every=1,
+                               resume_from=d)
+        done.fit_stream(SyntheticStream(n_features=4, seed=1, block_rows=64,
+                                        total_rows=640))
+        assert done.n_windows_ == 10
+        assert np.array_equal(done.cluster_centers_, ref.cluster_centers_)
+
+    def test_dry_head_pauses_not_converges(self, tmp_path):
+        """A dry stream head checkpoints ``converged=False``: the same
+        directory keeps consuming when the producer appends more."""
+        log = FileSegmentLog(str(tmp_path / "log"), segment_rows=64)
+        rng = np.random.default_rng(0)
+        log.append(_clustered_rows(128, rng))
+        d = str(tmp_path / "ck")
+        kw = dict(n_clusters=3, window_rows=64, commit_every=1,
+                  checkpoint_dir=d, resume_from=d)
+        km = StreamingKMeans(**kw).fit_stream(log)
+        assert km.n_windows_ == 2  # paused at the head, not converged
+        log.append(_clustered_rows(192, rng))
+        km2 = StreamingKMeans(**kw).fit_stream(log)
+        assert km2.n_windows_ == 5 and km2.offset_ == 320
+
+    def test_divergence_guarded(self, tmp_path):
+        log = FileSegmentLog(str(tmp_path), segment_rows=64)
+        rows = _clustered_rows(192, np.random.default_rng(0))
+        rows[100] = np.nan  # a poisoned window
+        log.append(rows)
+        with pytest.raises(DivergenceError):
+            StreamingKMeans(n_clusters=3, window_rows=64).fit_stream(log)
+
+    def test_servable_conversions(self):
+        syn = SyntheticStream(n_features=4, seed=1, block_rows=64, total_rows=320)
+        km = StreamingKMeans(n_clusters=3, window_rows=64).fit_stream(syn)
+        q = ht.array(syn.read(0, 16))
+        labels = np.asarray(km.to_estimator().predict(q)._dense())
+        assert labels.shape[0] == 16 and set(labels.ravel()) <= {0, 1, 2}
+
+        syn_l = SyntheticStream(n_features=3, seed=4, block_rows=64, total_rows=320)
+        las = StreamingLasso(lam=0.01, lr=0.1, window_rows=64).fit_stream(syn_l)
+        ql = ht.array(syn_l.read(0, 8)[:, :-1])
+        assert np.asarray(las.to_estimator().predict(ql)._dense()).shape == (8, 1)
+
+
+# ----------------------------------------------------------------------
+# drift-triggered refresh
+# ----------------------------------------------------------------------
+def _seed_streamed_model(tmp_path, name="km"):
+    """v1: a streamed KMeans over pre-drift rows, saved WITH a baseline
+    from its recent training window; returns (log, ckpt dir, model dir)."""
+    log = FileSegmentLog(str(tmp_path / "log"), segment_rows=256)
+    log.append(_clustered_rows(64 * 8, np.random.default_rng(0)))
+    ck = str(tmp_path / "ck")
+    km = StreamingKMeans(n_clusters=3, window_rows=64, commit_every=1,
+                         checkpoint_dir=ck, resume_from=ck).fit_stream(log)
+    sk = tsketch.ModelSketch(name, 4)
+    sk.update(km.recent_window_)
+    d = str(tmp_path / "models")
+    serving.save_model(km.to_estimator(), d, version=1, name=name,
+                       baseline=sk.doc())
+    return log, ck, d
+
+
+def _drifted_fitter(log, ck, shift=4.0, windows=6, seed=1):
+    """The refresh recipe: append recent (drifted) rows, resume the
+    online fit from its own checkpoints — a warm start from the live
+    model's centers, so label indices stay aligned."""
+
+    def fitter():
+        log.append(_clustered_rows(64 * windows, np.random.default_rng(seed),
+                                   shift=shift))
+        km = StreamingKMeans(n_clusters=3, window_rows=64, commit_every=1,
+                             checkpoint_dir=ck, resume_from=ck)
+        return km.fit_stream(log)
+
+    return fitter
+
+
+class TestRefreshDriver:
+    def test_idle_without_drift(self, tmp_path):
+        log, ck, d = _seed_streamed_model(tmp_path)
+        svc = serving.InferenceService(max_batch=32, max_delay_ms=1.0)
+        try:
+            svc.load("km", d, version=1)
+            drv = RefreshDriver(svc, "km", d, _drifted_fitter(log, ck))
+            assert drv.check() == "idle"
+            assert svc.registry.canary_version("km") is None
+        finally:
+            svc.close()
+
+    def test_fire_refresh_promote_resolve_cycle(self, tmp_path):
+        """The satellite acceptance cycle: drift fires -> refresh saves
+        a canary carrying a FRESH baseline from its recent window -> the
+        re-warmed live sketch scores clean, the alert RESOLVES (instead
+        of re-firing against the stale baseline) -> the decision plane's
+        drift veto clears and the canary auto-promotes."""
+        log, ck, d = _seed_streamed_model(tmp_path)
+        svc = serving.InferenceService(max_batch=32, max_delay_ms=1.0)
+        try:
+            svc.load("km", d, version=1)
+            svc.canary.fraction = 1.0
+            svc.canary.min_rows = 48
+            drv = RefreshDriver(svc, "km", d, _drifted_fitter(log, ck))
+            rng = np.random.default_rng(99)
+
+            # drifted traffic warms the live sketch past the floor
+            for _ in range(30):
+                svc.predict("km", _clustered_rows(8, rng, shift=4.0))
+            assert drv.check() == "refreshed"
+            assert talerts.is_firing("drift:km", labels={"model": "km"})
+            assert svc.registry.canary_version("km") == 2
+            # a second check while the canary is resident defers to the
+            # decision plane instead of stacking refreshes
+            assert drv.check() in ("pending", "idle")
+
+            failed = 0
+            for _ in range(60):
+                try:
+                    svc.predict("km", _clustered_rows(8, rng, shift=4.0))
+                except Exception:
+                    failed += 1
+                drv.check()
+                if svc.registry.active_version("km") == 2:
+                    break
+            assert svc.canary.wait_idle(30)
+            assert failed == 0
+            assert svc.registry.active_version("km") == 2
+            assert svc.registry.canary_version("km") is None
+            st = cn.status("km")
+            assert st["decision"]["action"] == "promoted"
+            # the triggering alert stays RESOLVED after promotion
+            tsketch.check_drift()
+            assert not talerts.is_firing("drift:km", labels={"model": "km"})
+            assert drv.refreshes == 1 and drv.last_version == 2
+        finally:
+            svc.close()
+
+    def test_cooldown_defers(self, tmp_path):
+        log, ck, d = _seed_streamed_model(tmp_path)
+        svc = serving.InferenceService(max_batch=32, max_delay_ms=1.0)
+        try:
+            svc.load("km", d, version=1)
+            drv = RefreshDriver(svc, "km", d, _drifted_fitter(log, ck),
+                                min_interval_s=3600.0)
+            rng = np.random.default_rng(5)
+            for _ in range(30):
+                svc.predict("km", _clustered_rows(8, rng, shift=4.0))
+            assert drv.check() == "refreshed"
+            # promote the canary out of the slot, re-poison the live
+            # sketch: the cooldown (not the canary slot) must defer now
+            svc.registry.promote("km", 2)
+            tsketch.SKETCHES.set_baseline(
+                "km", tsketch.SKETCHES.baseline("km"))
+            for _ in range(30):
+                svc.predict("km", _clustered_rows(8, rng, shift=-6.0))
+            assert drv.check() in ("pending", "idle")
+            assert drv.refreshes == 1
+        finally:
+            svc.close()
+
+    def test_background_poller_lifecycle(self, tmp_path):
+        log, ck, d = _seed_streamed_model(tmp_path)
+        svc = serving.InferenceService(max_batch=32, max_delay_ms=1.0)
+        try:
+            svc.load("km", d, version=1)
+            with RefreshDriver(svc, "km", d,
+                               _drifted_fitter(log, ck)).start(poll_s=0.05) as drv:
+                rng = np.random.default_rng(7)
+                deadline = time.monotonic() + 30.0
+                while drv.refreshes == 0 and time.monotonic() < deadline:
+                    svc.predict("km", _clustered_rows(8, rng, shift=4.0))
+                assert drv.refreshes >= 1
+            assert drv._thread is None  # closed
+        finally:
+            svc.close()
+
+
+# ----------------------------------------------------------------------
+# e2e: injected drift under LIVE threaded traffic -> refresh -> shadow
+# compare -> auto-promote, zero failed client requests
+# ----------------------------------------------------------------------
+class TestLiveTrafficE2E:
+    def test_drift_refresh_promote_under_live_traffic(self, tmp_path):
+        log, ck, d = _seed_streamed_model(tmp_path)
+        svc = serving.InferenceService(max_batch=32, max_delay_ms=1.0)
+        try:
+            svc.load("km", d, version=1)
+            svc.canary.fraction = 1.0
+            svc.canary.min_rows = 48
+            drv = RefreshDriver(svc, "km", d, _drifted_fitter(log, ck))
+
+            stop = threading.Event()
+            failures, requests = [], [0] * 4
+
+            def client(i):
+                rng = np.random.default_rng(100 + i)
+                while not stop.is_set():
+                    try:
+                        out = svc.predict("km", _clustered_rows(8, rng, shift=4.0))
+                        assert np.asarray(out).shape[0] == 8
+                        requests[i] += 1
+                    except Exception as exc:  # lint: allow H501(the assertion IS "no exception escapes predict")
+                        failures.append(repr(exc))
+                        return
+
+            threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                deadline = time.monotonic() + 60.0
+                promoted = False
+                while time.monotonic() < deadline:
+                    drv.check()
+                    if svc.registry.active_version("km") == 2:
+                        promoted = True
+                        break
+                    time.sleep(0.05)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=10.0)
+            assert promoted, "the refreshed canary must auto-promote under live traffic"
+            assert not failures, f"client requests failed: {failures[:3]}"
+            assert min(requests) > 0, "every client thread must have served"
+            assert svc.canary.wait_idle(30)
+            st = cn.status("km")
+            assert st["decision"]["action"] == "promoted"
+            tsketch.check_drift()
+            assert not talerts.is_firing("drift:km", labels={"model": "km"})
+        finally:
+            svc.close()

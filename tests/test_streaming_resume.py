@@ -1,0 +1,101 @@
+"""Kill-mid-stream exactly-once resume (ISSUE 17).
+
+Real host preemption for each online estimator: the child process is
+``os._exit``-killed by the env fault plan between window commits
+(``stream.commit``), the parent resumes the same checkpoint directory
+over the same segment log, and the final model must equal the
+uninterrupted fit **bitwise** — the committed offset rides in the same
+atomic checkpoint step as the model state, so the resumed consumer
+replays the identical window sequence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from heat_tpu.streaming import (
+    FileSegmentLog,
+    StreamingKMeans,
+    StreamingLasso,
+    StreamingPCA,
+)
+from heat_tpu.utils.checkpoint import Checkpointer
+
+_CHILD = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)  # mirror conftest
+import sys
+from heat_tpu.streaming import (FileSegmentLog, StreamingKMeans,
+                                StreamingLasso, StreamingPCA)
+name, log_dir, ck = sys.argv[1], sys.argv[2], sys.argv[3]
+log = FileSegmentLog(log_dir)
+kw = dict(window_rows=32, commit_every=1, checkpoint_dir=ck, resume_from=ck)
+if name == 'kmeans':
+    est = StreamingKMeans(n_clusters=3, **kw)
+elif name == 'pca':
+    est = StreamingPCA(n_components=2, **kw)
+else:
+    est = StreamingLasso(lam=0.01, lr=0.1, **kw)
+est.fit_stream(log)
+"""
+
+
+def _make(name, **kw):
+    if name == "kmeans":
+        return StreamingKMeans(n_clusters=3, window_rows=32, **kw)
+    if name == "pca":
+        return StreamingPCA(n_components=2, window_rows=32, **kw)
+    return StreamingLasso(lam=0.01, lr=0.1, window_rows=32, **kw)
+
+
+_FITTED = {
+    "kmeans": ("cluster_centers_", "counts_"),
+    "pca": ("components_", "singular_values_", "mean_", "m2_"),
+    "lasso": ("theta_",),
+}
+
+
+@pytest.mark.parametrize("name", ["kmeans", "pca", "lasso"])
+def test_kill_between_window_commits_resumes_bitwise(tmp_path, name):
+    log_dir = str(tmp_path / "log")
+    rows = np.random.default_rng(5).standard_normal((32 * 12, 4)).astype(np.float32)
+    FileSegmentLog(log_dir, segment_rows=80).append(rows)
+
+    # the uninterrupted reference (same process as the resume leg)
+    ref = _make(name).fit_stream(FileSegmentLog(log_dir))
+
+    # the child dies at the 5th window-commit boundary
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HEAT_TPU_FAULT_PLAN"] = json.dumps(
+        {"plan": {"stream.commit": [{"at": 5, "kind": "kill", "exit_code": 137}]}}
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, name, log_dir, ck],
+        env=env, capture_output=True, timeout=300,
+    )
+    assert proc.returncode == 137, proc.stderr.decode()[-2000:]
+    step = Checkpointer(ck).latest_step()
+    assert step is not None and step < 12, "the kill must land mid-stream"
+    committed = Checkpointer(ck).restore(step)
+    assert committed["converged"] is False
+    # the offset rode the commit (PCA's SVD seed consumes window 0
+    # outside the iteration count, so its offset runs one window ahead)
+    seed_rows = 32 if name == "pca" else 0
+    assert committed["state"]["offset"] == step * 32 + seed_rows
+
+    # the parent resumes the surviving directory over the same log
+    resumed = _make(name, commit_every=1, resume_from=ck).fit_stream(
+        FileSegmentLog(log_dir)
+    )
+    assert resumed.offset_ == ref.offset_ == 32 * 12
+    for attr in _FITTED[name]:
+        assert np.array_equal(
+            np.asarray(getattr(ref, attr)), np.asarray(getattr(resumed, attr))
+        ), attr
