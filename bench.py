@@ -1787,6 +1787,129 @@ def bench_streaming(ht, sync_floor, roofline=None):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_qos(ht, sync_floor, roofline=None):
+    """Config 13: multi-tenant QoS scheduling (ISSUE 18).
+
+    A latency-class tenant's small-request stream is measured solo and
+    then again with four batch-class clients flooding 64-row requests
+    through the same service — the strict-priority depth gate plus the
+    EDF batch pick must keep the latency tail pinned near its solo
+    shape while the batch lane absorbs the shedding.  Reported: solo
+    and contended latency p50/p99, the noisy-neighbor p99 inflation
+    (``vs_baseline`` = contended p99 / solo p99 — the number the
+    ``qos_noisy_neighbor`` CI gate caps at 1.10), latency-class sheds
+    (must be 0), batch-lane admit/shed traffic, per-lane depth
+    surfaces, and the per-tenant cost accounts folded by the request
+    stream (``/tenantz``: the accounts must sum to the service total).
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from heat_tpu import serving as srv
+    from heat_tpu.resilience import OverloadedError
+    from heat_tpu.telemetry import tenants as ttenants
+
+    rng = np.random.default_rng(18)
+    pts = rng.standard_normal((1 << 12, 16)).astype(np.float32)
+    x = ht.array(pts, split=0)
+    km = ht.cluster.KMeans(n_clusters=8, init="random", max_iter=5, random_state=0).fit(x)
+
+    d = tempfile.mkdtemp(prefix="heat_tpu_bench_qos_")
+    svc = None
+    try:
+        ttenants.reset()
+        srv.save_model(km, d, version=1, name="km")
+        svc = srv.InferenceService(max_delay_ms=1.0, max_batch=64)
+        svc.load("km", d)
+        svc.set_class("slo", "latency")
+        svc.set_class("bulk", "batch")
+        for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+            svc.predict("km", pts[:b])
+
+        sizes = (1, 3, 7, 12)  # the latency-class small-request mix
+        sheds = {"latency": 0, "batch_ok": 0, "batch_shed": 0}
+
+        def lat_stream(n=200):
+            lat = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                try:
+                    svc.predict("km", pts[: sizes[i % len(sizes)]],
+                                tenant="slo", timeout=30)
+                except OverloadedError:
+                    sheds["latency"] += 1
+                    continue
+                lat.append(time.perf_counter() - t0)
+            return np.sort(np.asarray(lat))
+
+        solo = lat_stream()
+
+        stop = threading.Event()
+
+        def bulk():
+            while not stop.is_set():
+                try:
+                    svc.predict("km", pts[:64], tenant="bulk", timeout=30)
+                    sheds["batch_ok"] += 1
+                except OverloadedError as e:
+                    # honor the lane-aware Retry-After hint (a batch
+                    # client hammering a full lane measures its own
+                    # retry storm, not the scheduler)
+                    sheds["batch_shed"] += 1
+                    time.sleep(min(max(e.retry_after_s or 0.01, 0.005), 0.05))
+
+        floods = [threading.Thread(target=bulk, name=f"bench-qos-bulk-{i}",
+                                   daemon=True) for i in range(4)]
+        for t in floods:
+            t.start()
+        time.sleep(0.1)  # flood to steady state
+        contended = lat_stream()
+        lanes = svc.admission.lane_depths()
+        stop.set()
+        for t in floods:
+            t.join()
+
+        # drain the account hook (it fires on the batcher thread after
+        # callers wake), then read the per-tenant cost ledger
+        deadline = time.time() + 5.0
+        rep = ttenants.tenantz_report()
+        while time.time() < deadline:
+            rep = ttenants.tenantz_report()
+            by = {(r["tenant"], r["class"]) for r in rep["tenants"]}
+            if ("slo", "latency") in by and ("bulk", "batch") in by:
+                break
+            time.sleep(0.01)
+        acct_rows = sum(r["rows"] for r in rep["tenants"])
+        solo_p99 = float(solo[int(len(solo) * 0.99)])
+        cont_p99 = float(contended[int(len(contended) * 0.99)])
+        return {
+            "metric": "qos_latency_p99_ms",
+            "value": round(cont_p99 * 1e3, 3),
+            "unit": "ms",
+            "vs_baseline": round(cont_p99 / solo_p99, 3) if solo_p99 else 0.0,
+            "vs_baseline_kind": "same_stream_solo_no_batch_flood",
+            "solo_p50_ms": round(float(solo[len(solo) // 2]) * 1e3, 3),
+            "solo_p99_ms": round(solo_p99 * 1e3, 3),
+            "contended_p50_ms": round(float(contended[len(contended) // 2]) * 1e3, 3),
+            "contended_p99_ms": round(cont_p99 * 1e3, 3),
+            "latency_shed": sheds["latency"],
+            "batch_admitted": sheds["batch_ok"],
+            "batch_shed": sheds["batch_shed"],
+            "lane_limits": {c: lanes[c]["limit"] for c in lanes},
+            "tenant_accounts": {
+                f"{r['tenant']}/{r['class']}": r["rows"] for r in rep["tenants"]
+            },
+            "accounts_rows_total": acct_rows,
+            "accounts_match_total": acct_rows == rep["total"]["rows"],
+        }
+    finally:
+        if svc is not None:
+            svc.close()
+        ttenants.reset()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main() -> None:
     import heat_tpu as ht
 
@@ -1802,7 +1925,7 @@ def main() -> None:
     for bench in (bench_smoke, bench_kmeans, bench_hsvd, bench_dpsgd, bench_fft3d,
                   bench_dispatch, bench_resilience, bench_overlap, bench_telemetry,
                   bench_analysis, bench_serving, bench_canary, bench_streaming,
-                  bench_fleet):
+                  bench_qos, bench_fleet):
         try:
             r = bench(ht, sync_floor, roofline)
             r.setdefault("vs_baseline_kind", BASELINE_KIND)

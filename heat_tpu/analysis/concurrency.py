@@ -217,6 +217,18 @@ LOCK_REGISTRY = {
         "structures": ("streaming.segment_log.index",),
         "doc": "FileSegmentLog in-memory segment index (start offset -> file) + cached end offset: append() runs on producer threads (bench ingest, refresh drivers) while read()/size rescan from consumer threads; segment files themselves are immutable once atomically renamed in, so reads outside the lock see only committed bytes",
     },
+    "core.preemption": {
+        "file": "heat_tpu/core/preempt.py",
+        "spellings": ("self._lock",),
+        "structures": ("core.preemption.state",),
+        "doc": "PreemptionGate pending-yield slot + counters: requested by admission/handler threads on a latency spike, consulted (and its stats mutated) by fit threads at resumable-fit chunk boundaries, cleared when the latency lane drains",
+    },
+    "telemetry.tenants": {
+        "file": "heat_tpu/telemetry/tenants.py",
+        "spellings": ("_LOCK",),
+        "structures": ("telemetry.tenants.accounts",),
+        "doc": "the per-tenant cost-metering account table (rows/FLOPs/bytes/device-ms per tenant): batcher threads settle each coalesced batch's pro-rata split in, /tenantz handler threads, the fleet poller scrape and the metrics dump read",
+    },
     "streaming.refresh": {
         "file": "heat_tpu/streaming/refresh.py",
         "spellings": ("self._lock",),

@@ -147,6 +147,15 @@ KNOBS = {
     "HEAT_TPU_SERVE_QUEUE_DEPTH": ("int", "256", "admission bound: rows queued-or-in-flight across the service before requests shed with OverloadedError/429"),
     "HEAT_TPU_SERVE_RATE": ("float", "0", "default per-tenant token-bucket refill (rows/s); 0 = unlimited (tenants without an explicit set_quota are not rate-limited)"),
     "HEAT_TPU_SERVE_BURST": ("float", "64", "default per-tenant token-bucket burst capacity (rows)"),
+    # -- QoS scheduling (docs/serving.md "QoS scheduling") --------------
+    "HEAT_TPU_QOS_DEFAULT_CLASS": ("choice", "standard", "priority class of tenants without an explicit set_class: latency | standard | batch"),
+    "HEAT_TPU_QOS_LATENCY_RESERVED_PCT": ("float", "20", "percent of HEAT_TPU_SERVE_QUEUE_DEPTH reserved for the latency lane: standard/batch requests queue-shed once total depth crosses (100 - this)% of the bound, so latency-class admission can never be starved by lower lanes"),
+    "HEAT_TPU_QOS_BATCH_LIMIT_PCT": ("float", "60", "percent of HEAT_TPU_SERVE_QUEUE_DEPTH at which batch-class requests queue-shed (strict class ordering at the depth gate: batch sheds first, then standard, latency last)"),
+    "HEAT_TPU_QOS_DEADLINE_LATENCY_MS": ("float", "10", "class-default coalescing deadline budget (ms) of a latency-class request without an explicit deadline_ms"),
+    "HEAT_TPU_QOS_DEADLINE_STANDARD_MS": ("float", "50", "class-default coalescing deadline budget (ms) of a standard-class request without an explicit deadline_ms"),
+    "HEAT_TPU_QOS_DEADLINE_BATCH_MS": ("float", "1000", "class-default coalescing deadline budget (ms) of a batch-class request without an explicit deadline_ms"),
+    "HEAT_TPU_QOS_PREEMPT_ON_LATENCY": ("bool", "0", "arm the preemption gate from admission: each admitted latency-class request asks running checkpointed batch fits to yield at their next resumable-fit chunk boundary (cleared when the latency lane drains empty)"),
+    "HEAT_TPU_QOS_METER": ("bool", "1", "per-tenant cost metering on the serving path: each coalesced batch's executable FLOPs/bytes and device-ms are attributed to its member tenants pro rata by rows (/tenantz)"),
     # -- streaming (heat_tpu/streaming, docs/streaming.md) --------------
     "HEAT_TPU_STREAM_WINDOW": ("int", "256", "rows per stream fit window (the resumable-fit chunk unit of the online estimators); windows are fixed-size so a resumed consumer replays the identical window sequence from its committed offset"),
     "HEAT_TPU_STREAM_SEGMENT_ROWS": ("int", "4096", "rows per segment file of the file-backed stream log (FileSegmentLog append granularity; reads may span segments)"),

@@ -104,7 +104,7 @@ def resumable_fit_loop(
     import sys as _sys
     import time as _time
 
-    from ..resilience.errors import DivergenceError  # lazy: avoid import cycles
+    from ..resilience.errors import DivergenceError, PreemptedError  # lazy: avoid import cycles
     from ..resilience.faults import inject
     from ..resilience.guard import all_finite
     from ..telemetry import metrics as _tm
@@ -112,6 +112,7 @@ def resumable_fit_loop(
     from ..utils.checkpoint import Checkpointer
     from ..utils.overlap import async_checkpoint_enabled
     from ._env import env_str
+    from .preempt import preemption_gate
 
     # fit heartbeat: iterations/s of the most recent chunk and its
     # convergence delta, refreshed at every chunk boundary so a stalled
@@ -220,6 +221,25 @@ def resumable_fit_loop(
                 # checkpoint above committed converged=False, so a
                 # resume keeps going when more stream data arrives)
                 break
+            # QoS preemption poll — after the boundary checkpoint is
+            # scheduled, so the pause is durable, and only for fits
+            # that actually checkpoint (take(durable=False) refuses and
+            # counts the refusal).  The qos.preempt site fires only
+            # when the gate is honored, so a scripted kill here lands
+            # at the exact yield moment; raising instead pauses
+            # cooperatively — either way a resume_from the same
+            # directory reproduces the uninterrupted result bitwise.
+            preempt_reason = preemption_gate().take(durable=ckpt is not None)
+            if preempt_reason is not None:
+                inject("qos.preempt", iteration=total, reason=preempt_reason)
+                raise PreemptedError(
+                    f"{what} fit preempted at iteration {total} "
+                    f"({preempt_reason}); resume from {directory!r} to "
+                    "continue the identical iteration sequence",
+                    iteration=total,
+                    checkpoint_dir=directory,
+                    reason=preempt_reason,
+                )
             last_good = (state, total)
     finally:
         if ckpt is not None:

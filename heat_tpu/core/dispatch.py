@@ -65,7 +65,9 @@ analyzer.
 
 from __future__ import annotations
 
+import contextlib
 import sys
+import threading
 import time
 import warnings
 from collections import OrderedDict
@@ -99,6 +101,7 @@ __all__ = [
     "fusion_enabled",
     "make_node",
     "materialize",
+    "meter_costs",
     "record_external_dispatch",
     "reset_stats",
     "set_cost_accounting",
@@ -366,6 +369,65 @@ def _record_cost(key, entry, leaves) -> None:
         _cost_records[key] = rec
         while len(_cost_records) > _CACHE_MAXSIZE:
             _cost_records.popitem(last=False)
+
+
+class CostMeter:
+    """Accumulated analyzed cost of the executables one thread ran.
+
+    Filled by :func:`_run` while a :func:`meter_costs` scope is active
+    on the thread: each dispatch adds its cached cost record's FLOPs and
+    bytes.  ``unmetered_calls`` counts dispatches with no cost record
+    (accounting off, analysis probe failed, or record evicted) — the
+    honesty counter that distinguishes "this work was free" from "this
+    work was invisible"."""
+
+    __slots__ = ("flops", "bytes_accessed", "calls", "unmetered_calls")
+
+    def __init__(self) -> None:
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.calls = 0
+        self.unmetered_calls = 0
+
+
+_METER_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def meter_costs():
+    """Meter the analyzed cost of every dispatch on this thread.
+
+    Thread-local and re-entrant (a nested scope meters independently
+    and the outer scope resumes on exit) — the serving path wraps one
+    coalesced batch's inference in a scope to attribute the batch's
+    FLOPs/bytes to its member tenants (/tenantz).  Yields the
+    :class:`CostMeter` being filled."""
+    meter = CostMeter()
+    prev = getattr(_METER_TLS, "meter", None)
+    _METER_TLS.meter = meter
+    try:
+        yield meter
+    finally:
+        _METER_TLS.meter = prev
+
+
+def _meter_note(key) -> None:
+    """Add ``key``'s analyzed cost to the thread's active meter (no-op
+    without one: one TLS read on the unmetered hot path)."""
+    meter = getattr(_METER_TLS, "meter", None)
+    if meter is None:
+        return
+    rec = None
+    if key is not None:
+        with _CACHE_LOCK:
+            _tsan.note_access("dispatch.cache", write=False)
+            rec = _cost_records.get(key)
+    if rec is None:
+        meter.unmetered_calls += 1
+        return
+    meter.calls += 1
+    meter.flops += rec["flops"]
+    meter.bytes_accessed += rec["bytes_accessed"]
 
 
 def _note_lookup(hit: bool) -> None:
@@ -666,8 +728,11 @@ def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = Fals
             t0 = time.perf_counter()
             out = call()
             _obsv.note(key, time.perf_counter() - t0, out)
+            _meter_note(key)
             return out
-        return call()
+        out = call()
+        _meter_note(key)
+        return out
     # cache miss: the first call traces + compiles; record the wall time
     # so ``where did the compile time go?`` is answerable from telemetry
     t0 = time.perf_counter()
@@ -680,6 +745,7 @@ def _run(compiled, leaves, n_ops: int, donated: bool = False, fresh: bool = Fals
         with warnings.catch_warnings():
             warnings.filterwarnings("ignore", message=".*[Dd]onat")
             _record_cost(key, compiled, leaves)
+    _meter_note(key)
     return out
 
 

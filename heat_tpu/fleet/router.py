@@ -57,7 +57,10 @@ watermark table, slowest replica per key highlighted via the PR 6
 straggler score, plus each ready replica's ``/canaryz`` canary
 decision-plane snapshot rolled into a fleet-wide per-model verdict
 table with divergent-replica highlighting; ``?format=json`` for the
-machine form), ``/metrics`` (the router process's own registry).
+machine form), ``/tenantz`` (the fleet-merged per-tenant cost ledger:
+each ready replica's ``/tenantz`` accounts summed per tenant via
+``aggregate.merge_tenant_accounts`` — the fleet answer to "which tenant
+cost what"), ``/metrics`` (the router process's own registry).
 """
 
 from __future__ import annotations
@@ -115,6 +118,7 @@ class _Replica:
         "url", "ready", "state", "models", "not_models", "inflight", "fails",
         "cb_open", "cb_open_until", "probing", "last_poll_ok", "added_at",
         "observatory", "observatory_ts", "canary", "canary_ts",
+        "tenants", "tenants_ts",
     )
 
     def __init__(self, url: str):
@@ -139,6 +143,10 @@ class _Replica:
         #: the fleet-wide canary rollup's per-replica half
         self.canary: Optional[Dict[str, Any]] = None
         self.canary_ts = 0.0
+        #: last /tenantz?format=json snapshot (same throttled cadence) —
+        #: the fleet-wide per-tenant cost rollup's per-replica half
+        self.tenants: Optional[Dict[str, Any]] = None
+        self.tenants_ts = 0.0
 
     def doc(self) -> Dict[str, Any]:
         return {
@@ -350,6 +358,7 @@ class FleetRouter:
             due = ready and now - obs_ts.get(url, 0.0) >= obs_period
             obs = self._probe_rooflinez(url) if due else None
             can = self._probe_canaryz(url) if due else None
+            ten = self._probe_tenantz(url) if due else None
             with self._lock:
                 _tsan.note_access("fleet.router.replicas")
                 r = self._replicas.get(url)
@@ -361,6 +370,9 @@ class FleetRouter:
                 if can is not None:
                     r.canary = can
                     r.canary_ts = time.time()
+                if ten is not None:
+                    r.tenants = ten
+                    r.tenants_ts = time.time()
                 if r.state == "draining" and state not in ("ready",):
                     # a locally initiated drain sticks until the replica
                     # itself reports ready again (a cancelled drain)
@@ -416,6 +428,19 @@ class FleetRouter:
                 doc = json.load(resp)
             return doc if isinstance(doc, dict) else None
         except Exception:  # lint: allow H501(a canary-less replica is a rollup gap, not an error)
+            return None
+
+    def _probe_tenantz(self, url: str) -> Optional[Dict[str, Any]]:
+        """One replica's per-tenant cost-account snapshot, or None
+        (replica without the route, unreachable, or malformed — never
+        raises)."""
+        try:
+            with urllib.request.urlopen(
+                url + "/tenantz?format=json", timeout=2.0
+            ) as resp:
+                doc = json.load(resp)
+            return doc if isinstance(doc, dict) else None
+        except Exception:  # lint: allow H501(a meter-less replica is a rollup gap, not an error)
             return None
 
     # -- routing policy -------------------------------------------------
@@ -617,7 +642,7 @@ class FleetRouter:
         headers)``.  The in-process entry point the HTTP handlers and
         the tests share."""
         bare = path.split("?", 1)[0]
-        if bare.startswith("/fleet/") or bare in ("/metrics", "/fleetz"):
+        if bare.startswith("/fleet/") or bare in ("/metrics", "/fleetz", "/tenantz"):
             query = path.split("?", 1)[1] if "?" in path else ""
             params = dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
             return self._handle_local(bare, params)
@@ -713,6 +738,14 @@ class FleetRouter:
             if params.get("format") == "json":
                 return 200, json.dumps(self.fleetz_report(), indent=1, default=str), "application/json", {}
             return 200, self.render_fleetz_html(), "text/html", {}
+        if path == "/tenantz":
+            # the fleet-merged view of every replica's tenant accounts —
+            # same route name as the replica surface, so a dashboard
+            # pointed at "the service" works against router or replica
+            doc = self.fleetz_report()["tenants"]
+            if params.get("format") == "json":
+                return 200, json.dumps(doc, indent=1, default=str), "application/json", {}
+            return 200, self._render_tenants_html(doc), "text/html", {}
         if path == "/metrics":
             from ..telemetry.server import OPENMETRICS_CONTENT_TYPE
 
@@ -741,6 +774,11 @@ class FleetRouter:
                 r.url: dict(r.canary)
                 for r in self._replicas.values()
                 if r.canary is not None
+            }
+            tenant_snaps = {
+                r.url: dict(r.tenants)
+                for r in self._replicas.values()
+                if r.tenants is not None
             }
         replicas: Dict[str, Any] = {}
         kernels: Dict[str, Dict[str, Any]] = {}
@@ -803,12 +841,21 @@ class FleetRouter:
             e["divergent"] = (
                 len(e["verdicts"]) > 1 or len(e["canary_versions"]) > 1
             )
+        # fleet-wide per-tenant cost rollup: each replica's /tenantz
+        # accounts merged by tenant — totals re-derived from the merged
+        # rows, so "accounts sum to the fleet total" survives the merge
+        from ..telemetry.aggregate import merge_tenant_accounts
+
+        tenants = merge_tenant_accounts(
+            [tenant_snaps[u] for u in sorted(tenant_snaps)]
+        )
         return {
             "timestamp": now,
             "ready_replicas": self._count_ready(),
             "replicas": replicas,
             "kernels": dict(sorted(kernels.items())),
             "canary": dict(sorted(canary_models.items())),
+            "tenants": tenants,
         }
 
     def render_fleetz_html(self) -> str:
@@ -922,8 +969,59 @@ class FleetRouter:
             parts.append("</table>")
         else:
             parts.append("<p>no canary snapshots collected yet</p>")
-        parts.append("</body></html>")
+        parts.append("<h2>fleet tenant accounts</h2>")
+        parts.append(self._tenants_table_html(doc.get("tenants") or {}))
+        parts.append(
+            "<p><a href='/tenantz'>full /tenantz</a> · "
+            "<a href='/fleetz?format=json'>json</a></p></body></html>"
+        )
         return "".join(parts)
+
+    @staticmethod
+    def _tenants_table_html(doc: Dict[str, Any]) -> str:
+        """The merged-tenant-ledger table fragment (/fleetz + /tenantz)."""
+        import html as _html
+
+        rows = doc.get("tenants") or []
+        if not rows:
+            return "<p>no tenant-account snapshots collected yet</p>"
+        t = doc.get("total") or {}
+        parts = [
+            f"<p>{t.get('tenants', 0)} tenants · {t.get('rows', 0)} rows · "
+            f"{float(t.get('flops') or 0.0):.3g} FLOPs · "
+            f"{float(t.get('device_ms') or 0.0):.1f} device-ms across "
+            f"{doc.get('sources', 0)} replica snapshot(s)</p>",
+            "<table border=1 cellpadding=3><tr><th>tenant</th><th>class</th>"
+            "<th>requests</th><th>rows</th><th>FLOPs</th><th>bytes</th>"
+            "<th>device-ms</th><th>replicas</th><th>models</th></tr>",
+        ]
+        for r in rows:
+            parts.append(
+                "<tr>"
+                f"<td>{_html.escape(str(r['tenant']))}</td>"
+                f"<td>{_html.escape(str(r.get('class')))}</td>"
+                f"<td align=right>{r['requests']}</td>"
+                f"<td align=right>{r['rows']}</td>"
+                f"<td align=right>{float(r['flops']):.3g}</td>"
+                f"<td align=right>{float(r['bytes_accessed']):.3g}</td>"
+                f"<td align=right>{float(r['device_ms']):.1f}</td>"
+                f"<td align=right>{r.get('replicas')}</td>"
+                f"<td>{_html.escape(', '.join(r.get('models') or []))}</td>"
+                "</tr>"
+            )
+        parts.append("</table>")
+        return "".join(parts)
+
+    def _render_tenants_html(self, doc: Dict[str, Any]) -> str:
+        """The human form of the router's merged ``/tenantz``."""
+        return (
+            "<html><head><title>tenantz (fleet)</title></head><body>"
+            "<h1>Fleet per-tenant cost accounts</h1>"
+            + self._tenants_table_html(doc)
+            + "<p><a href='/tenantz?format=json'>json</a> · merged from the "
+            "health poller's per-replica /tenantz snapshots</p>"
+            "</body></html>"
+        )
 
     # -- introspection / autoscaler signals ----------------------------
     def statusz(self) -> Dict[str, Any]:

@@ -39,6 +39,7 @@ from .errors import (
     NoReplicaError,
     OverloadedError,
     PermanentFault,
+    PreemptedError,
     ReshapeError,
     ResilienceError,
     TransientFault,
@@ -77,6 +78,7 @@ __all__ = [
     "PermanentFault",
     "NoReplicaError",
     "OverloadedError",
+    "PreemptedError",
     "ReshapeError",
     "ResilienceError",
     "RetryPolicy",

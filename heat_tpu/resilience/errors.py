@@ -33,6 +33,7 @@ __all__ = [
     "DivergenceError",
     "NoReplicaError",
     "OverloadedError",
+    "PreemptedError",
     "ReshapeError",
     "WorkerLostError",
 ]
@@ -145,6 +146,33 @@ class OverloadedError(ResilienceError, RuntimeError):
         self.tenant = tenant
         self.cause = cause
         self.retry_after_s = retry_after_s
+
+
+class PreemptedError(ResilienceError, RuntimeError):
+    """A checkpointed batch fit yielded at a chunk boundary.
+
+    Deliberate scheduling, not a malfunction: a latency spike (or an
+    operator) asked the :class:`~heat_tpu.core.preempt.PreemptionGate`
+    to reclaim the chips, and the fit paused at the first chunk boundary
+    after the request — the point where its checkpoint (committed with
+    ``converged=False``) already makes the pause durable.  Re-running
+    the same fit with ``resume_from`` pointing at ``checkpoint_dir``
+    continues the identical iteration sequence, so the resumed result is
+    bitwise-equal to the uninterrupted fit.  Never retried by the
+    resilience machinery — resuming *while the spike is still on* is
+    exactly the contention the preemption exists to end."""
+
+    def __init__(
+        self,
+        message: str = "fit preempted",
+        iteration: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        reason: Optional[str] = None,
+    ):
+        super().__init__(message)
+        self.iteration = iteration
+        self.checkpoint_dir = checkpoint_dir
+        self.reason = reason
 
 
 class NoReplicaError(ResilienceError, RuntimeError):

@@ -115,6 +115,7 @@ KNOWN_SITES = (
     "stream.read",
     "stream.commit",
     "stream.refresh",
+    "qos.preempt",
 )
 
 #: process-lifetime totals (survive injector deactivation) — registered

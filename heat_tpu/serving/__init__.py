@@ -45,10 +45,10 @@ semantics, quota knobs and curl examples.
 
 from __future__ import annotations
 
-from ..resilience.errors import OverloadedError
-from .admission import AdmissionController, TokenBucket
+from ..resilience.errors import OverloadedError, PreemptedError
+from .admission import QOS_CLASSES, AdmissionController, TokenBucket
 from .canary import CanaryController
-from .coalescer import ModelBatcher
+from .coalescer import ModelBatcher, effective_deadline, take_edf_batch
 from .model_io import (
     SUPPORTED_KINDS,
     build_estimator,
@@ -71,12 +71,16 @@ __all__ = [
     "ModelRegistry",
     "OverloadedError",
     "PendingLoad",
+    "PreemptedError",
+    "QOS_CLASSES",
     "SUPPORTED_KINDS",
     "TokenBucket",
     "build_estimator",
     "default_service",
+    "effective_deadline",
     "export_state",
     "save_model",
     "start_serving",
     "stop_serving",
+    "take_edf_batch",
 ]

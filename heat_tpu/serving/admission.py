@@ -13,20 +13,38 @@ queue:
   never competes with in-quota tenants for batch slots — the isolation
   property the acceptance gate measures (an over-quota tenant hammers,
   in-quota p99 holds).
-* **bounded admission depth** — at most ``HEAT_TPU_SERVE_QUEUE_DEPTH``
-  rows may be queued-or-in-flight across the service; past it every
-  tenant is shed (``cause="queue"``) instead of the queue growing
-  without bound and collapsing tail latency for everyone.  The shed's
-  ``Retry-After`` is computed from the **measured drain rate** (rows
-  released over a sliding window): ``excess_rows / drain_rate``,
-  clamped to [1 ms, 30 s] — so the fleet router and clients back off
-  proportionally to how fast the queue actually moves, not by a coarse
-  constant (``None`` before any drain has been observed).
+* **bounded admission depth, in priority lanes** — at most
+  ``HEAT_TPU_SERVE_QUEUE_DEPTH`` rows may be queued-or-in-flight across
+  the service, but the bound is applied per **QoS class** with strict
+  ordering (docs/serving.md "QoS scheduling").  Each tenant carries a
+  class (:data:`QOS_CLASSES`: ``latency`` / ``standard`` / ``batch``,
+  default from ``HEAT_TPU_QOS_DEFAULT_CLASS``), and each class sheds
+  (``cause="queue"``) at its own depth limit: ``batch`` first (at
+  ``HEAT_TPU_QOS_BATCH_LIMIT_PCT`` percent of the bound), ``standard``
+  next (the bound minus the ``HEAT_TPU_QOS_LATENCY_RESERVED_PCT``
+  percent reserved for the latency lane), ``latency`` last (the full
+  bound).  Because the lower lanes stop admitting before the reserve is
+  reached, a saturated batch lane can never starve latency-class
+  admission — the reserve is headroom only the latency lane may use.
+  The shed's ``Retry-After`` is computed from the **lane's own measured
+  drain rate** (rows of that class released over a sliding window):
+  ``excess_rows / lane_drain_rate``, clamped to [1 ms, 30 s] — so a
+  slow-draining batch lane does not inflate the latency lane's
+  advertised backoff (the all-lane rate is the cold-lane fallback,
+  ``None`` before any drain has been observed at all).
+
+Admitting a latency-class request under
+``HEAT_TPU_QOS_PREEMPT_ON_LATENCY`` also raises the process-wide
+:class:`~heat_tpu.core.preempt.PreemptionGate` — running checkpointed
+batch fits yield the chips at their next resumable-fit chunk boundary
+— and the gate is cleared when the latency lane drains empty.
 
 Every decision is accounted in the metrics registry:
 ``serving.requests`` / ``serving.shed_quota`` / ``serving.shed_queue``
-counters and the ``serving.queue_depth`` gauge — the signals a load
-balancer or autoscaler watches on ``/metrics``.
+counters (queue sheds also per lane, ``serving.shed_queue.<class>``)
+and the ``serving.queue_depth`` / ``serving.lane_depth.<class>``
+gauges — the signals a load balancer or autoscaler watches on
+``/metrics``.
 """
 
 from __future__ import annotations
@@ -36,10 +54,16 @@ from collections import deque
 from typing import Dict, Optional
 
 from ..analysis import tsan as _tsan
+from ..core._env import env_flag, env_float, env_str
 from ..resilience.errors import OverloadedError
 from ..telemetry import metrics as _tm
 
-__all__ = ["AdmissionController", "TokenBucket"]
+__all__ = ["AdmissionController", "QOS_CLASSES", "TokenBucket"]
+
+#: Priority classes, highest first.  Strict ordering at the depth gate:
+#: a class's depth limit is never below any lower class's, so the lanes
+#: shed in reverse priority order as the queue fills.
+QOS_CLASSES = ("latency", "standard", "batch")
 
 _REQS_C = _tm.counter("serving.requests", "prediction requests admitted")
 _SHED_QUOTA_C = _tm.counter(
@@ -51,6 +75,20 @@ _SHED_QUEUE_C = _tm.counter(
 _DEPTH_G = _tm.gauge(
     "serving.queue_depth", "rows admitted and not yet answered"
 )
+_LANE_SHED_C = {
+    cls: _tm.counter(
+        f"serving.shed_queue.{cls}",
+        f"{cls}-class requests shed at the lane's depth limit (429)",
+    )
+    for cls in QOS_CLASSES
+}
+_LANE_DEPTH_G = {
+    cls: _tm.gauge(
+        f"serving.lane_depth.{cls}",
+        f"{cls}-class rows admitted and not yet answered",
+    )
+    for cls in QOS_CLASSES
+}
 
 
 class TokenBucket:
@@ -107,8 +145,38 @@ class AdmissionController:
         self._depth = 0
         #: (monotonic, rows) per release inside the sliding window — the
         #: measured service drain rate a queue-caused shed's Retry-After
-        #: is computed from (rows ahead / rows-per-second drained)
+        #: is computed from (rows ahead / rows-per-second drained).
+        #: ``_drained`` is the all-lane window (cold-lane fallback);
+        #: ``_lane_drained[cls]`` is the lane's own window, so one slow
+        #: lane cannot mis-pace another lane's advertised backoff.
         self._drained: deque = deque()
+        self._lane_drained: Dict[str, deque] = {cls: deque() for cls in QOS_CLASSES}
+        self._lane_depth: Dict[str, int] = {cls: 0 for cls in QOS_CLASSES}
+        self._classes: Dict[str, str] = {}
+        self.default_class = env_str("HEAT_TPU_QOS_DEFAULT_CLASS")
+        if self.default_class not in QOS_CLASSES:
+            raise ValueError(
+                f"HEAT_TPU_QOS_DEFAULT_CLASS must be one of {QOS_CLASSES}, "
+                f"got {self.default_class!r}"
+            )
+        # strict class ordering: batch limit <= standard limit <= bound,
+        # so the lanes shed lowest-priority-first as the queue fills and
+        # the top (100 - reserved)% .. 100% band is latency-only
+        reserved = self.max_depth * env_float("HEAT_TPU_QOS_LATENCY_RESERVED_PCT") / 100.0
+        standard_limit = max(1, int(round(self.max_depth - reserved)))
+        batch_limit = max(
+            1,
+            min(
+                standard_limit,
+                int(round(self.max_depth * env_float("HEAT_TPU_QOS_BATCH_LIMIT_PCT") / 100.0)),
+            ),
+        )
+        self.lane_limits: Dict[str, int] = {
+            "latency": self.max_depth,
+            "standard": standard_limit,
+            "batch": batch_limit,
+        }
+        self._preempt_on_latency = env_flag("HEAT_TPU_QOS_PREEMPT_ON_LATENCY")
         self._lock = _tsan.register_lock("serving.admission")
 
     def set_quota(self, tenant: str, rate: float, burst: Optional[float] = None) -> None:
@@ -120,20 +188,41 @@ class AdmissionController:
                 rate, burst if burst is not None else max(rate, 1.0)
             )
 
-    def admit(self, tenant: str, rows: int = 1) -> None:
+    def set_class(self, tenant: str, cls: str) -> None:
+        """Pin ``tenant``'s QoS class (``latency``/``standard``/``batch``);
+        unknown tenants default to ``HEAT_TPU_QOS_DEFAULT_CLASS``."""
+        if cls not in QOS_CLASSES:
+            raise ValueError(f"QoS class must be one of {QOS_CLASSES}, got {cls!r}")
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets")
+            self._classes[tenant] = cls
+
+    def class_of(self, tenant: str) -> str:
+        """``tenant``'s QoS class (the registered default when unset)."""
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets", write=False)
+            return self._classes.get(tenant, self.default_class)
+
+    def admit(self, tenant: str, rows: int = 1) -> str:
         """Admit ``rows`` for ``tenant`` or raise :class:`OverloadedError`.
 
-        Queue bound first (protects the process), quota second (bills
-        the tenant only for admittable work)."""
+        Queue bound (at the tenant's lane limit) first — protects the
+        process — quota second (bills the tenant only for admittable
+        work).  Returns the tenant's QoS class; pass it back to
+        :meth:`release` so the lane accounting stays balanced."""
         rows = max(1, int(rows))
         with self._lock:
             _tsan.note_access("serving.admission.buckets")
-            if self._depth + rows > self.max_depth:
+            cls = self._classes.get(tenant, self.default_class)
+            limit = self.lane_limits[cls]
+            if self._depth + rows > limit:
                 _SHED_QUEUE_C.inc()
-                retry_after = self._queue_retry_after(rows)
+                _LANE_SHED_C[cls].inc()
+                retry_after = self._queue_retry_after(rows, cls)
                 raise OverloadedError(
-                    f"admission queue full ({self._depth}/{self.max_depth} rows "
-                    f"in flight); request of {rows} rows shed",
+                    f"admission queue full for the {cls} lane ({self._depth} rows "
+                    f"in flight, lane limit {limit}/{self.max_depth}); request "
+                    f"of {rows} rows shed",
                     tenant=tenant,
                     cause="queue",
                     retry_after_s=retry_after,
@@ -154,26 +243,52 @@ class AdmissionController:
                     retry_after_s=retry_after,
                 )
             self._depth += rows
+            self._lane_depth[cls] += rows
             _DEPTH_G.set(self._depth)
+            _LANE_DEPTH_G[cls].set(self._lane_depth[cls])
         _REQS_C.inc()
+        if cls == "latency" and self._preempt_on_latency:
+            # outside the admission lock: the gate has its own lock and
+            # the request is level-triggered, so ordering races between
+            # concurrent admits are harmless
+            from ..core.preempt import preemption_gate  # lazy: serving->core edge
 
-    def release(self, rows: int = 1) -> None:
+            preemption_gate().request("latency-lane admission")
+        return cls
+
+    def release(self, rows: int = 1, cls: Optional[str] = None) -> None:
         """Return ``rows`` previously admitted (request answered or
-        failed); each release feeds the drain-rate window queue-shed
+        failed); ``cls`` is the class :meth:`admit` returned (defaults
+        to the controller's default class).  Each release feeds both the
+        all-lane and the lane's own drain-rate window queue-shed
         Retry-After estimates are computed from."""
         rows = max(1, int(rows))
         now = time.monotonic()
+        lane_empty = False
         with self._lock:
             _tsan.note_access("serving.admission.buckets")
+            if cls is None or cls not in QOS_CLASSES:
+                cls = self.default_class
             self._depth = max(0, self._depth - rows)
+            self._lane_depth[cls] = max(0, self._lane_depth[cls] - rows)
             _DEPTH_G.set(self._depth)
+            _LANE_DEPTH_G[cls].set(self._lane_depth[cls])
             self._drained.append((now, rows))
+            self._lane_drained[cls].append((now, rows))
             self._prune(now)
+            lane_empty = cls == "latency" and self._lane_depth["latency"] == 0
+        if lane_empty and self._preempt_on_latency:
+            from ..core.preempt import preemption_gate  # lazy: serving->core edge
+
+            preemption_gate().clear()
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.DRAIN_WINDOW_S
         while self._drained and self._drained[0][0] < cutoff:
             self._drained.popleft()
+        for lane in self._lane_drained.values():
+            while lane and lane[0][0] < cutoff:
+                lane.popleft()
 
     def drain_rate(self) -> float:
         """Measured service drain rate (rows released per second over
@@ -190,25 +305,55 @@ class AdmissionController:
             span = max(now - self._drained[0][0], 0.1)
             return rows / span
 
-    def _queue_retry_after(self, rows: int) -> Optional[float]:
+    def _queue_retry_after(self, rows: int, cls: Optional[str] = None) -> Optional[float]:
         """Retry-After for a queue-caused shed: how long until the queue
-        has drained enough headroom for ``rows``, at the measured drain
-        rate (caller holds the lock).  ``None`` before any drain has
-        been observed — a cold process has no basis for an estimate and
-        the coarse constant it would fabricate mis-paces every client."""
+        has drained enough headroom below ``cls``'s lane limit for
+        ``rows``, at the **lane's own** measured drain rate (caller
+        holds the lock).  A lane that has not drained inside the window
+        falls back to the all-lane rate — better a blended estimate
+        than none — and ``None`` before any drain has been observed at
+        all: a cold process has no basis for an estimate and the coarse
+        constant it would fabricate mis-paces every client."""
         now = time.monotonic()
         self._prune(now)
-        if not self._drained:
+        window = self._lane_drained.get(cls) if cls is not None else None
+        if not window:
+            window = self._drained
+        if not window:
             return None
-        drained_rows = sum(r for _, r in self._drained)
-        span = max(now - self._drained[0][0], 0.1)
+        drained_rows = sum(r for _, r in window)
+        # span floor: a single just-now release must not read as an
+        # (effectively infinite) instantaneous rate
+        span = max(now - window[0][0], 0.1)
         rate = drained_rows / span
         if rate <= 0.0:
             return None
-        excess = self._depth + rows - self.max_depth
+        limit = self.lane_limits.get(cls, self.max_depth)
+        excess = self._depth + rows - limit
         return min(max(excess / rate, 0.001), 30.0)
 
     def depth(self) -> int:
         with self._lock:
             _tsan.note_access("serving.admission.buckets", write=False)
             return self._depth
+
+    def lane_depths(self) -> Dict[str, Dict[str, float]]:
+        """Per-class admission accounting: rows in flight, the lane's
+        depth limit and its windowed drain rate (rows/s) — the
+        per-model healthz and /tenantz surfaces read this."""
+        now = time.monotonic()
+        with self._lock:
+            _tsan.note_access("serving.admission.buckets", write=False)
+            self._prune(now)
+            out: Dict[str, Dict[str, float]] = {}
+            for cls in QOS_CLASSES:
+                window = self._lane_drained[cls]
+                rate = 0.0
+                if window:
+                    rate = sum(r for _, r in window) / max(now - window[0][0], 0.1)
+                out[cls] = {
+                    "depth": self._lane_depth[cls],
+                    "limit": self.lane_limits[cls],
+                    "drain_rate": round(rate, 3),
+                }
+            return out

@@ -61,7 +61,7 @@ from ..telemetry import slo as _slo
 from ..telemetry import tracing as _tracing
 from ..telemetry.spans import stage_note as _stage_note
 from . import canary as _canary
-from .admission import AdmissionController
+from .admission import QOS_CLASSES, AdmissionController
 from .coalescer import ModelBatcher, observe_stage
 from .model_io import infer as _infer
 from .registry import ModelRegistry
@@ -139,6 +139,14 @@ class InferenceService:
         self._batchers: Dict[str, ModelBatcher] = {}
         self._open = True
         self._started_monitor = False
+        #: per-tenant cost metering (HEAT_TPU_QOS_METER): each coalesced
+        #: batch's analyzed FLOPs/bytes + device-ms are attributed to
+        #: its member tenants pro rata by rows (/tenantz)
+        self._meter = env.env_flag("HEAT_TPU_QOS_METER")
+        #: batcher-thread-local handoff from _infer_batch (which meters
+        #: the inference) to _account_batch (which settles it) — both
+        #: run on the same batcher thread, in that order, per batch
+        self._infer_cost = threading.local()
         #: lifecycle state the /readyz readiness verdict keys off:
         #: "warming" (up, pre-warming the executable cache — not ready),
         #: "ready" (routable), "draining" (finishing in-flight work —
@@ -179,6 +187,11 @@ class InferenceService:
     def set_quota(self, tenant: str, rate: float, burst: Optional[float] = None) -> None:
         self.admission.set_quota(tenant, rate, burst)
 
+    def set_class(self, tenant: str, cls: str) -> None:
+        """Pin ``tenant``'s QoS class (``latency``/``standard``/``batch``,
+        docs/serving.md "QoS scheduling")."""
+        self.admission.set_class(tenant, cls)
+
     # -- the hot path ---------------------------------------------------
     def _batcher(self, name: str) -> ModelBatcher:
         self.registry.record(name)  # KeyError -> 404 before a thread spawns
@@ -202,6 +215,14 @@ class InferenceService:
                     on_mirror=lambda rows, out, tid, ms, _n=name: (
                         self.canary.offer(_n, rows, out, tid, ms)
                     ),
+                    # per-tenant cost settlement (HEAT_TPU_QOS_METER) —
+                    # reads the metered inference cost _infer_batch
+                    # parked on this same batcher thread
+                    on_account=(
+                        (lambda parts, ms, _n=name: self._account_batch(_n, parts, ms))
+                        if self._meter
+                        else None
+                    ),
                 )
             return b
 
@@ -211,6 +232,9 @@ class InferenceService:
         ``dispatch`` stage (DNDarray wrap + program dispatch — any
         compile span nests here and inherits the trace) and the
         ``execute`` stage (forcing the result: device compute + fetch)."""
+        from contextlib import nullcontext
+
+        from ..core import dispatch as _dispatch
         from ..core import factories
 
         est = self.registry.get(name)
@@ -220,20 +244,46 @@ class InferenceService:
                 (name, int(rows.shape[0]), int(rows.shape[1]), str(rows.dtype))
             )
         tid = _tracing.current_trace_id()
-        t0 = time.perf_counter_ns()
-        # the ambient trace context is live here, so a cold bucket's
-        # dispatch.compile span inherits the request that paid for it
-        x = factories.array(rows, split=self.split, comm=self.registry.comm)
-        y = _infer(est, x)
-        t1 = time.perf_counter_ns()
-        _stage_note("serve.dispatch", t0, t1 - t0, model=name, rows=int(rows.shape[0]))
-        observe_stage("dispatch", (t1 - t0) / 1e6, tid)
-        t0 = time.perf_counter_ns()
-        out = y.numpy()
-        t1 = time.perf_counter_ns()
-        _stage_note("serve.execute", t0, t1 - t0, model=name)
-        observe_stage("execute", (t1 - t0) / 1e6, tid)
+        td0 = time.perf_counter_ns()
+        # cost metering scope: every dispatch of this batch's inference
+        # adds its analyzed FLOPs/bytes to the meter; _account_batch
+        # (same batcher thread, right after the callers wake) splits it
+        # across the batch's tenants
+        with (_dispatch.meter_costs() if self._meter else nullcontext(None)) as meter:
+            t0 = time.perf_counter_ns()
+            # the ambient trace context is live here, so a cold bucket's
+            # dispatch.compile span inherits the request that paid for it
+            x = factories.array(rows, split=self.split, comm=self.registry.comm)
+            y = _infer(est, x)
+            t1 = time.perf_counter_ns()
+            _stage_note("serve.dispatch", t0, t1 - t0, model=name, rows=int(rows.shape[0]))
+            observe_stage("dispatch", (t1 - t0) / 1e6, tid)
+            t0 = time.perf_counter_ns()
+            out = y.numpy()
+            t1 = time.perf_counter_ns()
+            _stage_note("serve.execute", t0, t1 - t0, model=name)
+            observe_stage("execute", (t1 - t0) / 1e6, tid)
+        if meter is not None:
+            self._infer_cost.last = (
+                meter.flops,
+                meter.bytes_accessed,
+                (time.perf_counter_ns() - td0) / 1e6,
+            )
         return out
+
+    def _account_batch(self, name: str, parts, infer_ms: float) -> None:
+        """Settle one coalesced batch into the tenant ledger (/tenantz):
+        the metered cost _infer_batch parked on this thread, split pro
+        rata by rows.  Batcher-thread hook — never a caller's latency."""
+        from ..telemetry import tenants as _tenants
+
+        cost = getattr(self._infer_cost, "last", None)
+        self._infer_cost.last = None
+        flops, bytes_accessed, device_ms = cost if cost else (0.0, 0.0, float(infer_ms))
+        _tenants.note_batch(
+            name, parts, flops=flops, bytes_accessed=bytes_accessed,
+            device_ms=device_ms,
+        )
 
     def predict(
         self,
@@ -241,13 +291,19 @@ class InferenceService:
         rows,
         tenant: str = "default",
         timeout: Optional[float] = None,
+        deadline_s: Optional[float] = None,
     ) -> np.ndarray:
         """Predict ``rows`` (one (n, features) request) on model
         ``name``; blocks until the coalesced batch answers.
 
-        Raises :class:`OverloadedError` when shed, ``KeyError`` for an
-        unknown model, the batch's error when its dispatch failed."""
-        out, _info = self._predict(name, rows, tenant=tenant, timeout=timeout)
+        ``deadline_s`` is an explicit coalescing deadline budget
+        (seconds from now; default: the tenant's class budget,
+        ``HEAT_TPU_QOS_DEADLINE_*_MS``).  Raises
+        :class:`OverloadedError` when shed, ``KeyError`` for an unknown
+        model, the batch's error when its dispatch failed."""
+        out, _info = self._predict(
+            name, rows, tenant=tenant, timeout=timeout, deadline_s=deadline_s
+        )
         return out
 
     def _predict(
@@ -257,6 +313,7 @@ class InferenceService:
         tenant: str = "default",
         timeout: Optional[float] = None,
         trace_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ):
         """The traced predict path: returns ``(out, info)`` where
         ``info`` carries the request's ``trace_id`` and its measured
@@ -279,7 +336,7 @@ class InferenceService:
         with req:
             t0 = time.perf_counter_ns()
             try:
-                self.admission.admit(tenant, n)
+                cls = self.admission.admit(tenant, n)
             finally:
                 t1 = time.perf_counter_ns()
                 _stage_note(
@@ -287,9 +344,12 @@ class InferenceService:
                 )
             observe_stage("admission", (t1 - t0) / 1e6, req.trace_id)
             try:
-                out = self._batcher(name).submit(rows, timeout=timeout)
+                out = self._batcher(name).submit(
+                    rows, timeout=timeout, tenant=tenant, cls=cls,
+                    deadline_s=deadline_s,
+                )
             finally:
-                self.admission.release(n)
+                self.admission.release(n, cls)
         _LATENCY_H.observe(
             req.duration_ms,
             exemplar=req.trace_id
@@ -497,6 +557,25 @@ class InferenceService:
             ),
             "last_batch_trace_id": b.last_batch_trace_id if b is not None else None,
         }
+        # per-lane picture: queued rows + oldest-waiting-age from this
+        # model's coalescer joined with the service-wide admission lane
+        # depths/limits — "latency stuck behind batch" is diagnosable
+        # from this route alone, no /varz scrape needed
+        queue_lanes = (
+            b.lane_depths()
+            if b is not None
+            else {c: {"queued_rows": 0, "oldest_wait_s": 0.0} for c in QOS_CLASSES}
+        )
+        adm_lanes = self.admission.lane_depths()
+        doc["lanes"] = {
+            c: {
+                "queued_rows": queue_lanes[c]["queued_rows"],
+                "oldest_wait_s": queue_lanes[c]["oldest_wait_s"],
+                "admitted_rows_in_flight": adm_lanes[c]["depth"],
+                "depth_limit": adm_lanes[c]["limit"],
+            }
+            for c in QOS_CLASSES
+        }
         if b is None:
             doc["status"] = "idle"  # loaded, no traffic yet — healthy
         elif not b.alive():
@@ -616,6 +695,19 @@ class InferenceService:
         name = doc["model"]
         rows = np.asarray(doc["inputs"], dtype=np.float32)
         tenant = str(doc.get("tenant", "default"))
+        # explicit coalescing deadline: the ``deadline_ms`` body field
+        # wins over the ``X-Heat-Deadline-Ms`` header (the body rides
+        # through the fleet router's proxy verbatim; the header works at
+        # the replica surface)
+        deadline_ms = doc.get("deadline_ms")
+        if deadline_ms is None:
+            deadline_ms = _tserver.request_headers().get("x-heat-deadline-ms")
+        try:
+            deadline_s = float(deadline_ms) / 1e3 if deadline_ms is not None else None
+        except (TypeError, ValueError):
+            return 400, "application/json", json.dumps(
+                {"error": f"deadline_ms must be a number, got {deadline_ms!r}"}
+            )
         # one timing source: the latency (and trace id) the response
         # reports IS the measurement serving.latency_ms observed — the
         # route never re-times the request independently
@@ -623,6 +715,7 @@ class InferenceService:
         out, info = self._predict(
             name, rows, tenant=tenant, timeout=doc.get("timeout"),
             trace_id=str(trace_id) if trace_id else None,
+            deadline_s=deadline_s,
         )
         version = self.registry.active_version(name)
         return 200, "application/json", json.dumps(

@@ -51,6 +51,7 @@ from . import tracing as _tracing
 __all__ = [
     "gather_snapshots",
     "merge_snapshots",
+    "merge_tenant_accounts",
     "read_worker_snapshots",
     "span_stats",
     "stitch_traces",
@@ -380,6 +381,56 @@ def _merge_canary(snaps: Sequence[Dict]) -> Dict[str, Any]:
     events.sort(key=lambda ev: (ev.get("ts", 0.0), ev.get("worker", ""),
                                 ev.get("model", "")))
     return {"models": dict(sorted(models.items())), "events": events}
+
+
+def merge_tenant_accounts(reports: Sequence[Dict]) -> Dict[str, Any]:
+    """Fold per-replica ``/tenantz`` reports into one fleet-wide ledger.
+
+    Every account field is a lifetime *sum* on each replica, so the
+    fleet view sums them per tenant across replicas; the fleet total is
+    re-derived from the merged tenant rows, so "accounts sum to the
+    fleet total" survives the rollup by construction.  Pure and
+    deterministic like the rest of the merge (tenants sorted by FLOPs
+    descending then name; no clocks)."""
+    tenants: Dict[str, Dict[str, Any]] = {}
+    sources = 0
+    for rep in reports:
+        if not rep:
+            continue
+        sources += 1
+        for row in rep.get("tenants") or []:
+            name = str(row.get("tenant", ""))
+            e = tenants.setdefault(
+                name,
+                {"tenant": name, "class": row.get("class"), "requests": 0,
+                 "rows": 0, "flops": 0.0, "bytes_accessed": 0.0,
+                 "device_ms": 0.0, "batches": 0, "models": [],
+                 "replicas": 0},
+            )
+            e["class"] = row.get("class", e["class"])
+            e["requests"] += int(row.get("requests", 0) or 0)
+            e["rows"] += int(row.get("rows", 0) or 0)
+            e["flops"] += float(row.get("flops", 0.0) or 0.0)
+            e["bytes_accessed"] += float(row.get("bytes_accessed", 0.0) or 0.0)
+            e["device_ms"] += float(row.get("device_ms", 0.0) or 0.0)
+            e["batches"] += int(row.get("batches", 0) or 0)
+            e["replicas"] += 1
+            for m in row.get("models") or []:
+                if m not in e["models"]:
+                    e["models"].append(m)
+    rows = sorted(tenants.values(), key=lambda r: (-r["flops"], r["tenant"]))
+    for r in rows:
+        r["models"].sort()
+        r["device_ms"] = round(r["device_ms"], 3)
+    total = {
+        "tenants": len(rows),
+        "requests": sum(r["requests"] for r in rows),
+        "rows": sum(r["rows"] for r in rows),
+        "flops": sum(r["flops"] for r in rows),
+        "bytes_accessed": sum(r["bytes_accessed"] for r in rows),
+        "device_ms": round(sum(r["device_ms"] for r in rows), 3),
+    }
+    return {"tenants": rows, "total": total, "sources": sources}
 
 
 def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str, Any]:

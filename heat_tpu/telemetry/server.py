@@ -38,6 +38,10 @@ route     payload
           joined with cost-accounting FLOPs/bytes — achieved GFLOP/s,
           GB/s, intensity and bound-class vs the device peaks, plus the
           live HBM watermark; HTML by default, ``?format=json``
+/tenantz  per-tenant cost accounts (QoS scheduling): rows, analyzed
+          FLOPs/bytes and device-ms per serving tenant, pro-rata split
+          of every coalesced batch, summing to the process total; HTML
+          by default, ``?format=json`` for the machine form
 /profilez on-demand bounded ``jax.profiler`` capture: POST
           ``/profilez/start[?duration_s=]`` / ``/profilez/stop``
           (single in-flight, 409 on conflict), GET lists completed
@@ -94,6 +98,7 @@ __all__ = [
     "readiness_report",
     "register_route",
     "registered_routes",
+    "request_headers",
     "server_running",
     "set_readiness",
     "start_server",
@@ -149,6 +154,22 @@ def registered_routes() -> list:
     with _LOCK:
         _tsan.note_access("telemetry.server.routes", write=False)
         return sorted(_ROUTES, key=len, reverse=True)
+
+
+#: ambient request headers for mounted route handlers.  The
+#: ``register_route`` handler signature is (method, path, body) — too
+#: narrow for header-carried request metadata (the QoS deadline header)
+#: and widening it would break every mounted owner — so the server
+#: parks the current request's headers in a thread-local around the
+#: dispatch instead (one handler thread serves one request at a time).
+_REQ_TLS = threading.local()
+
+
+def request_headers() -> Dict[str, str]:
+    """Headers of the HTTP request currently being dispatched to a
+    mounted route handler, lowercase-keyed ({} outside a dispatch —
+    direct calls into a service bypass HTTP and carry no headers)."""
+    return getattr(_REQ_TLS, "headers", None) or {}
 
 
 #: readiness provider the /readyz route consults: ``() -> (ready, doc)``.
@@ -417,7 +438,11 @@ class _Handler(BaseHTTPRequestHandler):
         handler = _route_for(path)
         if handler is None:
             return False
-        result = handler(method, path, body)
+        _REQ_TLS.headers = {k.lower(): v for k, v in self.headers.items()}  # lint: allow H701(threading.local: each thread mutates only its own slot)
+        try:
+            result = handler(method, path, body)
+        finally:
+            _REQ_TLS.headers = None  # lint: allow H701(threading.local: each thread mutates only its own slot)
         status, ctype, payload = result[0], result[1], result[2]
         headers = result[3] if len(result) > 3 else None
         data = payload.encode("utf-8") if isinstance(payload, str) else payload
@@ -496,6 +521,18 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(_observatory.rooflinez_report(limit=limit))
                 else:
                     self._send(200, _observatory.render_rooflinez_html(), "text/html")
+            elif path == "/tenantz":
+                from . import tenants as _tenants
+
+                params = self._query_params()
+                if params.get("format") == "json":
+                    try:
+                        limit = int(params["limit"]) if "limit" in params else None
+                    except ValueError:
+                        limit = None
+                    self._send_json(_tenants.tenantz_report(limit=limit))
+                else:
+                    self._send(200, _tenants.render_tenantz_html(), "text/html")
             elif path == "/profilez":
                 if self._query_params().get("format") == "json":
                     self._send_json(_observatory.capture_status())
@@ -527,7 +564,7 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     "heat_tpu runtime introspection: "
                     "/metrics /varz /healthz /readyz /trace /tracez /sloz /driftz "
-                    "/canaryz /rooflinez /profilez /statusz"
+                    "/canaryz /rooflinez /tenantz /profilez /statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",

@@ -18,7 +18,10 @@ dispatch-thread ledger vs /rooflinez scrapes plus the /profilez
 capture slot vs its auto-stop timer (``test_observatory.py``), and the
 streaming layer's segment-log producer/consumer split, refresh-driver
 poll thread and 4-thread live-traffic e2e (``test_streaming.py``,
-``test_streaming_resume.py``) — in a
+``test_streaming_resume.py``), and the QoS layer's priority-lane
+admission under flood threads, EDF coalescer wake races and the
+process-wide preemption gate vs fit threads (``test_qos.py``,
+``test_qos_resume.py``) — in a
 subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
@@ -56,6 +59,8 @@ LANE_FILES = (
     "tests/test_observatory.py",
     "tests/test_streaming.py",
     "tests/test_streaming_resume.py",
+    "tests/test_qos.py",
+    "tests/test_qos_resume.py",
 )
 
 

@@ -479,8 +479,12 @@ class TestReadiness:
 # admission: queue-shed Retry-After from the measured drain rate
 # ----------------------------------------------------------------------
 class TestQueueRetryAfter:
+    # the tenant rides the latency lane: its limit equals max_depth, so
+    # the pre-QoS depth arithmetic below still holds exactly (the
+    # standard lane caps at 80% of max_depth since the priority lanes)
     def test_cold_queue_shed_has_no_estimate(self):
         ac = AdmissionController(max_depth=4)
+        ac.set_class("t", "latency")
         ac.admit("t", 4)
         with pytest.raises(OverloadedError) as ei:
             ac.admit("t", 2)
@@ -488,11 +492,12 @@ class TestQueueRetryAfter:
 
     def test_queue_shed_retry_after_tracks_drain_rate(self):
         ac = AdmissionController(max_depth=100)
+        ac.set_class("t", "latency")
         # a steady drain: ~200 rows/s released over the window
         t0 = time.monotonic()
         ac.admit("t", 100)
         for _ in range(10):
-            ac.release(10)
+            ac.release(10, "latency")
             time.sleep(0.02)
         rate = ac.drain_rate()
         assert rate > 0

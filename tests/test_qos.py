@@ -1,0 +1,513 @@
+"""Multi-tenant QoS scheduling (ISSUE 18).
+
+The acceptance properties: the coalescer is earliest-deadline-first
+with FIFO tie-breaks and deadline inheritance; an SLO-critical arrival
+mid-wait shortens the tick instead of waiting out a best-effort delay;
+the admission lanes shed lowest-priority-first so a saturated batch
+lane can never starve latency-class admission (and the shed's
+Retry-After is paced by the lane's OWN drain rate); a checkpointed fit
+yields to the preemption gate at a chunk boundary and the resumed fit
+is bitwise-equal to the uninterrupted one; and the per-tenant cost
+accounts always sum to the totals — locally, over HTTP, and through
+the fleet merge.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.preempt import PreemptionGate, preemption_gate
+from heat_tpu.resilience import OverloadedError, PreemptedError
+from heat_tpu.serving.admission import QOS_CLASSES, AdmissionController
+from heat_tpu.serving.coalescer import (
+    ModelBatcher,
+    _Request,
+    effective_deadline,
+    take_edf_batch,
+)
+from heat_tpu.telemetry import tenants as tenants_mod
+from heat_tpu.telemetry.aggregate import merge_tenant_accounts
+from heat_tpu.telemetry import metrics as tm
+from heat_tpu.utils.checkpoint import Checkpointer
+
+
+def _req(n, deadline, enqueued_at=0.0, tenant="t", cls="standard"):
+    r = _Request(np.zeros((n, 2), np.float32), tenant=tenant, cls=cls)
+    r.enqueued_at = enqueued_at
+    r.deadline = deadline
+    r.dispatch_by = deadline
+    return r
+
+
+# ----------------------------------------------------------------------
+# EDF batch pick + deadline inheritance
+# ----------------------------------------------------------------------
+class TestEDF:
+    def test_earliest_deadline_first(self):
+        q = [_req(1, 5.0), _req(1, 1.0), _req(1, 3.0)]
+        batch = take_edf_batch(q, max_batch=64)
+        assert [r.deadline for r in batch] == [1.0, 3.0, 5.0]
+        assert q == []
+
+    def test_fifo_among_equal_deadlines(self):
+        q = [
+            _req(1, 2.0, enqueued_at=0.3, tenant="late"),
+            _req(1, 2.0, enqueued_at=0.1, tenant="early"),
+            _req(1, 2.0, enqueued_at=0.2, tenant="mid"),
+        ]
+        batch = take_edf_batch(q, max_batch=64)
+        assert [r.tenant for r in batch] == ["early", "mid", "late"]
+
+    def test_skip_and_backfill(self):
+        # the most urgent request fits, the next (huge) one is skipped
+        # but keeps its queue place, and a later small one backfills
+        q = [_req(3, 1.0, tenant="a"), _req(6, 2.0, tenant="big"),
+             _req(2, 3.0, tenant="c")]
+        batch = take_edf_batch(q, max_batch=5)
+        assert [r.tenant for r in batch] == ["a", "c"]
+        assert [r.tenant for r in q] == ["big"]
+        # the skipped request leads the next tick
+        batch = take_edf_batch(q, max_batch=8)
+        assert [r.tenant for r in batch] == ["big"]
+
+    @pytest.mark.parametrize("deadlines,expected", [
+        ((4.0, 2.0, 9.0), 2.0),
+        ((1.5,), 1.5),
+        ((7.0, 7.0), 7.0),
+    ])
+    def test_deadline_inheritance_grid(self, deadlines, expected):
+        batch = [_req(1, d) for d in deadlines]
+        assert effective_deadline(batch) == expected
+
+    def test_class_default_ordering_mixed_lanes(self):
+        # equal arrivals, class-default budgets: latency < standard <
+        # batch deadlines, so EDF orders strictly by priority
+        now = 100.0
+        q = [
+            _req(1, now + 1.0, enqueued_at=now, cls="batch", tenant="b"),
+            _req(1, now + 0.01, enqueued_at=now, cls="latency", tenant="l"),
+            _req(1, now + 0.05, enqueued_at=now, cls="standard", tenant="s"),
+        ]
+        batch = take_edf_batch(q, max_batch=64)
+        assert [r.cls for r in batch] == ["latency", "standard", "batch"]
+
+
+class TestDeadlineTick:
+    def test_urgent_arrival_wakes_tick_early(self):
+        """A batch-class request opens a long window; a latency-class
+        arrival mid-wait must pull the tick earlier than max_delay_s."""
+        done = threading.Event()
+
+        def infer(rows):
+            done.set()
+            return rows
+
+        b = ModelBatcher("m", infer, max_batch=64, max_delay_s=5.0)
+        try:
+            early = tm.counter("serving.qos.early_wakes").value
+            t0 = time.monotonic()
+            threading.Thread(
+                target=lambda: b.submit(
+                    np.zeros((1, 2), np.float32), cls="batch", deadline_s=5.0
+                ),
+                daemon=True,
+            ).start()
+            for _ in range(200):  # wait until the batcher is mid-wait
+                if b._wait_deadline is not None or done.is_set():
+                    break
+                time.sleep(0.005)
+            b.submit(np.zeros((1, 2), np.float32), cls="latency", deadline_s=0.02)
+            elapsed = time.monotonic() - t0
+            assert done.is_set()
+            assert elapsed < 2.0, f"tick waited out the long window ({elapsed:.2f}s)"
+            assert tm.counter("serving.qos.early_wakes").value >= early + 1
+        finally:
+            b.close()
+
+    def test_explicit_deadline_caps_window(self):
+        b = ModelBatcher("m", lambda r: r, max_batch=64, max_delay_s=5.0)
+        try:
+            t0 = time.monotonic()
+            b.submit(np.zeros((2, 2), np.float32), deadline_s=0.05)
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            b.close()
+
+    def test_account_hook_reports_batch_membership(self):
+        got = []
+        b = ModelBatcher(
+            "m", lambda r: r, max_batch=64, max_delay_s=0.05,
+            on_account=lambda parts, ms: got.append((parts, ms)),
+        )
+        try:
+            t = threading.Thread(
+                target=lambda: b.submit(
+                    np.zeros((3, 2), np.float32), tenant="a", cls="latency"
+                ),
+                daemon=True,
+            )
+            t.start()
+            b.submit(np.zeros((2, 2), np.float32), tenant="b", cls="batch")
+            t.join(10)
+            for _ in range(200):
+                if got:
+                    break
+                time.sleep(0.005)
+            parts = [p for batch, _ in got for p in batch]
+            assert ("a", "latency", 3) in parts
+            assert ("b", "batch", 2) in parts
+        finally:
+            b.close()
+
+
+# ----------------------------------------------------------------------
+# admission lanes
+# ----------------------------------------------------------------------
+class TestAdmissionLanes:
+    def test_strict_lane_limits(self):
+        ac = AdmissionController(max_depth=100)
+        assert ac.lane_limits == {"latency": 100, "standard": 80, "batch": 60}
+        assert tuple(ac.lane_limits) == QOS_CLASSES
+
+    def test_lanes_shed_lowest_priority_first(self):
+        ac = AdmissionController(max_depth=100)
+        ac.set_class("bat", "batch")
+        ac.set_class("std", "standard")
+        ac.set_class("lat", "latency")
+        assert ac.admit("bat", 60) == "batch"
+        # batch lane full: batch sheds, standard and latency still admit
+        with pytest.raises(OverloadedError) as e:
+            ac.admit("bat", 1)
+        assert e.value.cause == "queue"
+        assert ac.admit("std", 20) == "standard"
+        with pytest.raises(OverloadedError):
+            ac.admit("std", 1)  # 80 in flight = the standard limit
+        # the top 20% band is latency-only headroom
+        assert ac.admit("lat", 20) == "latency"
+        with pytest.raises(OverloadedError):
+            ac.admit("lat", 1)
+        ac.release(60, "batch")
+        ac.release(20, "standard")
+        ac.release(20, "latency")
+        assert ac.depth() == 0
+
+    def test_latency_admitted_at_batch_saturation(self):
+        ac = AdmissionController(max_depth=10)
+        ac.set_class("bat", "batch")
+        ac.set_class("lat", "latency")
+        admitted = 0
+        while True:
+            try:
+                ac.admit("bat", 1)
+                admitted += 1
+            except OverloadedError:
+                break
+        assert admitted == ac.lane_limits["batch"]
+        assert ac.admit("lat", 1) == "latency"  # never starved
+
+    def test_lane_aware_retry_after(self):
+        """A slow batch lane must not inflate the latency lane's
+        advertised backoff: each lane's Retry-After is paced by its own
+        drain window."""
+        ac = AdmissionController(max_depth=10)
+        ac.set_class("bat", "batch")
+        ac.set_class("lat", "latency")
+        # drain histories: latency drains fast, batch drains slowly
+        ac._lane_drained["latency"].append((time.monotonic() - 0.5, 50))
+        ac._lane_drained["batch"].append((time.monotonic() - 0.5, 1))
+        for _ in range(ac.lane_limits["batch"]):
+            ac.admit("bat", 1)
+        for _ in range(ac.lane_limits["latency"] - ac.lane_limits["batch"]):
+            ac.admit("lat", 1)
+        with pytest.raises(OverloadedError) as lat_shed:
+            ac.admit("lat", 1)
+        with pytest.raises(OverloadedError) as bat_shed:
+            ac.admit("bat", 1)
+        assert lat_shed.value.retry_after_s is not None
+        assert bat_shed.value.retry_after_s is not None
+        assert lat_shed.value.retry_after_s < bat_shed.value.retry_after_s
+
+    def test_cold_lane_retry_after_is_none(self):
+        ac = AdmissionController(max_depth=2)
+        ac.admit("t", 2)
+        with pytest.raises(OverloadedError) as e:
+            ac.admit("t", 1)
+        assert e.value.retry_after_s is None  # no drain observed at all
+
+    def test_no_starvation_under_batch_flood(self):
+        """Saturating the batch lane from threads for a while: every
+        latency-class admit during the flood must succeed."""
+        ac = AdmissionController(max_depth=40)
+        ac.set_class("flood", "batch")
+        ac.set_class("slo", "latency")
+        stop = threading.Event()
+        shed = [0]
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    ac.admit("flood", 4)
+                    time.sleep(0.001)
+                    ac.release(4, "batch")
+                except OverloadedError:
+                    shed[0] += 1
+
+        threads = [threading.Thread(target=flood, daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 1.0
+            admits = 0
+            while time.monotonic() < deadline:
+                cls = ac.admit("slo", 2)  # must NEVER raise
+                assert cls == "latency"
+                ac.release(2, cls)
+                admits += 1
+            assert admits > 50
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5)
+
+    def test_lane_depths_surface(self):
+        ac = AdmissionController(max_depth=20)
+        ac.set_class("lat", "latency")
+        ac.admit("lat", 3)
+        d = ac.lane_depths()
+        assert set(d) == set(QOS_CLASSES)
+        assert d["latency"]["depth"] == 3
+        assert d["latency"]["limit"] == 20
+        ac.release(3, "latency")
+        assert ac.lane_depths()["latency"]["depth"] == 0
+        assert ac.lane_depths()["latency"]["drain_rate"] > 0
+
+
+# ----------------------------------------------------------------------
+# preemption gate + cooperative preempt -> resume bitwise
+# ----------------------------------------------------------------------
+class TestPreemptionGate:
+    def test_level_triggered_until_cleared(self):
+        g = PreemptionGate()
+        assert g.take(durable=True) is None
+        g.request("spike")
+        assert g.take(durable=True) == "spike"
+        assert g.take(durable=True) == "spike"  # not consumed
+        g.clear()
+        assert g.take(durable=True) is None
+        assert g.stats()["preemptions"] == 2
+
+    def test_refuses_non_durable_fits(self):
+        g = PreemptionGate()
+        g.request()
+        assert g.take(durable=False) is None
+        assert g.pending() is not None  # stays pending for durable fits
+        assert g.stats()["ignored"] == 1
+
+    def test_rerequest_counts_one_spike(self):
+        g = PreemptionGate()
+        g.request("a")
+        g.request("b")
+        assert g.stats()["requests"] == 1
+        assert g.pending() == "b"  # reason refreshed
+
+
+class TestPreemptResume:
+    def test_checkpointed_fit_yields_and_resumes_bitwise(self, tmp_path):
+        ht.random.seed(13)
+        x = ht.random.randn(240, 6, split=0).astype(ht.float32)
+        kw = dict(n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3)
+        plain = ht.cluster.KMeans(**kw).fit(x)
+        d = str(tmp_path / "ck")
+        gate = preemption_gate()
+        gate.request("test latency spike")
+        try:
+            with pytest.raises(PreemptedError) as e:
+                ht.cluster.KMeans(**kw, checkpoint_every=2, checkpoint_dir=d).fit(x)
+        finally:
+            gate.clear()
+        assert e.value.checkpoint_dir == d
+        assert e.value.reason == "test latency spike"
+        assert e.value.iteration == Checkpointer(d).latest_step()
+        resumed = ht.cluster.KMeans(**kw, checkpoint_every=2, resume_from=d).fit(x)
+        assert np.array_equal(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(resumed.cluster_centers_._dense()),
+        )
+        assert plain.n_iter_ == resumed.n_iter_
+
+    def test_unpreempted_fit_unaffected_by_pending_gate(self, tmp_path):
+        """A fit without a checkpointer must run to completion through a
+        pending gate (nothing durable to pause into)."""
+        ht.random.seed(13)
+        x = ht.random.randn(120, 4, split=0).astype(ht.float32)
+        kw = dict(n_clusters=3, init="random", max_iter=10, random_state=1)
+        plain = ht.cluster.KMeans(**kw).fit(x)
+        gate = preemption_gate()
+        gate.request("spike")
+        try:
+            under = ht.cluster.KMeans(**kw).fit(x)
+        finally:
+            gate.clear()
+        assert np.array_equal(
+            np.asarray(plain.cluster_centers_._dense()),
+            np.asarray(under.cluster_centers_._dense()),
+        )
+
+
+# ----------------------------------------------------------------------
+# per-tenant cost metering
+# ----------------------------------------------------------------------
+class TestTenantMetering:
+    def setup_method(self):
+        tenants_mod.reset()
+
+    def test_pro_rata_split_sums_to_batch(self):
+        tenants_mod.note_batch(
+            "m", [("a", "latency", 3), ("b", "batch", 9)],
+            flops=1200.0, bytes_accessed=480.0, device_ms=12.0,
+        )
+        rep = tenants_mod.tenantz_report()
+        by = {r["tenant"]: r for r in rep["tenants"]}
+        assert by["a"]["flops"] == pytest.approx(300.0)
+        assert by["b"]["flops"] == pytest.approx(900.0)
+        assert rep["total"]["flops"] == pytest.approx(
+            sum(r["flops"] for r in rep["tenants"])
+        )
+        assert rep["total"]["rows"] == 12
+
+    def test_accounts_sum_to_total_with_limit(self):
+        for i in range(8):
+            tenants_mod.note_batch("m", [(f"t{i}", "standard", 1)], flops=float(i))
+        rep = tenants_mod.tenantz_report(limit=3)
+        assert len(rep["tenants"]) == 3
+        assert rep["total"]["tenants"] == 8  # no silent truncation of the sum
+        assert rep["total"]["rows"] == 8
+
+    def test_merge_tenant_accounts_rederives_total(self):
+        tenants_mod.note_batch("m", [("a", "latency", 2)], flops=100.0)
+        rep = tenants_mod.tenantz_report()
+        merged = merge_tenant_accounts([rep, rep, {}])
+        assert merged["sources"] == 2
+        by = {r["tenant"]: r for r in merged["tenants"]}
+        assert by["a"]["flops"] == pytest.approx(200.0)
+        assert by["a"]["replicas"] == 2
+        assert merged["total"]["flops"] == pytest.approx(
+            sum(r["flops"] for r in merged["tenants"])
+        )
+
+    def test_html_renders(self):
+        tenants_mod.note_batch("m", [("a", "batch", 4)], flops=5.0)
+        html = tenants_mod.render_tenantz_html()
+        assert "tenantz" in html and "a" in html
+
+
+# ----------------------------------------------------------------------
+# the served surfaces: healthz lanes, /tenantz, metered service traffic
+# ----------------------------------------------------------------------
+PTS = np.random.default_rng(0).standard_normal((120, 6)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def qos_service(tmp_path_factory):
+    from heat_tpu import serving
+    from heat_tpu.serving.service import InferenceService
+
+    d = str(tmp_path_factory.mktemp("qos") / "km")
+    est = ht.cluster.KMeans(n_clusters=3, init="random", max_iter=5,
+                            random_state=0).fit(ht.array(PTS, split=0))
+    serving.save_model(est, d, version=1, name="km")
+    svc = InferenceService(max_delay_ms=1.0, max_batch=64)
+    svc.load("km", d)
+    url = svc.serve(0)
+    yield svc, url
+    svc.close()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, doc, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        r = urllib.request.urlopen(req, timeout=timeout)
+        return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else None)
+
+
+class TestServedQoSSurfaces:
+    def test_healthz_reports_lanes(self, qos_service):
+        svc, url = qos_service
+        svc.predict("km", PTS[:4])
+        code, doc = _get(f"{url}/v1/models/km/healthz")
+        assert code == 200
+        assert set(doc["lanes"]) == set(QOS_CLASSES)
+        for cls in QOS_CLASSES:
+            lane = doc["lanes"][cls]
+            assert set(lane) >= {"queued_rows", "oldest_wait_s",
+                                 "admitted_rows_in_flight", "depth_limit"}
+        assert doc["lanes"]["latency"]["depth_limit"] >= \
+            doc["lanes"]["standard"]["depth_limit"] >= \
+            doc["lanes"]["batch"]["depth_limit"]
+
+    def test_tenantz_accounts_sum_after_traffic(self, qos_service):
+        svc, url = qos_service
+        tenants_mod.reset()
+        svc.set_class("slo", "latency")
+        svc.set_class("bulk", "batch")
+        svc.predict("km", PTS[:4], tenant="slo")
+        svc.predict("km", PTS[:8], tenant="bulk")
+        svc.predict("km", PTS[:2], tenant="mid")
+        for _ in range(400):  # the account hook settles post-wake
+            rep = tenants_mod.tenantz_report()
+            if rep["total"]["rows"] >= 14:
+                break
+            time.sleep(0.005)
+        assert rep["total"]["rows"] == 14
+        assert rep["total"]["flops"] > 0, "metering captured no analyzed cost"
+        assert rep["total"]["flops"] == pytest.approx(
+            sum(r["flops"] for r in rep["tenants"])
+        )
+        by = {r["tenant"]: r for r in rep["tenants"]}
+        assert by["slo"]["class"] == "latency"
+        assert by["bulk"]["class"] == "batch"
+        code, doc = _get(f"{url}/tenantz?format=json")
+        assert code == 200
+        assert {"slo", "bulk", "mid"} <= {t["tenant"] for t in doc["tenants"]}
+        assert doc["total"]["flops"] == pytest.approx(
+            sum(t["flops"] for t in doc["tenants"])
+        )
+
+    def test_deadline_ms_header_and_body(self, qos_service):
+        svc, url = qos_service
+        code, doc = _post(
+            f"{url}/v1/predict",
+            {"model": "km", "inputs": PTS[:2].tolist(), "deadline_ms": 20},
+        )
+        assert code == 200 and doc["n"] == 2
+        code, doc = _post(
+            f"{url}/v1/predict", {"model": "km", "inputs": PTS[:2].tolist()},
+            headers={"X-Heat-Deadline-Ms": "20"},
+        )
+        assert code == 200 and doc["n"] == 2
+
+    def test_bad_deadline_is_400(self, qos_service):
+        svc, url = qos_service
+        code, doc = _post(
+            f"{url}/v1/predict",
+            {"model": "km", "inputs": PTS[:1].tolist(), "deadline_ms": "soon"},
+        )
+        assert code == 400
+        assert "deadline_ms" in doc["error"]

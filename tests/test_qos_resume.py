@@ -1,0 +1,84 @@
+"""Preempt-kill-and-resume at the qos.preempt fault site (ISSUE 18).
+
+Real host preemption at the exact moment a checkpointed fit yields to a
+latency spike: the child process arms the preemption gate mid-fit (a
+latency-class admission under HEAT_TPU_QOS_PREEMPT_ON_LATENCY), the
+env fault plan ``os._exit``-kills it at the ``qos.preempt`` site — the
+instant between the boundary checkpoint and the PreemptedError — and
+the parent resumes the surviving checkpoint directory.  The resumed
+model must equal the uninterrupted fit **bitwise**: a preemption (with
+or without the host dying at the yield point) stops at the same chunk
+boundary a kill would, and the checkpoint machinery replays the
+identical iteration sequence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.utils.checkpoint import Checkpointer
+
+_CHILD = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)  # mirror conftest
+import sys, threading, time
+import heat_tpu as ht
+from heat_tpu.serving.admission import AdmissionController
+
+ck = sys.argv[1]
+ht.random.seed(13)
+x = ht.random.randn(240, 6, split=0).astype(ht.float32)
+
+# the latency spike arrives while the fit owns the chips: a background
+# thread admits a latency-class request shortly after the fit starts,
+# which (HEAT_TPU_QOS_PREEMPT_ON_LATENCY=1) raises the preemption gate
+ac = AdmissionController(max_depth=64)
+ac.set_class('slo', 'latency')
+def spike():
+    time.sleep(0.05)
+    ac.admit('slo', 1)
+threading.Thread(target=spike, daemon=True).start()
+
+ht.cluster.KMeans(n_clusters=4, init='random', max_iter=40, tol=1e-4,
+                  random_state=3, checkpoint_every=2,
+                  checkpoint_dir=ck).fit(x)
+"""
+
+
+def test_kill_at_preempt_yield_resumes_bitwise(tmp_path):
+    d = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HEAT_TPU_QOS_PREEMPT_ON_LATENCY"] = "1"
+    # synchronous boundary saves: the yield's own checkpoint is durable
+    # BEFORE the qos.preempt site fires, so the kill deterministically
+    # leaves a committed step behind (with async saves the first
+    # boundary's write may be lost — resume still works, from scratch)
+    env["HEAT_TPU_ASYNC_CKPT"] = "0"
+    env["HEAT_TPU_FAULT_PLAN"] = json.dumps(
+        {"plan": {"qos.preempt": [{"at": 0, "kind": "kill", "exit_code": 137}]}}
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, d], env=env, capture_output=True, timeout=300
+    )
+    assert proc.returncode == 137, proc.stderr.decode()[-2000:]
+    # the kill landed at a yield: the boundary's synchronous checkpoint
+    # committed immediately before the qos.preempt site fired
+    step = Checkpointer(d).latest_step()
+    assert step is not None and step < 40, "the kill must land mid-fit"
+
+    ht.random.seed(13)
+    x = ht.random.randn(240, 6, split=0).astype(ht.float32)
+    kw = dict(n_clusters=4, init="random", max_iter=40, tol=1e-4, random_state=3)
+    plain = ht.cluster.KMeans(**kw).fit(x)
+    resumed = ht.cluster.KMeans(**kw, checkpoint_every=2, resume_from=d).fit(x)
+    assert np.array_equal(
+        np.asarray(plain.cluster_centers_._dense()),
+        np.asarray(resumed.cluster_centers_._dense()),
+    )
+    assert plain.n_iter_ == resumed.n_iter_
