@@ -103,6 +103,18 @@ LOCK_REGISTRY = {
         "structures": ("telemetry.alerts.state",),
         "doc": "the alert active table + fired/resolved transition ring: SLO monitors fire from the tick thread, drift checks from batcher threads, /sloz + /statusz handler threads read",
     },
+    "telemetry.journal": {
+        "file": "heat_tpu/telemetry/journal.py",
+        "spellings": ("_LOCK",),
+        "structures": ("telemetry.journal.state",),
+        "doc": "the decision-journal hot ring + durable-segment cursor: every autonomous controller emits from its own thread (SLO tick, shadow thread, router poller, fit threads), /decisionz handler threads and snapshot gathers read; the durable segment append runs under it too (control-plane rates, the streaming segment-log trade)",
+    },
+    "telemetry.tsdb": {
+        "file": "heat_tpu/telemetry/tsdb.py",
+        "spellings": ("_LOCK",),
+        "structures": ("telemetry.tsdb.state",),
+        "doc": "the metric-history ring map + sampler-thread handle: the sampler scrapes on its interval, controllers push via record(), /queryz handler threads read; the registry scrape itself runs outside it (no cross-module lock nesting)",
+    },
     "telemetry.slo": {
         "file": "heat_tpu/telemetry/slo.py",
         "spellings": ("_LOCK",),

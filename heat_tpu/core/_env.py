@@ -93,6 +93,11 @@ KNOBS = {
     "HEAT_TPU_SLO_SHED_PCT": ("float", "1", "default serving shed objective: shed requests (quota + queue) must stay under this percent of admitted+shed"),
     "HEAT_TPU_SLO_HEARTBEAT_S": ("float", "0", "fit.heartbeat_ts freshness objective in seconds (0 = heartbeat SLO not installed; serving-only processes have no fit heartbeat)"),
     "HEAT_TPU_ALERT_RING": ("int", "256", "capacity of the alert fired/resolved transition ring (/sloz, /statusz, crash bundles)"),
+    "HEAT_TPU_JOURNAL_RING": ("int", "256", "capacity of the control-plane decision-journal hot ring (/decisionz, cross-worker snapshots, crash bundles)"),
+    "HEAT_TPU_JOURNAL_DIR": ("str", "", "durable decision-journal directory: every journal event also commits as an immutable atomic+CRC jsonl segment there, replayable after the process dies via python -m heat_tpu.telemetry.replay (empty = hot ring only)"),
+    "HEAT_TPU_TSDB_INTERVAL_S": ("float", "1.0", "embedded metric-history sampler interval: seconds between registry scrapes into the /queryz ring buffers"),
+    "HEAT_TPU_TSDB_RETENTION": ("int", "512", "points retained per metric-history series (memory is series x retention x two floats, strictly bounded)"),
+    "HEAT_TPU_TSDB_SERIES": ("str", "", "comma-separated allowlist of registry series the TSDB sampler scrapes (trailing * = prefix match); empty = the curated control-plane default set (slo.*, serve.*, drift.*, canary.*, fleet.*, qos.*, stream.*, journal.*, alerts.*, dispatch.compile_fallbacks)"),
     "HEAT_TPU_SKETCH": ("bool", "1", "input-drift sketches on the /v1/predict path: per-feature moments + log-bucket histograms folded per coalesced batch off the caller's latency path"),
     "HEAT_TPU_DRIFT_THRESHOLD": ("float", "0.25", "PSI score above which a served model's input distribution counts as drifted (fires the drift:<model> alert and flips its /healthz status)"),
     "HEAT_TPU_DRIFT_MIN_ROWS": ("int", "200", "rows the live sketch must hold before a drift score is reported (small-sample PSI is noise: ~0.2 at 100 in-distribution rows against a 0.25 threshold)"),

@@ -790,6 +790,27 @@ def _compiled_or_fallback(key, builder, leaves, n_ops, eager_fn, out_sharding=No
             RuntimeWarning,
             stacklevel=3,
         )
+        # the same event, routed into the alert layer: a warn-severity
+        # deduplicated alert (re-fires only update value/message) so an
+        # operator watching /statusz or /decisionz sees fallback storms
+        # without scraping stderr for RuntimeWarnings.  Lazy import:
+        # telemetry.alerts at module level would cycle through core.
+        try:
+            from ..telemetry import alerts as _alerts
+
+            _alerts.fire(
+                "dispatch:compile_fallback",
+                severity="warn",
+                message=(
+                    f"compiled execution failed ({type(e).__name__}); "
+                    "eager fallback taken"
+                ),
+                value=float(_C["compile_fallbacks"].value),
+                evidence={"error": type(e).__name__,
+                          "series": ["dispatch.compile_fallbacks"]},
+            )
+        except Exception:  # lint: allow H501(alerting is best-effort; the fallback itself must proceed)
+            pass
         return eager_fn()
 
 

@@ -38,6 +38,7 @@ import threading
 from typing import Dict, Optional
 
 from ..analysis import tsan as _tsan
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 
 __all__ = ["PreemptionGate", "preemption_gate"]
@@ -88,13 +89,29 @@ class PreemptionGate:
                 _REQUESTS_C.inc()
         if fresh:
             _PENDING_G.set(1.0)
+            # journal after our lock is released (emit takes its own)
+            _journal.emit(
+                "preempt", "raise",
+                severity="warn",
+                message=f"preemption requested: {reason}",
+                evidence={"reason": str(reason)},
+            )
 
     def clear(self) -> None:
         """Withdraw the request (the latency lane drained)."""
         with self._lock:
             _tsan.note_access("core.preemption.state")
-            self._reason = None
+            was, self._reason = self._reason, None
         _PENDING_G.set(0.0)
+        if was is not None:
+            raised = _journal.find_last(actor="preempt", action="raise")
+            _journal.emit(
+                "preempt", "clear",
+                severity="info",
+                message=f"preemption cleared: {was}",
+                cause=raised["event_id"] if raised else None,
+                evidence={"reason": was},
+            )
 
     # -- fit side -------------------------------------------------------
     def pending(self) -> Optional[str]:

@@ -25,6 +25,7 @@ from ..parallel.comm import Communication, get_comm
 from ..resilience.errors import ReshapeError, WorkerLostError
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import RetryPolicy, default_init_policy
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
 
@@ -196,6 +197,17 @@ class ElasticSupervisor:
         new_world = self.retry_policy.call(_do_reshape)
         RESHAPES_C.inc()
         WORLD_G.set(new_world.size)
+        _journal.emit(
+            "elastic", "reshape",
+            severity="warn",
+            message=(
+                f"mesh reshaped {world.size} -> {new_world.size} after "
+                f"worker loss ({type(err).__name__})"
+            ),
+            evidence={"old_world": world.size, "new_world": new_world.size,
+                      "lost": lost, "error": type(err).__name__,
+                      "recovery": self.recoveries},
+        )
         if self.on_world_change is not None:
             self.on_world_change(new_world)
         _inject("elastic.resume", world_size=new_world.size)

@@ -35,7 +35,9 @@ import time
 from typing import Any, Dict, Optional
 
 from ..analysis import tsan as _tsan
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
+from ..telemetry import tsdb as _tsdb
 
 __all__ = ["FleetAutoscaler"]
 
@@ -154,7 +156,12 @@ class FleetAutoscaler:
                 "under_streak": self._under_streak,
                 "action": action,
             }
-            return action
+        # signal history OUTSIDE our lock: tsdb has its own registered
+        # lock and the journal evidence resolves against these series
+        _tsdb.record("fleet.p99_ms", p99)
+        _tsdb.record("fleet.inflight_per_ready", per_ready)
+        _tsdb.record("fleet.replicas", float(n))
+        return action
 
     # -- the actuation --------------------------------------------------
     def scale_up(self) -> Optional[str]:
@@ -166,6 +173,7 @@ class FleetAutoscaler:
             return None
         self.router.add_replica(url)
         _UPS_C.inc()
+        self._journal_scale("spawn", url)
         return url
 
     def scale_down(self) -> Optional[str]:
@@ -179,7 +187,38 @@ class FleetAutoscaler:
         self.replica_set.drain_stop(url)
         self.router.remove_replica(url)
         _DOWNS_C.inc()
+        self._journal_scale("drain", url)
         return url
+
+    def _journal_scale(self, action: str, url: Optional[str]) -> None:
+        """One decision-journal entry per actuation, carrying the exact
+        signal snapshot that tripped the hysteresis plus the metric
+        windows the evidence resolves against (``/queryz``)."""
+        decision = self.state()
+        sig = decision.get("signal", {})
+        evidence: Dict[str, Any] = {
+            "replica_url": url,
+            "signal": sig,
+            "over_streak_needed": self.up_ticks,
+            "under_streak_needed": self.down_ticks,
+            "series": ["fleet.p99_ms", "fleet.inflight_per_ready",
+                       "fleet.replicas"],
+        }
+        for series in ("fleet.p99_ms", "fleet.inflight_per_ready"):
+            stats = _tsdb.window_stats(series, window_s=60.0)
+            if stats.get("n"):
+                evidence[series] = {k: stats[k] for k in ("n", "min", "max", "mean", "last")}
+        _journal.emit(
+            "autoscaler", action,
+            severity="info",
+            message=(
+                f"scale-{'up' if action == 'spawn' else 'down'}: "
+                f"p99={sig.get('p99_ms', 0.0):g}ms "
+                f"inflight/ready={sig.get('inflight_per_ready', 0.0):g} "
+                f"replicas={sig.get('replicas', 0)}"
+            ),
+            evidence=evidence,
+        )
 
     def tick(self) -> Optional[str]:
         """One evaluation + actuation cycle (the tick thread's body;

@@ -81,6 +81,7 @@ from ..resilience.errors import NoReplicaError, OverloadedError, TransientFault
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import RetryPolicy
 from ..serving.admission import TokenBucket
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry import tracing as _tracing
 
@@ -118,7 +119,7 @@ class _Replica:
         "url", "ready", "state", "models", "not_models", "inflight", "fails",
         "cb_open", "cb_open_until", "probing", "last_poll_ok", "added_at",
         "observatory", "observatory_ts", "canary", "canary_ts",
-        "tenants", "tenants_ts",
+        "tenants", "tenants_ts", "journal", "journal_ts",
     )
 
     def __init__(self, url: str):
@@ -147,6 +148,10 @@ class _Replica:
         #: the fleet-wide per-tenant cost rollup's per-replica half
         self.tenants: Optional[Dict[str, Any]] = None
         self.tenants_ts = 0.0
+        #: last /decisionz?format=json snapshot (same throttled cadence) —
+        #: the fleet-wide decision-timeline rollup's per-replica half
+        self.journal: Optional[Dict[str, Any]] = None
+        self.journal_ts = 0.0
 
     def doc(self) -> Dict[str, Any]:
         return {
@@ -359,6 +364,7 @@ class FleetRouter:
             obs = self._probe_rooflinez(url) if due else None
             can = self._probe_canaryz(url) if due else None
             ten = self._probe_tenantz(url) if due else None
+            jnl = self._probe_decisionz(url) if due else None
             with self._lock:
                 _tsan.note_access("fleet.router.replicas")
                 r = self._replicas.get(url)
@@ -373,6 +379,9 @@ class FleetRouter:
                 if ten is not None:
                     r.tenants = ten
                     r.tenants_ts = time.time()
+                if jnl is not None:
+                    r.journal = jnl
+                    r.journal_ts = time.time()
                 if r.state == "draining" and state not in ("ready",):
                     # a locally initiated drain sticks until the replica
                     # itself reports ready again (a cancelled drain)
@@ -443,6 +452,18 @@ class FleetRouter:
         except Exception:  # lint: allow H501(a meter-less replica is a rollup gap, not an error)
             return None
 
+    def _probe_decisionz(self, url: str) -> Optional[Dict[str, Any]]:
+        """One replica's decision-journal snapshot, or None (replica
+        without the route, unreachable, or malformed — never raises)."""
+        try:
+            with urllib.request.urlopen(
+                url + "/decisionz?format=json&limit=64", timeout=2.0
+            ) as resp:
+                doc = json.load(resp)
+            return doc if isinstance(doc, dict) else None
+        except Exception:  # lint: allow H501(a journal-less replica is a rollup gap, not an error)
+            return None
+
     # -- routing policy -------------------------------------------------
     def _preference(self, model: str, replicas: List[_Replica]) -> List[_Replica]:
         """Rendezvous-hash preference order of ``replicas`` for
@@ -494,14 +515,33 @@ class FleetRouter:
             chosen = next((r for r in order if r.inflight < cap), None)
             if chosen is None:
                 chosen = min(order, key=lambda r: r.inflight)
-            if chosen.cb_open:
+            probe = chosen.cb_open
+            if probe:
                 chosen.probing = True  # the admitted half-open probe
             chosen.inflight += 1
-            return chosen
+        # journal after our lock is released (emit takes its own lock)
+        if probe:
+            trip = _journal.find_last(actor="router", action="cb_trip")
+            _journal.emit(
+                "router", "cb_half_open",
+                model=model or None,
+                severity="info",
+                message=f"half-open probe admitted to {chosen.url}",
+                cause=(
+                    trip["event_id"]
+                    if trip and trip["evidence"].get("replica") == chosen.url
+                    else None
+                ),
+                evidence={"replica": chosen.url,
+                          "cooldown_s": self.cb_cooldown_s},
+            )
+        return chosen
 
     def _report(self, replica: _Replica, ok: bool) -> None:
         """Account one attempt's outcome into the replica's breaker."""
         now = time.monotonic()
+        transition = None  # journal verb decided under the lock, emitted after
+        fails = 0
         with self._lock:
             _tsan.note_access("fleet.router.replicas")
             replica.inflight = max(0, replica.inflight - 1)
@@ -511,17 +551,45 @@ class FleetRouter:
                     replica.cb_open = False
                     replica.probing = False
                     _CB_CLOSE_C.inc()
-                return
-            replica.fails += 1
-            if replica.cb_open:
-                # failed half-open probe: re-open for another cooldown
-                replica.probing = False
-                replica.cb_open_until = now + self.cb_cooldown_s
-            elif replica.fails >= self.cb_failures:
-                replica.cb_open = True
-                replica.probing = False
-                replica.cb_open_until = now + self.cb_cooldown_s
-                _CB_OPEN_C.inc()
+                    transition = "cb_readmit"
+            else:
+                replica.fails += 1
+                fails = replica.fails
+                if replica.cb_open:
+                    # failed half-open probe: re-open for another cooldown
+                    replica.probing = False
+                    replica.cb_open_until = now + self.cb_cooldown_s
+                elif replica.fails >= self.cb_failures:
+                    replica.cb_open = True
+                    replica.probing = False
+                    replica.cb_open_until = now + self.cb_cooldown_s
+                    _CB_OPEN_C.inc()
+                    transition = "cb_trip"
+        if transition == "cb_trip":
+            _journal.emit(
+                "router", "cb_trip",
+                severity="warn",
+                message=(
+                    f"circuit breaker opened for {replica.url} after "
+                    f"{fails} consecutive failures"
+                ),
+                evidence={"replica": replica.url, "consecutive_failures": fails,
+                          "threshold": self.cb_failures,
+                          "cooldown_s": self.cb_cooldown_s},
+            )
+        elif transition == "cb_readmit":
+            probe = _journal.find_last(actor="router", action="cb_half_open")
+            _journal.emit(
+                "router", "cb_readmit",
+                severity="info",
+                message=f"half-open probe succeeded; {replica.url} readmitted",
+                cause=(
+                    probe["event_id"]
+                    if probe and probe["evidence"].get("replica") == replica.url
+                    else None
+                ),
+                evidence={"replica": replica.url},
+            )
 
     # -- proxying -------------------------------------------------------
     def _forward(self, replica: _Replica, method: str, path: str,
@@ -780,6 +848,11 @@ class FleetRouter:
                 for r in self._replicas.values()
                 if r.tenants is not None
             }
+            journal_snaps = {
+                r.url: dict(r.journal)
+                for r in self._replicas.values()
+                if r.journal is not None
+            }
         replicas: Dict[str, Any] = {}
         kernels: Dict[str, Dict[str, Any]] = {}
         now = time.time()
@@ -849,6 +922,14 @@ class FleetRouter:
         tenants = merge_tenant_accounts(
             [tenant_snaps[u] for u in sorted(tenant_snaps)]
         )
+        # fleet-wide decision timeline: every polled replica's decision
+        # journal plus the router's own (breaker trips, probes), merged
+        # into one worker-tagged timeline — "what did the fleet decide,
+        # in what order" without ssh-ing into N replicas
+        decisions = _journal.merge_journal_snapshots(
+            [(u, journal_snaps[u]) for u in sorted(journal_snaps)]
+            + [("router", _journal.journal_snapshot())]
+        )
         return {
             "timestamp": now,
             "ready_replicas": self._count_ready(),
@@ -856,6 +937,7 @@ class FleetRouter:
             "kernels": dict(sorted(kernels.items())),
             "canary": dict(sorted(canary_models.items())),
             "tenants": tenants,
+            "decisions": decisions,
         }
 
     def render_fleetz_html(self) -> str:
@@ -971,6 +1053,29 @@ class FleetRouter:
             parts.append("<p>no canary snapshots collected yet</p>")
         parts.append("<h2>fleet tenant accounts</h2>")
         parts.append(self._tenants_table_html(doc.get("tenants") or {}))
+        parts.append("<h2>fleet decision timeline</h2>")
+        decisions = (doc.get("decisions") or {}).get("events") or []
+        if decisions:
+            parts.append(
+                "<table border=1 cellpadding=3><tr><th>time</th><th>worker</th>"
+                "<th>actor</th><th>action</th><th>model</th><th>sev</th>"
+                "<th>message</th></tr>"
+            )
+            for e in decisions[-32:]:
+                parts.append(
+                    "<tr>"
+                    f"<td>{time.strftime('%H:%M:%S', time.localtime(e.get('ts', 0)))}</td>"
+                    f"<td>{_html.escape(str(e.get('worker', '')))}</td>"
+                    f"<td>{_html.escape(str(e.get('actor', '')))}</td>"
+                    f"<td>{_html.escape(str(e.get('action', '')))}</td>"
+                    f"<td>{_html.escape(str(e.get('model') or '—'))}</td>"
+                    f"<td>{_html.escape(str(e.get('severity', '')))}</td>"
+                    f"<td>{_html.escape(str(e.get('message', '')))}</td>"
+                    "</tr>"
+                )
+            parts.append("</table>")
+        else:
+            parts.append("<p>no decision events collected yet</p>")
         parts.append(
             "<p><a href='/tenantz'>full /tenantz</a> · "
             "<a href='/fleetz?format=json'>json</a></p></body></html>"

@@ -77,7 +77,9 @@ from ..analysis import tsan as _tsan
 from ..analysis.precision_policy import POLICIES
 from ..resilience.faults import inject as _inject
 from ..telemetry import alerts as _alerts
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
+from ..telemetry import tsdb as _tsdb
 
 __all__ = [
     "CanaryController",
@@ -307,6 +309,23 @@ def compare_batch(
         elif out["mismatched"]:
             out["max_rel_err"] = _ERR_CAP
     return out
+
+
+def _upstream_alert_cause(model: str) -> Optional[str]:
+    """The journal event_id of the newest quality-signal alert fire for
+    this model (drift/SLO/page — NOT a previous ``canary:*`` alert): the
+    upstream cause a canary decision links to, so ``/decisionz?event_id=``
+    walks from the rollback back to the evidence that provoked it."""
+    for e in reversed(_journal.journal_events()):
+        if e.get("actor") != "alerts" or e.get("action") != "fire":
+            continue
+        alert = (e.get("evidence") or {}).get("alert") or ""
+        if alert.startswith("canary:"):
+            continue
+        if e.get("model") == model or alert.startswith("slo:") \
+                or e.get("severity") == "page":
+            return e.get("event_id")
+    return None
 
 
 def _collect_vetoes(model: str) -> List[str]:
@@ -608,6 +627,12 @@ class CanaryController:
                     "promotion held by veto: " + "; ".join(vetoes),
                     trace_id=tid, action="held", vetoes=vetoes,
                 )
+                _journal.emit(
+                    "canary", "veto", model=model, severity="warn",
+                    message="promotion held by veto: " + "; ".join(vetoes),
+                    cause=_upstream_alert_cause(model), trace_id=tid,
+                    evidence={"vetoes": vetoes},
+                )
             return
         self._decide(model, "pass", [])
 
@@ -659,11 +684,6 @@ class CanaryController:
                 f"canary v{version} FAILED over {summary['rows']} shadow rows: "
                 + "; ".join(reasons)
             )
-            _alerts.fire(
-                f"canary:{model}", severity="page", message=msg,
-                value=summary["mismatch_pct"], threshold=self.max_mismatch_pct,
-                trace_id=tid, labels={"model": model},
-            )
         decision = {
             "ts": time.time(),
             "model": model,
@@ -690,20 +710,61 @@ class CanaryController:
                 "rows", "mismatch_pct", "latency_ratio",
             )
         })
+        # journal the decision: evidence is the exact window the engine
+        # judged, recorded into the TSDB so /queryz can resolve the very
+        # samples the event cites; a failing verdict links back to the
+        # quality-signal alert that preceded it (drift/SLO), and the
+        # page alert + flight-recorder bundle chain off the decision
+        if summary["mismatch_pct"] is not None:
+            _tsdb.record("canary.mismatch_pct", summary["mismatch_pct"])
+        if summary["latency_ratio"] is not None:
+            _tsdb.record("canary.latency_ratio", summary["latency_ratio"])
+        jev = _journal.emit(
+            "canary", action, model=model, severity=severity, message=msg,
+            cause=_upstream_alert_cause(model) if verdict == "fail" else None,
+            trace_id=tid,
+            evidence={
+                "canary_version": version,
+                "verdict": verdict,
+                "reasons": reasons,
+                "rows": summary["rows"],
+                "mismatch_pct": summary["mismatch_pct"],
+                "max_rel_err": summary["max_rel_err"],
+                "latency_ratio": summary["latency_ratio"],
+                "series": ["canary.mismatch_pct", "canary.latency_ratio"],
+            },
+        )
         if verdict == "fail":
-            self._dump_bundle(model, decision)
+            _alerts.fire(
+                f"canary:{model}", severity="page", message=msg,
+                value=summary["mismatch_pct"], threshold=self.max_mismatch_pct,
+                trace_id=tid, labels={"model": model},
+                cause=jev["event_id"],
+                evidence={"series": ["canary.mismatch_pct"],
+                          "mismatch_pct": summary["mismatch_pct"]},
+            )
+            self._dump_bundle(model, decision, cause=jev["event_id"])
 
-    def _dump_bundle(self, model: str, decision: Dict[str, Any]) -> None:
+    def _dump_bundle(self, model: str, decision: Dict[str, Any],
+                     cause: Optional[str] = None) -> None:
         """Best-effort flight-recorder bundle on a rollback: the failed
         comparison stats ride in the bundle's canary section (the module
         state the recorder snapshots) — a rollback must be explainable
-        after the process is gone."""
+        after the process is gone.  The bundle write itself is journaled
+        with its cause linked to the rollback decision, closing the
+        ``evidence → rollback → page → bundle`` causal chain."""
         from ..telemetry import flight_recorder as _fr
 
         if not _fr.installed():
             return
         try:
-            _fr.dump_bundle(reason=f"canary_rollback:{model}")
+            path = _fr.dump_bundle(reason=f"canary_rollback:{model}")
+            _journal.emit(
+                "flight_recorder", "bundle", model=model, severity="info",
+                message="forensic bundle written for canary rollback",
+                cause=cause, trace_id=decision.get("trace_id"),
+                evidence={"path": path, "reason": f"canary_rollback:{model}"},
+            )
         except Exception:  # lint: allow H501(a bundle-write failure must never mask the rollback itself)
             pass
 

@@ -27,7 +27,9 @@ import numpy as np
 
 from ..resilience.faults import inject
 from ..resilience.retry import default_io_policy
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
+from ..telemetry import tsdb as _tsdb
 from ..telemetry.spans import span as _span
 from .source import StreamSource
 
@@ -187,6 +189,7 @@ class StreamConsumer:
             self._merge_hist(cur, h)
         score = _psi(self._key_ref, cur)
         self.last_key_psi = score
+        _tsdb.record("stream.key_psi", score)
         if score > self.reshard_psi:
             # re-anchor by re-entering warm-up: the rolling view that
             # tripped straddles the transition, so the NEXT windows
@@ -199,6 +202,18 @@ class StreamConsumer:
             self.reshard_events += 1
             self._needs_reshard = True
             _RESHARDS.inc()
+            _journal.emit(
+                "stream", "reshard",
+                severity="warn",
+                message=(
+                    f"key-distribution drift PSI {score:.4f} > "
+                    f"{self.reshard_psi:g}: split-axis reshard pending"
+                ),
+                evidence={"psi": round(score, 6),
+                          "threshold": self.reshard_psi,
+                          "reshard_events": self.reshard_events,
+                          "series": ["stream.key_psi"]},
+            )
 
     def maybe_reshard(self, dnd=None) -> bool:
         """Apply a pending key-drift reshard to the caller's persistent

@@ -27,6 +27,7 @@ from typing import Callable, Optional, Tuple
 from ..analysis import tsan as _tsan
 from ..resilience.faults import inject
 from ..telemetry import alerts as _alerts
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry.sketch import SKETCHES, ModelSketch, check_drift
 from ..telemetry.spans import span as _span
@@ -169,6 +170,30 @@ class RefreshDriver:
                 self.refreshes += 1
             _REFRESHES.inc()
             sp.attrs.update(version=version)
+            # causal link back to the drift page that triggered this
+            # refresh (journal after our lock is released)
+            cause = None
+            for e in reversed(_journal.journal_events()):
+                if (
+                    e.get("actor") == "alerts"
+                    and e.get("action") == "fire"
+                    and str(e.get("evidence", {}).get("alert", ""))
+                    .startswith(f"drift:{self.model}")
+                ):
+                    cause = e["event_id"]
+                    break
+            _journal.emit(
+                "refresh", "trigger",
+                model=self.model,
+                severity="info",
+                message=(
+                    f"drift-triggered refresh fitted v{version} of "
+                    f"{self.model} and staged it as canary"
+                ),
+                cause=cause,
+                evidence={"version": version, "rows": int(recent.shape[0]),
+                          "refreshes": self.refreshes},
+            )
 
     # -- optional background poller -------------------------------------
     def start(self, poll_s: float = 1.0) -> "RefreshDriver":

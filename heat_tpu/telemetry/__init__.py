@@ -57,9 +57,12 @@ from __future__ import annotations
 
 import atexit
 import os
+import time as _time
 from typing import Any, Dict, Optional
 
 from . import metrics
+from . import journal
+from . import tsdb
 from . import tracing
 from . import spans
 from . import profiling
@@ -120,6 +123,23 @@ from .observatory import (
 )
 from .server import start_server, stop_server
 from .alerts import active_alerts, alert_events, alerts_snapshot
+from .journal import (
+    DecisionEvent,
+    causal_chain,
+    decisionz_report,
+    emit,
+    journal_events,
+    read_journal,
+)
+from .tsdb import (
+    query,
+    queryz_report,
+    record,
+    sample_once,
+    start_sampler,
+    stop_sampler,
+    window_stats,
+)
 from .slo import (
     SLO,
     install_default_slos,
@@ -137,6 +157,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
+    "DecisionEvent",
     "SKETCHES",
     "SLO",
     "SpanRecord",
@@ -145,7 +166,19 @@ __all__ = [
     "alert_events",
     "alerts_snapshot",
     "annotate",
+    "causal_chain",
     "check_drift",
+    "decisionz_report",
+    "emit",
+    "journal_events",
+    "query",
+    "queryz_report",
+    "read_journal",
+    "record",
+    "sample_once",
+    "start_sampler",
+    "stop_sampler",
+    "window_stats",
     "drift_report",
     "install_default_slos",
     "parse_slo",
@@ -212,8 +245,11 @@ _DOMAIN_PREFIXES = {
     "slo": ("slo.",),
     "drift": ("drift.",),
     "observatory": ("observatory.",),
+    "journal": ("journal.",),
+    "tsdb": ("tsdb.",),
     "telemetry": ("spans.", "tracing.", "fit.", "telemetry.", "flight.",
-                  "checkpoint.", "alerts.", "slo.", "drift.", "observatory."),
+                  "checkpoint.", "alerts.", "slo.", "drift.", "observatory.",
+                  "journal.", "tsdb."),
 }
 
 
@@ -236,6 +272,8 @@ def reset_all(domain: Optional[str] = None) -> None:
         slo.reset_monitors()
         sketch.SKETCHES.clear()
         observatory.reset()
+        journal.reset_journal()
+        tsdb.reset_tsdb()
         return
     prefixes = _DOMAIN_PREFIXES.get(domain)
     if prefixes is None:
@@ -256,6 +294,10 @@ def reset_all(domain: Optional[str] = None) -> None:
         sketch.SKETCHES.clear()
     if domain in ("observatory", "telemetry"):
         observatory.reset()
+    if domain in ("journal", "telemetry"):
+        journal.reset_journal()
+    if domain in ("tsdb", "telemetry"):
+        tsdb.reset_tsdb()
 
 
 def summary_line(iter_rate: Optional[float] = None) -> str:
@@ -298,6 +340,44 @@ def _dump_at_exit() -> None:  # pragma: no cover - exercised via subprocess
     except Exception:  # lint: allow H501(best-effort metrics dump at interpreter exit)
         pass
 
+
+def build_info_labels() -> Dict[str, str]:
+    """The binary's identity labels: heat_tpu version, jax/jaxlib
+    versions, the active backend and device kind.  Resolved lazily by
+    the ``build_info`` metric on its first read (``jax.devices()``
+    initializes the backend; an import must not)."""
+    from ..version import __version__ as _v
+
+    labels: Dict[str, str] = {"version": str(_v)}
+    try:
+        import jax
+        import jaxlib
+
+        labels["jax"] = str(jax.__version__)
+        labels["jaxlib"] = str(getattr(jaxlib, "__version__", "?"))
+        labels["backend"] = str(jax.default_backend())
+        devs = jax.devices()
+        labels["device_kind"] = str(devs[0].device_kind) if devs else "none"
+    except Exception:  # lint: allow H501(no working backend: identity degrades to the version labels)
+        labels.setdefault("backend", "unavailable")
+    return labels
+
+
+#: satellite identity metrics on every scrape surface (/metrics, /varz,
+#: /statusz): which binary produced these numbers, and since when.  The
+#: start timestamp is a callback gauge so ``reset_all()`` cannot zero
+#: the process's birth time.
+_PROCESS_START_TS = _time.time()
+metrics.info(
+    "build_info",
+    "binary identity: heat_tpu/jax/jaxlib versions, backend, device kind",
+    fn=build_info_labels,
+)
+metrics.gauge(
+    "process.start_ts",
+    "unix timestamp this process imported heat_tpu.telemetry",
+    fn=lambda: _PROCESS_START_TS,
+)
 
 # runtime introspection: HEAT_TPU_HTTP_PORT starts the HTTP endpoint,
 # HEAT_TPU_FLIGHT_RECORDER arms the crash recorder — both off by

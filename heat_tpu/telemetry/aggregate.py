@@ -43,6 +43,7 @@ import os
 from typing import Any, Dict, List, Optional, Sequence
 
 from . import alerts as _alerts
+from . import journal as _journal
 from . import metrics as _metrics
 from . import sketch as _sketch
 from . import spans as _spans
@@ -126,6 +127,7 @@ def tag_snapshot() -> Dict[str, Any]:
         "alerts": _alerts.alerts_snapshot(),
         "drift": _sketch.SKETCHES.digest(),
         "canary": _canary_state(),
+        "journal": _journal.journal_snapshot(),
     }
 
 
@@ -453,7 +455,12 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
       fleet-worst score (:func:`_merge_drift`);
     * ``canary`` — per-model canary verdicts per worker with a
       ``divergent`` flag when replicas disagree, plus every worker's
-      retained canary events in one timeline (:func:`_merge_canary`).
+      retained canary events in one timeline (:func:`_merge_canary`);
+    * ``journal`` — every worker's retained control-plane decision
+      events interleaved into one fleet timeline ordered by
+      ``(ts, worker, event_id)`` (:func:`heat_tpu.telemetry.journal.
+      merge_journal_snapshots`) — the cross-replica half of "why did
+      the canary roll back while worker 2 preempted a fit".
 
     Determinism: output depends only on the input snapshots; workers are
     ordered by ``process_index`` and every dict is key-sorted."""
@@ -550,4 +557,10 @@ def merge_snapshots(snapshots: Sequence[Dict], publish: bool = True) -> Dict[str
         ),
         "drift": _merge_drift(snaps),
         "canary": _merge_canary(snaps),
+        "journal": _journal.merge_journal_snapshots(
+            [
+                (str(int(s.get("process_index", 0))), s.get("journal") or {})
+                for s in snaps
+            ]
+        ),
     }

@@ -40,6 +40,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..analysis import tsan as _tsan
+from . import journal as _journal
 from . import metrics as _metrics
 
 __all__ = [
@@ -149,12 +150,21 @@ def fire(
     threshold: Optional[float] = None,
     trace_id: Optional[str] = None,
     labels: Optional[Dict[str, str]] = None,
+    cause: Optional[str] = None,
+    evidence: Optional[Dict[str, Any]] = None,
 ) -> bool:
     """Fire (or refresh) an alert; returns True on the fired *transition*.
 
     A first fire for ``(name, labels)`` records a ``fired`` event in the
     ring and counts in ``alerts.fired``; re-firing an active alert only
-    updates its observed value/message/exemplar (dedup — no event)."""
+    updates its observed value/message/exemplar (dedup — no event).
+
+    The fired transition also lands in the control-plane **decision
+    journal** (actor ``alerts``, action ``fire``), carrying the firing
+    monitor's ``evidence`` — by convention the exact metric values it
+    compared plus the TSDB ``series`` names whose samples are
+    resolvable via ``/queryz`` — and an optional ``cause`` event_id
+    linking this alert to the upstream decision that provoked it."""
     if severity not in SEVERITIES:
         raise ValueError(f"severity must be one of {SEVERITIES}, got {severity!r}")
     key = alert_key(name, labels)
@@ -178,13 +188,30 @@ def fire(
         _EVENTS.append(dict(a.doc(), event="fired", ts=now))
         _ACTIVE_G.set(len(_ACTIVE))
     _FIRED_C.inc()
+    # journal after the alert lock is released: emit takes the journal
+    # lock (and may append a durable segment) — never nested under ours
+    ev = {"alert": key, "value": value, "threshold": threshold}
+    ev.update(evidence or {})
+    _journal.emit(
+        "alerts", "fire",
+        model=(labels or {}).get("model"),
+        tenant=(labels or {}).get("tenant"),
+        severity=severity,
+        message=message or f"alert {key} fired",
+        cause=cause,
+        trace_id=trace_id,
+        evidence=ev,
+    )
     return True
 
 
 def resolve(name: str, labels: Optional[Dict[str, str]] = None) -> bool:
     """Resolve an active alert; returns True on the resolved
     *transition* (False when it was not firing — resolving is
-    idempotent, quiet monitors can call it every tick)."""
+    idempotent, quiet monitors can call it every tick).  The resolved
+    transition is journaled (actor ``alerts``, action ``resolve``) with
+    its cause linked back to the retained fire event, so an incident's
+    timeline shows how long the condition held."""
     key = alert_key(name, labels)
     now = time.time()
     with _LOCK:
@@ -192,12 +219,27 @@ def resolve(name: str, labels: Optional[Dict[str, str]] = None) -> bool:
         a = _ACTIVE.pop(key, None)
         if a is None:
             return False
-        _EVENTS.append(
-            dict(a.doc(), event="resolved", ts=now,
-                 active_s=round(now - a.fired_ts, 3))
-        )
+        doc = a.doc()
+        active_s = round(now - a.fired_ts, 3)
+        _EVENTS.append(dict(doc, event="resolved", ts=now, active_s=active_s))
         _ACTIVE_G.set(len(_ACTIVE))
     _RESOLVED_C.inc()
+    fired_id = None
+    for e in reversed(_journal.journal_events()):
+        if (e.get("actor") == "alerts" and e.get("action") == "fire"
+                and (e.get("evidence") or {}).get("alert") == key):
+            fired_id = e.get("event_id")
+            break
+    _journal.emit(
+        "alerts", "resolve",
+        model=doc["labels"].get("model"),
+        tenant=doc["labels"].get("tenant"),
+        severity="info",
+        message=f"alert {key} resolved after {active_s}s",
+        cause=fired_id,
+        trace_id=doc.get("trace_id"),
+        evidence={"alert": key, "active_s": active_s},
+    )
     return True
 
 

@@ -26,6 +26,11 @@ One bundle carries everything the post-mortem needs::
                 windows, decision history, veto reasons, retained events
     observatory the roofline execution ledger + the last HBM watermark
                 sample vs the static prediction + calibration provenance
+    journal     the decision journal's hot ring: the control-plane
+                actions (scale, rollback, preempt, reshard) that led
+                into the crash, each with causal link + evidence
+    tsdb        the embedded metric history's retained windows — the
+                exact samples the journaled decisions cite
     knobs       every registered HEAT_TPU_* knob's effective value
     dispatch    cache stats + keys + per-executable cost accounting
     checkpoint  last durable step (where a resume would restart)
@@ -296,6 +301,29 @@ def _elastic_state() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _journal_state() -> Optional[Dict[str, Any]]:
+    """The decision journal's hot ring at crash time — the control-plane
+    actions (scale, rollback, preempt, reshard) that led INTO the crash,
+    each with its causal link and evidence."""
+    try:
+        from . import journal as _journal
+
+        return _journal.decisionz_report(limit=128)
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
+def _tsdb_state() -> Optional[Dict[str, Any]]:
+    """The embedded metric history's retained windows at crash time —
+    the exact samples the journaled decisions cite as evidence."""
+    try:
+        from . import tsdb as _tsdb
+
+        return _tsdb.tsdb_snapshot(max_points=64)
+    except Exception:  # lint: allow H501(forensics degrade field-by-field, never abort the bundle)
+        return None
+
+
 def build_bundle(
     exc: Optional[BaseException] = None,
     reason: str = "manual",
@@ -332,6 +360,8 @@ def build_bundle(
         "analysis": _analysis_state(),
         "observatory": _observatory_state(),
         "elastic": _elastic_state(),
+        "journal": _journal_state(),
+        "tsdb": _tsdb_state(),
         "runtime": _runtime_info(),
     }
     if exc is not None:

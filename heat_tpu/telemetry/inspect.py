@@ -206,6 +206,31 @@ def format_bundle(doc: Dict[str, Any], n_metrics: int = 20, n_spans: int = 15) -
                 + (f" (trace {ev.get('trace_id')})" if ev.get("trace_id") else "")
             )
 
+    jnl = doc.get("journal") or {}
+    j_events = jnl.get("events") or []
+    if j_events:
+        lines.append(_rule(f"decision journal ({len(j_events)} event(s) retained)"))
+        for e in j_events[-12:]:
+            lines.append(
+                f"  {str(e.get('severity', '?')).upper():5s} "
+                f"{e.get('actor')}/{e.get('action')}"
+                + (f" [{e.get('model')}]" if e.get("model") else "")
+                + f": {e.get('message')}"
+                + (f" (cause {e.get('cause')})" if e.get("cause") else "")
+                + (f" (trace {e.get('trace_id')})" if e.get("trace_id") else "")
+            )
+
+    tsdb_doc = doc.get("tsdb") or {}
+    series = tsdb_doc.get("series") or {}
+    if series:
+        lines.append(_rule(f"metric history ({len(series)} series retained)"))
+        for name in sorted(series)[:12]:
+            pts = series[name] or []
+            last = pts[-1][1] if pts else None
+            lines.append(f"  {name}: {len(pts)} point(s), last={last}")
+        if len(series) > 12:
+            lines.append(f"  ... {len(series) - 12} more")
+
     metrics = doc.get("metrics") or {}
     nonzero = {
         k: v

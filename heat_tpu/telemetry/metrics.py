@@ -49,11 +49,13 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "Info",
     "MetricsRegistry",
     "REGISTRY",
     "counter",
     "gauge",
     "histogram",
+    "info",
     "register_dump_section",
     "snapshot",
     "reset",
@@ -74,6 +76,11 @@ def register_dump_section(name: str, provider: Callable[[], Any]) -> None:
     _DUMP_SECTIONS[str(name)] = provider
 
 Number = Union[int, float]
+
+
+def _escape_label(v: str) -> str:
+    """OpenMetrics label-value escaping: backslash, double-quote, newline."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 #: histogram bucket upper bounds: 10**(e/20) for e in [-120, 240] — a
 #: geometric ladder from 1e-6 to 1e12 in ~12% steps.  Quantile estimates
@@ -307,6 +314,50 @@ class Histogram:
             self._exemplars.clear()
 
 
+class Info:
+    """Constant build/runtime identity: the OpenMetrics *info* pattern.
+
+    A metric whose payload is its **labels** (version strings, backend,
+    device kind) with a constant sample value of 1 — ``build_info`` in
+    the exposition joins any scraped series to the binary that produced
+    it.  Labels come from a zero-arg provider resolved **lazily on first
+    read and cached**: ``build_info`` needs ``jax.devices()``, and
+    resolving that at registration time would initialize the backend as
+    an import side effect.  :meth:`reset` keeps the cache — identity is
+    not a counter."""
+
+    __slots__ = ("name", "doc", "fn", "_labels", "_lock")
+
+    def __init__(self, name: str, doc: str = "",
+                 fn: Optional[Callable[[], Dict[str, str]]] = None):
+        self.name = name
+        self.doc = doc
+        self.fn = fn
+        self._labels: Optional[Dict[str, str]] = None
+        self._lock = threading.Lock()
+
+    def labels(self) -> Dict[str, str]:
+        with self._lock:
+            if self._labels is None:
+                resolved: Dict[str, str] = {}
+                if self.fn is not None:
+                    try:
+                        resolved = {
+                            str(k): str(v) for k, v in (self.fn() or {}).items()
+                        }
+                    except Exception:  # lint: allow H501(label provider isolation, identity degrades to empty)
+                        resolved = {}
+                self._labels = resolved
+            return dict(self._labels)
+
+    @property
+    def value(self) -> int:
+        return 1
+
+    def reset(self) -> None:
+        pass  # identity is constant; nothing to zero
+
+
 class MetricsRegistry:
     """Name -> metric map with one snapshot/reset/export surface.
 
@@ -347,6 +398,13 @@ class MetricsRegistry:
     def histogram(self, name: str, doc: str = "") -> Histogram:
         return self._get_or_make(name, Histogram, doc=doc)
 
+    def info(self, name: str, doc: str = "",
+             fn: Optional[Callable[[], Dict[str, str]]] = None) -> Info:
+        m = self._get_or_make(name, Info, doc=doc)
+        if fn is not None and m.fn is None:
+            m.fn = fn
+        return m
+
     def get(self, name: str):
         with self._lock:
             _tsan.note_access("telemetry.metrics.registry", write=False)
@@ -373,6 +431,8 @@ class MetricsRegistry:
                 if not include_zero and m.count == 0:
                     continue
                 out[name] = m.snapshot()
+            elif isinstance(m, Info):
+                out[name] = m.labels()
             else:
                 v = m.value
                 if not include_zero and not v:
@@ -439,7 +499,16 @@ class MetricsRegistry:
             pname = "heat_tpu_" + "".join(
                 c if (c.isalnum() or c == "_") else "_" for c in name
             )
-            if isinstance(m, Counter):
+            if isinstance(m, Info):
+                # the OpenMetrics info pattern: identity in the labels,
+                # constant sample value 1
+                lines.append(f"# TYPE {pname} gauge")
+                labels = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(m.labels().items())
+                )
+                lines.append(f"{pname}{{{labels}}} 1" if labels else f"{pname} 1")
+            elif isinstance(m, Counter):
                 lines.append(f"# TYPE {pname} counter")
                 lines.append(f"{pname} {m.value}")
             elif isinstance(m, Gauge):
@@ -486,6 +555,13 @@ def gauge(name: str, doc: str = "", fn: Optional[Callable[[], Number]] = None) -
 def histogram(name: str, doc: str = "") -> Histogram:
     """Get-or-create a bounded histogram in the global registry."""
     return REGISTRY.histogram(name, doc)
+
+
+def info(name: str, doc: str = "",
+         fn: Optional[Callable[[], Dict[str, str]]] = None) -> Info:
+    """Get-or-create an info metric (lazy labeled identity) in the
+    global registry."""
+    return REGISTRY.info(name, doc, fn)
 
 
 def snapshot(include_zero: bool = True) -> Dict[str, Any]:

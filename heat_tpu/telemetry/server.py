@@ -46,6 +46,16 @@ route     payload
           ``/profilez/start[?duration_s=]`` / ``/profilez/stop``
           (single in-flight, 409 on conflict), GET lists completed
           captures with downloadable artifacts
+/decisionz  control-plane decision journal: every autonomous action
+          (autoscaler, canary, refresh driver, preemption, circuit
+          breakers, reshape, reshard, alert transitions) as a typed
+          event with actor/action/evidence and cause links; HTML
+          timeline by default, ``?format=json`` for the machine form,
+          ``?event_id=<id>`` for the causal-chain explain view
+/queryz   embedded metric history: range queries over the in-process
+          TSDB ring buffers (``?series=<name>&window=<seconds>``) —
+          the very samples journal evidence references; HTML by
+          default, ``?format=json`` for the machine form
 /statusz  build/runtime info: every registered env knob's effective
           value, dispatch cache keys + hit rate + per-executable cost
           accounting, jax/device/version info, active alerts
@@ -77,12 +87,14 @@ from typing import Any, Dict, Optional, Tuple
 
 from ..analysis import tsan as _tsan
 from . import alerts as _alerts
+from . import journal as _journal
 from . import metrics as _metrics
 from . import observatory as _observatory
 from . import sketch as _sketch
 from . import slo as _slo
 from . import spans as _spans
 from . import tracing as _tracing
+from . import tsdb as _tsdb
 
 #: /metrics content type: the payload carries OpenMetrics exemplar
 #: syntax and the ``# EOF`` terminator, so it must be declared as
@@ -90,7 +102,72 @@ from . import tracing as _tracing
 #: a spec violation scrapers reject (exposition hygiene, PR 14)
 OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
+#: the declarative route registry: one row per HTTP route a process can
+#: serve, the single source the docs generator renders the endpoint
+#: index from (``scripts/build_api_docs.py`` — the hand-maintained
+#: table in docs/observability.md drifted silently as routes grew).
+#: PURE LITERAL, like KNOBS and LOCK_REGISTRY: ``owner`` is the module
+#: that serves the route ("server" = this introspection endpoint;
+#: the fleet router and the serving layer mount/serve the rest);
+#: ``html`` marks routes whose default rendering takes ``?format=json``.
+BUILTIN_ROUTES = (
+    {"route": "/metrics", "owner": "server", "html": False,
+     "purpose": "OpenMetrics exposition of the whole registry (exemplar'd histograms, `# EOF`-terminated, `application/openmetrics-text`)",
+     "knobs": ("HEAT_TPU_TRACE_EXEMPLARS",)},
+    {"route": "/varz", "owner": "server", "html": False,
+     "purpose": "full registry snapshot as JSON",
+     "knobs": ()},
+    {"route": "/healthz", "owner": "server", "html": False,
+     "purpose": "liveness: fit-heartbeat age + last durable checkpoint step; 503 when stale",
+     "knobs": ("HEAT_TPU_HEALTH_MAX_AGE_S",)},
+    {"route": "/readyz", "owner": "server", "html": False,
+     "purpose": "readiness: should a router send traffic (warming/ready/draining state machine)",
+     "knobs": ()},
+    {"route": "/trace", "owner": "server", "html": False,
+     "purpose": "Chrome trace-event JSON of the span ring (perfetto-loadable)",
+     "knobs": ("HEAT_TPU_TRACE", "HEAT_TPU_TRACE_RING")},
+    {"route": "/tracez", "owner": "server", "html": True,
+     "purpose": "tail-sampled request traces per route; `?trace_id=` for one span tree",
+     "knobs": ("HEAT_TPU_TRACE_KEEP", "HEAT_TPU_TRACE_MAX_SPANS")},
+    {"route": "/statusz", "owner": "server", "html": False,
+     "purpose": "every knob's effective value, dispatch cache + cost accounting, analysis + observatory + elastic sections, runtime/build info",
+     "knobs": ()},
+    {"route": "/sloz", "owner": "server", "html": True,
+     "purpose": "SLO burn-rate monitors + active alert table",
+     "knobs": ("HEAT_TPU_SLO_*", "HEAT_TPU_ALERT_RING")},
+    {"route": "/driftz", "owner": "server", "html": True,
+     "purpose": "per-model input-drift PSI vs baseline",
+     "knobs": ("HEAT_TPU_SKETCH", "HEAT_TPU_DRIFT_*")},
+    {"route": "/canaryz", "owner": "server", "html": True,
+     "purpose": "canary decision plane: per-model shadow evidence window (rows compared, mismatch rate, latency ratio), verdict + veto reasons, retained comparison/decision events with exemplar trace_ids",
+     "knobs": ("HEAT_TPU_SHADOW_*", "HEAT_TPU_CANARY_*")},
+    {"route": "/rooflinez", "owner": "server", "html": True,
+     "purpose": "kernel roofline observatory: per-executable measured GFLOP/s, GB/s, intensity, bound-class + HBM watermark",
+     "knobs": ("HEAT_TPU_OBSERVATORY", "HEAT_TPU_PERF_SYNC_EVERY",
+               "HEAT_TPU_PEAK_*", "HEAT_TPU_HBM_*")},
+    {"route": "/profilez", "owner": "server", "html": True,
+     "purpose": "on-demand bounded `jax.profiler` capture: `POST /profilez/start` / `/stop`, artifact download",
+     "knobs": ("HEAT_TPU_PROFILE_DIR", "HEAT_TPU_PROFILE_MAX_S")},
+    {"route": "/tenantz", "owner": "server", "html": True,
+     "purpose": "per-tenant cost accounts: analyzed FLOPs/bytes + device-ms per tenant, pro-rata by rows over coalesced batches; accounts sum to the derived total (the fleet router serves the same route merged across replicas)",
+     "knobs": ("HEAT_TPU_QOS_METER",)},
+    {"route": "/decisionz", "owner": "server", "html": True,
+     "purpose": "control-plane decision journal: every autonomous action (autoscaler, canary, refresh, preemption, circuit breakers, reshape, reshard, alerts) with actor/action/evidence; `?event_id=` walks the causal chain",
+     "knobs": ("HEAT_TPU_JOURNAL_DIR", "HEAT_TPU_JOURNAL_RING")},
+    {"route": "/queryz", "owner": "server", "html": True,
+     "purpose": "embedded metric history: range queries over the in-process TSDB rings (`?series=<name>&window=<seconds>`); the samples journal evidence cites",
+     "knobs": ("HEAT_TPU_TSDB_INTERVAL_S", "HEAT_TPU_TSDB_RETENTION",
+               "HEAT_TPU_TSDB_SERIES")},
+    {"route": "/fleetz", "owner": "fleet.router", "html": True,
+     "purpose": "*(router)* fleet-wide per-kernel utilization + watermark rollup (slowest replica per key highlighted) + per-model canary verdicts across replicas (divergent replicas highlighted) + the merged tenant-account table + the interleaved cross-replica decision timeline",
+     "knobs": ("HEAT_TPU_FLEET_HEALTH_PERIOD_S",)},
+    {"route": "/v1/*", "owner": "serving.service", "html": False,
+     "purpose": "serving: `/v1/models`, `POST /v1/predict`, per-model `/v1/models/<name>/healthz`",
+     "knobs": ("HEAT_TPU_SERVE_*",)},
+)
+
 __all__ = [
+    "BUILTIN_ROUTES",
     "IntrospectionServer",
     "clear_readiness",
     "health_report",
@@ -406,6 +483,15 @@ def _runtime_info() -> Dict[str, Any]:
         info["heat_tpu"] = version.__version__
     except Exception:  # lint: allow H501(version probe is decorative)
         pass
+    # the identity satellites every scrape surface shares: which binary
+    # produced these numbers, and since when
+    try:
+        binfo = _metrics.REGISTRY.get("build_info")
+        info["build_info"] = binfo.labels() if binfo is not None else None
+        start = _metrics.REGISTRY.get("process.start_ts")
+        info["process_start_ts"] = start.value if start is not None else None
+    except Exception:  # lint: allow H501(identity probe is decorative)
+        pass
     return info
 
 
@@ -556,6 +642,37 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     self.end_headers()
                     self.wfile.write(data)
+            elif path == "/decisionz":
+                params = self._query_params()
+                event_id = params.get("event_id")
+                if params.get("format") == "json":
+                    if event_id is not None:
+                        self._send_json(_journal.causal_chain(event_id))
+                    else:
+                        try:
+                            limit = int(params["limit"]) if "limit" in params else None
+                        except ValueError:
+                            limit = None
+                        self._send_json(_journal.decisionz_report(limit=limit))
+                else:
+                    self._send(
+                        200, _journal.render_decisionz_html(event_id), "text/html"
+                    )
+            elif path == "/queryz":
+                params = self._query_params()
+                series = [
+                    s for s in params.get("series", "").split(",") if s
+                ] or None
+                try:
+                    window = float(params["window"]) if "window" in params else None
+                except ValueError:
+                    window = None
+                if params.get("format") == "json":
+                    self._send_json(_tsdb.queryz_report(series, window))
+                else:
+                    self._send(
+                        200, _tsdb.render_queryz_html(series, window), "text/html"
+                    )
             elif path == "/statusz":
                 self._send_json(statusz_report())
             elif path == "/":
@@ -564,7 +681,8 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     "heat_tpu runtime introspection: "
                     "/metrics /varz /healthz /readyz /trace /tracez /sloz /driftz "
-                    "/canaryz /rooflinez /tenantz /profilez /statusz"
+                    "/canaryz /rooflinez /tenantz /profilez /decisionz /queryz "
+                    "/statusz"
                     + (f" | mounted: {extra}" if extra else "")
                     + "\n",
                     "text/plain",

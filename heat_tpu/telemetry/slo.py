@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..analysis import tsan as _tsan
 from . import alerts as _alerts
 from . import metrics as _metrics
+from . import tsdb as _tsdb
 
 __all__ = [
     "SLO",
@@ -502,6 +503,7 @@ def evaluate(now: Optional[float] = None) -> List[Dict[str, Any]]:
     the verdict transitions; returns (and caches, for ``/sloz``) the
     verdict documents.  ``now`` is injectable so tests can walk a
     synthetic clock through the windows."""
+    now = time.time() if now is None else now
     with _LOCK:
         _tsan.note_access("telemetry.slo.state")
         slos = list(_SLOS.values())
@@ -511,9 +513,15 @@ def evaluate(now: Optional[float] = None) -> List[Dict[str, Any]]:
             report.append(doc)
         _LAST_REPORT[:] = report
     # alert transitions OUTSIDE the slo lock: alerts has its own
-    # registered lock and holding both invites an order cycle
+    # registered lock and holding both invites an order cycle (tsdb
+    # recording likewise takes only the tsdb lock)
     for slo, doc in zip(slos, report):
         aname = f"slo:{slo.name}"
+        fast_series = f"slo.{slo.name}.burn_fast"
+        slow_series = f"slo.{slo.name}.burn_slow"
+        if not doc.get("no_data"):
+            _tsdb.record(fast_series, doc["burn_fast"], ts=now)
+            _tsdb.record(slow_series, doc["burn_slow"], ts=now)
         if doc["firing"]:
             _alerts.fire(
                 aname,
@@ -526,6 +534,13 @@ def evaluate(now: Optional[float] = None) -> List[Dict[str, Any]]:
                 threshold=slo.fast_burn,
                 trace_id=slo.exemplar_trace_id(),
                 labels=slo.labels,
+                evidence={
+                    "objective": slo.describe(),
+                    "burn_fast": doc["burn_fast"],
+                    "burn_slow": doc["burn_slow"],
+                    "windows": doc.get("windows", {}),
+                    "series": [fast_series, slow_series],
+                },
             )
         elif doc.get("resolved"):
             _alerts.resolve(aname, labels=slo.labels)

@@ -59,6 +59,17 @@ ROLL_K = 5
 #: between the two adjacent k-run medians that counts as drift
 DRIFT_PCT = 10.0
 
+#: drift threshold for ``overhead_pct``-kind metrics, in absolute
+#: percentage POINTS between the two adjacent k-run medians.  Paired
+#: overhead statistics hover at 0 by construction (their per-run <3%
+#: hard caps are the primary gate), so a RELATIVE move against a ~0-pp
+#: median is unbounded noise — a measured −0.18pp → 0.46pp window
+#: rotation reads as "+356%" while both medians sit far inside every
+#: cap that actually defends the property.  Half the hard cap: a
+#: sustained 1.5-pp median creep is a real signal the caps would only
+#: catch one noisy run at a time.
+DRIFT_POINTS = 1.5
+
 #: gate-record key -> direction of good: +1 = bigger is better (anchored
 #: ratios), -1 = smaller is better (wall time, overhead, counts), 0 =
 #: informational (anchors themselves — runner speed is not a regression)
@@ -250,25 +261,44 @@ def metric_series(records: list, name: str) -> list:
     return out
 
 
-def metric_direction(records: list, name: str) -> int:
-    """The metric's direction of good from the newest record that
-    stamped its kind (0 = informational/unknown: never gated)."""
+def metric_kind(records: list, name: str):
+    """The metric's gate kind from the newest record that stamped it."""
     for r in reversed(records):
         kind = (r.get("kinds") or {}).get(name)
         if kind is not None:
-            return KIND_DIRECTION.get(kind, 0)
-    return 0
+            return kind
+    return None
+
+
+def metric_direction(records: list, name: str) -> int:
+    """The metric's direction of good from the newest record that
+    stamped its kind (0 = informational/unknown: never gated)."""
+    return KIND_DIRECTION.get(metric_kind(records, name), 0)
 
 
 def trend_verdict(series: list, direction: int, k: int = ROLL_K,
-                  drift_pct: float = DRIFT_PCT) -> dict:
+                  drift_pct: float = DRIFT_PCT, kind: str = None) -> dict:
     """One metric's verdict: compare the median of the newest ``k``
     runs against the median of the ``k`` runs before them.
+
+    Two noise guards, both forced by measured window rotations on this
+    runner (the per-run gates in perf_gate.py stay the primary defense
+    either way):
+
+    * ``overhead_pct`` metrics drift on the ABSOLUTE move in
+      percentage points (``DRIFT_POINTS``) — their medians hover at 0,
+      so a relative threshold divides by noise;
+    * every other kind scales the threshold to the previous window's
+      own min..max span: a committed window spanning ~25% run to run
+      cannot certify a 10% median move as signal (perf_gate's
+      median-minus-spread principle at window scale), while genuine
+      route regressions (5–20×) clear any plausible span.
 
     Returns ``{"verdict", "median_now", "median_prev", "move_pct"}``
     where verdict is ``ok`` / ``DRIFT`` / ``warming`` (fewer than
     ``2k`` runs) / ``n/a`` (informational metric).  ``move_pct`` is
-    signed in raw units (positive = value went up)."""
+    signed (positive = value went up); for ``overhead_pct`` metrics it
+    is absolute percentage points, relative percent otherwise."""
     if direction == 0:
         return {"verdict": "n/a", "median_now": None, "median_prev": None,
                 "move_pct": None}
@@ -277,11 +307,19 @@ def trend_verdict(series: list, direction: int, k: int = ROLL_K,
         return {"verdict": "warming", "median_now": med, "median_prev": None,
                 "move_pct": None}
     med_now = _median(series[-k:])
-    med_prev = _median(series[-2 * k: -k])
-    move = 100.0 * (med_now - med_prev) / abs(med_prev) if med_prev else 0.0
+    prev_win = series[-2 * k: -k]
+    med_prev = _median(prev_win)
+    if kind == "overhead_pct":
+        move = med_now - med_prev  # percentage points
+        threshold = DRIFT_POINTS
+    else:
+        move = 100.0 * (med_now - med_prev) / abs(med_prev) if med_prev else 0.0
+        spread = (100.0 * (max(prev_win) - min(prev_win)) / abs(med_prev)
+                  if med_prev else 0.0)
+        threshold = max(drift_pct, spread)
     # drift = the median moved AGAINST the direction of good: ratios
     # falling, or seconds/overhead/counts rising
-    bad = (-move if direction > 0 else move) > drift_pct
+    bad = (-move if direction > 0 else move) > threshold
     return {
         "verdict": "DRIFT" if bad else "ok",
         "median_now": med_now,
@@ -301,6 +339,7 @@ def trend_verdicts(records: list, k: int = ROLL_K,
             metric_series(records, name),
             metric_direction(records, name),
             k=k, drift_pct=drift_pct,
+            kind=metric_kind(records, name),
         )
     return out
 

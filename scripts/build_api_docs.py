@@ -33,6 +33,9 @@ MODULES = [
     ("SLO monitors", "heat_tpu.telemetry.slo", "declarative objectives with multi-window burn-rate alerting over the bounded histograms (/sloz; docs/observability.md)"),
     ("Input-drift sketches", "heat_tpu.telemetry.sketch", "streaming per-feature moment + log-bucket sketches, PSI/KL divergence vs persisted baselines (/driftz; docs/observability.md)"),
     ("Alerts", "heat_tpu.telemetry.alerts", "deduplicated severity-tagged fired/resolved alert events with exemplar trace ids (docs/observability.md)"),
+    ("Decision journal", "heat_tpu.telemetry.journal", "typed control-plane decision events with causal links + evidence, bounded hot ring + durable atomic/CRC segment log (/decisionz; docs/observability.md)"),
+    ("Metric history (TSDB)", "heat_tpu.telemetry.tsdb", "embedded fixed-interval metric history: allowlisted series sampled into bounded rings, range queries + window stats (/queryz; docs/observability.md)"),
+    ("Journal replay", "heat_tpu.telemetry.replay", "offline reconstruction of the decision timeline and causal chains from a durable journal directory (python -m heat_tpu.telemetry.replay; docs/observability.md)"),
     ("Roofline observatory", "heat_tpu.telemetry.observatory", "per-executable runtime attribution: sampled execution ledger, device-peak calibration, live HBM watermarks, on-demand profiler capture (/rooflinez + /profilez; docs/observability.md)"),
     ("Static analysis", "heat_tpu.analysis", "SPMD program lint (J101-J105) + framework-invariant AST lint (H101-H601, H701-H705) (docs/static_analysis.md)"),
     ("Dtype-flow lint", "heat_tpu.analysis.dtype_flow", "jaxpr precision lint: silent truncation, low-precision accumulation, unpinned contractions, policy violations (J201-J204; docs/static_analysis.md)"),
@@ -158,14 +161,59 @@ def build_env_vars(out_path: str) -> int:
     return len(KNOBS)
 
 
+#: markers bounding the generated endpoint-index block inside
+#: docs/observability.md (everything between them is regenerated)
+ENDPOINT_BEGIN = "<!-- BEGIN GENERATED: endpoint-index (scripts/build_api_docs.py) -->"
+ENDPOINT_END = "<!-- END GENERATED: endpoint-index -->"
+
+
+def build_endpoint_index(doc_path: str) -> int:
+    """Regenerate the endpoint-index table in ``docs/observability.md``
+    from the server's declarative route registry
+    (``heat_tpu.telemetry.server.BUILTIN_ROUTES``) — one source of
+    truth, so a new route cannot ship without its docs row.  Returns the
+    number of routes written."""
+    from heat_tpu.telemetry.server import BUILTIN_ROUTES
+
+    rows = [
+        "| route | purpose | knobs |",
+        "|---|---|---|",
+    ]
+    for r in BUILTIN_ROUTES:
+        knobs = ", ".join(f"`{k}`" for k in r["knobs"]) or "—"
+        purpose = str(r["purpose"]).replace("|", "\\|")
+        rows.append(f"| `{r['route']}` | {purpose} | {knobs} |")
+    with open(doc_path) as f:
+        text = f.read()
+    try:
+        head, rest = text.split(ENDPOINT_BEGIN, 1)
+        _, tail = rest.split(ENDPOINT_END, 1)
+    except ValueError:
+        raise SystemExit(
+            f"{doc_path} is missing the endpoint-index markers "
+            f"({ENDPOINT_BEGIN!r} ... {ENDPOINT_END!r})"
+        )
+    block = ENDPOINT_BEGIN + "\n" + "\n".join(rows) + "\n" + ENDPOINT_END
+    with open(doc_path, "w") as f:
+        f.write(head + block + tail)
+    return len(BUILTIN_ROUTES)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "docs", "api_reference.md"))
     ap.add_argument("--env-out", default=os.path.join(REPO, "docs", "env_vars.md"))
+    ap.add_argument(
+        "--endpoints-doc",
+        default=os.path.join(REPO, "docs", "observability.md"),
+    )
     args = ap.parse_args()
 
     n_knobs = build_env_vars(args.env_out)
     print(f"env vars: {n_knobs} knobs -> {args.env_out}")
+
+    n_routes = build_endpoint_index(args.endpoints_doc)
+    print(f"endpoint index: {n_routes} routes -> {args.endpoints_doc}")
 
     parts = [
         "# API reference",

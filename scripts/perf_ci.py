@@ -841,6 +841,123 @@ def main():
 
     guarded("quality_signals_overhead", bench_quality_signals_overhead)
 
+    # decision-journal + TSDB overhead (ISSUE 19): the bench_serving
+    # request stream with the FULL explainability plane armed — the
+    # durable decision journal writing atomic+CRC segments for a 20 Hz
+    # control-plane decision storm (an order of magnitude above a real
+    # controller's rate) on its emitter thread, and the TSDB sampler
+    # scraping the whole metric registry through the allowlist at
+    # 20 Hz — vs everything disarmed.  Rep-level pairing (150
+    # sequential requests per side, order alternating per pair, min
+    # over 3 pairs): the journal writes and scrapes happen on their
+    # own threads, so per-request alternation cannot toggle them
+    # meaningfully — the same argument as the quality-signals gate.
+    # Hard cap: the layer that explains every autonomous action must
+    # stay under 3% of the request stream it explains, or production
+    # runs blind.
+    def bench_journal_overhead():
+        import shutil
+        import tempfile
+        import threading as th
+
+        from heat_tpu import serving as srv
+        from heat_tpu.telemetry import journal as tjournal
+        from heat_tpu.telemetry import tsdb as ttsdb
+
+        rows = np.random.default_rng(19).standard_normal((64, f)).astype(np.float32)
+        km = fit()
+        d = tempfile.mkdtemp(prefix="heat_tpu_ci_journal_")
+        jdir = os.path.join(d, "journal")
+        svc = None
+        prev_interval = os.environ.get("HEAT_TPU_TSDB_INTERVAL_S")
+        emitted = [0]
+        try:
+            os.environ["HEAT_TPU_TSDB_INTERVAL_S"] = "0.05"
+            ttsdb.refresh_env()
+            srv.save_model(km, d, version=1, name="km")
+            svc = srv.InferenceService(max_batch=64)  # default MAX_DELAY_MS
+            svc.load("km", d)
+            for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+                svc.predict("km", rows[:b])
+
+            sizes = (1, 3, 7, 12, 18, 27, 33, 50, 64)  # the bench_serving mix
+
+            def storm(stop):
+                # a 20 Hz decision storm: each tick records the sample
+                # its decision cites, then commits a durable segment
+                i = 0
+                while not stop.wait(0.05):
+                    i += 1
+                    ttsdb.record("fleet.p99_ms", 5.0 + (i % 7))
+                    tjournal.emit(
+                        "autoscaler", "tick", severity="info",
+                        message="steady-state probe",
+                        evidence={"i": i, "series": ["fleet.p99_ms"]},
+                    )
+                emitted[0] += i
+
+            def one_side(armed, n=150):
+                stop = th.Event()
+                ticker = None
+                if armed:
+                    tjournal.set_journal_dir(jdir)
+                    ttsdb.start_sampler()
+                    ticker = th.Thread(target=storm, args=(stop,), daemon=True)
+                    ticker.start()
+                else:
+                    ttsdb.stop_sampler()
+                    tjournal.set_journal_dir(None)
+                lat = []
+                try:
+                    for i in range(n):
+                        t0 = time.perf_counter()
+                        svc.predict("km", rows[: sizes[i % len(sizes)]], timeout=30)
+                        lat.append(time.perf_counter() - t0)
+                finally:
+                    stop.set()
+                    if ticker is not None:
+                        ticker.join(5)
+                    if armed:
+                        ttsdb.stop_sampler()
+                        tjournal.set_journal_dir(None)
+                return float(np.median(lat))
+
+            pairs = []
+            on_med = off_med = None
+            for p in range(3):
+                if p % 2 == 0:
+                    on_med = one_side(True)
+                    off_med = one_side(False)
+                else:
+                    off_med = one_side(False)
+                    on_med = one_side(True)
+                if off_med > 0:
+                    pairs.append((100.0 * (on_med - off_med) / off_med, on_med, off_med))
+            overhead_pct, on_med, off_med = min(pairs)
+            results["journal_overhead"] = {
+                "overhead_pct": round(overhead_pct, 2),
+                "max_overhead_pct": 3.0,
+                "request_latency_on_s": round(on_med, 6),
+                "request_latency_off_s": round(off_med, 6),
+                "pair_overheads_pct": [round(p[0], 2) for p in pairs],
+                "requests_per_side": 150,
+                "decisions_emitted": emitted[0],
+            }
+        finally:
+            if prev_interval is None:
+                os.environ.pop("HEAT_TPU_TSDB_INTERVAL_S", None)
+            else:
+                os.environ["HEAT_TPU_TSDB_INTERVAL_S"] = prev_interval
+            ttsdb.reset_tsdb()
+            ttsdb.refresh_env()
+            tjournal.set_journal_dir(None)
+            tjournal.reset_journal()
+            if svc is not None:
+                svc.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+    guarded("journal_overhead", bench_journal_overhead)
+
     # shadow-traffic overhead (ISSUE 15): the bench_serving request
     # stream with a resident canary version and HEAT_TPU_SHADOW_FRACTION
     # at 1.0 — EVERY coalesced batch mirrored to the canary's own

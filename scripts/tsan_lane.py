@@ -21,7 +21,10 @@ poll thread and 4-thread live-traffic e2e (``test_streaming.py``,
 ``test_streaming_resume.py``), and the QoS layer's priority-lane
 admission under flood threads, EDF coalescer wake races and the
 process-wide preemption gate vs fit threads (``test_qos.py``,
-``test_qos_resume.py``) — in a
+``test_qos_resume.py``), and the explainability plane's decision
+journal (durable segment writer vs /decisionz scrapes vs the forced
+4-thread incident e2e) plus the TSDB sampler thread vs controller
+``record`` pushes (``test_journal.py``, ``test_tsdb.py``) — in a
 subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
@@ -61,6 +64,8 @@ LANE_FILES = (
     "tests/test_streaming_resume.py",
     "tests/test_qos.py",
     "tests/test_qos_resume.py",
+    "tests/test_journal.py",
+    "tests/test_tsdb.py",
 )
 
 

@@ -68,6 +68,31 @@ class TestTrendVerdict:
         v = trend_verdict([1.0] * 20 + [9.0] * 20, direction=0)
         assert v["verdict"] == "n/a"
 
+    def test_overhead_pct_drifts_on_absolute_points(self):
+        # a near-zero-median paired statistic: −0.18pp → 0.46pp is a
+        # +356% relative move but only 0.64 absolute points — noise,
+        # not drift (the per-run 3% hard cap is the primary gate)
+        series = [-0.53, 0.29, -0.75, 0.64, -0.18, 0.46, 0.58, -0.77,
+                  2.68, -0.51]
+        v = trend_verdict(series, direction=-1, k=5, kind="overhead_pct")
+        assert v["verdict"] == "ok"
+        # a sustained 2-point median creep IS drift
+        crept = [0.0] * 5 + [2.0] * 5
+        v = trend_verdict(crept, direction=-1, k=5, kind="overhead_pct")
+        assert v["verdict"] == "DRIFT" and v["move_pct"] == 2.0
+
+    def test_noisy_window_scales_relative_threshold(self):
+        # the previous window's own span is ~32% of its median: an 18%
+        # median move is inside the demonstrated run-to-run noise
+        series = [0.30, 0.31, 0.38, 0.38, 0.40, 0.30, 0.46, 0.30, 0.31,
+                  0.44]
+        v = trend_verdict(series, direction=+1, k=5, kind="rel_to_anchor")
+        assert v["verdict"] == "ok"
+        # a tight window certifies the same relative move as drift
+        tight = [0.38] * 5 + [0.31] * 5
+        v = trend_verdict(tight, direction=+1, k=5, kind="rel_to_anchor")
+        assert v["verdict"] == "DRIFT"
+
 
 class TestTrendCheck:
     def test_check_counts_drifts_with_current_run_appended(self, tmp_path):

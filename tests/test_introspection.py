@@ -469,3 +469,74 @@ class TestKnobsAndSatellites:
             assert s["entries"] >= 2 and s["nbytes"] > 0
         finally:
             _weight_cache.weight_cache_clear()
+
+
+# ----------------------------------------------------------------------
+# ISSUE 19 satellite: every server-owned route scrapes clean
+# ----------------------------------------------------------------------
+SERVER_ROUTES = [r for r in tserver.BUILTIN_ROUTES if r["owner"] == "server"]
+
+
+def _get_full(srv, route):
+    with urllib.request.urlopen(f"{srv.url}{route}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+class TestAllRoutesScrape:
+    def test_route_registry_covers_every_server_route(self):
+        assert len(SERVER_ROUTES) >= 15
+        assert len({r["route"] for r in tserver.BUILTIN_ROUTES}) == len(
+            tserver.BUILTIN_ROUTES
+        )
+        for entry in tserver.BUILTIN_ROUTES:
+            assert entry["purpose"] and entry["owner"], entry["route"]
+
+    @pytest.mark.parametrize(
+        "entry", SERVER_ROUTES, ids=[r["route"] for r in SERVER_ROUTES]
+    )
+    def test_route_scrapes_clean(self, live_server, entry):
+        route = entry["route"]
+        status, ctype, body = _get_full(live_server, route)
+        assert status == 200, route
+        assert body
+        if route == "/metrics":
+            assert ctype.startswith("application/openmetrics-text")
+            assert body.rstrip().endswith("# EOF")
+        elif entry["html"]:
+            assert "text/html" in ctype
+            sep = "&" if "?" in route else "?"
+            jstatus, jctype, jbody = _get_full(
+                live_server, f"{route}{sep}format=json"
+            )
+            assert jstatus == 200 and "application/json" in jctype
+            json.loads(jbody)
+        else:
+            assert "application/json" in ctype
+            json.loads(body)
+
+    def test_hostile_names_are_escaped(self, live_server):
+        from heat_tpu.telemetry import alerts as talerts
+        from heat_tpu.telemetry import journal as tjournal
+
+        hostile = "<script>alert(1)</script>"
+        tjournal.reset_journal()
+        talerts.clear_alerts()
+        try:
+            ev = tjournal.emit(
+                "canary", "rolled_back", model=hostile,
+                tenant=f"t-{hostile}", severity="page",
+                message=f"bad {hostile} news",
+                evidence={"reason": hostile},
+            )
+            talerts.fire(
+                f"canary:{hostile}", severity="page",
+                message=f"alert {hostile}", labels={"model": hostile},
+            )
+            for route in ("/decisionz", f"/decisionz?event_id={ev['event_id']}"):
+                status, _ctype, body = _get_full(live_server, route)
+                assert status == 200
+                assert "<script>" not in body, route
+                assert "&lt;script&gt;" in body, route
+        finally:
+            tjournal.reset_journal()
+            talerts.clear_alerts()

@@ -1,0 +1,555 @@
+"""Control-plane decision journal tests (ISSUE 19 tentpole).
+
+The contract under test (docs/observability.md):
+
+* every ``emit`` lands a typed DecisionEvent in the bounded hot ring
+  (monotonic + wall timestamps, actor/action, optional cause link and
+  exemplar trace_id, JSON-safe evidence);
+* ``causal_chain`` walks one decision back to its root and forward to
+  its transitive effects, terminating on cycles and dangling causes;
+* arming ``HEAT_TPU_JOURNAL_DIR`` makes every event durable as an
+  atomic single-event segment with a CRC32 sidecar; ``read_journal``
+  verifies, orders and deduplicates; a corrupted segment is detected;
+* ``/decisionz`` serves the timeline as HTML and JSON and explains one
+  event's causal chain; per-worker snapshots merge deterministically;
+* the offline twin ``python -m heat_tpu.telemetry.replay`` rebuilds
+  the incident timeline from the durable directory alone — no live
+  process required;
+* forced incident: a degraded canary under 4-thread live load rolls
+  back with the full ``drift evidence -> rollback -> page alert +
+  flight-recorder bundle`` chain on ``/decisionz``, every link carrying
+  an exemplar trace_id and evidence series resolvable via ``/queryz``,
+  and the replay CLI (a fresh process — the "after kill+restart" leg)
+  reconstructs the same chain from the durable journal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.resilience.atomic import ChecksumError
+from heat_tpu.serving import canary as cn
+from heat_tpu.serving import model_io
+from heat_tpu.telemetry import aggregate
+from heat_tpu.telemetry import alerts as talerts
+from heat_tpu.telemetry import flight_recorder
+from heat_tpu.telemetry import journal as tjournal
+from heat_tpu.telemetry import replay as treplay
+from heat_tpu.telemetry import server as tserver
+from heat_tpu.telemetry import tsdb as ttsdb
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RNG = np.random.default_rng(7)
+PTS = RNG.standard_normal((160, 6)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    tjournal.set_journal_dir(None)
+    tjournal.reset_journal()
+    talerts.clear_alerts()
+    ttsdb.reset_tsdb()
+    yield
+    tjournal.set_journal_dir(None)
+    tjournal.reset_journal()
+    talerts.clear_alerts()
+    ttsdb.reset_tsdb()
+    cn.reset_canary_state()
+
+
+@pytest.fixture
+def live_server():
+    srv = tserver.start_server(0)
+    yield srv
+    tserver.stop_server()
+
+
+def _get(srv, route):
+    import urllib.request
+
+    with urllib.request.urlopen(f"{srv.url}{route}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def _get_json(srv, route):
+    status, _ctype, body = _get(srv, route)
+    assert status == 200
+    return json.loads(body)
+
+
+# ----------------------------------------------------------------------
+# the hot ring
+# ----------------------------------------------------------------------
+class TestEmit:
+    def test_emit_returns_typed_doc(self):
+        before = time.time()
+        ev = tjournal.emit(
+            "autoscaler", "spawn", model="km", tenant="acme",
+            severity="warn", message="scale-up", trace_id="t-123",
+            evidence={"p99_ms": 80.0},
+        )
+        assert ev["actor"] == "autoscaler" and ev["action"] == "spawn"
+        assert ev["model"] == "km" and ev["tenant"] == "acme"
+        assert ev["severity"] == "warn" and ev["message"] == "scale-up"
+        assert ev["trace_id"] == "t-123"
+        assert ev["evidence"] == {"p99_ms": 80.0}
+        assert ev["cause"] is None
+        assert before <= ev["ts"] <= time.time()
+        assert isinstance(ev["mono"], float)
+        assert ev["event_id"].endswith(f"{ev['seq']:06d}")
+
+    def test_seq_monotonic_and_ids_unique(self):
+        docs = [tjournal.emit("a", "act") for _ in range(5)]
+        seqs = [d["seq"] for d in docs]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 5
+        assert len({d["event_id"] for d in docs}) == 5
+
+    def test_journal_events_oldest_first_with_limit(self):
+        for i in range(6):
+            tjournal.emit("a", f"act{i}")
+        events = tjournal.journal_events()
+        assert [e["action"] for e in events] == [f"act{i}" for i in range(6)]
+        assert [e["action"] for e in tjournal.journal_events(limit=2)] == [
+            "act4", "act5",
+        ]
+
+    def test_get_event_and_find_last(self):
+        tjournal.emit("canary", "promoted", model="km")
+        mid = tjournal.emit("canary", "rolled_back", model="lr")
+        tjournal.emit("alerts", "fire", model="lr")
+        assert tjournal.get_event(mid["event_id"])["action"] == "rolled_back"
+        assert tjournal.get_event("nope") is None
+        assert tjournal.find_last(actor="canary")["action"] == "rolled_back"
+        assert tjournal.find_last(actor="canary", model="km")["action"] == "promoted"
+        assert tjournal.find_last(actor="canary", action="vetoed") is None
+
+    def test_ring_bound_env_keeps_newest(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_JOURNAL_RING", "4")
+        tjournal.refresh_env()
+        try:
+            for i in range(10):
+                tjournal.emit("a", f"act{i}")
+            events = tjournal.journal_events()
+            assert [e["action"] for e in events] == [
+                "act6", "act7", "act8", "act9",
+            ]
+        finally:
+            monkeypatch.undo()
+            tjournal.refresh_env()
+
+    def test_evidence_is_copied_not_aliased(self):
+        evidence = {"k": 1}
+        ev = tjournal.emit("a", "act", evidence=evidence)
+        evidence["k"] = 2
+        assert tjournal.get_event(ev["event_id"])["evidence"] == {"k": 1}
+
+
+# ----------------------------------------------------------------------
+# causal chains
+# ----------------------------------------------------------------------
+class TestCausalChain:
+    def test_chain_root_first_and_transitive_effects(self):
+        root = tjournal.emit("alerts", "fire", message="drift")
+        mid = tjournal.emit("canary", "rolled_back", cause=root["event_id"])
+        eff1 = tjournal.emit("alerts", "fire", cause=mid["event_id"])
+        eff2 = tjournal.emit(
+            "flight_recorder", "bundle", cause=mid["event_id"]
+        )
+        grand = tjournal.emit("alerts", "resolve", cause=eff1["event_id"])
+        doc = tjournal.causal_chain(mid["event_id"])
+        assert doc["found"]
+        assert [e["event_id"] for e in doc["chain"]] == [
+            root["event_id"], mid["event_id"],
+        ]
+        assert [e["event_id"] for e in doc["effects"]] == [
+            eff1["event_id"], eff2["event_id"], grand["event_id"],
+        ]
+
+    def test_unknown_event(self):
+        doc = tjournal.causal_chain("missing")
+        assert doc == {
+            "event_id": "missing", "found": False, "chain": [], "effects": [],
+        }
+
+    def test_dangling_cause_terminates(self):
+        ev = tjournal.emit("a", "act", cause="gone-from-ring")
+        doc = tjournal.causal_chain(ev["event_id"])
+        assert [e["event_id"] for e in doc["chain"]] == [ev["event_id"]]
+
+    def test_cycle_terminates(self):
+        pool = [
+            {"event_id": "a", "cause": "b", "ts": 1.0},
+            {"event_id": "b", "cause": "a", "ts": 2.0},
+        ]
+        doc = tjournal.causal_chain("a", events=pool)
+        assert doc["found"]
+        assert [e["event_id"] for e in doc["chain"]] == ["b", "a"]
+        # "b" is already on the chain, so the effects walk must not loop
+        assert doc["effects"] == []
+
+
+# ----------------------------------------------------------------------
+# the durable log
+# ----------------------------------------------------------------------
+class TestDurable:
+    def test_hot_ring_only_without_dir(self, tmp_path):
+        tjournal.emit("a", "act")
+        assert tjournal.journal_dir() is None
+        assert tjournal.read_journal(str(tmp_path)) == []
+
+    def test_segments_with_crc_sidecars(self, tmp_path):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        assert tjournal.journal_dir() == d
+        docs = [tjournal.emit("a", f"act{i}") for i in range(3)]
+        segs = sorted(n for n in os.listdir(d) if n.endswith(".jsonl"))
+        assert len(segs) == 3
+        for seg in segs:
+            assert os.path.exists(os.path.join(d, seg + ".crc32"))
+        back = tjournal.read_journal(d)
+        assert [e["event_id"] for e in back] == [e["event_id"] for e in docs]
+        assert back[0]["evidence"] == {}
+
+    def test_restart_resumes_segment_numbering_and_dedups(self, tmp_path):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        for _ in range(3):
+            tjournal.emit("a", "before")
+        # simulated restart: the ring dies, the durable cursor re-scans
+        tjournal.reset_journal()
+        tjournal.set_journal_dir(d)
+        for _ in range(2):
+            tjournal.emit("a", "after")
+        segs = sorted(n for n in os.listdir(d) if n.endswith(".jsonl"))
+        assert len(segs) == 5
+        starts = [int(n.split("-")[1]) for n in segs]
+        assert starts == [0, 1, 2, 3, 4]
+        # the restarted process reuses seq 1..2 under the same epoch, so
+        # the reader's event_id dedup collapses them — the committed
+        # record is never double-counted
+        back = tjournal.read_journal(d)
+        assert len(back) == len({e["event_id"] for e in back})
+
+    def test_corrupt_segment_detected(self, tmp_path):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        tjournal.emit("a", "act")
+        seg = [n for n in os.listdir(d) if n.endswith(".jsonl")][0]
+        with open(os.path.join(d, seg), "a") as f:
+            f.write('{"event_id": "forged"}\n')
+        with pytest.raises(ChecksumError):
+            tjournal.read_journal(d)
+
+    def test_env_arming(self, tmp_path, monkeypatch):
+        d = str(tmp_path / "journal")
+        monkeypatch.setenv("HEAT_TPU_JOURNAL_DIR", d)
+        tjournal.refresh_env()
+        try:
+            assert tjournal.journal_dir() == d
+            tjournal.emit("a", "act")
+            assert len(tjournal.read_journal(d)) == 1
+        finally:
+            monkeypatch.undo()
+            tjournal.refresh_env()
+        assert tjournal.journal_dir() is None
+
+
+# ----------------------------------------------------------------------
+# reports, snapshots, fleet merge
+# ----------------------------------------------------------------------
+class TestReportsAndMerge:
+    def test_decisionz_report_shape(self, tmp_path):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        tjournal.emit("a", "act")
+        doc = tjournal.decisionz_report()
+        assert doc["dir"] == d and doc["ring"] >= 1
+        assert len(doc["events"]) == 1
+        assert json.loads(json.dumps(doc))  # JSON-safe end to end
+
+    def test_journal_snapshot_limit(self):
+        for i in range(5):
+            tjournal.emit("a", f"act{i}")
+        snap = tjournal.journal_snapshot(limit=2)
+        assert [e["action"] for e in snap["events"]] == ["act3", "act4"]
+
+    def test_merge_interleaves_by_ts_then_worker(self):
+        snap0 = {"events": [
+            {"event_id": "x", "actor": "canary", "ts": 2.0},
+            {"event_id": "y", "actor": "alerts", "ts": 4.0},
+        ]}
+        snap1 = {"events": [
+            {"event_id": "z", "actor": "canary", "ts": 3.0},
+        ]}
+        merged = tjournal.merge_journal_snapshots([("1", snap1), ("0", snap0)])
+        assert merged["event_count"] == 3
+        assert [(e["event_id"], e["worker"]) for e in merged["events"]] == [
+            ("x", "0"), ("z", "1"), ("y", "0"),
+        ]
+        assert merged["actors"] == {"alerts": 1, "canary": 2}
+
+    def test_merge_tolerates_missing_snapshots(self):
+        merged = tjournal.merge_journal_snapshots([("0", None), ("1", {})])
+        assert merged == {"events": [], "event_count": 0, "actors": {}}
+
+    def test_aggregate_snapshot_carries_journal(self):
+        tjournal.emit("canary", "rolled_back", model="km")
+        snap = aggregate.tag_snapshot()
+        assert snap["journal"]["events"][-1]["action"] == "rolled_back"
+        merged = aggregate.merge_snapshots([snap], publish=False)
+        events = merged["journal"]["events"]
+        assert events[-1]["action"] == "rolled_back"
+        assert events[-1]["worker"] == str(int(snap["process_index"]))
+
+
+# ----------------------------------------------------------------------
+# /decisionz
+# ----------------------------------------------------------------------
+class TestDecisionzEndpoint:
+    def test_html_and_json_timeline(self, live_server):
+        root = tjournal.emit("alerts", "fire", message="drift high")
+        tjournal.emit(
+            "canary", "rolled_back", model="km", severity="page",
+            message="canary v3 FAILED", cause=root["event_id"],
+        )
+        status, ctype, body = _get(live_server, "/decisionz")
+        assert status == 200 and "text/html" in ctype
+        assert "rolled_back" in body and "drift high" in body
+        doc = _get_json(live_server, "/decisionz?format=json")
+        assert [e["action"] for e in doc["events"]] == ["fire", "rolled_back"]
+        limited = _get_json(live_server, "/decisionz?format=json&limit=1")
+        assert [e["action"] for e in limited["events"]] == ["rolled_back"]
+
+    def test_event_id_explains_chain(self, live_server):
+        root = tjournal.emit("alerts", "fire", message="drift high")
+        mid = tjournal.emit("canary", "rolled_back", cause=root["event_id"])
+        eff = tjournal.emit("alerts", "fire", cause=mid["event_id"])
+        doc = _get_json(
+            live_server, f"/decisionz?format=json&event_id={mid['event_id']}"
+        )
+        assert doc["found"]
+        assert [e["event_id"] for e in doc["chain"]] == [
+            root["event_id"], mid["event_id"],
+        ]
+        assert [e["event_id"] for e in doc["effects"]] == [eff["event_id"]]
+        status, ctype, body = _get(
+            live_server, f"/decisionz?event_id={mid['event_id']}"
+        )
+        assert status == 200 and "text/html" in ctype
+        assert mid["event_id"] in body and root["event_id"] in body
+
+
+# ----------------------------------------------------------------------
+# offline replay
+# ----------------------------------------------------------------------
+class TestReplay:
+    def _seed_incident(self, tmp_path):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        root = tjournal.emit("alerts", "fire", message="drift:km fired",
+                             evidence={"alert": "drift:km"})
+        mid = tjournal.emit("canary", "rolled_back", model="km",
+                            severity="page", cause=root["event_id"],
+                            trace_id="t-9")
+        eff = tjournal.emit("flight_recorder", "bundle",
+                            cause=mid["event_id"])
+        return d, root, mid, eff
+
+    def test_replay_report_pure(self, tmp_path):
+        d, root, mid, eff = self._seed_incident(tmp_path)
+        doc = treplay.replay_report(d, event_id=mid["event_id"])
+        assert doc["event_count"] == 3
+        assert doc["actors"] == {
+            "alerts": 1, "canary": 1, "flight_recorder": 1,
+        }
+        assert doc["roots"] == [root["event_id"]]
+        assert [e["event_id"] for e in doc["explain"]["chain"]] == [
+            root["event_id"], mid["event_id"],
+        ]
+        text = treplay.format_replay(doc)
+        assert "causal chain" in text and "exemplar trace_id=t-9" in text
+
+    def test_cli_timeline_and_explain(self, tmp_path):
+        d, root, mid, _eff = self._seed_incident(tmp_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "heat_tpu.telemetry.replay", d],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "3 event(s)" in out.stdout and "canary/rolled_back" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "heat_tpu.telemetry.replay", d,
+             "--event-id", mid["event_id"], "--json"],
+            capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+        )
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert [e["event_id"] for e in doc["explain"]["chain"]] == [
+            root["event_id"], mid["event_id"],
+        ]
+
+    def test_cli_empty_dir_exits_nonzero(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "heat_tpu.telemetry.replay",
+             str(tmp_path)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert out.returncode == 1
+        assert "0 event(s)" in out.stdout
+
+
+# ----------------------------------------------------------------------
+# the forced incident (acceptance e2e)
+# ----------------------------------------------------------------------
+def _fit_kmeans():
+    x = ht.array(PTS, split=0)
+    return ht.cluster.KMeans(
+        n_clusters=3, init="random", max_iter=5, random_state=0
+    ).fit(x)
+
+
+def _degrade_kmeans(est):
+    bad = model_io.build_estimator(model_io.export_state(est))
+    centers = np.asarray(bad._cluster_centers.numpy())
+    bad._cluster_centers = ht.array(centers[::-1].copy(), split=None)
+    return bad
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    est = _fit_kmeans()
+    d = str(tmp_path / "km")
+    serving.save_model(est, d, version=1, name="km")
+    serving.save_model(_degrade_kmeans(est), d, version=3, name="km")
+    return d
+
+
+class TestForcedIncident:
+    def test_degraded_canary_chain_live_and_replayed(
+        self, model_dir, live_server, tmp_path
+    ):
+        jdir = str(tmp_path / "journal")
+        tjournal.set_journal_dir(jdir)
+        flight_recorder.install(str(tmp_path / "bundles"))
+        svc = serving.InferenceService(max_batch=32, max_delay_ms=1.0)
+        try:
+            # the quality signal that provokes the incident: a drift
+            # alert for the model, its sample landed in the TSDB first
+            # so the journal evidence is resolvable via /queryz
+            ttsdb.record("drift.km.psi", 0.41)
+            talerts.fire(
+                "drift:km", severity="warn", value=0.41, threshold=0.2,
+                message="input PSI drift on km",
+                labels={"model": "km"},
+                evidence={"series": ["drift.km.psi"]},
+            )
+            drift_ev = tjournal.find_last(actor="alerts", action="fire")
+            assert drift_ev is not None
+
+            svc.load("km", model_dir, version=1)
+            svc.load("km", model_dir, version=3, activate=False)
+            svc.canary.fraction = 1.0
+            svc.canary.min_rows = 48
+
+            errors = []
+
+            def client(seed):
+                rng = np.random.default_rng(seed)
+                for i in range(40):
+                    off = int(rng.integers(0, 64))
+                    rows = (3, 5, 8, 13)[i % 4]
+                    try:
+                        svc.predict("km", PTS[off:off + rows])
+                    except Exception as e:  # lint: allow H501(the e2e asserts zero client failures of ANY kind)
+                        errors.append(e)
+
+            threads = [
+                threading.Thread(target=client, args=(s,)) for s in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert svc.canary.wait_idle(60)
+            assert not errors
+
+            st = cn.status("km")
+            assert st["decision"]["action"] == "rolled_back"
+
+            # -- the live chain: drift fire -> rollback -> page + bundle
+            rb = tjournal.find_last(actor="canary", action="rolled_back")
+            assert rb is not None and rb["model"] == "km"
+            assert rb["trace_id"]
+            assert rb["cause"] == drift_ev["event_id"]
+            assert rb["evidence"]["mismatch_pct"] is not None
+            assert "canary.mismatch_pct" in rb["evidence"]["series"]
+
+            chain = tjournal.causal_chain(rb["event_id"])
+            assert [e["event_id"] for e in chain["chain"]] == [
+                drift_ev["event_id"], rb["event_id"],
+            ]
+            by_actor = {
+                (e["actor"], e["action"]): e for e in chain["effects"]
+            }
+            page = by_actor[("alerts", "fire")]
+            assert page["severity"] == "page"
+            assert page["evidence"]["alert"].startswith("canary:km")
+            bundle = by_actor[("flight_recorder", "bundle")]
+            assert bundle["trace_id"] == rb["trace_id"]
+            assert os.path.exists(bundle["evidence"]["path"])
+
+            # -- every cited series resolves via /queryz
+            for series in ("drift.km.psi", "canary.mismatch_pct"):
+                doc = _get_json(
+                    live_server,
+                    f"/queryz?format=json&series={series}&window=600",
+                )
+                assert doc["series"][series]["stats"]["n"] >= 1
+
+            # -- /decisionz explains the rollback over HTTP
+            doc = _get_json(
+                live_server,
+                f"/decisionz?format=json&event_id={rb['event_id']}",
+            )
+            assert [e["event_id"] for e in doc["chain"]] == [
+                drift_ev["event_id"], rb["event_id"],
+            ]
+            assert {e["event_id"] for e in doc["effects"]} >= {
+                page["event_id"], bundle["event_id"],
+            }
+
+            # -- kill+restart leg: a FRESH process reconstructs the same
+            # chain from the durable journal directory alone
+            out = subprocess.run(
+                [sys.executable, "-m", "heat_tpu.telemetry.replay", jdir,
+                 "--event-id", rb["event_id"], "--json"],
+                capture_output=True, text=True, cwd=REPO_ROOT,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            assert out.returncode == 0, out.stderr
+            replayed = json.loads(out.stdout)["explain"]
+            assert replayed["found"]
+            assert [e["event_id"] for e in replayed["chain"]] == [
+                drift_ev["event_id"], rb["event_id"],
+            ]
+            assert {e["event_id"] for e in replayed["effects"]} >= {
+                page["event_id"], bundle["event_id"],
+            }
+            replayed_rb = replayed["chain"][-1]
+            assert replayed_rb["trace_id"] == rb["trace_id"]
+            assert replayed_rb["evidence"]["mismatch_pct"] == \
+                rb["evidence"]["mismatch_pct"]
+        finally:
+            svc.close()
+            flight_recorder.uninstall()

@@ -1,0 +1,186 @@
+"""Embedded metric-history (TSDB) tests (ISSUE 19 tentpole).
+
+The contract under test (docs/observability.md):
+
+* ``record`` pushes controller-side points into bounded per-series
+  rings; ``query``/``window_stats`` trim to the trailing window and
+  summarize in the exact shape controllers embed as journal evidence;
+* ``sample_once`` scrapes the live metric registry through the
+  allowlist (env-overridable, ``*`` suffix = prefix match), fanning
+  histograms out into ``.count``/``.p50``/``.p99`` sub-series;
+* the background sampler thread arms/disarms idempotently and the
+  ``HEAT_TPU_TSDB_*`` knobs re-apply mid-process via ``refresh_env``
+  (existing rings re-bounded, points kept);
+* ``/queryz`` serves per-series points + stats as JSON and an HTML
+  table, and the snapshot form bounds itself for crash bundles.
+"""
+
+import json
+import time
+
+import pytest
+
+from heat_tpu.telemetry import metrics as tm
+from heat_tpu.telemetry import server as tserver
+from heat_tpu.telemetry import tsdb as ttsdb
+
+
+@pytest.fixture(autouse=True)
+def _clean_tsdb():
+    ttsdb.reset_tsdb()
+    yield
+    ttsdb.reset_tsdb()
+    ttsdb.refresh_env()
+
+
+@pytest.fixture
+def live_server():
+    srv = tserver.start_server(0)
+    yield srv
+    tserver.stop_server()
+
+
+def _get(srv, route):
+    import urllib.request
+
+    with urllib.request.urlopen(f"{srv.url}{route}", timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+# ----------------------------------------------------------------------
+# record / query / window stats
+# ----------------------------------------------------------------------
+class TestRecordAndQuery:
+    def test_record_and_query_oldest_first(self):
+        ttsdb.record("canary.mismatch_pct", 1.0, ts=10.0)
+        ttsdb.record("canary.mismatch_pct", 3.0, ts=20.0)
+        assert ttsdb.query("canary.mismatch_pct") == [(10.0, 1.0), (20.0, 3.0)]
+        assert ttsdb.series_names() == ["canary.mismatch_pct"]
+        assert ttsdb.query("unknown.series") == []
+
+    def test_window_trims_to_trailing_seconds(self):
+        for i in range(5):
+            ttsdb.record("s", float(i), ts=100.0 + 10 * i)
+        assert ttsdb.query("s", window_s=20.0) == [
+            (120.0, 2.0), (130.0, 3.0), (140.0, 4.0),
+        ]
+
+    def test_window_stats_shape(self):
+        for v in (4.0, 1.0, 7.0):
+            ttsdb.record("s", v, ts=time.time())
+        st = ttsdb.window_stats("s", window_s=60.0)
+        assert st["series"] == "s" and st["window_s"] == 60.0
+        assert st["n"] == 3 and st["min"] == 1.0 and st["max"] == 7.0
+        assert st["mean"] == 4.0 and st["first"] == 4.0 and st["last"] == 7.0
+
+    def test_window_stats_empty(self):
+        st = ttsdb.window_stats("nothing")
+        assert st["n"] == 0 and st["min"] is None and st["last"] is None
+
+    def test_retention_bounds_each_ring(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_TSDB_RETENTION", "4")
+        ttsdb.refresh_env()
+        for i in range(10):
+            ttsdb.record("s", float(i), ts=float(i))
+        assert ttsdb.query("s") == [
+            (6.0, 6.0), (7.0, 7.0), (8.0, 8.0), (9.0, 9.0),
+        ]
+
+    def test_refresh_env_rebounds_existing_rings(self, monkeypatch):
+        for i in range(10):
+            ttsdb.record("s", float(i), ts=float(i))
+        monkeypatch.setenv("HEAT_TPU_TSDB_RETENTION", "3")
+        ttsdb.refresh_env()
+        assert ttsdb.query("s") == [(7.0, 7.0), (8.0, 8.0), (9.0, 9.0)]
+
+
+# ----------------------------------------------------------------------
+# allowlist + registry scrape
+# ----------------------------------------------------------------------
+class TestScrape:
+    def test_allowlist_default_and_env_override(self, monkeypatch):
+        assert ttsdb._matches("canary.mismatch_pct", ttsdb.allowed_series())
+        assert ttsdb._matches("dispatch.compile_fallbacks",
+                              ttsdb.allowed_series())
+        assert not ttsdb._matches("dispatch.cache_hits",
+                                  ttsdb.allowed_series())
+        monkeypatch.setenv("HEAT_TPU_TSDB_SERIES", "custom.*, exact.name")
+        ttsdb.refresh_env()
+        assert ttsdb.allowed_series() == ("custom.*", "exact.name")
+        assert ttsdb._matches("custom.anything", ttsdb.allowed_series())
+        assert ttsdb._matches("exact.name", ttsdb.allowed_series())
+        assert not ttsdb._matches("exact.name.sub", ttsdb.allowed_series())
+
+    def test_sample_once_scrapes_allowlisted_scalars(self, monkeypatch):
+        tm.gauge("stream.test_lag").set(5.0)
+        tm.counter("dispatch.cache_hits")  # outside the allowlist
+        n = ttsdb.sample_once(now=123.0)
+        assert n >= 1
+        assert ttsdb.query("stream.test_lag") == [(123.0, 5.0)]
+        assert ttsdb.query("dispatch.cache_hits") == []
+
+    def test_sample_once_fans_out_histograms(self):
+        h = tm.histogram("serve.test_latency_ms")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        ttsdb.sample_once(now=50.0)
+        for sub in ("count", "p50", "p99"):
+            pts = ttsdb.query(f"serve.test_latency_ms.{sub}")
+            assert len(pts) == 1 and pts[0][0] == 50.0
+        assert ttsdb.query("serve.test_latency_ms.count")[0][1] == 4.0
+
+    def test_sampler_thread_idempotent(self):
+        assert not ttsdb.sampler_running()
+        try:
+            assert ttsdb.start_sampler() is True
+            assert ttsdb.start_sampler() is False  # already armed
+            assert ttsdb.sampler_running()
+        finally:
+            ttsdb.stop_sampler()
+            ttsdb.stop_sampler()  # idempotent
+        assert not ttsdb.sampler_running()
+
+
+# ----------------------------------------------------------------------
+# reports + /queryz
+# ----------------------------------------------------------------------
+class TestReports:
+    def test_queryz_report_shape(self):
+        ttsdb.record("canary.mismatch_pct", 2.5, ts=time.time())
+        doc = ttsdb.queryz_report()
+        assert doc["sampler_running"] is False
+        assert "canary.*" in doc["allowlist"]
+        entry = doc["series"]["canary.mismatch_pct"]
+        assert entry["stats"]["n"] == 1 and entry["stats"]["last"] == 2.5
+        assert len(entry["points"]) == 1
+        assert json.loads(json.dumps(doc))  # JSON-safe end to end
+
+    def test_queryz_report_selects_series(self):
+        ttsdb.record("a.one", 1.0)
+        ttsdb.record("b.two", 2.0)
+        doc = ttsdb.queryz_report(series=["a.one"])
+        assert list(doc["series"]) == ["a.one"]
+
+    def test_tsdb_snapshot_bounds_points(self):
+        for i in range(50):
+            ttsdb.record("s", float(i), ts=float(i))
+        snap = ttsdb.tsdb_snapshot(max_points=8)
+        assert len(snap["series"]["s"]) == 8
+        assert snap["series"]["s"][-1] == [49.0, 49.0]
+
+    def test_queryz_endpoint_json_and_html(self, live_server):
+        ttsdb.record("canary.mismatch_pct", 7.5, ts=time.time())
+        status, ctype, body = _get(
+            live_server, "/queryz?format=json&series=canary.mismatch_pct"
+        )
+        assert status == 200 and "application/json" in ctype
+        doc = json.loads(body)
+        assert doc["series"]["canary.mismatch_pct"]["stats"]["last"] == 7.5
+        status, ctype, body = _get(live_server, "/queryz")
+        assert status == 200 and "text/html" in ctype
+        assert "canary.mismatch_pct" in body and "7.5" in body
+
+    def test_queryz_html_empty_state(self, live_server):
+        status, _ctype, body = _get(live_server, "/queryz")
+        assert status == 200
+        assert "no series retained" in body
