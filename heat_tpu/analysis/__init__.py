@@ -41,6 +41,19 @@ Three cooperating analyzers (docs/static_analysis.md):
   potential deadlock, ``tsan.lock_cycle``) and guarded-structure
   checkpoints (``tsan.unguarded_access``), with acquisition stacks
   attached to every finding.
+* the control-plane protocol layer (ISSUE 20):
+  :mod:`~heat_tpu.analysis.protocols` holds the pure-literal
+  :data:`~heat_tpu.analysis.protocols.PROTOCOLS` registry — every
+  autonomous controller's state machine, the journal ``(actor,
+  action)`` each transition emits, and the temporal properties the
+  composed system must satisfy — enforced statically by the H801–H804
+  AST rules, exhaustively by the bounded model checker
+  (``python -m heat_tpu.analysis.model_check``,
+  :mod:`~heat_tpu.analysis.model_check`), and at runtime by
+  :mod:`~heat_tpu.analysis.conformance`
+  (``HEAT_TPU_PROTOCOL_CHECK=0/1/raise``), which steps every live
+  journal emit through the declared machines and reports illegal
+  transitions as H805.
 
 This package ``__init__`` is **lazy** (PEP 562): the low-level modules
 that create registered locks at import time (``telemetry.metrics`` is
@@ -60,6 +73,8 @@ __all__ = [
     "Diagnostic",
     "LOCK_REGISTRY",
     "POLICIES",
+    "PROPERTIES",
+    "PROTOCOLS",
     "PrecisionPolicyError",
     "ProgramLintError",
     "RULES",
@@ -69,13 +84,22 @@ __all__ = [
     "analyze_compiled_text",
     "analyze_dtype_flow",
     "analyze_jaxpr",
+    "check_all",
+    "check_property",
     "clear_diagnostics",
     "concurrency",
+    "conformance",
+    "conformance_report",
     "estimate_peak",
     "lint_file",
     "lint_paths",
+    "model_check",
+    "note_emit",
+    "protocol_mode",
+    "protocols",
     "recent_diagnostics",
     "set_analysis_mode",
+    "set_protocol_mode",
     "tsan",
 ]
 
@@ -100,16 +124,27 @@ _EXPORTS = {
     "POLICIES": "precision_policy",
     "PrecisionPolicyError": "precision_policy",
     "LOCK_REGISTRY": "concurrency",
+    "PROTOCOLS": "protocols",
+    "PROPERTIES": "protocols",
+    "check_all": "model_check",
+    "check_property": "model_check",
+    "conformance_report": "conformance",
+    "note_emit": "conformance",
+    "protocol_mode": "conformance",
+    "set_protocol_mode": "conformance",
 }
 
 _SUBMODULES = (
     "ast_lint",
     "concurrency",
+    "conformance",
     "diagnostics",
     "dtype_flow",
     "memory_model",
+    "model_check",
     "precision_policy",
     "program_lint",
+    "protocols",
     "tsan",
 )
 

@@ -50,6 +50,19 @@ H704        blocking call (``queue.get`` / ``join`` /
 H705        ``time.sleep`` polling loop in a class that already owns a
             ``threading.Condition``/``Event`` — wait on the primitive
             instead of burning wakeups
+H801        controller protocol state (a ``state_attrs`` attribute or
+            ``state_keys`` subscript declared in
+            ``analysis/protocols.py PROTOCOLS``) written outside a
+            registered transition/silent function — an unjournaled,
+            unverifiable state change
+H802        registered transition function missing (or never emitting)
+            its protocol's declared decision-journal event
+H803        decision-journal ``emit`` whose literal ``(actor, action)``
+            pair is not declared by any protocol — the conformance
+            checker would flag it at runtime; declare it first
+H804        ``PROTOCOLS`` registry self-inconsistency: non-literal
+            table, transition from/to an undeclared state, or a
+            declared-but-unreachable state
 ==========  ==========================================================
 
 Suppressions: append ``# lint: allow H501(<reason>)`` to the flagged
@@ -79,6 +92,8 @@ __all__ = [
     "load_registered_knobs",
     "load_registered_sites",
     "load_lock_spellings",
+    "load_protocols",
+    "load_protocol_constants",
 ]
 
 #: rule ID -> one-line description (the catalogue docs and the CLI share)
@@ -95,6 +110,10 @@ RULES = {
     "H703": "Thread without explicit daemon= and no join()/close path",
     "H704": "blocking call while holding a registered lock",
     "H705": "time.sleep polling loop where a Condition/Event exists in the class",
+    "H801": "protocol state written outside a registered transition function",
+    "H802": "transition function missing its declared journal emit",
+    "H803": "journal emit (actor, action) not declared in analysis/protocols.py",
+    "H804": "PROTOCOLS registry inconsistency (unreachable/undeclared state)",
 }
 
 #: repo-relative files whose explicit acquire() IS the sanctioned
@@ -174,6 +193,82 @@ def load_lock_spellings(repo_root: str) -> Set[str]:
     return out
 
 
+def load_protocols(repo_root: str) -> Dict:
+    """The ``PROTOCOLS`` table from ``analysis/protocols.py`` (static
+    parse — the linter checks that module, so it must not import it)."""
+    path = os.path.join(repo_root, "heat_tpu", "analysis", "protocols.py")
+    return _literal_assignment(path, "PROTOCOLS")
+
+
+def load_protocol_constants(repo_root: str) -> Dict[str, str]:
+    """Module-level string constants of ``analysis/protocols.py`` (the
+    centralized actor/action vocabulary) — lets the H802/H803 rules
+    resolve ``_journal.emit(ACTOR_X, ACTION_Y, ...)`` spellings."""
+    path = os.path.join(repo_root, "heat_tpu", "analysis", "protocols.py")
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _protocol_table_problems(table) -> List[str]:
+    """Structural H804 defects of a PROTOCOLS-shaped literal (kept
+    independent of protocols.registry_problems — the linter never
+    imports the module it checks)."""
+    problems: List[str] = []
+    pair_owner: Dict[Tuple[str, str], str] = {}
+    if not isinstance(table, dict):
+        return ["PROTOCOLS must be a dict literal"]
+    for name in sorted(table):
+        rec = table[name]
+        states = set(rec.get("states", ()))
+        initial = rec.get("initial")
+        if initial not in states:
+            problems.append(
+                f"{name}: initial state {initial!r} is not a declared state"
+            )
+        adjacency: Dict[str, Set[str]] = {s: set() for s in states}
+        for t in rec.get("transitions", ()):
+            for end, label in ((t.get("from"), "from"), (t.get("to"), "to")):
+                if end not in states:
+                    problems.append(
+                        f"{name}: transition {t.get('action')!r} {label}-state "
+                        f"{end!r} is not a declared state"
+                    )
+            if t.get("from") in states and t.get("to") in states:
+                adjacency[t["from"]].add(t["to"])
+            pair = (rec.get("actor"), t.get("action"))
+            owner = pair_owner.setdefault(pair, name)
+            if owner != name:
+                problems.append(
+                    f"{name}: journal pair {pair!r} is already declared by "
+                    f"protocol {owner!r}"
+                )
+        if initial in states:
+            seen = {initial}
+            frontier = [initial]
+            while frontier:
+                for nxt in adjacency.get(frontier.pop(), ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        frontier.append(nxt)
+            for s in sorted(states - seen):
+                problems.append(
+                    f"{name}: state {s!r} is unreachable from initial "
+                    f"{initial!r} via the declared transitions"
+                )
+    return problems
+
+
 def _find_repo_root(start: str) -> str:
     """Walk up from ``start`` to the directory containing ``heat_tpu/``."""
     d = os.path.abspath(start)
@@ -235,12 +330,16 @@ class _Linter(ast.NodeVisitor):
         knobs: Set[str],
         sites: Set[str],
         lock_spellings: Optional[Set[str]] = None,
+        protocols: Optional[Dict] = None,
+        protocol_constants: Optional[Dict[str, str]] = None,
     ):
         self.rel = rel_path
         self.lines = source.splitlines()
         self.knobs = knobs
         self.sites = sites
         self.lock_spellings = lock_spellings or set()
+        self.protocols = protocols or {}
+        self.protocol_constants = protocol_constants or {}
         self.violations: List[Violation] = []
         # lexical context stacks
         self._with_atomic = 0       # inside atomic_write/_atomic_out block
@@ -259,6 +358,28 @@ class _Linter(ast.NodeVisitor):
         self._module_has_join = False
         self._cond_classes: Set[str] = set()
         self._is_comm = rel_path.replace(os.sep, "/").endswith("parallel/comm.py")
+        # protocol (H8xx) context: the protocols declared over THIS
+        # module, their guarded state spellings and sanctioned writers
+        rel_posix = rel_path.replace(os.sep, "/")
+        self._proto_local = {
+            name: rec for name, rec in self.protocols.items()
+            if rel_posix.endswith(rec["module"])
+        }
+        self._proto_state_attrs: Set[str] = set()
+        self._proto_state_keys: Set[str] = set()
+        self._proto_sanctioned: Set[str] = set()
+        for rec in self._proto_local.values():
+            self._proto_state_attrs.update(rec["state_attrs"])
+            self._proto_state_keys.update(rec["state_keys"])
+            self._proto_sanctioned.update(rec["transition_fns"])
+            self._proto_sanctioned.update(rec["silent_fns"])
+        self._declared_pairs: Set[Tuple[str, str]] = {
+            (rec["actor"], t["action"])
+            for rec in self.protocols.values()
+            for t in rec["transitions"]
+        }
+        self._str_consts: Dict[str, str] = {}
+        self._is_protocols_mod = rel_posix.endswith("analysis/protocols.py")
         self._h101_sanctioned = any(
             self.rel.replace(os.sep, "/").endswith(p) for p in H101_SANCTIONED_FILES
         )
@@ -364,6 +485,113 @@ class _Linter(ast.NodeVisitor):
                     frontier.append(callee)
         self._thread_reachable = reachable
 
+    # -- pre-pass: resolvable string constants (H802/H803) ----------------
+    def collect_constants(self, tree: ast.AST) -> None:
+        """Module-level ``NAME = "str"`` assignments plus names imported
+        from ``analysis/protocols.py`` — the spellings under which emit
+        sites may reference the journal vocabulary."""
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                self._str_consts[node.targets[0].id] = node.value.value
+            elif (
+                isinstance(node, ast.ImportFrom)
+                and node.module
+                and node.module.rsplit(".", 1)[-1] == "protocols"
+            ):
+                for alias in node.names:
+                    val = self.protocol_constants.get(alias.name)
+                    if val is not None:
+                        self._str_consts[alias.asname or alias.name] = val
+
+    def _resolve_str(self, node: ast.AST) -> Optional[str]:
+        """A call argument's string value, when statically resolvable:
+        a literal, a known module constant, or ``mod.CONSTANT`` where
+        CONSTANT is in the protocols vocabulary."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self._str_consts.get(node.id)
+        if isinstance(node, ast.Attribute):
+            return self.protocol_constants.get(node.attr)
+        return None
+
+    # -- H802/H804 post-passes -------------------------------------------
+    def check_protocol_fns(self, tree: ast.AST) -> None:
+        """H802: every registered transition function of this module's
+        protocols exists and lexically contains a journal ``emit`` whose
+        actor resolves to the protocol's declared actor."""
+        if not self._proto_local:
+            return
+        required: Dict[str, Set[str]] = {}
+        for rec in self._proto_local.values():
+            for fn in rec["transition_fns"]:
+                required.setdefault(fn, set()).add(rec["actor"])
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in required:
+                defs.setdefault(node.name, []).append(node)
+        for fn, actors in sorted(required.items()):
+            fnodes = defs.get(fn)
+            if not fnodes:
+                anchor = tree.body[0] if getattr(tree, "body", None) else None
+                self._add(
+                    "H802", anchor if anchor is not None else ast.Module(),
+                    f"registered transition function {fn!r} "
+                    "(analysis/protocols.py) is not defined in this module",
+                )
+                continue
+            for fnode in fnodes:
+                found: Set[str] = set()
+                for sub in ast.walk(fnode):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and _dotted(sub.func).rsplit(".", 1)[-1] == "emit"
+                        and len(sub.args) >= 2
+                    ):
+                        actor = self._resolve_str(sub.args[0])
+                        if actor is not None:
+                            found.add(actor)
+                for actor in sorted(actors - found):
+                    self._add(
+                        "H802", fnode,
+                        f"transition function {fn!r} never emits its "
+                        f"declared decision-journal event (actor "
+                        f"{actor!r}); the protocol transition would be "
+                        "invisible to /decisionz and the conformance "
+                        "checker",
+                    )
+
+    def check_protocols_registry(self, tree: ast.AST) -> None:
+        """H804: registry self-consistency, anchored on the PROTOCOLS
+        assignment when linting analysis/protocols.py itself."""
+        if not self._is_protocols_mod:
+            return
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "PROTOCOLS"
+                        for t in node.targets)
+            ):
+                try:
+                    table = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError, TypeError):
+                    self._add(
+                        "H804", node,
+                        "PROTOCOLS must be a pure literal "
+                        "(ast.literal_eval-parsable, the KNOBS idiom)",
+                    )
+                    return
+                for problem in _protocol_table_problems(table):
+                    self._add("H804", node, problem)
+                return
+
     # -- with blocks ----------------------------------------------------
     def visit_With(self, node: ast.With) -> None:
         atomic = account = lock = False
@@ -451,11 +679,40 @@ class _Linter(ast.NodeVisitor):
     def visit_Assign(self, node: ast.Assign) -> None:
         for t in node.targets:
             self._check_global_mutation(t, node)
+            self._check_protocol_write(t, node)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         self._check_global_mutation(node.target, node)
+        self._check_protocol_write(node.target, node)
         self.generic_visit(node)
+
+    # -- H801: protocol state written outside a registered transition ----
+    def _check_protocol_write(self, target: ast.AST, node: ast.AST) -> None:
+        if not self._proto_local:
+            return
+        spelled = None
+        if isinstance(target, ast.Attribute) \
+                and target.attr in self._proto_state_attrs:
+            spelled = target.attr
+        elif (
+            isinstance(target, ast.Subscript)
+            and isinstance(target.slice, ast.Constant)
+            and target.slice.value in self._proto_state_keys
+        ):
+            spelled = f"[{target.slice.value!r}]"
+        if spelled is None:
+            return
+        if any(f in self._proto_sanctioned for f in self._func_stack):
+            return
+        self._add(
+            "H801", node,
+            f"protocol state {spelled} written outside the registered "
+            "transition/silent functions declared in analysis/protocols.py "
+            "— the change is unjournaled and the conformance checker "
+            "cannot see it; route it through a registered transition "
+            "helper",
+        )
 
     def _check_site_default(self, fn_node, default) -> None:
         # FunctionDef defaults for parameters named site/fault_site
@@ -638,6 +895,25 @@ class _Linter(ast.NodeVisitor):
                 "of burning periodic wakeups",
             )
 
+        # H803: journal emit with an undeclared (actor, action) literal —
+        # only when both args statically resolve to strings (dynamic
+        # actions are the runtime conformance checker's job)
+        if tail == "emit" and len(node.args) >= 2 and self._declared_pairs:
+            actor = self._resolve_str(node.args[0])
+            action = self._resolve_str(node.args[1])
+            if (
+                actor is not None
+                and action is not None
+                and (actor, action) not in self._declared_pairs
+            ):
+                self._add(
+                    "H803", node,
+                    f"journal emit ({actor!r}, {action!r}) is not declared "
+                    "by any protocol in analysis/protocols.py PROTOCOLS — "
+                    "declare the transition (and its states) so the model "
+                    "checker and runtime conformance can verify it",
+                )
+
         # H601: host-entropy seeding
         if name in ("time.time", "time.time_ns") and any(
             "seed" in f.lower() for f in self._func_stack
@@ -711,6 +987,8 @@ def lint_file(
     source: Optional[str] = None,
     rel_path: Optional[str] = None,
     lock_spellings: Optional[Set[str]] = None,
+    protocols: Optional[Dict] = None,
+    protocol_constants: Optional[Dict[str, str]] = None,
 ) -> List[Violation]:
     """Lint one Python file; returns its violations (suppressions
     applied).  ``source``/``rel_path`` let tests lint embedded fixture
@@ -723,16 +1001,24 @@ def lint_file(
         sites = load_registered_sites(repo_root)
     if lock_spellings is None:
         lock_spellings = load_lock_spellings(repo_root)
+    if protocols is None:
+        protocols = load_protocols(repo_root)
+    if protocol_constants is None:
+        protocol_constants = load_protocol_constants(repo_root)
     if source is None:
         with open(path) as f:
             source = f.read()
     if rel_path is None:
         rel_path = os.path.relpath(os.path.abspath(path), repo_root)
     tree = ast.parse(source, filename=rel_path)
-    linter = _Linter(rel_path, source, knobs, sites, lock_spellings)
+    linter = _Linter(rel_path, source, knobs, sites, lock_spellings,
+                     protocols, protocol_constants)
     linter.collect_chunk_fns(tree)
     linter.collect_thread_context(tree)
+    linter.collect_constants(tree)
     linter.visit(tree)
+    linter.check_protocol_fns(tree)
+    linter.check_protocols_registry(tree)
     return sorted(linter.violations, key=lambda v: (v.file, v.line, v.rule))
 
 
@@ -745,6 +1031,8 @@ def lint_paths(
     knobs = load_registered_knobs(repo_root)
     sites = load_registered_sites(repo_root)
     spellings = load_lock_spellings(repo_root)
+    protocols = load_protocols(repo_root)
+    constants = load_protocol_constants(repo_root)
     out: List[Violation] = []
     for p in paths:
         if os.path.isfile(p):
@@ -758,7 +1046,9 @@ def lint_paths(
             )
         for f in files:
             out.extend(lint_file(f, repo_root, knobs, sites,
-                                 lock_spellings=spellings))
+                                 lock_spellings=spellings,
+                                 protocols=protocols,
+                                 protocol_constants=constants))
     return sorted(out, key=lambda v: (v.file, v.line, v.rule))
 
 

@@ -145,6 +145,12 @@ LOCK_REGISTRY = {
         "structures": ("analysis.diagnostics.ring",),
         "doc": "the bounded recent-diagnostics ring: emit() appends from any thread (program lint on the dispatch path, tsan findings), recent_diagnostics() lists",
     },
+    "analysis.conformance": {
+        "file": "heat_tpu/analysis/conformance.py",
+        "spellings": ("_LOCK",),
+        "structures": ("analysis.conformance.state",),
+        "doc": "the protocol-conformance tracked machine states + bounded recent-violations list: note_emit() steps from whichever thread journaled (a strict leaf — journal.emit calls it only after the telemetry.journal lock is released; the violation alert/diagnostic is reported outside it)",
+    },
     "resilience.faults.injector": {
         "file": "heat_tpu/resilience/faults.py",
         "spellings": ("self._lock",),

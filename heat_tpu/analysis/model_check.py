@@ -1,0 +1,701 @@
+"""Bounded exhaustive model checker for the declared control-plane
+protocols (docs/static_analysis.md).
+
+Composes the state machines declared in :mod:`.protocols` with the
+small adversarial :data:`~.protocols.ENVIRONMENT` model (alerts fire
+and resolve, load rises and falls, replicas die, shadow windows pass,
+fail or degrade) and explores the full product state space —
+exhaustively, up to ``HEAT_TPU_MODEL_CHECK_STATES`` states — for the
+declared :data:`~.protocols.PROPERTIES`:
+
+* ``never`` — a safety invariant: no reachable product state may
+  satisfy the atom conjunction (e.g. two in-flight half-open probes);
+* ``reach`` — a liveness floor: from every reachable state matching
+  ``when``, a ``goal`` state stays reachable (an open breaker can
+  still readmit; a resident canary can still decide);
+* ``no_cycle`` — the livelock/flap shape: no reachable cycle contains
+  all the required ``actions``, none of the ``forbid_actions``, and
+  (unless ``env_ok``) no environment move at all.
+
+Every violation carries a **counterexample rendered as a synthetic
+causal decision-journal chain** — the same document shape the live
+journal emits, with each step ``cause``-linked to the previous one —
+so a protocol bug found before it ships reads exactly like the
+``/decisionz`` trace it would have produced in production.
+
+CLI::
+
+    python -m heat_tpu.analysis.model_check [--json] \\
+        [--seed-defect {refresh_livelock,breaker_double_probe,autoscaler_flap}] \\
+        [--max-states N]
+
+exits non-zero iff violations are found.  ``--seed-defect`` checks a
+deliberately broken copy of the registry (the self-test the CI gate
+and tests/test_protocols.py rely on: the checker must *find* these).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .protocols import ENVIRONMENT, PROPERTIES, PROTOCOLS, registry_problems
+
+__all__ = [
+    "ModelCheckError",
+    "check_property",
+    "check_all",
+    "seeded_defect",
+    "main",
+]
+
+_DEFAULT_MAX_STATES = 200_000
+
+
+class ModelCheckError(RuntimeError):
+    """The exploration bound was exceeded or the registry is malformed."""
+
+
+# ----------------------------------------------------------------------
+# atoms
+# ----------------------------------------------------------------------
+def _parse_atom(atom: str) -> Tuple[str, str, str]:
+    """``"lhs=rhs"``/``"lhs!=rhs"`` (guards) or additionally
+    ``"lhs+=n"``/``"lhs-=n"`` (effects) -> ``(lhs, op, rhs)``."""
+    for op in ("!=", "+=", "-="):
+        if op in atom:
+            lhs, rhs = atom.split(op, 1)
+            return lhs.strip(), op, rhs.strip()
+    if "=" in atom:
+        lhs, rhs = atom.split("=", 1)
+        return lhs.strip(), "=", rhs.strip()
+    raise ModelCheckError(f"malformed atom {atom!r}")
+
+
+def _coerce(domain: Sequence[Any], raw: str) -> Any:
+    """Coerce an atom's string rhs onto the env var's domain type."""
+    if domain and isinstance(domain[0], int):
+        return int(raw)
+    return raw
+
+
+class _Product:
+    """One property's product automaton: the listed machines (plus any
+    transitively referenced by ``when`` atoms) x the env vars (plus
+    events) they touch."""
+
+    def __init__(
+        self,
+        machines: Sequence[str],
+        protocols: Dict[str, Any],
+        environment: Dict[str, Any],
+    ) -> None:
+        probs = registry_problems(protocols)
+        if probs:
+            raise ModelCheckError(
+                "registry is malformed; fix H804 first: " + "; ".join(probs)
+            )
+        self.protocols = protocols
+        self.env_domains: Dict[str, Tuple[Any, ...]] = {
+            k: tuple(v) for k, v in environment["vars"].items()
+        }
+
+        # transitive machine closure over cross-machine "when" atoms
+        names: List[str] = []
+        frontier = list(machines)
+        while frontier:
+            m = frontier.pop(0)
+            if m in names:
+                continue
+            if m not in protocols:
+                raise ModelCheckError(f"property references unknown machine {m!r}")
+            names.append(m)
+            for t in protocols[m]["transitions"]:
+                for atom in t["when"]:
+                    lhs, _, _ = _parse_atom(atom)
+                    if not lhs.startswith("env.") and lhs not in names:
+                        frontier.append(lhs)
+        self.machines = tuple(names)
+        self.initial_machine = tuple(
+            protocols[m]["initial"] for m in self.machines
+        )
+
+        # env var closure: vars the machines reference, then the events
+        # that can move them, then the vars those events reference, ...
+        vars_used: Set[str] = set()
+        for m in self.machines:
+            for t in protocols[m]["transitions"]:
+                for atom in list(t["when"]) + list(t["effect"]):
+                    lhs, _, _ = _parse_atom(atom)
+                    if lhs.startswith("env."):
+                        vars_used.add(lhs[4:])
+        events: List[Dict[str, Any]] = []
+        changed = True
+        while changed:
+            changed = False
+            for ev in environment["events"]:
+                if ev in events:
+                    continue
+                touches = {
+                    _parse_atom(a)[0][4:] for a in ev["set"]
+                }
+                if touches & vars_used:
+                    events.append(ev)
+                    for atom in list(ev["when"]) + list(ev["set"]):
+                        lhs, _, _ = _parse_atom(atom)
+                        v = lhs[4:]
+                        if v not in vars_used:
+                            vars_used.add(v)
+                            changed = True
+        self.events = tuple(
+            ev for ev in environment["events"] if ev in events
+        )  # declared order
+        self.env_vars = tuple(
+            k for k in environment["vars"] if k in vars_used
+        )
+        for v in self.env_vars:
+            if v not in self.env_domains:
+                raise ModelCheckError(f"atom references undeclared env var {v!r}")
+        self.initial_env = tuple(self.env_domains[v][0] for v in self.env_vars)
+        self._midx = {m: i for i, m in enumerate(self.machines)}
+        self._vidx = {v: i for i, v in enumerate(self.env_vars)}
+
+    # -- state predicates ------------------------------------------------
+    def holds(self, state: Tuple[Tuple, Tuple], atom: str) -> bool:
+        lhs, op, rhs = _parse_atom(atom)
+        ms, env = state
+        if lhs.startswith("env."):
+            v = lhs[4:]
+            cur = env[self._vidx[v]]
+            want = _coerce(self.env_domains[v], rhs)
+        else:
+            cur = ms[self._midx[lhs]]
+            want = rhs
+        return (cur == want) if op == "=" else (cur != want)
+
+    def holds_all(self, state, atoms: Iterable[str]) -> bool:
+        return all(self.holds(state, a) for a in atoms)
+
+    # -- successor relation ----------------------------------------------
+    def _apply_env(self, env: Tuple, atoms: Iterable[str]) -> Tuple:
+        out = list(env)
+        for atom in atoms:
+            lhs, op, rhs = _parse_atom(atom)
+            v = lhs[4:]
+            i = self._vidx[v]
+            dom = self.env_domains[v]
+            if op in ("+=", "-="):
+                # step along the declared domain, clamped at its ends
+                step = int(rhs) if op == "+=" else -int(rhs)
+                j = dom.index(out[i]) + step
+                out[i] = dom[max(0, min(len(dom) - 1, j))]
+            elif op == "=":
+                out[i] = _coerce(dom, rhs)
+            else:
+                raise ModelCheckError(f"malformed effect {atom!r}")
+        return tuple(out)
+
+    def successors(
+        self, state: Tuple[Tuple, Tuple]
+    ) -> List[Tuple[Dict[str, Any], Tuple[Tuple, Tuple]]]:
+        """Enabled moves as ``(edge_label, next_state)`` — machine
+        transitions first (declaration order), then env events."""
+        ms, env = state
+        out: List[Tuple[Dict[str, Any], Tuple[Tuple, Tuple]]] = []
+        for mi, m in enumerate(self.machines):
+            rec = self.protocols[m]
+            for t in rec["transitions"]:
+                if t["from"] != ms[mi]:
+                    continue
+                if not self.holds_all(state, t["when"]):
+                    continue
+                nms = list(ms)
+                nms[mi] = t["to"]
+                nenv = self._apply_env(env, t["effect"])
+                label = {
+                    "kind": "machine",
+                    "machine": m,
+                    "actor": rec["actor"],
+                    "action": t["action"],
+                    "from": t["from"],
+                    "to": t["to"],
+                }
+                out.append((label, (tuple(nms), nenv)))
+        for ev in self.events:
+            if not self.holds_all(state, ev["when"]):
+                continue
+            nenv = self._apply_env(env, ev["set"])
+            label = {
+                "kind": "env",
+                "actor": "environment",
+                "action": ev["name"],
+            }
+            out.append((label, (ms, nenv)))
+        return out
+
+    def render(self, state: Tuple[Tuple, Tuple]) -> Dict[str, Any]:
+        ms, env = state
+        doc = {m: ms[i] for i, m in enumerate(self.machines)}
+        doc.update({f"env.{v}": env[i] for i, v in enumerate(self.env_vars)})
+        return doc
+
+
+# ----------------------------------------------------------------------
+# exploration
+# ----------------------------------------------------------------------
+def _explore(product: _Product, max_states: int):
+    """Full reachable graph: ``(order, edges, parents)`` where
+    ``edges[s] = [(label, t), ...]`` and ``parents[s] = (prev, label)``
+    along a BFS-shortest path from the initial state."""
+    init = (product.initial_machine, product.initial_env)
+    order: List[Tuple] = [init]
+    edges: Dict[Tuple, List] = {}
+    parents: Dict[Tuple, Optional[Tuple]] = {init: None}
+    i = 0
+    while i < len(order):
+        s = order[i]
+        i += 1
+        succ = product.successors(s)
+        edges[s] = succ
+        for label, t in succ:
+            if t not in parents:
+                parents[t] = (s, label)
+                order.append(t)
+                if len(order) > max_states:
+                    raise ModelCheckError(
+                        f"exploration exceeded the {max_states}-state bound "
+                        f"(HEAT_TPU_MODEL_CHECK_STATES); the product of "
+                        f"machines {product.machines} is not small"
+                    )
+    return order, edges, parents
+
+
+def _path_to(parents, state) -> List[Tuple[Dict[str, Any], Tuple]]:
+    """``[(label, state_after), ...]`` from the initial state."""
+    steps = []
+    cur = state
+    while parents[cur] is not None:
+        prev, label = parents[cur]
+        steps.append((label, cur))
+        cur = prev
+    steps.reverse()
+    return steps
+
+
+def _journal_chain(
+    product: _Product,
+    prop: Dict[str, Any],
+    prefix: List[Tuple[Dict[str, Any], Tuple]],
+    cycle: Optional[List[Tuple[Dict[str, Any], Tuple]]],
+    verdict: str,
+) -> List[Dict[str, Any]]:
+    """Render a counterexample as a synthetic causal decision-journal
+    chain (same doc shape as telemetry/journal.py emits)."""
+    chain: List[Dict[str, Any]] = []
+    prev_id: Optional[str] = None
+
+    def _push(actor, action, severity, message, evidence):
+        nonlocal prev_id
+        seq = len(chain)
+        ev = {
+            "event_id": f"model-check-{seq:06d}",
+            "seq": seq,
+            "ts": float(seq),
+            "actor": actor,
+            "action": action,
+            "severity": severity,
+            "message": message,
+            "model": None,
+            "tenant": None,
+            "trace_id": None,
+            "cause": prev_id,
+            "evidence": evidence,
+        }
+        chain.append(ev)
+        prev_id = ev["event_id"]
+
+    for part, steps in (("prefix", prefix), ("cycle", cycle or [])):
+        for label, after in steps:
+            if label["kind"] == "machine":
+                msg = (
+                    f"{label['machine']}: {label['from']} -> {label['to']}"
+                )
+            else:
+                msg = f"environment move {label['action']}"
+            _push(
+                label["actor"], label["action"], "info", msg,
+                {"part": part, "state": product.render(after)},
+            )
+    _push(
+        "model_check", "violation", "page",
+        f"property {prop['name']} ({prop['kind']}) violated: {verdict}",
+        {"property": prop["name"], "doc": prop["doc"]},
+    )
+    return chain
+
+
+# ----------------------------------------------------------------------
+# property kinds
+# ----------------------------------------------------------------------
+def _check_never(product, prop, order, edges, parents):
+    for s in order:
+        if product.holds_all(s, prop["atoms"]):
+            prefix = _path_to(parents, s)
+            verdict = (
+                "reachable state satisfies "
+                + " & ".join(prop["atoms"])
+                + f" ({product.render(s)})"
+            )
+            return {
+                "counterexample": _journal_chain(
+                    product, prop, prefix, None, verdict
+                ),
+                "message": verdict,
+                "state": product.render(s),
+            }
+    return None
+
+
+def _trap_cycle(product, edges, region: Set[Tuple], start: Tuple):
+    """A lasso inside a successor-closed trap region: walk from
+    ``start`` until a state repeats (or a deadlock)."""
+    path: List[Tuple[Dict[str, Any], Tuple]] = []
+    seen_at = {start: 0}
+    cur = start
+    while True:
+        succ = [e for e in edges[cur] if e[1] in region]
+        if not succ:
+            return path, True  # deadlock: the trap has no moves at all
+        label, nxt = succ[0]
+        path.append((label, nxt))
+        if nxt in seen_at:
+            return path[seen_at[nxt]:], False
+        seen_at[nxt] = len(path)
+        cur = nxt
+
+
+def _check_reach(product, prop, order, edges, parents):
+    goals = {s for s in order if product.holds_all(s, prop["goal"])}
+    # reverse reachability to the goal set
+    rev: Dict[Tuple, List[Tuple]] = {s: [] for s in order}
+    for s in order:
+        for _, t in edges[s]:
+            rev[t].append(s)
+    can_reach = set(goals)
+    frontier = list(goals)
+    while frontier:
+        t = frontier.pop()
+        for s in rev[t]:
+            if s not in can_reach:
+                can_reach.add(s)
+                frontier.append(s)
+    for s in order:
+        if product.holds_all(s, prop["when"]) and s not in can_reach:
+            trap = {x for x in order if x not in can_reach}
+            prefix = _path_to(parents, s)
+            cycle, deadlocked = _trap_cycle(product, edges, trap, s)
+            verdict = (
+                "state satisfying " + " & ".join(prop["when"])
+                + " can never reach " + " & ".join(prop["goal"])
+                + (" (deadlocked)" if deadlocked else " (livelocked)")
+            )
+            return {
+                "counterexample": _journal_chain(
+                    product, prop, prefix, cycle, verdict
+                ),
+                "message": verdict,
+                "state": product.render(s),
+            }
+    return None
+
+
+def _sccs(nodes: List[Tuple], adj: Dict[Tuple, List[Tuple]]):
+    """Iterative Tarjan; yields each strongly connected component."""
+    index: Dict[Tuple, int] = {}
+    low: Dict[Tuple, int] = {}
+    on_stack: Set[Tuple] = set()
+    stack: List[Tuple] = []
+    counter = [0]
+    out: List[List[Tuple]] = []
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+    return out
+
+
+def _check_no_cycle(product, prop, order, edges, parents):
+    required = tuple(prop["actions"])
+    forbid = set(prop["forbid_actions"])
+    env_ok = bool(prop.get("env_ok", False))
+
+    def _allowed(label):
+        if label["kind"] == "env":
+            return env_ok
+        return label["action"] not in forbid
+
+    adj = {
+        s: [t for lab, t in edges[s] if _allowed(lab)] for s in order
+    }
+    for comp in _sccs(order, adj):
+        comp_set = set(comp)
+        nontrivial = len(comp) > 1 or any(
+            t in comp_set for t in adj[comp[0]]
+        )
+        if not nontrivial:
+            continue
+        # every required action must appear on an edge inside this SCC
+        action_edges: Dict[str, Tuple[Tuple, Dict, Tuple]] = {}
+        for s in comp:
+            for lab, t in edges[s]:
+                if t in comp_set and _allowed(lab) and lab["kind"] == "machine":
+                    action_edges.setdefault(lab["action"], (s, lab, t))
+        if not all(a in action_edges for a in required):
+            continue
+
+        # construct a closed walk hitting every required action
+        def _bfs(src, dst_pred):
+            if dst_pred(src):
+                return []
+            par = {src: None}
+            q = [src]
+            while q:
+                u = q.pop(0)
+                for lab, t in edges[u]:
+                    if t in comp_set and _allowed(lab) and t not in par:
+                        par[t] = (u, lab)
+                        if dst_pred(t):
+                            steps = []
+                            cur = t
+                            while par[cur] is not None:
+                                pu, plab = par[cur]
+                                steps.append((plab, cur))
+                                cur = pu
+                            steps.reverse()
+                            return steps
+                        q.append(t)
+            return None
+
+        start_s, start_lab, start_t = action_edges[required[0]]
+        cycle = [(start_lab, start_t)]
+        cur = start_t
+        ok = True
+        for a in required[1:]:
+            src_a = action_edges[a][0]
+            seg = _bfs(cur, lambda x, s=src_a: x == s)
+            if seg is None:
+                ok = False
+                break
+            cycle.extend(seg)
+            _, lab_a, t_a = action_edges[a]
+            cycle.append((lab_a, t_a))
+            cur = t_a
+        if ok:
+            back = _bfs(cur, lambda x: x == start_s)
+            if back is None:
+                ok = False
+            else:
+                cycle.extend(back)
+        if not ok:
+            continue  # SCC guarantees connectivity; defensive only
+        prefix = _path_to(parents, start_s)
+        verdict = (
+            "reachable cycle repeats "
+            + " + ".join(required)
+            + (" without any environment change" if not env_ok else
+               " without any of " + "/".join(sorted(forbid)))
+        )
+        return {
+            "counterexample": _journal_chain(
+                product, prop, prefix, cycle, verdict
+            ),
+            "message": verdict,
+            "state": product.render(start_s),
+        }
+    return None
+
+
+_KIND_CHECKERS = {
+    "never": _check_never,
+    "reach": _check_reach,
+    "no_cycle": _check_no_cycle,
+}
+
+
+# ----------------------------------------------------------------------
+# entry points
+# ----------------------------------------------------------------------
+def _max_states_default() -> int:
+    from ..core._env import env_int
+
+    return env_int("HEAT_TPU_MODEL_CHECK_STATES", _DEFAULT_MAX_STATES)
+
+
+def check_property(
+    prop: Dict[str, Any],
+    protocols: Dict[str, Any] = None,
+    environment: Dict[str, Any] = None,
+    max_states: Optional[int] = None,
+) -> Optional[Dict[str, Any]]:
+    """Check one property; returns the violation record (with its
+    counterexample journal chain) or ``None``."""
+    protocols = PROTOCOLS if protocols is None else protocols
+    environment = ENVIRONMENT if environment is None else environment
+    bound = _max_states_default() if max_states is None else int(max_states)
+    product = _Product(prop["machines"], protocols, environment)
+    order, edges, parents = _explore(product, bound)
+    hit = _KIND_CHECKERS[prop["kind"]](product, prop, order, edges, parents)
+    if hit is None:
+        return None
+    hit.update(
+        property=prop["name"], kind=prop["kind"], doc=prop["doc"],
+        machines=list(product.machines), states_explored=len(order),
+    )
+    return hit
+
+
+def check_all(
+    protocols: Dict[str, Any] = None,
+    environment: Dict[str, Any] = None,
+    properties: Sequence[Dict[str, Any]] = None,
+    max_states: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Check every declared property; returns the violations (empty on
+    the shipped registry — the ``protocol_gate`` CI invariant)."""
+    props = PROPERTIES if properties is None else properties
+    out = []
+    for prop in props:
+        hit = check_property(prop, protocols, environment, max_states)
+        if hit is not None:
+            out.append(hit)
+    return out
+
+
+def seeded_defect(name: str):
+    """A deliberately broken ``(protocols, environment, properties)``
+    triple for checker self-tests — the model checker must FIND these:
+
+    * ``refresh_livelock``: drops the refresh driver's canary-resident
+      guard (streaming/refresh.py's ``canary_version(...) is not None``
+      early-out), restoring the trigger/veto livelock;
+    * ``breaker_double_probe``: lets the router re-admit a half-open
+      probe while one is already in flight (the stale-success readmit
+      defect this PR fixed in fleet/router.py), breaching the
+      single-probe invariant;
+    * ``autoscaler_flap``: removes the load guards from spawn/drain,
+      modeling an autoscaler with no hysteresis.
+    """
+    protocols = copy.deepcopy(PROTOCOLS)
+    environment = copy.deepcopy(ENVIRONMENT)
+    properties = copy.deepcopy(PROPERTIES)
+    if name == "refresh_livelock":
+        (t,) = protocols["refresh"]["transitions"]
+        t["when"] = tuple(a for a in t["when"] if a != "canary!=resident")
+    elif name == "breaker_double_probe":
+        rec = protocols["router.breaker"]
+        trans = list(rec["transitions"])
+        for t in trans:
+            if t["action"] == "cb_half_open":
+                t["when"] = ()
+                t["effect"] = ("env.probes+=1",)
+        trans.append({
+            "from": "half_open", "to": "half_open",
+            "action": "cb_half_open", "when": (),
+            "effect": ("env.probes+=1",),
+        })
+        rec["transitions"] = tuple(trans)
+    elif name == "autoscaler_flap":
+        rec = protocols["autoscaler"]
+        for t in rec["transitions"]:
+            t["when"] = ()
+            t["effect"] = ()
+        rec["transitions"] = tuple(rec["transitions"])
+    else:
+        raise ValueError(
+            f"unknown seeded defect {name!r}; pick one of "
+            "refresh_livelock, breaker_double_probe, autoscaler_flap"
+        )
+    return protocols, environment, properties
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m heat_tpu.analysis.model_check",
+        description="bounded model check of the declared control-plane protocols",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--seed-defect", default=None,
+                    help="check a deliberately broken registry copy "
+                         "(refresh_livelock | breaker_double_probe | "
+                         "autoscaler_flap)")
+    ap.add_argument("--max-states", type=int, default=None,
+                    help="exploration bound (default: "
+                         "HEAT_TPU_MODEL_CHECK_STATES)")
+    ns = ap.parse_args(argv)
+
+    if ns.seed_defect:
+        protocols, environment, properties = seeded_defect(ns.seed_defect)
+    else:
+        protocols, environment, properties = PROTOCOLS, ENVIRONMENT, PROPERTIES
+    violations = check_all(protocols, environment, properties,
+                           max_states=ns.max_states)
+    if ns.json:
+        print(json.dumps({
+            "registry": "seeded:" + ns.seed_defect if ns.seed_defect else "shipped",
+            "properties": len(properties),
+            "violations": violations,
+        }, indent=2, sort_keys=True))
+    else:
+        label = f"seeded defect {ns.seed_defect!r}" if ns.seed_defect else "shipped registry"
+        if not violations:
+            print(f"model check: {label}: {len(properties)} properties clean")
+        for v in violations:
+            print(f"VIOLATION {v['property']} ({v['kind']}): {v['message']}")
+            for ev in v["counterexample"]:
+                part = ev["evidence"].get("part", "")
+                tag = " [cycle]" if part == "cycle" else ""
+                print(f"  {ev['event_id']}  {ev['actor']}/{ev['action']}"
+                      f"{tag}  {ev['message']}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,8 @@ KNOBS = {
     "HEAT_TPU_HBM_BUDGET_BYTES": ("int", "0", "per-device HBM budget for the static peak-memory estimator: a freshly compiled program whose predicted per-device peak exceeds this many bytes emits J301 (0 = budget check off)"),
     "HEAT_TPU_PREDICT_DTYPE": ("choice", "", "low-precision predict compute dtype for tolerance-policy estimators (bfloat16; empty = native float32); kinds whose POLICIES entry is bitwise or does not list the dtype keep serving native and emit one J204"),
     "HEAT_TPU_COMPAT_FORCE": ("choice", "", "force one branch of the core/_compat.py jax-API resolver: 'legacy' uses the jax.experimental shard_map adapter even when jax.shard_map exists, 'native' requires the top-level API; empty = auto-detect (the compat-matrix CI lane sets this)"),
+    "HEAT_TPU_PROTOCOL_CHECK": ("choice", "0", "runtime conformance of journal events against the declared control-plane protocols (analysis/protocols.py): 0 = off (one global read per emit), 1 = warn (H805 diagnostic + protocol:<actor> alert per illegal transition), raise = ProgramLintError at the offending emit site"),
+    "HEAT_TPU_MODEL_CHECK_STATES": ("int", "200000", "bounded-model-checker state budget: the product state-space exploration of python -m heat_tpu.analysis.model_check aborts past this many distinct states"),
     # -- telemetry (heat_tpu/telemetry, docs/observability.md) ----------
     "HEAT_TPU_TRACE": ("bool", "1", "host-side span recording (0 = span() costs two attribute reads and records nothing)"),
     "HEAT_TPU_TRACE_RING": ("int", "4096", "span ring-buffer capacity (newest spans win)"),

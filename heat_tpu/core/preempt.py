@@ -34,10 +34,12 @@ either way.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Dict, Optional
 
 from ..analysis import tsan as _tsan
+from ..analysis.protocols import ACTOR_PREEMPT, PREEMPT_CLEAR, PREEMPT_RAISE
 from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 
@@ -75,6 +77,9 @@ class PreemptionGate:
         self._requests = 0
         self._preemptions = 0
         self._ignored = 0
+        #: stable per-gate key the journal events carry (the protocol
+        #: conformance checker tracks one raise/clear machine per gate)
+        self._gate_key = f"gate{next(_GATE_SEQ)}"
 
     # -- requester side -------------------------------------------------
     def request(self, reason: str = "latency spike") -> None:
@@ -91,10 +96,10 @@ class PreemptionGate:
             _PENDING_G.set(1.0)
             # journal after our lock is released (emit takes its own)
             _journal.emit(
-                "preempt", "raise",
+                ACTOR_PREEMPT, PREEMPT_RAISE,
                 severity="warn",
                 message=f"preemption requested: {reason}",
-                evidence={"reason": str(reason)},
+                evidence={"reason": str(reason), "gate": self._gate_key},
             )
 
     def clear(self) -> None:
@@ -104,13 +109,13 @@ class PreemptionGate:
             was, self._reason = self._reason, None
         _PENDING_G.set(0.0)
         if was is not None:
-            raised = _journal.find_last(actor="preempt", action="raise")
+            raised = _journal.find_last(actor=ACTOR_PREEMPT, action=PREEMPT_RAISE)
             _journal.emit(
-                "preempt", "clear",
+                ACTOR_PREEMPT, PREEMPT_CLEAR,
                 severity="info",
                 message=f"preemption cleared: {was}",
                 cause=raised["event_id"] if raised else None,
-                evidence={"reason": was},
+                evidence={"reason": was, "gate": self._gate_key},
             )
 
     # -- fit side -------------------------------------------------------
@@ -152,6 +157,9 @@ class PreemptionGate:
                 "ignored": self._ignored,
             }
 
+
+#: per-process gate counter behind each gate's journal scope key
+_GATE_SEQ = itertools.count()
 
 _GATE = PreemptionGate()
 

@@ -25,6 +25,7 @@ from ..parallel.comm import Communication, get_comm
 from ..resilience.errors import ReshapeError, WorkerLostError
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import RetryPolicy, default_init_policy
+from ..analysis.protocols import ACTOR_ELASTIC, ELASTIC_RESHAPE
 from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry.spans import span as _span
@@ -198,7 +199,7 @@ class ElasticSupervisor:
         RESHAPES_C.inc()
         WORLD_G.set(new_world.size)
         _journal.emit(
-            "elastic", "reshape",
+            ACTOR_ELASTIC, ELASTIC_RESHAPE,
             severity="warn",
             message=(
                 f"mesh reshaped {world.size} -> {new_world.size} after "

@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ..analysis import tsan as _tsan
+from ..analysis.protocols import ACTOR_AUTOSCALER
 from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry import tsdb as _tsdb
@@ -209,7 +210,7 @@ class FleetAutoscaler:
             if stats.get("n"):
                 evidence[series] = {k: stats[k] for k in ("n", "min", "max", "mean", "last")}
         _journal.emit(
-            "autoscaler", action,
+            ACTOR_AUTOSCALER, action,
             severity="info",
             message=(
                 f"scale-{'up' if action == 'spawn' else 'down'}: "

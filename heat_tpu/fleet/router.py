@@ -77,6 +77,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import tsan as _tsan
+from ..analysis.protocols import (
+    ACTOR_ROUTER, CB_HALF_OPEN, CB_READMIT, CB_REOPEN, CB_TRIP,
+)
 from ..resilience.errors import NoReplicaError, OverloadedError, TransientFault
 from ..resilience.faults import inject as _inject
 from ..resilience.retry import RetryPolicy
@@ -515,15 +518,13 @@ class FleetRouter:
             chosen = next((r for r in order if r.inflight < cap), None)
             if chosen is None:
                 chosen = min(order, key=lambda r: r.inflight)
-            probe = chosen.cb_open
-            if probe:
-                chosen.probing = True  # the admitted half-open probe
+            probe = self._cb_mark_probe(chosen)
             chosen.inflight += 1
         # journal after our lock is released (emit takes its own lock)
         if probe:
-            trip = _journal.find_last(actor="router", action="cb_trip")
+            trip = _journal.find_last(actor=ACTOR_ROUTER, action=CB_TRIP)
             _journal.emit(
-                "router", "cb_half_open",
+                ACTOR_ROUTER, CB_HALF_OPEN,
                 model=model or None,
                 severity="info",
                 message=f"half-open probe admitted to {chosen.url}",
@@ -537,37 +538,72 @@ class FleetRouter:
             )
         return chosen
 
+    # -- breaker transitions (registered in analysis/protocols.py:
+    # writes live in the lock-held helpers below, the declared journal
+    # events are emitted by _pick/_report after the lock is released) --
+    def _cb_mark_probe(self, replica: _Replica) -> bool:
+        """(caller holds ``self._lock``) Flip an eligible open replica
+        into its half-open probe slot; True iff this attempt IS the
+        probe (open -> half_open)."""
+        if not replica.cb_open:
+            return False
+        replica.probing = True  # the one admitted half-open probe
+        return True
+
+    def _cb_on_success(self, replica: _Replica) -> Optional[str]:
+        """(caller holds ``self._lock``) Success-path breaker
+        bookkeeping; returns the journal verb to emit after release.
+
+        Only the half-open PROBE's success readmits (half_open ->
+        closed).  A success while open with no probe out is a stale
+        response from before the trip — readmitting on it would skip
+        the probe protocol entirely, so it only clears the failure
+        streak."""
+        replica.fails = 0
+        if replica.cb_open and replica.probing:
+            replica.cb_open = False
+            replica.probing = False
+            _CB_CLOSE_C.inc()
+            return CB_READMIT
+        return None
+
+    def _cb_on_failure(self, replica: _Replica, now: float) -> Optional[str]:
+        """(caller holds ``self._lock``) Failure-path breaker
+        bookkeeping; returns the journal verb to emit after release.
+
+        A failed half-open probe re-opens for another cooldown
+        (half_open -> open, journaled as ``cb_reopen``); a stale
+        failure while open with no probe out is silent bookkeeping; a
+        closed replica trips once the consecutive-failure threshold is
+        crossed."""
+        replica.fails += 1
+        if replica.cb_open:
+            probe_failed = replica.probing
+            replica.probing = False
+            replica.cb_open_until = now + self.cb_cooldown_s
+            return CB_REOPEN if probe_failed else None
+        if replica.fails >= self.cb_failures:
+            replica.cb_open = True
+            replica.probing = False
+            replica.cb_open_until = now + self.cb_cooldown_s
+            _CB_OPEN_C.inc()
+            return CB_TRIP
+        return None
+
     def _report(self, replica: _Replica, ok: bool) -> None:
         """Account one attempt's outcome into the replica's breaker."""
         now = time.monotonic()
-        transition = None  # journal verb decided under the lock, emitted after
-        fails = 0
         with self._lock:
             _tsan.note_access("fleet.router.replicas")
             replica.inflight = max(0, replica.inflight - 1)
             if ok:
-                replica.fails = 0
-                if replica.cb_open:
-                    replica.cb_open = False
-                    replica.probing = False
-                    _CB_CLOSE_C.inc()
-                    transition = "cb_readmit"
+                transition = self._cb_on_success(replica)
             else:
-                replica.fails += 1
-                fails = replica.fails
-                if replica.cb_open:
-                    # failed half-open probe: re-open for another cooldown
-                    replica.probing = False
-                    replica.cb_open_until = now + self.cb_cooldown_s
-                elif replica.fails >= self.cb_failures:
-                    replica.cb_open = True
-                    replica.probing = False
-                    replica.cb_open_until = now + self.cb_cooldown_s
-                    _CB_OPEN_C.inc()
-                    transition = "cb_trip"
-        if transition == "cb_trip":
+                transition = self._cb_on_failure(replica, now)
+            fails = replica.fails
+        if transition == CB_TRIP:
             _journal.emit(
-                "router", "cb_trip",
+                ACTOR_ROUTER, CB_TRIP,
                 severity="warn",
                 message=(
                     f"circuit breaker opened for {replica.url} after "
@@ -577,10 +613,10 @@ class FleetRouter:
                           "threshold": self.cb_failures,
                           "cooldown_s": self.cb_cooldown_s},
             )
-        elif transition == "cb_readmit":
-            probe = _journal.find_last(actor="router", action="cb_half_open")
+        elif transition == CB_READMIT:
+            probe = _journal.find_last(actor=ACTOR_ROUTER, action=CB_HALF_OPEN)
             _journal.emit(
-                "router", "cb_readmit",
+                ACTOR_ROUTER, CB_READMIT,
                 severity="info",
                 message=f"half-open probe succeeded; {replica.url} readmitted",
                 cause=(
@@ -589,6 +625,23 @@ class FleetRouter:
                     else None
                 ),
                 evidence={"replica": replica.url},
+            )
+        elif transition == CB_REOPEN:
+            probe = _journal.find_last(actor=ACTOR_ROUTER, action=CB_HALF_OPEN)
+            _journal.emit(
+                ACTOR_ROUTER, CB_REOPEN,
+                severity="warn",
+                message=(
+                    f"half-open probe failed; {replica.url} re-opened for "
+                    f"another {self.cb_cooldown_s}s cooldown"
+                ),
+                cause=(
+                    probe["event_id"]
+                    if probe and probe["evidence"].get("replica") == replica.url
+                    else None
+                ),
+                evidence={"replica": replica.url,
+                          "cooldown_s": self.cb_cooldown_s},
             )
 
     # -- proxying -------------------------------------------------------

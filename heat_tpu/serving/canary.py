@@ -75,6 +75,10 @@ import numpy as np
 
 from ..analysis import tsan as _tsan
 from ..analysis.precision_policy import POLICIES
+from ..analysis.protocols import (
+    ACTOR_CANARY, ACTOR_FLIGHT_RECORDER, ACTOR_REFRESH, CANARY_STAGE,
+    CANARY_VETO, FLIGHT_RECORDER_BUNDLE, REFRESH_TRIGGER,
+)
 from ..resilience.faults import inject as _inject
 from ..telemetry import alerts as _alerts
 from ..telemetry import journal as _journal
@@ -425,29 +429,60 @@ class CanaryController:
             active = self.service.registry.active_version(model)
         except KeyError:
             return False
+        enqueued = False
         with self._cond:
             _tsan.note_access("serving.canary.state")
+            prev = _STATE.get(model)
+            fresh = prev is None or prev["canary_version"] != version
             st = self._window(model, version, kind, active)
-            if st["decision"] is not None:
-                return False  # this canary version is already judged
-            st["acc"] += self.fraction
-            if st["acc"] < 1.0:
-                return False
-            st["acc"] -= 1.0
-            if len(self._queue) >= self.queue_depth:
-                st["dropped"] += 1
-                _DROPPED_C.inc()
-                return False
-            self._queue.append(
-                _Mirror(model, version, rows, out, trace_id, primary_ms)
-            )
-            self._cond.notify_all()
             started = self._thread is not None
+            if st["decision"] is not None:
+                pass  # this canary version is already judged
+            else:
+                st["acc"] += self.fraction
+                if st["acc"] >= 1.0:
+                    st["acc"] -= 1.0
+                    if len(self._queue) >= self.queue_depth:
+                        st["dropped"] += 1
+                        _DROPPED_C.inc()
+                    else:
+                        self._queue.append(
+                            _Mirror(model, version, rows, out, trace_id,
+                                    primary_ms)
+                        )
+                        self._cond.notify_all()
+                        enqueued = True
+        if fresh:
+            # journal the residency transition AFTER the lock (emit
+            # takes its own; first offer against a new canary version
+            # marks the window opening)
+            self._journal_stage(model, version, active)
+        if not enqueued:
+            return False
         _SAMPLED_C.inc()
         _SAMPLED_ROWS_C.inc(int(rows.shape[0]))
         if not started:
             self._start()
         return True
+
+    def _journal_stage(self, model: str, version: int,
+                       active: Optional[int]) -> None:
+        """Registered transition helper (PROTOCOLS ``canary``): a staged
+        version entering shadow residency, cause-linked to the refresh
+        trigger that staged it when there is one."""
+        trig = _journal.find_last(actor=ACTOR_REFRESH, action=REFRESH_TRIGGER)
+        _journal.emit(
+            ACTOR_CANARY, CANARY_STAGE, model=model, severity="info",
+            message=(
+                f"canary v{version} resident; shadow window open against "
+                f"active v{active}"
+            ),
+            cause=(
+                trig["event_id"]
+                if trig and trig.get("model") == model else None
+            ),
+            evidence={"canary_version": version, "active_version": active},
+        )
 
     def _window(self, model: str, version: int, kind: str,
                 active: Optional[int]) -> Dict[str, Any]:
@@ -612,29 +647,35 @@ class CanaryController:
             return
         vetoes = _collect_vetoes(model)
         if vetoes:
-            with self._lock:
-                _tsan.note_access("serving.canary.state")
-                st = _STATE.get(model)
-                if st is None or st["decision"]:
-                    return
-                first_hold = st["verdict"] != "held"
-                st["verdict"] = "held"
-                st["vetoes"] = vetoes
-                tid = st["last_trace_id"]
-            if first_hold:
-                record_event(
-                    model, "decision", "warn",
-                    "promotion held by veto: " + "; ".join(vetoes),
-                    trace_id=tid, action="held", vetoes=vetoes,
-                )
-                _journal.emit(
-                    "canary", "veto", model=model, severity="warn",
-                    message="promotion held by veto: " + "; ".join(vetoes),
-                    cause=_upstream_alert_cause(model), trace_id=tid,
-                    evidence={"vetoes": vetoes},
-                )
+            self._hold(model, vetoes)
             return
         self._decide(model, "pass", [])
+
+    def _hold(self, model: str, vetoes: List[str]) -> None:
+        """Registered transition helper (PROTOCOLS ``canary``): the veto
+        self-loop — a passing window held resident by a firing quality
+        alert, journaled once per hold streak, never terminal."""
+        with self._lock:
+            _tsan.note_access("serving.canary.state")
+            st = _STATE.get(model)
+            if st is None or st["decision"]:
+                return
+            first_hold = st["verdict"] != "held"
+            st["verdict"] = "held"
+            st["vetoes"] = vetoes
+            tid = st["last_trace_id"]
+        if first_hold:
+            record_event(
+                model, "decision", "warn",
+                "promotion held by veto: " + "; ".join(vetoes),
+                trace_id=tid, action="held", vetoes=vetoes,
+            )
+            _journal.emit(
+                ACTOR_CANARY, CANARY_VETO, model=model, severity="warn",
+                message="promotion held by veto: " + "; ".join(vetoes),
+                cause=_upstream_alert_cause(model), trace_id=tid,
+                evidence={"vetoes": vetoes},
+            )
 
     def _decide(self, model: str, verdict: str, reasons: List[str]) -> None:
         """Commit one decision: mutate the registry (when ``auto``),
@@ -720,7 +761,7 @@ class CanaryController:
         if summary["latency_ratio"] is not None:
             _tsdb.record("canary.latency_ratio", summary["latency_ratio"])
         jev = _journal.emit(
-            "canary", action, model=model, severity=severity, message=msg,
+            ACTOR_CANARY, action, model=model, severity=severity, message=msg,
             cause=_upstream_alert_cause(model) if verdict == "fail" else None,
             trace_id=tid,
             evidence={
@@ -760,7 +801,8 @@ class CanaryController:
         try:
             path = _fr.dump_bundle(reason=f"canary_rollback:{model}")
             _journal.emit(
-                "flight_recorder", "bundle", model=model, severity="info",
+                ACTOR_FLIGHT_RECORDER, FLIGHT_RECORDER_BUNDLE, model=model,
+                severity="info",
                 message="forensic bundle written for canary rollback",
                 cause=cause, trace_id=decision.get("trace_id"),
                 evidence={"path": path, "reason": f"canary_rollback:{model}"},

@@ -43,7 +43,9 @@ effect on the next tick with zero downtime and zero dropped requests.
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -51,9 +53,13 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..analysis import tsan as _tsan
+from ..analysis.protocols import (
+    ACTOR_REPLICA, REPLICA_DRAIN, REPLICA_READY, REPLICA_STOP, REPLICA_WARM,
+)
 from ..resilience.errors import OverloadedError
 from ..resilience.faults import inject as _inject
 from ..telemetry import alerts as _alerts
+from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry import server as _tserver
 from ..telemetry import sketch as _sketch
@@ -72,6 +78,17 @@ __all__ = [
     "start_serving",
     "stop_serving",
 ]
+
+#: lifecycle journal action per target state (PROTOCOLS "replica")
+_STATE_ACTIONS = {
+    "warming": REPLICA_WARM,
+    "ready": REPLICA_READY,
+    "draining": REPLICA_DRAIN,
+    "stopped": REPLICA_STOP,
+}
+
+#: per-process instance counter behind each service's replica key
+_SERVICE_SEQ = itertools.count()
 
 _LATENCY_H = _tm.histogram(
     "serving.latency_ms", "end-to-end predict latency (admission to result)"
@@ -150,8 +167,14 @@ class InferenceService:
         #: lifecycle state the /readyz readiness verdict keys off:
         #: "warming" (up, pre-warming the executable cache — not ready),
         #: "ready" (routable), "draining" (finishing in-flight work —
-        #: not ready).  Liveness (/healthz) is unaffected by any of it.
+        #: not ready), "stopped" (terminal, post-close).  Liveness
+        #: (/healthz) is unaffected by any of it.  The machine is
+        #: declared in analysis/protocols.py ("replica"); every change
+        #: goes through :meth:`set_state`, which journals it.
         self._state = "ready"
+        #: stable per-instance key the lifecycle journal events carry
+        #: (the conformance checker tracks one machine per replica)
+        self._replica_key = f"pid{os.getpid()}-svc{next(_SERVICE_SEQ)}"
         #: (model, bucket_rows, features, dtype) per coalesced-batch
         #: shape this service has dispatched — the pre-warm manifest a
         #: fresh replica replays to reach hit rate 1.0 before its first
@@ -359,18 +382,22 @@ class InferenceService:
         return out, {"trace_id": req.trace_id, "latency_ms": req.duration_ms}
 
     # -- lifecycle state + readiness ------------------------------------
-    _STATES = ("warming", "ready", "draining")
+    _STATES = ("warming", "ready", "draining", "stopped")
 
     @property
     def state(self) -> str:
-        """Lifecycle state: "warming" / "ready" / "draining"."""
+        """Lifecycle state: "warming" / "ready" / "draining" /
+        "stopped"."""
         with self._lock:
             _tsan.note_access("serving.service.state", write=False)
             return self._state
 
     def set_state(self, state: str) -> str:
         """Set the lifecycle state (readiness flips with it); returns
-        the previous state."""
+        the previous state.  The registered transition helper of the
+        ``replica`` protocol: every actual change is journaled (actor
+        ``replica``) after the lock is released, keyed by this
+        instance's replica key."""
         if state not in self._STATES:
             raise ValueError(
                 f"unknown service state {state!r}; expected one of {self._STATES}"
@@ -378,6 +405,13 @@ class InferenceService:
         with self._lock:
             _tsan.note_access("serving.service.state")
             prev, self._state = self._state, state
+        if prev != state:
+            _journal.emit(
+                ACTOR_REPLICA, _STATE_ACTIONS[state],
+                severity="info",
+                message=f"replica lifecycle: {prev} -> {state}",
+                evidence={"replica": self._replica_key, "prev": prev},
+            )
         return prev
 
     def readiness(self):
@@ -733,6 +767,7 @@ class InferenceService:
     def close(self) -> None:
         """Unmount the routes, drain and join every batcher, drain the
         registry's background loader.  Idempotent."""
+        self.set_state("stopped")  # terminal lifecycle transition (journaled once)
         _tserver.unregister_route(ROUTE_PREFIX)
         _tserver.clear_readiness(self.readiness)
         if self._started_monitor:

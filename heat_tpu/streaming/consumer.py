@@ -27,6 +27,7 @@ import numpy as np
 
 from ..resilience.faults import inject
 from ..resilience.retry import default_io_policy
+from ..analysis.protocols import ACTOR_STREAM, STREAM_RESHARD
 from ..telemetry import journal as _journal
 from ..telemetry import metrics as _tm
 from ..telemetry import tsdb as _tsdb
@@ -203,7 +204,7 @@ class StreamConsumer:
             self._needs_reshard = True
             _RESHARDS.inc()
             _journal.emit(
-                "stream", "reshard",
+                ACTOR_STREAM, STREAM_RESHARD,
                 severity="warn",
                 message=(
                     f"key-distribution drift PSI {score:.4f} > "

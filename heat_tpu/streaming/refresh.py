@@ -25,6 +25,12 @@ import time
 from typing import Callable, Optional, Tuple
 
 from ..analysis import tsan as _tsan
+from ..analysis.protocols import (
+    ACTOR_ALERTS,
+    ACTOR_REFRESH,
+    ALERT_FIRE,
+    REFRESH_TRIGGER,
+)
 from ..resilience.faults import inject
 from ..telemetry import alerts as _alerts
 from ..telemetry import journal as _journal
@@ -175,15 +181,15 @@ class RefreshDriver:
             cause = None
             for e in reversed(_journal.journal_events()):
                 if (
-                    e.get("actor") == "alerts"
-                    and e.get("action") == "fire"
+                    e.get("actor") == ACTOR_ALERTS
+                    and e.get("action") == ALERT_FIRE
                     and str(e.get("evidence", {}).get("alert", ""))
                     .startswith(f"drift:{self.model}")
                 ):
                     cause = e["event_id"]
                     break
             _journal.emit(
-                "refresh", "trigger",
+                ACTOR_REFRESH, REFRESH_TRIGGER,
                 model=self.model,
                 severity="info",
                 message=(

@@ -40,6 +40,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..analysis import tsan as _tsan
+from ..analysis.protocols import ACTOR_ALERTS, ALERT_FIRE, ALERT_RESOLVE
 from . import journal as _journal
 from . import metrics as _metrics
 
@@ -193,7 +194,7 @@ def fire(
     ev = {"alert": key, "value": value, "threshold": threshold}
     ev.update(evidence or {})
     _journal.emit(
-        "alerts", "fire",
+        ACTOR_ALERTS, ALERT_FIRE,
         model=(labels or {}).get("model"),
         tenant=(labels or {}).get("tenant"),
         severity=severity,
@@ -231,7 +232,7 @@ def resolve(name: str, labels: Optional[Dict[str, str]] = None) -> bool:
             fired_id = e.get("event_id")
             break
     _journal.emit(
-        "alerts", "resolve",
+        ACTOR_ALERTS, ALERT_RESOLVE,
         model=doc["labels"].get("model"),
         tenant=doc["labels"].get("tenant"),
         severity="info",

@@ -53,6 +53,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..analysis import conformance as _conformance
 from ..analysis import tsan as _tsan
 from . import metrics as _metrics
 
@@ -192,6 +193,10 @@ def reset_journal() -> None:
         _EVENTS.clear()
         _SEQ = 0
         _NEXT_START = None
+    # a fresh journal means fresh controllers: the protocol conformance
+    # checker forgets its tracked machine instances too (outside our
+    # lock — it takes its own leaf lock)
+    _conformance.reset_conformance()
 
 
 def _scan_next_start_locked(directory: str) -> int:
@@ -278,7 +283,12 @@ def emit(
         except Exception:  # lint: allow H501(a durable-write failure degrades to hot-ring only, never breaks the deciding controller)
             pass
     _EMITTED_C.inc()
-    return ev.doc()
+    doc = ev.doc()
+    # protocol conformance hook — one module-global read when off; runs
+    # strictly after our lock is released because a violation report
+    # fires an alert, which legally re-enters emit() one level deep
+    _conformance.note_emit(doc)
+    return doc
 
 
 def journal_events(limit: Optional[int] = None) -> List[Dict[str, Any]]:
@@ -454,15 +464,40 @@ def _evidence_summary(ev: Dict[str, Any], max_len: int = 160) -> str:
     return s if len(s) <= max_len else s[: max_len - 1] + "…"
 
 
-def _event_rows_html(events: List[Dict[str, Any]], esc) -> List[str]:
+def _protocol_cell(ann: Optional[Dict[str, Any]], esc) -> str:
+    """One table cell describing the event's declared protocol step —
+    ``protocol: from → to`` — or the H805 violation it committed."""
+    if ann is None:
+        return "<td>—</td>"
+    if ann.get("ok"):
+        return (
+            f"<td>{esc(ann.get('protocol'))}: {esc(ann.get('from'))} "
+            f"&rarr; {esc(ann.get('to'))}</td>"
+        )
+    return (
+        "<td style='background:#ffd6d6'><b>H805</b> "
+        f"{esc(ann.get('message'))}</td>"
+    )
+
+
+def _event_rows_html(
+    events: List[Dict[str, Any]],
+    esc,
+    annotations: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> List[str]:
+    proto_th = "<th>protocol</th>" if annotations is not None else ""
     parts = [
         "<table><tr><th>ts</th><th>actor</th><th>action</th><th>model</th>"
-        "<th>sev</th><th>message</th><th>evidence</th><th>cause</th>"
-        "<th>exemplar</th><th>event</th></tr>"
+        "<th>sev</th><th>message</th><th>evidence</th>" + proto_th +
+        "<th>cause</th><th>exemplar</th><th>event</th></tr>"
     ]
     for e in events:
         tid = e.get("trace_id")
         cause = e.get("cause")
+        proto_td = (
+            _protocol_cell(annotations.get(str(e.get("event_id"))), esc)
+            if annotations is not None else ""
+        )
         parts.append(
             f"<tr style='background:{_SEV_COLOR.get(e.get('severity'), '')}'>"
             f"<td>{esc(round(e.get('ts', 0), 3))}</td>"
@@ -471,6 +506,7 @@ def _event_rows_html(events: List[Dict[str, Any]], esc) -> List[str]:
             f"<td>{esc(e.get('severity'))}</td>"
             f"<td>{esc(e.get('message'))}</td>"
             f"<td>{esc(_evidence_summary(e))}</td>"
+            + proto_td
             + (
                 f"<td><a href='/decisionz?event_id={esc(cause)}'>{esc(cause)}</a></td>"
                 if cause else "<td>—</td>"
@@ -511,13 +547,18 @@ def render_decisionz_html(event_id: Optional[str] = None) -> str:
                 f"{esc(rep['dir'] or '<dir>')})</p>"
             )
         else:
+            # the explain view annotates every event with its declared
+            # protocol transition (state before → after), flagging H805
+            # violations inline — stepped over the whole retained ring
+            # so tracked states are right even for mid-ring events
+            annotations = _conformance.annotate(rep["events"])
             parts.append(
                 f"<h2>causal chain ({len(doc['chain'])} event(s), root first)</h2>"
             )
-            parts.extend(_event_rows_html(doc["chain"], esc))
+            parts.extend(_event_rows_html(doc["chain"], esc, annotations))
             parts.append(f"<h2>downstream effects ({len(doc['effects'])})</h2>")
             if doc["effects"]:
-                parts.extend(_event_rows_html(doc["effects"], esc))
+                parts.extend(_event_rows_html(doc["effects"], esc, annotations))
             else:
                 parts.append("<p>(none retained)</p>")
         parts.append("<p><a href='/decisionz'>full timeline</a></p>")

@@ -15,8 +15,11 @@ and the postmortem starts from this directory alone.
 The default rendering is the chronological timeline with cause links
 resolved inline; ``--event-id`` walks one decision's causal chain to
 its root and lists its downstream effects (the offline twin of
-``/decisionz?event_id=``); ``--json`` emits the machine form.
-:func:`replay_report` is the pure core the tests drive.
+``/decisionz?event_id=``); ``--check`` steps the timeline through the
+declared control-plane protocols (:mod:`heat_tpu.analysis.protocols`)
+and reports every H805 conformance violation, exiting non-zero if any;
+``--json`` emits the machine form.  :func:`replay_report` is the pure
+core the tests drive.
 """
 
 from __future__ import annotations
@@ -26,15 +29,22 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
+from ..analysis import conformance as _conformance
 from .journal import causal_chain, read_journal
 
 __all__ = ["format_replay", "main", "replay_report"]
 
 
-def replay_report(directory: str, event_id: Optional[str] = None) -> Dict[str, Any]:
+def replay_report(
+    directory: str,
+    event_id: Optional[str] = None,
+    check: bool = False,
+) -> Dict[str, Any]:
     """The machine form of a replay: the full durable timeline, per-actor
     counts, root events (no retained cause), and — when ``event_id`` is
-    given — that event's causal chain and effects."""
+    given — that event's causal chain and effects.  With ``check`` the
+    timeline is stepped through the declared control-plane protocols and
+    the violations land under ``"check"``."""
     events = read_journal(directory)
     actors: Dict[str, int] = {}
     for e in events:
@@ -50,6 +60,20 @@ def replay_report(directory: str, event_id: Optional[str] = None) -> Dict[str, A
     }
     if event_id is not None:
         doc["explain"] = causal_chain(event_id, events=events)
+    if check:
+        annotations = _conformance.annotate(events)
+        stepped = sum(1 for a in annotations.values())
+        bad = [
+            {"event_id": eid, "protocol": a.get("protocol"),
+             "scope_key": a.get("scope_key"), "from": a.get("from"),
+             "message": a.get("message")}
+            for eid, a in annotations.items() if not a.get("ok")
+        ]
+        doc["check"] = {
+            "stepped": stepped,
+            "violations": bad,
+            "violation_count": len(bad),
+        }
     return doc
 
 
@@ -97,6 +121,15 @@ def format_replay(doc: Dict[str, Any]) -> str:
         for e in explain["effects"]:
             out.append(_fmt_event(e, indent="  "))
         return "\n".join(out)
+    check = doc.get("check")
+    if check is not None:
+        out.append(
+            f"protocol conformance: {check['stepped']} protocol event(s) "
+            f"stepped, {check['violation_count']} violation(s)"
+        )
+        for v in check["violations"]:
+            out.append(f"  H805 {v['event_id']}: {v['message']}")
+        out.append("")
     out.append("timeline (oldest first):")
     for e in doc["events"]:
         out.append(_fmt_event(e))
@@ -112,14 +145,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("directory", help="HEAT_TPU_JOURNAL_DIR of the dead process")
     ap.add_argument("--event-id", default=None,
                     help="explain one decision: causal chain + effects")
+    ap.add_argument("--check", action="store_true",
+                    help="step the timeline through the declared control-"
+                    "plane protocols; non-zero exit on any H805 violation")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     args = ap.parse_args(argv)
 
-    doc = replay_report(args.directory, event_id=args.event_id)
+    doc = replay_report(args.directory, event_id=args.event_id, check=args.check)
     if args.json:
         print(json.dumps(doc, indent=1, default=str))
     else:
         print(format_replay(doc))
+    if args.check and doc["check"]["violation_count"]:
+        return 2
     return 0 if doc["event_count"] else 1
 
 

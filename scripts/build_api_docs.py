@@ -42,6 +42,9 @@ MODULES = [
     ("Peak-HBM estimator", "heat_tpu.analysis.memory_model", "static per-device peak-memory prediction from the jaxpr (liveness + donation + sharding), J301 against HEAT_TPU_HBM_BUDGET_BYTES (docs/static_analysis.md)"),
     ("Precision policies", "heat_tpu.analysis.precision_policy", "the per-estimator bitwise/tolerance POLICIES registry and its three enforcement choke points (docs/static_analysis.md)"),
     ("Concurrency sanitizer", "heat_tpu.analysis.tsan", "runtime lock-order/unguarded-access sanitizer over the central LOCK_REGISTRY (HEAT_TPU_TSAN; docs/static_analysis.md)"),
+    ("Control-plane protocols", "heat_tpu.analysis.protocols", "pure-literal PROTOCOLS registry: every controller's declared state machine, journal vocabulary constants, temporal PROPERTIES (docs/static_analysis.md)"),
+    ("Protocol model checker", "heat_tpu.analysis.model_check", "bounded exhaustive check of the declared machines against the adversarial environment; counterexamples as synthetic causal journal chains (python -m heat_tpu.analysis.model_check; docs/static_analysis.md)"),
+    ("Protocol conformance", "heat_tpu.analysis.conformance", "runtime stepping of live journal events through the declared machines, H805 on illegal transitions (HEAT_TPU_PROTOCOL_CHECK; docs/static_analysis.md)"),
     ("Elastic", "heat_tpu.elastic", "worker-loss detection, mesh reshape + cross-world resume supervision (docs/elasticity.md)"),
     ("Serving", "heat_tpu.serving", "online inference: model registry + hot-load, request coalescing with pad-to-bucket dispatch, per-tenant admission control, /v1 HTTP endpoints (docs/serving.md)"),
     ("Fleet", "heat_tpu.fleet", "fleet-scale serving: fault-tolerant replica router (consistent-hash affinity, circuit breakers, bounded-retry failover), replica process management, load-driven elastic autoscaling (docs/fleet.md)"),

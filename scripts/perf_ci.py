@@ -1791,6 +1791,139 @@ def main():
 
     guarded("lint_new_violations", bench_lint_gate)
 
+    # control-plane protocol gate (ISSUE 20): the bounded model checker
+    # must prove every declared PROPERTY on the shipped PROTOCOLS
+    # registry, the registry itself must be hygienic, and the checker
+    # must still have teeth — each seeded defect class
+    # (--seed-defect's triple) must yield a counterexample — plus any
+    # runtime H805s the protocol_overhead storm stepped into, all gated
+    # as one hard-cap count.
+    def bench_protocol_gate():
+        from heat_tpu.analysis import model_check, protocols
+
+        problems = protocols.registry_problems()
+        violated = model_check.check_all()
+        missed = []
+        for name in ("refresh_livelock", "breaker_double_probe", "autoscaler_flap"):
+            p, e, props = model_check.seeded_defect(name)
+            if not model_check.check_all(p, e, props):
+                missed.append(name)
+        runtime = results.get("protocol_overhead", {}).get("violations", 0)
+        results["protocol_gate"] = {
+            "count": len(problems) + len(violated) + len(missed) + runtime,
+            "max_count": 0,
+            "machines": len(protocols.PROTOCOLS),
+            "properties_checked": len(protocols.PROPERTIES),
+            "declared_pairs": len(protocols.declared_pairs()),
+            "registry_problems": problems,
+            "violated_properties": [v["property"] for v in violated],
+            "seeded_defects_missed": missed,
+            "runtime_violations": runtime,
+        }
+
+    # protocol-conformance overhead (ISSUE 20): the bench_serving
+    # request stream with a 20 Hz declared-pair decision storm running
+    # on BOTH sides — HEAT_TPU_PROTOCOL_CHECK=warn (every emit stepped
+    # through the declared machines) vs off (one global read per emit)
+    # — as the paired median of request latency, best of 3 alternating
+    # pairs (the bench_journal_overhead methodology; only the
+    # conformance mode differs between sides, so the delta isolates
+    # the hook).  The storm walks the preempt machine's legal
+    # raise/clear pair each tick, so the armed side steps REAL
+    # transitions and must step them clean (violations feed the
+    # protocol_gate hard cap).
+    def bench_protocol_overhead():
+        import shutil
+        import tempfile
+        import threading as th
+
+        from heat_tpu import serving as srv
+        from heat_tpu.analysis import conformance
+        from heat_tpu.analysis.protocols import (
+            ACTOR_PREEMPT, PREEMPT_CLEAR, PREEMPT_RAISE,
+        )
+        from heat_tpu.telemetry import journal as tjournal
+
+        rows = np.random.default_rng(23).standard_normal((64, f)).astype(np.float32)
+        km = fit()
+        d = tempfile.mkdtemp(prefix="heat_tpu_ci_protocol_")
+        svc = None
+        emitted = [0]
+        try:
+            srv.save_model(km, d, version=1, name="km")
+            svc = srv.InferenceService(max_batch=64)  # default MAX_DELAY_MS
+            svc.load("km", d)
+            for b in (1, 2, 4, 8, 16, 32, 64):  # warm every bucket
+                svc.predict("km", rows[:b])
+
+            sizes = (1, 3, 7, 12, 18, 27, 33, 50, 64)  # the bench_serving mix
+
+            def storm(stop):
+                # a complete raise/clear pair per tick: idle -> raised
+                # -> idle, so the machine is back at its initial state
+                # wherever the stop lands and every armed side resumes
+                # on a legal edge
+                i = 0
+                while not stop.wait(0.05):
+                    i += 1
+                    for action in (PREEMPT_RAISE, PREEMPT_CLEAR):
+                        tjournal.emit(
+                            ACTOR_PREEMPT, action, severity="info",
+                            message="protocol-overhead storm",
+                            evidence={"gate": "bench", "i": i},
+                        )
+                emitted[0] += 2 * i
+
+            def one_side(armed, n=150):
+                conformance.set_protocol_mode("warn" if armed else "0")
+                stop = th.Event()
+                ticker = th.Thread(target=storm, args=(stop,), daemon=True)
+                ticker.start()
+                lat = []
+                try:
+                    for i in range(n):
+                        t0 = time.perf_counter()
+                        svc.predict("km", rows[: sizes[i % len(sizes)]], timeout=30)
+                        lat.append(time.perf_counter() - t0)
+                finally:
+                    stop.set()
+                    ticker.join(5)
+                    conformance.set_protocol_mode("0")
+                return float(np.median(lat))
+
+            pairs = []
+            on_med = off_med = None
+            for p in range(3):
+                if p % 2 == 0:
+                    on_med = one_side(True)
+                    off_med = one_side(False)
+                else:
+                    off_med = one_side(False)
+                    on_med = one_side(True)
+                if off_med > 0:
+                    pairs.append((100.0 * (on_med - off_med) / off_med, on_med, off_med))
+            stepped = len(conformance.violations())
+            overhead_pct, on_med, off_med = min(pairs)
+            results["protocol_overhead"] = {
+                "overhead_pct": round(overhead_pct, 2),
+                "max_overhead_pct": 3.0,
+                "request_latency_on_s": round(on_med, 6),
+                "request_latency_off_s": round(off_med, 6),
+                "pair_overheads_pct": [round(pp[0], 2) for pp in pairs],
+                "requests_per_side": 150,
+                "storm_emits": emitted[0],
+                "violations": stepped,
+            }
+        finally:
+            conformance.set_protocol_mode("0")
+            tjournal.reset_journal()
+            if svc is not None:
+                svc.close()
+            shutil.rmtree(d, ignore_errors=True)
+
+    guarded("protocol_overhead", bench_protocol_overhead)
+    guarded("protocol_gate", bench_protocol_gate)
+
     # rolling-median trend gate (ROADMAP 5c): THIS run's headline
     # numbers appended to BENCH_HISTORY.jsonl's record, per-metric
     # k-run medians compared window-against-window — sustained drift

@@ -24,7 +24,9 @@ process-wide preemption gate vs fit threads (``test_qos.py``,
 ``test_qos_resume.py``), and the explainability plane's decision
 journal (durable segment writer vs /decisionz scrapes vs the forced
 4-thread incident e2e) plus the TSDB sampler thread vs controller
-``record`` pushes (``test_journal.py``, ``test_tsdb.py``) — in a
+``record`` pushes (``test_journal.py``, ``test_tsdb.py``), and the
+protocol verifier's runtime conformance hook racing controller emits
+through the journal (``test_protocols.py``) — in a
 subprocess with the concurrency
 sanitizer armed, then audits the subprocess's ``HEAT_TPU_TSAN_DUMP``
 findings artifact.  The lane passes only when the tests pass AND the
@@ -66,6 +68,7 @@ LANE_FILES = (
     "tests/test_qos_resume.py",
     "tests/test_journal.py",
     "tests/test_tsdb.py",
+    "tests/test_protocols.py",
 )
 
 

@@ -392,7 +392,10 @@ class TestDrain:
         for t in threads:
             t.join(timeout=10)
         assert not errs and len(results) == 4
-        assert svc.state == "draining"
+        # drain() closes once everything admitted is answered, and close
+        # lands in the declared terminal lifecycle state (the "replica"
+        # machine in analysis/protocols.py: ... -> draining -> stopped)
+        assert svc.state == "stopped"
 
 
 # ----------------------------------------------------------------------

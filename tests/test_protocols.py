@@ -1,0 +1,533 @@
+"""Control-plane protocol verifier tests (ISSUE 20 tentpole).
+
+The contract under test (docs/static_analysis.md "Protocol rules"):
+
+* ``analysis/protocols.py`` holds the pure-literal ``PROTOCOLS`` /
+  ``ENVIRONMENT`` / ``PROPERTIES`` registries (``ast.literal_eval``
+  verifiable), structurally sound (``registry_problems() == []``), and
+  the centralized journal vocabulary constants match exactly the pairs
+  the registry declares;
+* the H801-H804 AST rules catch: controller state written outside a
+  registered transition function, a transition function missing its
+  declared journal emit, an emit with an undeclared ``(actor, action)``
+  literal, and a malformed/unreachable registry;
+* the bounded model checker runs clean on the shipped registry and
+  produces counterexample journal chains for each seeded defect class
+  (livelock, invariant breach, flap);
+* runtime conformance (``HEAT_TPU_PROTOCOL_CHECK``) steps every live
+  emit through the declared machines: legal controller flows are clean,
+  illegal transitions surface as H805 + a ``protocol:<actor>`` alert,
+  raise mode turns the first violation into ``ProgramLintError``;
+* the real controllers (service lifecycle, preemption gate, alerts,
+  router breaker, autoscaler) conform end to end with checking armed;
+* ``python -m heat_tpu.telemetry.replay <dir> --check`` verdicts the
+  durable log offline; ``/decisionz?event_id=`` annotates the explain
+  view with declared transitions; the docs diagrams match the
+  generator.
+"""
+
+import ast
+import json
+import os
+import sys
+
+import pytest
+
+from heat_tpu.analysis import ast_lint
+from heat_tpu.analysis import conformance as conf
+from heat_tpu.analysis import model_check as mc
+from heat_tpu.analysis import protocols as proto
+from heat_tpu.analysis.diagnostics import ProgramLintError, clear_diagnostics, recent_diagnostics
+from heat_tpu.telemetry import alerts as talerts
+from heat_tpu.telemetry import journal as tjournal
+from heat_tpu.telemetry import replay as treplay
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    tjournal.set_journal_dir(None)
+    tjournal.reset_journal()
+    talerts.clear_alerts()
+    clear_diagnostics()
+    conf.set_protocol_mode("0")
+    yield
+    tjournal.set_journal_dir(None)
+    tjournal.reset_journal()
+    talerts.clear_alerts()
+    clear_diagnostics()
+    conf.set_protocol_mode("0")
+
+
+# ----------------------------------------------------------------------
+# registry hygiene
+# ----------------------------------------------------------------------
+class TestRegistryHygiene:
+    def _literal(self, name):
+        src = open(os.path.join(REPO_ROOT, "heat_tpu/analysis/protocols.py")).read()
+        for node in ast.parse(src).body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+            ):
+                return ast.literal_eval(node.value)
+        raise AssertionError(f"{name} not found at module level")
+
+    def test_registries_are_pure_literals(self):
+        # ast.literal_eval must reproduce the live objects exactly: no
+        # computed values, no interpolation, no imports involved
+        assert self._literal("PROTOCOLS") == proto.PROTOCOLS
+        assert self._literal("ENVIRONMENT") == proto.ENVIRONMENT
+        assert list(self._literal("PROPERTIES")) == list(proto.PROPERTIES)
+
+    def test_registry_structurally_sound(self):
+        assert proto.registry_problems() == []
+
+    def test_registry_problems_catches_defects(self):
+        import copy
+
+        bad = copy.deepcopy(proto.PROTOCOLS)
+        bad["preempt"]["states"] = ("idle", "raised", "orphan")
+        assert any("orphan" in p for p in proto.registry_problems(bad))
+        bad = copy.deepcopy(proto.PROTOCOLS)
+        bad["preempt"]["initial"] = "nowhere"
+        assert any("initial" in p for p in proto.registry_problems(bad))
+        bad = copy.deepcopy(proto.PROTOCOLS)
+        bad["preempt"]["actor"] = "alerts"
+        bad["preempt"]["transitions"] = (
+            dict(bad["preempt"]["transitions"][0], action="fire"),
+        ) + tuple(bad["preempt"]["transitions"][1:])
+        assert any("already declared" in p for p in proto.registry_problems(bad))
+
+    def test_constants_match_declared_pairs(self):
+        # the centralized vocabulary derives from PROTOCOLS: every
+        # declared (actor, action) pair is reachable through the module
+        # constants, and no constant names an undeclared actor
+        consts = {
+            name: getattr(proto, name)
+            for name in dir(proto)
+            if name.isupper() and isinstance(getattr(proto, name), str)
+            and name not in ("ENVIRONMENT",)
+        }
+        actor_values = {v for k, v in consts.items() if k.startswith("ACTOR_")}
+        action_values = {v for k, v in consts.items() if not k.startswith("ACTOR_")}
+        declared = proto.declared_pairs()
+        assert {a for a, _ in declared} == actor_values
+        assert {a for _, a in declared} <= action_values
+
+    def test_every_pair_owned_by_one_protocol(self):
+        for actor, action in sorted(proto.declared_pairs()):
+            owners = proto.protocol_for_pair(actor, action)
+            assert len(owners) == 1, (actor, action, owners)
+
+    def test_declared_modules_and_transition_fns_exist(self):
+        for name, rec in sorted(proto.PROTOCOLS.items()):
+            path = os.path.join(REPO_ROOT, rec["module"])
+            assert os.path.isfile(path), (name, rec["module"])
+            src = open(path).read()
+            tree = ast.parse(src)
+            defined = {
+                n.name for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for fn in rec["transition_fns"]:
+                assert fn in defined, (name, rec["module"], fn)
+
+    def test_transition_index_shape(self):
+        idx = proto.transition_index()
+        assert set(idx) == proto.declared_pairs()
+        p, scope, edges = idx[("preempt", "raise")]
+        assert p == "preempt" and scope == "gate"
+        assert ("idle", "raised") in edges
+
+
+# ----------------------------------------------------------------------
+# AST rules H801-H804 (seeded-defect fixtures through lint_file)
+# ----------------------------------------------------------------------
+class TestAstRules:
+    def test_repo_is_clean(self):
+        # in-process (scripts/lint_gate.py's run_gate) — a subprocess
+        # would re-pay interpreter + package import on every tier-1 run
+        sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+        try:
+            from lint_gate import run_gate
+        finally:
+            sys.path.pop(0)
+        res = run_gate(quiet=True)
+        assert res["new_count"] == 0, res["new"]
+
+    def test_h801_state_write_outside_transition_fn(self):
+        bad = (
+            "class Replica:\n"
+            "    def rogue(self):\n"
+            "        self.cb_open = True\n"
+        )
+        v = ast_lint.lint_file("heat_tpu/fleet/router.py", source=bad)
+        assert any(x.rule == "H801" for x in v)
+
+    def test_h801_sanctioned_fn_is_clean(self):
+        ok = (
+            "class Replica:\n"
+            "    def _cb_mark_probe(self):\n"
+            "        self.cb_open = True\n"
+        )
+        v = ast_lint.lint_file("heat_tpu/fleet/router.py", source=ok)
+        assert not any(x.rule == "H801" for x in v)
+
+    def test_h801_subscript_state_key(self):
+        bad = (
+            "def rogue(st):\n"
+            "    st['verdict'] = 'promoted'\n"
+        )
+        v = ast_lint.lint_file("heat_tpu/serving/canary.py", source=bad)
+        assert any(x.rule == "H801" for x in v)
+
+    def test_h802_transition_fn_missing_emit(self):
+        bad = (
+            "class R:\n"
+            "    def _pick(self):\n"
+            "        pass\n"
+            "    def _report(self):\n"
+            "        pass\n"
+        )
+        v = ast_lint.lint_file("heat_tpu/fleet/router.py", source=bad)
+        assert any(x.rule == "H802" for x in v)
+
+    def test_h803_undeclared_pair_literal(self):
+        bad = (
+            "from ..telemetry import journal as _journal\n"
+            "def f():\n"
+            "    _journal.emit('router', 'cb_explode')\n"
+        )
+        v = ast_lint.lint_file("heat_tpu/fleet/router.py", source=bad)
+        assert any(x.rule == "H803" for x in v)
+
+    def test_h803_declared_pair_is_clean(self):
+        ok = (
+            "from ..analysis.protocols import ACTOR_ROUTER, CB_TRIP\n"
+            "from ..telemetry import journal as _journal\n"
+            "def f():\n"
+            "    _journal.emit(ACTOR_ROUTER, CB_TRIP)\n"
+            "    _journal.emit('preempt', 'raise')\n"
+        )
+        v = ast_lint.lint_file("heat_tpu/fleet/router.py", source=ok)
+        assert not any(x.rule == "H803" for x in v)
+
+    def test_h804_unreachable_state(self):
+        src = open(os.path.join(REPO_ROOT, "heat_tpu/analysis/protocols.py")).read()
+        bad = src.replace(
+            '"states": ("idle", "raised")',
+            '"states": ("idle", "raised", "orphan")',
+        )
+        assert bad != src
+        v = ast_lint.lint_file("heat_tpu/analysis/protocols.py", source=bad)
+        assert any(x.rule == "H804" for x in v)
+
+    def test_h804_impure_registry(self):
+        src = open(os.path.join(REPO_ROOT, "heat_tpu/analysis/protocols.py")).read()
+        bad = src.replace("PROTOCOLS = {", "PROTOCOLS = dict_maker() or {", 1)
+        assert bad != src
+        v = ast_lint.lint_file("heat_tpu/analysis/protocols.py", source=bad)
+        assert any(x.rule == "H804" and "literal" in x.message for x in v)
+
+
+# ----------------------------------------------------------------------
+# bounded model checker
+# ----------------------------------------------------------------------
+class TestModelChecker:
+    def test_shipped_registry_is_clean(self):
+        assert mc.check_all() == []
+
+    @pytest.mark.parametrize("defect,prop", [
+        ("refresh_livelock", "refresh_no_livelock"),
+        ("breaker_double_probe", "breaker_single_probe"),
+        ("autoscaler_flap", "autoscaler_no_flap"),
+    ])
+    def test_seeded_defects_are_found(self, defect, prop):
+        protocols, environment, properties = mc.seeded_defect(defect)
+        hits = mc.check_all(protocols, environment, properties)
+        assert prop in {h["property"] for h in hits}
+        hit = next(h for h in hits if h["property"] == prop)
+        chain = hit["counterexample"]
+        # the counterexample is a synthetic causal journal chain: same
+        # doc shape as telemetry/journal.py, each step cause-linked
+        assert chain[0]["cause"] is None
+        for prev, ev in zip(chain, chain[1:]):
+            assert ev["cause"] == prev["event_id"]
+        assert chain[-1]["actor"] == "model_check"
+        assert chain[-1]["action"] == "violation"
+
+    def test_livelock_cycle_contains_trigger_and_veto(self):
+        protocols, environment, properties = mc.seeded_defect("refresh_livelock")
+        hits = mc.check_all(protocols, environment, properties)
+        hit = next(h for h in hits if h["property"] == "refresh_no_livelock")
+        cycle_actions = {
+            ev["action"] for ev in hit["counterexample"]
+            if ev["evidence"].get("part") == "cycle"
+        }
+        assert {"trigger", "veto"} <= cycle_actions
+        # the decisive canary verdicts never appear in the loop
+        assert not ({"promoted", "rolled_back", "observed"} & cycle_actions)
+
+    def test_state_bound_enforced(self):
+        with pytest.raises(mc.ModelCheckError):
+            mc.check_all(max_states=2)
+
+    def test_cli_exit_codes(self, capsys):
+        # main(argv) in-process: same entry point the console uses,
+        # without a fresh interpreter per invocation
+        assert mc.main([]) == 0
+        capsys.readouterr()
+        assert mc.main(["--seed-defect", "refresh_livelock", "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["violations"]
+
+
+# ----------------------------------------------------------------------
+# runtime conformance (H805)
+# ----------------------------------------------------------------------
+class TestRuntimeConformance:
+    def test_off_by_default_records_nothing(self):
+        assert conf.protocol_mode() == "off"
+        tjournal.emit("preempt", "clear", evidence={"gate": "gX"})
+        assert conf.conformance_report()["violations"] == 0
+
+    def test_legal_flow_clean(self):
+        conf.set_protocol_mode("warn")
+        tjournal.emit("preempt", "raise", evidence={"gate": "g0"})
+        tjournal.emit("preempt", "clear", evidence={"gate": "g0"})
+        rep = conf.conformance_report()
+        assert rep["violations"] == 0 and rep["tracked_instances"] >= 1
+
+    def test_illegal_transition_reports_h805(self):
+        conf.set_protocol_mode("warn")
+        with pytest.warns(Warning):
+            tjournal.emit("preempt", "clear", evidence={"gate": "g1"})
+        rep = conf.conformance_report()
+        assert rep["violations"] == 1
+        v = rep["recent"][0]
+        assert v["protocol"] == "preempt" and v["from"] == "idle"
+        # surfaced as the H805 diagnostic + a protocol:<actor> alert
+        assert any(d.rule == "H805" for d in recent_diagnostics())
+        assert any(
+            a["name"] == "protocol:preempt" for a in talerts.active_alerts()
+        )
+
+    def test_scope_isolates_instances(self):
+        conf.set_protocol_mode("warn")
+        tjournal.emit("preempt", "raise", evidence={"gate": "gA"})
+        # gB never raised: its machine is still idle, so a clear there
+        # is a violation even though gA's raise is outstanding
+        with pytest.warns(Warning):
+            tjournal.emit("preempt", "clear", evidence={"gate": "gB"})
+        assert conf.conformance_report()["violations"] == 1
+
+    def test_unknown_actor_ignored(self):
+        conf.set_protocol_mode("warn")
+        tjournal.emit("some_future_subsystem", "anything")
+        assert conf.conformance_report()["violations"] == 0
+
+    def test_undeclared_action_from_known_actor(self):
+        conf.set_protocol_mode("warn")
+        with pytest.warns(Warning):
+            tjournal.emit("router", "cb_explode", evidence={"replica": "r"})
+        rep = conf.conformance_report()
+        assert rep["violations"] == 1
+        assert "undeclared" in rep["recent"][0]["message"]
+
+    def test_raise_mode(self):
+        conf.set_protocol_mode("raise")
+        with pytest.raises(ProgramLintError):
+            tjournal.emit("preempt", "clear", evidence={"gate": "g9"})
+
+    def test_resync_prevents_cascade(self):
+        conf.set_protocol_mode("warn")
+        with pytest.warns(Warning):
+            tjournal.emit("preempt", "clear", evidence={"gate": "gR"})
+        # after the resync the follow-up legal flow is clean again
+        tjournal.emit("preempt", "raise", evidence={"gate": "gR"})
+        tjournal.emit("preempt", "clear", evidence={"gate": "gR"})
+        assert conf.conformance_report()["violations"] == 1
+
+    def test_reset_journal_resets_conformance(self):
+        conf.set_protocol_mode("warn")
+        with pytest.warns(Warning):
+            tjournal.emit("preempt", "clear", evidence={"gate": "gZ"})
+        tjournal.reset_journal()
+        assert conf.conformance_report()["violations"] == 0
+
+
+# ----------------------------------------------------------------------
+# real controllers conform end to end with checking armed
+# ----------------------------------------------------------------------
+class TestControllersConform:
+    def test_preemption_gate_conforms(self):
+        from heat_tpu.core.preempt import PreemptionGate
+
+        conf.set_protocol_mode("warn")
+        gate = PreemptionGate()
+        gate.request("latency spike")
+        gate.request("still spiking")  # level-triggered: no second raise
+        gate.clear()
+        gate.clear()  # idempotent: no second clear event
+        assert conf.conformance_report()["violations"] == 0
+
+    def test_alert_lifecycle_conforms(self):
+        conf.set_protocol_mode("warn")
+        talerts.fire("proto_test_alert", severity="warn", message="x")
+        talerts.fire("proto_test_alert", severity="warn", message="x")
+        talerts.resolve("proto_test_alert")
+        assert conf.conformance_report()["violations"] == 0
+
+    def test_service_lifecycle_conforms(self):
+        from heat_tpu import serving
+
+        conf.set_protocol_mode("warn")
+        svc = serving.InferenceService()
+        try:
+            svc.set_state("warming")
+            svc.set_state("ready")
+            svc.set_state("draining")
+        finally:
+            svc.close()
+        assert svc.state == "stopped"
+        assert conf.conformance_report()["violations"] == 0
+
+    def test_router_breaker_conforms(self):
+        from heat_tpu.fleet.router import FleetRouter, _Replica
+
+        conf.set_protocol_mode("warn")
+        router = FleetRouter(cb_failures=2, cb_cooldown_s=0.0,
+                             health_period_s=900.0)
+        try:
+            router.add_replica("http://127.0.0.1:1")
+            with router._lock:
+                r = next(iter(router._replicas.values()))
+                r.ready = True
+            # closed -> open (two consecutive failures)
+            router._report(r, ok=False)
+            router._report(r, ok=False)
+            assert r.cb_open and not r.probing
+            # open -> half_open (cooldown over: _pick admits the probe)
+            picked = router._pick("")
+            assert picked is r and r.probing
+            # half_open -> open (failed probe: the cb_reopen defect fix)
+            router._report(r, ok=False)
+            assert r.cb_open and not r.probing
+            # around again, probe succeeds: half_open -> closed
+            picked = router._pick("")
+            assert picked is r
+            router._report(r, ok=True)
+            assert not r.cb_open
+        finally:
+            router.close()
+        actions = [
+            e["action"] for e in tjournal.journal_events()
+            if e["actor"] == "router"
+        ]
+        assert actions == ["cb_trip", "cb_half_open", "cb_reopen",
+                           "cb_half_open", "cb_readmit"]
+        assert conf.conformance_report()["violations"] == 0
+
+    def test_stale_success_while_open_does_not_readmit(self):
+        # the real defect this PR fixed: a success landing while the
+        # breaker is open with NO probe out must not skip the half-open
+        # protocol (previously it readmitted immediately)
+        from heat_tpu.fleet.router import FleetRouter
+
+        conf.set_protocol_mode("warn")
+        router = FleetRouter(cb_failures=2, cb_cooldown_s=60.0,
+                             health_period_s=900.0)
+        try:
+            router.add_replica("http://127.0.0.1:1")
+            with router._lock:
+                r = next(iter(router._replicas.values()))
+                r.ready = True
+            router._report(r, ok=False)
+            router._report(r, ok=False)
+            assert r.cb_open
+            router._report(r, ok=True)  # stale pre-trip response
+            assert r.cb_open, "stale success must not readmit an open breaker"
+        finally:
+            router.close()
+        assert conf.conformance_report()["violations"] == 0
+
+
+# ----------------------------------------------------------------------
+# replay --check + /decisionz explain
+# ----------------------------------------------------------------------
+class TestOfflineChecking:
+    def test_replay_check_clean_and_violating(self, tmp_path):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        tjournal.emit("preempt", "raise", evidence={"gate": "g0"})
+        tjournal.emit("preempt", "clear", evidence={"gate": "g0"})
+        doc = treplay.replay_report(d, check=True)
+        assert doc["check"]["violation_count"] == 0
+        assert doc["check"]["stepped"] >= 2
+
+        tjournal.emit("preempt", "clear", evidence={"gate": "gBad"})
+        doc = treplay.replay_report(d, check=True)
+        assert doc["check"]["violation_count"] == 1
+        assert "illegal" in doc["check"]["violations"][0]["message"]
+
+    def test_replay_check_cli_exit_code(self, tmp_path, capsys):
+        d = str(tmp_path / "journal")
+        tjournal.set_journal_dir(d)
+        tjournal.emit("preempt", "clear", evidence={"gate": "gBad"})
+        rc = treplay.main([d, "--check"])
+        out = capsys.readouterr().out
+        assert rc == 2, out
+        assert "H805" in out
+
+    def test_annotate_resets_on_epoch_change(self):
+        # a restarted process's controllers legitimately start over: the
+        # same scope key in a new epoch begins from the initial state
+        events = [
+            {"event_id": "aaa-111-000001", "actor": "preempt",
+             "action": "raise", "evidence": {"gate": "g"}},
+            {"event_id": "bbb-222-000001", "actor": "preempt",
+             "action": "raise", "evidence": {"gate": "g"}},
+        ]
+        ann = conf.annotate(events)
+        assert ann["aaa-111-000001"]["ok"]
+        assert ann["bbb-222-000001"]["ok"]
+
+    def test_decisionz_explain_annotates_transitions(self):
+        ev = tjournal.emit("preempt", "raise", evidence={"gate": "g0"})
+        tjournal.emit("preempt", "clear", cause=ev["event_id"],
+                      evidence={"gate": "g0"})
+        html = tjournal.render_decisionz_html(event_id=ev["event_id"])
+        assert "<th>protocol</th>" in html
+        assert "idle" in html and "raised" in html
+
+    def test_decisionz_explain_flags_violations(self):
+        ev = tjournal.emit("preempt", "clear", evidence={"gate": "gBad"})
+        html = tjournal.render_decisionz_html(event_id=ev["event_id"])
+        assert "H805" in html and "illegal" in html
+
+    def test_timeline_view_has_no_protocol_column(self):
+        tjournal.emit("preempt", "raise", evidence={"gate": "g0"})
+        html = tjournal.render_decisionz_html()
+        assert "<th>protocol</th>" not in html
+
+
+# ----------------------------------------------------------------------
+# docs stay generated
+# ----------------------------------------------------------------------
+class TestDocs:
+    def test_observability_diagrams_match_generator(self):
+        text = open(os.path.join(REPO_ROOT, "docs", "observability.md")).read()
+        begin = "<!-- protocol-diagrams:begin -->"
+        end = "<!-- protocol-diagrams:end -->"
+        assert begin in text and end in text
+        embedded = text.split(begin, 1)[1].split(end, 1)[0].strip()
+        assert embedded == proto.render_diagrams_markdown().strip()
+
+    def test_static_analysis_documents_rules(self):
+        text = open(os.path.join(REPO_ROOT, "docs", "static_analysis.md")).read()
+        for rule in ("H801", "H802", "H803", "H804", "H805"):
+            assert rule in text

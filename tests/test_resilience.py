@@ -175,8 +175,11 @@ class TestRetryPolicy:
         import time as _time
 
         pol = rz.RetryPolicy(max_attempts=2, no_sleep=True, attempt_timeout=0.1)
+        # the sleep only needs to outlive the 0.1s attempt budget with
+        # margin; the executor's shutdown joins the sleeping worker, so
+        # every extra second here is paid twice (once per attempt)
         with pytest.raises(rz.RetryTimeout):
-            pol.call(lambda: _time.sleep(5))
+            pol.call(lambda: _time.sleep(0.75))
 
     def test_decorator_and_stats(self):
         rz.reset_retry_stats()
